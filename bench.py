@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 benchmark — the TPU-native counterpart of the
+reference's ``examples/tensorflow2_synthetic_benchmark.py`` (img/sec on
+synthetic data, averaged over timed iterations; ``:119-132``).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}
+
+Baseline anchor: the reference's published tf_cnn_benchmarks ResNet-101
+number — 1656.82 total img/s on 16 GPUs = 103.55 img/s/GPU
+(``docs/benchmarks.rst:29-43``; see BASELINE.md).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32, help="per-chip batch")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-warmup-batches", type=int, default=3)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument(
+        "--smoke", action="store_true", help="tiny shapes for CPU sanity runs"
+    )
+    args = parser.parse_args()
+
+    if args.smoke:
+        args.batch_size, args.image_size = 4, 64
+        args.num_batches_per_iter, args.num_iters = 2, 2
+        args.num_classes = 100
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.jax import _shard_map
+    from horovod_tpu.models.resnet import ResNet50
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = build_mesh()
+    global_batch = args.batch_size * n_chips
+
+    model = ResNet50(num_classes=args.num_classes)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.RandomState(0)
+        .randn(global_batch, args.image_size, args.image_size, 3)
+        .astype(np.float32)
+    )
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, args.num_classes, (global_batch,)),
+        dtype=jnp.int32,
+    )
+
+    variables = model.init(rng, images[:2], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, bs, x, y):
+        logits, new_state = model.apply(
+            {"params": p, "batch_stats": bs}, x, train=True, mutable=["batch_stats"]
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, new_state["batch_stats"]
+
+    def step(p, bs, s, x, y):
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, bs, x, y
+        )
+        # The whole reference DistributedOptimizer pipeline: fusion-bucketed
+        # allreduce of gradients over the data axis.
+        grads = hvdj.allreduce_gradients(grads)
+        new_bs = jax.tree.map(lambda v: jax.lax.pmean(v, "data"), new_bs)
+        updates, s = tx.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_bs, s, jax.lax.pmean(loss, "data")
+
+    fn = jax.jit(
+        _shard_map(
+            step,
+            mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data")),
+            out_specs=P(),
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+
+    # Warmup (includes compile).
+    for _ in range(args.num_warmup_batches):
+        params, batch_stats, opt_state, loss = fn(
+            params, batch_stats, opt_state, images, labels
+        )
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, batch_stats, opt_state, loss = fn(
+                params, batch_stats, opt_state, images, labels
+            )
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(global_batch * args.num_batches_per_iter / dt)
+
+    total = float(np.mean(img_secs))
+    per_chip = total / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_synthetic_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+                "detail": {
+                    "total_img_per_sec": round(total, 2),
+                    "n_chips": n_chips,
+                    "batch_per_chip": args.batch_size,
+                    "image_size": args.image_size,
+                    "loss": float(loss),
+                    "platform": devices[0].platform,
+                },
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

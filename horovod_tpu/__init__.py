@@ -1,0 +1,340 @@
+"""horovod_tpu — a TPU-native distributed training framework with
+Horovod-capability parity.
+
+Public API parity with ``horovod/common/basics.py`` + framework modules:
+``init/shutdown/size/rank/local_rank/local_size``, eager
+``allreduce/allgather/broadcast`` (sync and ``_async`` handle-based variants),
+``join``, ``Compression``, ``Average/Sum/Adasum`` reduce ops — plus the
+TPU-native compiled mode under :mod:`horovod_tpu.jax` (fusion-bucketed psum
+inside pjit/shard_map) which is the performance path.
+
+The data plane is XLA: collectives lower to ``jax.lax.psum`` /
+``all_gather`` / ``ppermute`` over ICI (intra-slice) and DCN (inter-slice)
+instead of NCCL/MPI/Gloo (see SURVEY.md §5 "Distributed communication
+backend").
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Optional
+
+from .common import topology as _topology_mod
+from .common.compression import Compression
+from .common.env import Config
+from .common.types import (
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Status,
+    Sum,
+)
+from .core.runtime import Runtime
+
+__version__ = "0.1.0"
+
+_lock = threading.Lock()
+_runtime: Optional[Runtime] = None
+_mesh = None
+
+
+class HorovodInternalError(RuntimeError):
+    pass
+
+
+def init(config: Optional[Config] = None) -> None:
+    """Initialize the runtime (reference ``hvd.init()``,
+    ``horovod/common/basics.py:33-65``): detect topology, start the
+    background loop, and stand up the data plane."""
+    global _runtime
+    with _lock:
+        if _runtime is not None and _runtime.running:
+            return
+        cfg = config or Config.from_env()
+        topo = _topology_mod.detect()
+        _runtime = Runtime(cfg, topo)
+        _runtime.start()
+
+
+def shutdown() -> None:
+    global _runtime, _mesh
+    with _lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+        _mesh = None
+
+
+def is_initialized() -> bool:
+    return _runtime is not None and _runtime.running
+
+
+def _rt() -> Runtime:
+    if _runtime is None or not _runtime.running:
+        raise HorovodInternalError(
+            "Horovod has not been initialized; use hvd.init()."
+        )
+    return _runtime
+
+
+atexit.register(shutdown)
+
+
+# --- topology accessors (basics.py parity) ---
+def size() -> int:
+    return _rt().topology.size
+
+
+def rank() -> int:
+    return _rt().topology.rank
+
+
+def local_rank() -> int:
+    return _rt().topology.local_rank
+
+
+def local_size() -> int:
+    return _rt().topology.local_size
+
+
+def cross_rank() -> int:
+    return _rt().topology.cross_rank
+
+
+def cross_size() -> int:
+    return _rt().topology.cross_size
+
+
+def is_homogeneous() -> bool:
+    return _rt().topology.is_homogeneous
+
+
+# Build-capability probes (reference horovod_*_built/enabled,
+# operations.cc:683-769). MPI/Gloo/NCCL/DDL/MLSL do not exist in the TPU
+# build; XLA is the sole data plane.
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mlsl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    return True
+
+
+def xla_enabled() -> bool:
+    return True
+
+
+def mesh():
+    """The global device mesh (lazily built; single ``data`` axis over all
+    devices by default, or per ``HOROVOD_TPU_MESH_AXES``)."""
+    global _mesh
+    with _lock:
+        if _mesh is None:
+            from .parallel import mesh as mesh_mod
+
+            cfg = _rt().config
+            _mesh = mesh_mod.build_mesh(mesh_mod.parse_axes(cfg.mesh_axes) or None)
+        return _mesh
+
+
+# --- naming helper ---
+_name_counters: dict = {}
+
+
+def _auto_name(prefix: str, name: Optional[str]) -> str:
+    if name is not None:
+        return name
+    with _lock:
+        n = _name_counters.get(prefix, 0)
+        _name_counters[prefix] = n + 1
+    return f"{prefix}.noname.{n}"
+
+
+def _resolve_op(average: Optional[bool], op: Optional[ReduceOp]) -> ReduceOp:
+    # Reference horovod/torch/mpi_ops.py:101-124: `average` and `op` are
+    # mutually exclusive; default Average.
+    if average is not None and op is not None:
+        raise ValueError('The op parameter supersedes average; provide only one.')
+    if op is not None:
+        return op
+    if average is False:
+        return ReduceOp.SUM
+    return ReduceOp.AVERAGE
+
+
+# --- eager collective API ---
+def allreduce_async(
+    tensor: Any,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> int:
+    rop = _resolve_op(average, op)
+    rt = _rt()
+    tensor_name = _auto_name("allreduce", name)
+    if rop == ReduceOp.ADASUM:
+        return rt.enqueue_adasum(
+            tensor_name,
+            tensor,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+    return rt.enqueue_allreduce(
+        tensor_name,
+        tensor,
+        reduce_op=rop,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
+
+
+def allreduce(
+    tensor: Any,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    compression=Compression.none,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> Any:
+    tensor_compressed, ctx = compression.compress(tensor)
+    handle = allreduce_async(
+        tensor_compressed,
+        average=average,
+        name=name,
+        op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
+    out = synchronize(handle)
+    return compression.decompress(out, ctx)
+
+
+def allgather_async(tensor: Any, name: Optional[str] = None) -> int:
+    return _rt().enqueue_allgather(_auto_name("allgather", name), tensor)
+
+
+def allgather(tensor: Any, name: Optional[str] = None) -> Any:
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(
+    tensor: Any, root_rank: int, name: Optional[str] = None
+) -> int:
+    return _rt().enqueue_broadcast(_auto_name("broadcast", name), tensor, root_rank)
+
+
+def broadcast(tensor: Any, root_rank: int, name: Optional[str] = None) -> Any:
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def alltoall_async(tensor: Any, name: Optional[str] = None) -> int:
+    return _rt().enqueue_alltoall(_auto_name("alltoall", name), tensor)
+
+
+def alltoall(tensor: Any, name: Optional[str] = None) -> Any:
+    return synchronize(alltoall_async(tensor, name))
+
+
+def join() -> None:
+    """Signal this rank is out of data; blocks until all ranks join
+    (reference ``hvd.join``, ``operations.cc:910-934``)."""
+    synchronize(_rt().enqueue_join())
+
+
+def poll(handle: int) -> bool:
+    return _rt().poll(handle)
+
+
+def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
+    return _rt().synchronize(handle, timeout)
+
+
+def broadcast_variables(variables: Any, root_rank: int = 0) -> Any:
+    """Broadcast a pytree of arrays from root (reference
+    ``broadcast_variables`` / ``broadcast_parameters``)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(variables)
+    out = [broadcast(leaf, root_rank, name=f"bcast.var.{i}") for i, leaf in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, out)
+
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "size",
+    "rank",
+    "local_rank",
+    "local_size",
+    "cross_rank",
+    "cross_size",
+    "is_homogeneous",
+    "mesh",
+    "allreduce",
+    "allreduce_async",
+    "allgather",
+    "allgather_async",
+    "broadcast",
+    "broadcast_async",
+    "alltoall",
+    "alltoall_async",
+    "join",
+    "poll",
+    "synchronize",
+    "broadcast_variables",
+    "Compression",
+    "ReduceOp",
+    "Average",
+    "Sum",
+    "Adasum",
+    "Min",
+    "Max",
+    "Product",
+    "Status",
+    "mpi_threads_supported",
+    "mpi_built",
+    "mpi_enabled",
+    "gloo_built",
+    "gloo_enabled",
+    "nccl_built",
+    "ddl_built",
+    "mlsl_built",
+    "xla_built",
+    "xla_enabled",
+]

@@ -1,0 +1,156 @@
+"""HOROVOD_* environment-knob parsing.
+
+Parity with the reference's env surface (``horovod/common/common.h:62-87``
+knob names, ``horovod/common/utils/env_parser.cc:49-163``). The same names
+are honored so scripts/configs written for the reference keep working; a few
+TPU-specific knobs are added under the same prefix.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+# --- knob names (reference common.h:62-87) ---
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES = "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE = "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+HOROVOD_LOG_HIDE_TIMESTAMP = "HOROVOD_LOG_HIDE_TIMESTAMP"
+HOROVOD_ADASUM_MPI_CHUNK_SIZE = "HOROVOD_ADASUM_MPI_CHUNK_SIZE"
+HOROVOD_NUM_STREAMS = "HOROVOD_NUM_NCCL_STREAMS"  # kept for config parity
+# Rank/topology env (reference gloo_context.cc:38-49 + gloo_run.py env).
+HOROVOD_RANK = "HOROVOD_RANK"
+HOROVOD_SIZE = "HOROVOD_SIZE"
+HOROVOD_LOCAL_RANK = "HOROVOD_LOCAL_RANK"
+HOROVOD_LOCAL_SIZE = "HOROVOD_LOCAL_SIZE"
+HOROVOD_CROSS_RANK = "HOROVOD_CROSS_RANK"
+HOROVOD_CROSS_SIZE = "HOROVOD_CROSS_SIZE"
+HOROVOD_RENDEZVOUS_ADDR = "HOROVOD_GLOO_RENDEZVOUS_ADDR"
+HOROVOD_RENDEZVOUS_PORT = "HOROVOD_GLOO_RENDEZVOUS_PORT"
+HOROVOD_CONTROLLER = "HOROVOD_CONTROLLER"
+HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
+# TPU-native additions.
+HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
+HOROVOD_TPU_EAGER_BACKEND = "HOROVOD_TPU_EAGER_BACKEND"
+
+# Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
+FUSION_BUFFER_ATOMIC_UNIT = 64
+
+
+def _get_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+@dataclass
+class Config:
+    """Runtime knobs resolved at init.
+
+    Defaults follow the reference: 64 MB fusion threshold and 5 ms cycle time
+    (``operations.cc:411-417``), cache capacity 1024 (``global_state.h:88``),
+    60 s stall warning (``stall_inspector.h:72-80``).
+    """
+
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 5.0
+    cache_capacity: int = 1024
+    cache_enabled: bool = True
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    autotune: bool = False
+    autotune_log_file: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+    stall_check_disable: bool = False
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+    adasum_chunk_size: int = 1 << 26
+    log_level: str = "warning"
+    eager_backend: str = "auto"  # auto | xla | local
+    mesh_axes: str = ""  # e.g. "data:8" or "data:4,model:2"
+    extra: dict = field(default_factory=dict)
+
+    @staticmethod
+    def from_env() -> "Config":
+        cfg = Config()
+        cfg.fusion_threshold_bytes = _get_int(
+            HOROVOD_FUSION_THRESHOLD, cfg.fusion_threshold_bytes
+        )
+        # Reference accepts cycle time in ms as float via HOROVOD_CYCLE_TIME.
+        cfg.cycle_time_ms = _get_float(HOROVOD_CYCLE_TIME, cfg.cycle_time_ms)
+        cfg.cache_capacity = _get_int(HOROVOD_CACHE_CAPACITY, cfg.cache_capacity)
+        cfg.cache_enabled = cfg.cache_capacity > 0
+        cfg.hierarchical_allreduce = _get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE)
+        cfg.hierarchical_allgather = _get_bool(HOROVOD_HIERARCHICAL_ALLGATHER)
+        cfg.autotune = _get_bool(HOROVOD_AUTOTUNE)
+        cfg.autotune_log_file = os.environ.get(HOROVOD_AUTOTUNE_LOG, "")
+        cfg.autotune_warmup_samples = _get_int(
+            HOROVOD_AUTOTUNE_WARMUP_SAMPLES, cfg.autotune_warmup_samples
+        )
+        cfg.autotune_steps_per_sample = _get_int(
+            HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, cfg.autotune_steps_per_sample
+        )
+        cfg.autotune_bayes_opt_max_samples = _get_int(
+            HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, cfg.autotune_bayes_opt_max_samples
+        )
+        cfg.autotune_gaussian_process_noise = _get_float(
+            HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE,
+            cfg.autotune_gaussian_process_noise,
+        )
+        cfg.timeline_filename = os.environ.get(HOROVOD_TIMELINE, "")
+        cfg.timeline_mark_cycles = _get_bool(HOROVOD_TIMELINE_MARK_CYCLES)
+        cfg.stall_check_disable = _get_bool(HOROVOD_STALL_CHECK_DISABLE)
+        cfg.stall_warning_time_seconds = _get_float(
+            HOROVOD_STALL_CHECK_TIME_SECONDS, cfg.stall_warning_time_seconds
+        )
+        cfg.stall_shutdown_time_seconds = _get_float(
+            HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, cfg.stall_shutdown_time_seconds
+        )
+        cfg.adasum_chunk_size = _get_int(
+            HOROVOD_ADASUM_MPI_CHUNK_SIZE, cfg.adasum_chunk_size
+        )
+        cfg.log_level = os.environ.get(HOROVOD_LOG_LEVEL, cfg.log_level)
+        cfg.eager_backend = os.environ.get(HOROVOD_TPU_EAGER_BACKEND, cfg.eager_backend)
+        cfg.mesh_axes = os.environ.get(HOROVOD_TPU_MESH_AXES, cfg.mesh_axes)
+        return cfg

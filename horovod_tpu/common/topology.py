@@ -1,0 +1,121 @@
+"""Process/device topology discovery.
+
+TPU-native replacement of the reference's rank discovery
+(``horovod/common/mpi/mpi_controller.cc:25-81``: rank/size from MPI_Comm_rank,
+local from MPI_Comm_split_type(SHARED), cross split by local_rank). Here the
+same global/LOCAL/CROSS triple is derived, in priority order, from:
+
+1. ``HOROVOD_RANK``/``HOROVOD_SIZE``/... env vars set by the launcher
+   (parity with ``horovod/common/gloo/gloo_context.cc:113-157``),
+2. an already-initialized ``jax.distributed`` runtime (TPU pod slices: one
+   process per host; local = chips on this host; cross = same chip index on
+   other hosts — exactly the ICI/DCN split the hierarchical ops need),
+3. single-process fallback: rank 0 of 1.
+
+The LOCAL axis maps onto ICI (within a slice/host) and the CROSS axis onto
+DCN (across slices/hosts) — the analogue of the reference's NCCL-local /
+MPI-cross communicator pair (``horovod/common/common.h:110-114``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from . import env as env_mod
+
+
+@dataclass(frozen=True)
+class Topology:
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    # True when every host has the same number of local ranks
+    # (reference: is_homogeneous_, mpi_controller.cc:74-81).
+    is_homogeneous: bool = True
+    source: str = "single"
+
+    def __post_init__(self):
+        if not (0 <= self.rank < self.size):
+            raise ValueError(f"rank {self.rank} out of range for size {self.size}")
+        if not (0 <= self.local_rank < self.local_size):
+            raise ValueError(
+                f"local_rank {self.local_rank} out of range for local_size "
+                f"{self.local_size}"
+            )
+
+
+def _from_env() -> Optional[Topology]:
+    rank = os.environ.get(env_mod.HOROVOD_RANK)
+    size = os.environ.get(env_mod.HOROVOD_SIZE)
+    if rank is None or size is None:
+        return None
+    rank, size = int(rank), int(size)
+    local_rank = int(os.environ.get(env_mod.HOROVOD_LOCAL_RANK, 0))
+    local_size = int(os.environ.get(env_mod.HOROVOD_LOCAL_SIZE, 1))
+    cross_rank = int(os.environ.get(env_mod.HOROVOD_CROSS_RANK, rank // max(local_size, 1)))
+    cross_size = int(
+        os.environ.get(
+            env_mod.HOROVOD_CROSS_SIZE, (size + local_size - 1) // max(local_size, 1)
+        )
+    )
+    return Topology(
+        rank=rank,
+        size=size,
+        local_rank=local_rank,
+        local_size=local_size,
+        cross_rank=cross_rank,
+        cross_size=cross_size,
+        is_homogeneous=(size == local_size * cross_size),
+        source="env",
+    )
+
+
+def _from_jax_distributed() -> Optional[Topology]:
+    try:
+        import jax
+    except ImportError:  # pragma: no cover
+        return None
+    try:
+        nproc = jax.process_count()
+    except Exception:
+        return None
+    if nproc <= 1:
+        return None
+    rank = jax.process_index()
+    # One process per host; every process contributes the same number of
+    # local devices on TPU slices, which makes the topology homogeneous.
+    local_size = 1
+    return Topology(
+        rank=rank,
+        size=nproc,
+        local_rank=0,
+        local_size=local_size,
+        cross_rank=rank,
+        cross_size=nproc,
+        is_homogeneous=True,
+        source="jax.distributed",
+    )
+
+
+def detect() -> Topology:
+    topo = _from_env()
+    if topo is not None:
+        return topo
+    topo = _from_jax_distributed()
+    if topo is not None:
+        return topo
+    return Topology(
+        rank=0,
+        size=1,
+        local_rank=0,
+        local_size=1,
+        cross_rank=0,
+        cross_size=1,
+        is_homogeneous=True,
+        source="single",
+    )

@@ -1,0 +1,233 @@
+"""Core types shared across the framework.
+
+TPU-native re-design of the reference's ``horovod/common/common.h:104-250``
+(``Status``, ``StatusType``, dtype enumeration, ``TensorTableEntry``). Rather
+than abstract Tensor/OpContext adapters per framework, the TPU build keeps a
+single canonical array representation (``jax.Array`` / ``numpy.ndarray``) and
+lets framework bindings convert at the boundary.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+
+class StatusType(enum.IntEnum):
+    # Mirrors reference horovod/common/common.h:96-98 (OK/UNKNOWN_ERROR/
+    # PRECONDITION_ERROR/ABORTED/INVALID_ARGUMENT/IN_PROGRESS).
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclass(frozen=True)
+class Status:
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+    @staticmethod
+    def OK() -> "Status":  # noqa: N802 - parity with reference naming
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def UnknownError(msg: str) -> "Status":  # noqa: N802
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def PreconditionError(msg: str) -> "Status":  # noqa: N802
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def Aborted(msg: str) -> "Status":  # noqa: N802
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def InvalidArgument(msg: str) -> "Status":  # noqa: N802
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    @staticmethod
+    def InProgress() -> "Status":  # noqa: N802
+        return Status(StatusType.IN_PROGRESS)
+
+
+# Shutdown message text, parity with reference common.h:153-158.
+SHUT_DOWN_ERROR = Status.Aborted(
+    "Horovod has been shut down. This was caused by an exception on one of "
+    "the ranks or an attempt to allreduce, allgather or broadcast a tensor "
+    "after one of the ranks finished execution."
+)
+
+DUPLICATE_NAME_ERROR_FMT = (
+    "Requested to {op} a tensor with the same name as another tensor that is "
+    "currently being processed. If you want to request another tensor, use a "
+    "different tensor name."
+)
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype enum; values align with reference message.h:27-41."""
+
+    UINT8 = 0
+    INT8 = 1
+    UINT16 = 2
+    INT16 = 3
+    INT32 = 4
+    INT64 = 5
+    FLOAT16 = 6
+    FLOAT32 = 7
+    FLOAT64 = 8
+    BOOL = 9
+    # TPU-native additions (not in the reference wire format):
+    BFLOAT16 = 10
+    COMPLEX64 = 11
+
+
+_NP_NAME_TO_DTYPE = {
+    "uint8": DataType.UINT8,
+    "int8": DataType.INT8,
+    "uint16": DataType.UINT16,
+    "int16": DataType.INT16,
+    "int32": DataType.INT32,
+    "int64": DataType.INT64,
+    "float16": DataType.FLOAT16,
+    "float32": DataType.FLOAT32,
+    "float64": DataType.FLOAT64,
+    "bool": DataType.BOOL,
+    "bfloat16": DataType.BFLOAT16,
+    "complex64": DataType.COMPLEX64,
+}
+
+_DTYPE_TO_NP_NAME = {v: k for k, v in _NP_NAME_TO_DTYPE.items()}
+
+_DTYPE_SIZE = {
+    DataType.UINT8: 1,
+    DataType.INT8: 1,
+    DataType.UINT16: 2,
+    DataType.INT16: 2,
+    DataType.INT32: 4,
+    DataType.INT64: 8,
+    DataType.FLOAT16: 2,
+    DataType.FLOAT32: 4,
+    DataType.FLOAT64: 8,
+    DataType.BOOL: 1,
+    DataType.BFLOAT16: 2,
+    DataType.COMPLEX64: 8,
+}
+
+
+def dtype_from_array(array: Any) -> DataType:
+    name = str(array.dtype)
+    try:
+        return _NP_NAME_TO_DTYPE[name]
+    except KeyError:
+        raise ValueError(f"Unsupported dtype for collective: {name}") from None
+
+
+def dtype_size(dtype: DataType) -> int:
+    return _DTYPE_SIZE[dtype]
+
+
+def dtype_name(dtype: DataType) -> str:
+    return _DTYPE_TO_NP_NAME[dtype]
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops exposed at the public API.
+
+    Average/Sum/Adasum mirror the reference's enum
+    (``horovod/common/operations.cc:771-779`` horovod_reduce_op_* and
+    ``horovod/torch/mpi_ops.py`` Average/Sum/Adasum). Min/Max/Product are
+    TPU-native extensions (XLA gives them for free).
+    """
+
+    AVERAGE = 1
+    SUM = 2
+    ADASUM = 3
+    MIN = 4
+    MAX = 5
+    PRODUCT = 6
+
+
+# Public aliases, parity with hvd.Average / hvd.Sum / hvd.Adasum.
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+class RequestType(enum.IntEnum):
+    # Parity with reference message.h:48-50 plus TPU-native ALLTOALL /
+    # REDUCESCATTER extensions.
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ALLTOALL = 4
+    REDUCESCATTER = 5
+    ADASUM = 6
+
+
+class ResponseType(enum.IntEnum):
+    # Parity with reference message.h:131-136.
+    ALLREDUCE = 0
+    ALLGATHER = 1
+    BROADCAST = 2
+    JOIN = 3
+    ALLTOALL = 4
+    REDUCESCATTER = 5
+    ADASUM = 6
+    ERROR = 7
+
+
+@dataclass
+class TensorTableEntry:
+    """One pending named-tensor submission.
+
+    Parity with reference ``common.h:209-234`` but holds a framework-neutral
+    array plus the completion callback; device readiness events are not needed
+    (JAX arrays are ready-by-construction once dispatched; the executor calls
+    ``block_until_ready`` where required).
+    """
+
+    name: str
+    tensor: Any  # jax.Array | np.ndarray
+    root_rank: int = -1
+    device: int = -1
+    callback: Optional[Callable[[Status, Any], None]] = None
+    reduce_op: ReduceOp = ReduceOp.SUM
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+    # Output slot filled by the executor (for async handles).
+    output: Any = None
+    context: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class TensorShape:
+    dims: Tuple[int, ...]
+
+    @staticmethod
+    def of(array: Any) -> "TensorShape":
+        return TensorShape(tuple(int(d) for d in array.shape))
+
+    def num_elements(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def __str__(self) -> str:
+        return "[" + ", ".join(str(d) for d in self.dims) + "]"

@@ -1,0 +1,593 @@
+"""Eager/op-mode runtime: named-tensor async enqueue + background cycle loop.
+
+TPU-native re-design of the reference core (``horovod/common/operations.cc``):
+the same architectural invariant is kept — *all collective work happens on one
+background thread per process* (``operations.cc:306-326``); framework callers
+are async producers into a mutex-guarded ``TensorQueue`` and the loop is the
+single consumer, waking every ``cycle_time_ms`` (default 5 ms,
+``operations.cc:411-417``) to negotiate readiness, fuse, and execute.
+
+What changes on TPU: the data plane executes fused XLA collectives (jitted
+pack → psum/all_gather/ppermute → unpack) instead of NCCL/MPI calls, and GPU
+ready-event polling (``operations.cc:261-285``) disappears — JAX arrays are
+ready-by-construction once dispatch returns, and completion is observed with
+``block_until_ready`` on the executor thread.
+
+Multi-process coordination (the controller protocol of ``controller.cc``)
+plugs in behind the ``Coordinator`` interface; the single-process coordinator
+declares every tensor immediately ready, matching the reference's size=1
+fast path.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.env import Config
+from ..common.topology import Topology
+from ..common.types import (
+    DUPLICATE_NAME_ERROR_FMT,
+    ReduceOp,
+    RequestType,
+    ResponseType,
+    SHUT_DOWN_ERROR,
+    Status,
+    TensorTableEntry,
+    dtype_from_array,
+    dtype_size,
+)
+from ..utils.timeline import (
+    Timeline,
+    XLA_ALLGATHER,
+    XLA_ALLREDUCE,
+    XLA_ALLTOALL,
+    XLA_BROADCAST,
+    XLA_ADASUM,
+)
+
+logger = logging.getLogger("horovod_tpu")
+
+_REQ_TO_TIMELINE = {
+    RequestType.ALLREDUCE: XLA_ALLREDUCE,
+    RequestType.ALLGATHER: XLA_ALLGATHER,
+    RequestType.BROADCAST: XLA_BROADCAST,
+    RequestType.ALLTOALL: XLA_ALLTOALL,
+    RequestType.ADASUM: XLA_ADASUM,
+}
+
+
+@dataclass
+class Request:
+    """Readiness announcement for one named tensor (reference message.h:46-96)."""
+
+    rank: int
+    request_type: RequestType
+    tensor_name: str
+    dtype: int = 0
+    shape: Tuple[int, ...] = ()
+    root_rank: int = -1
+    reduce_op: int = int(ReduceOp.SUM)
+    prescale_factor: float = 1.0
+    postscale_factor: float = 1.0
+
+
+@dataclass
+class Response:
+    """Coordinator verdict: a set of tensors to execute together, or an error
+    (reference message.h:126-216)."""
+
+    response_type: ResponseType
+    tensor_names: List[str] = field(default_factory=list)
+    error_message: str = ""
+
+
+class TensorQueue:
+    """Thread-safe pending-tensor table (reference tensor_queue.cc).
+
+    Rejects duplicate names (reference common.h:160-163) and drains with an
+    abort status on shutdown (``operations.cc:511-517``).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: "OrderedDict[str, Tuple[Request, TensorTableEntry]]" = OrderedDict()
+        self._pending: List[Request] = []
+
+    def add(self, request: Request, entry: TensorTableEntry) -> Status:
+        with self._lock:
+            if entry.name in self._table:
+                op = request.request_type.name.lower()
+                return Status.PreconditionError(DUPLICATE_NAME_ERROR_FMT.format(op=op))
+            self._table[entry.name] = (request, entry)
+            self._pending.append(request)
+            return Status.OK()
+
+    def pop_requests(self) -> List[Request]:
+        with self._lock:
+            out = self._pending
+            self._pending = []
+            return out
+
+    def take_entry(self, name: str) -> Optional[TensorTableEntry]:
+        with self._lock:
+            item = self._table.pop(name, None)
+            return item[1] if item is not None else None
+
+    def get_request(self, name: str) -> Optional[Request]:
+        with self._lock:
+            item = self._table.get(name)
+            return item[0] if item is not None else None
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._table)
+
+    def drain(self, status: Status) -> None:
+        with self._lock:
+            entries = [e for _, e in self._table.values()]
+            self._table.clear()
+            self._pending.clear()
+        for entry in entries:
+            if entry.callback is not None:
+                entry.callback(status, None)
+
+
+class HandleManager:
+    """Handle → (status, output) map for the async API
+    (reference torch/handle_manager.cc)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        self._results: Dict[int, Tuple[Status, Any]] = {}
+        self._cv = threading.Condition(self._lock)
+
+    def allocate(self) -> int:
+        with self._lock:
+            h = self._next
+            self._next += 1
+            self._results[h] = (Status.InProgress(), None)
+            return h
+
+    def mark_done(self, handle: int, status: Status, output: Any) -> None:
+        with self._cv:
+            self._results[handle] = (status, output)
+            self._cv.notify_all()
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._results:
+                # Already synchronized-and-released (or never allocated):
+                # report complete, matching the reference where PollHandle
+                # after WaitAndClear is not an in-progress state.
+                return True
+            st, _ = self._results[handle]
+            return not st.in_progress()
+
+    def wait(self, handle: int, timeout: Optional[float] = None) -> Tuple[Status, Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                st, out = self._results.get(handle, (Status.InProgress(), None))
+                if not st.in_progress():
+                    self._results.pop(handle, None)
+                    return st, out
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return Status.InProgress(), None
+                self._cv.wait(timeout=0.1 if remaining is None else min(0.1, remaining))
+
+
+class StallInspector:
+    """Warns when tensors sit in the queue too long (reference
+    stall_inspector.cc; 60 s default warn, optional shutdown)."""
+
+    def __init__(self, config: Config):
+        self._config = config
+        self._first_seen: Dict[str, float] = {}
+        self._warned: set = set()
+        self.should_shutdown = False
+
+    def record(self, names: Sequence[str]) -> None:
+        now = time.monotonic()
+        for n in names:
+            self._first_seen.setdefault(n, now)
+
+    def clear(self, names: Sequence[str]) -> None:
+        for n in names:
+            self._first_seen.pop(n, None)
+            self._warned.discard(n)
+
+    def check(self) -> None:
+        if self._config.stall_check_disable:
+            return
+        now = time.monotonic()
+        stalled = [
+            n
+            for n, t in self._first_seen.items()
+            if now - t > self._config.stall_warning_time_seconds and n not in self._warned
+        ]
+        if stalled:
+            logger.warning(
+                "One or more tensors were submitted to be reduced, gathered or "
+                "broadcasted by subset of ranks and are waiting for remainder of "
+                "ranks for more than %d seconds. Stalled ops: %s",
+                int(self._config.stall_warning_time_seconds),
+                ", ".join(sorted(stalled)),
+            )
+            self._warned.update(stalled)
+        if self._config.stall_shutdown_time_seconds > 0:
+            for n, t in self._first_seen.items():
+                if now - t > self._config.stall_shutdown_time_seconds:
+                    self.should_shutdown = True
+                    break
+
+
+class Coordinator:
+    """Controller protocol seam (reference controller.h:63-97).
+
+    ``compute_response_list`` receives this rank's newly-announced requests
+    and returns globally-agreed fused Responses. The single-process
+    implementation marks everything ready immediately; the multi-process
+    implementation (C++ core / TCP control plane) gathers requests to rank 0,
+    counts readiness, validates, fuses, and broadcasts decisions.
+    """
+
+    def compute_response_list(
+        self, requests: List[Request], queue: TensorQueue, config: Config
+    ) -> List[Response]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class SingleProcessCoordinator(Coordinator):
+    def __init__(self):
+        self._pending: List[Request] = []
+
+    def compute_response_list(
+        self, requests: List[Request], queue: TensorQueue, config: Config
+    ) -> List[Response]:
+        # Everything announced is ready; fuse same-type/dtype/op requests up
+        # to the fusion threshold, preserving submission order (reference
+        # FuseResponses, controller.cc:626-750).
+        responses: List[Response] = []
+        current: Optional[Response] = None
+        current_key = None
+        current_bytes = 0
+        for req in requests:
+            if req.request_type == RequestType.JOIN:
+                responses.append(Response(ResponseType.JOIN, [req.tensor_name]))
+                current, current_key = None, None
+                continue
+            rtype = ResponseType(int(req.request_type))
+            nbytes = int(np.prod(req.shape or (1,))) * dtype_size_or(req.dtype)
+            key = (rtype, req.dtype, req.reduce_op, req.root_rank,
+                   req.prescale_factor, req.postscale_factor)
+            fusable = rtype in (ResponseType.ALLREDUCE, ResponseType.ADASUM)
+            if (
+                fusable
+                and current is not None
+                and key == current_key
+                and current_bytes + nbytes <= config.fusion_threshold_bytes
+            ):
+                current.tensor_names.append(req.tensor_name)
+                current_bytes += nbytes
+            else:
+                current = Response(rtype, [req.tensor_name])
+                current_key = key if fusable else None
+                current_bytes = nbytes
+                responses.append(current)
+        return responses
+
+
+def dtype_size_or(dtype: int, default: int = 4) -> int:
+    try:
+        from ..common.types import DataType
+
+        return dtype_size(DataType(dtype))
+    except Exception:
+        return default
+
+
+class DataPlane:
+    """Executes one fused Response worth of entries. Implementations:
+    ``LocalDataPlane`` (size=1), ``MeshDataPlane`` (in-process device mesh),
+    and the multi-process XLA plane (via jax.distributed)."""
+
+    def execute(
+        self, response: Response, entries: List[TensorTableEntry], topo: Topology
+    ) -> Status:
+        raise NotImplementedError
+
+
+class LocalDataPlane(DataPlane):
+    """size=1 data plane: collectives degenerate to (scaled) identity, as in
+    the reference running a single rank. Implemented with jitted ops so the
+    eager path exercises the same dispatch machinery."""
+
+    def __init__(self):
+        self._scale_fns: Dict[Any, Any] = {}
+
+    def _scale(self, x, factor: float):
+        if factor == 1.0:
+            return x
+        import jax
+        import jax.numpy as jnp
+
+        key = "scale"
+        fn = self._scale_fns.get(key)
+        if fn is None:
+            fn = jax.jit(lambda t, f: t * f.astype(t.dtype))
+            self._scale_fns[key] = fn
+        try:
+            return fn(x, np.asarray(factor, dtype=np.result_type(x.dtype, np.float32)))
+        except Exception:
+            return x * factor
+
+    def execute(
+        self, response: Response, entries: List[TensorTableEntry], topo: Topology
+    ) -> Status:
+        for entry in entries:
+            t = entry.tensor
+            if response.response_type in (
+                ResponseType.ALLREDUCE,
+                ResponseType.ADASUM,
+            ):
+                factor = entry.prescale_factor * entry.postscale_factor
+                if entry.reduce_op == ReduceOp.AVERAGE:
+                    factor /= topo.size  # size == 1, kept for symmetry
+                entry.output = self._scale(t, factor)
+            elif response.response_type in (
+                ResponseType.ALLGATHER,
+                ResponseType.BROADCAST,
+                ResponseType.ALLTOALL,
+                ResponseType.REDUCESCATTER,
+            ):
+                entry.output = t
+            else:
+                return Status.UnknownError(
+                    f"Unsupported response type {response.response_type}"
+                )
+        return Status.OK()
+
+
+class Runtime:
+    """Background-loop owner; the analogue of HorovodGlobalState +
+    BackgroundThreadLoop (``operations.cc:328-529``, ``global_state.h``)."""
+
+    def __init__(
+        self,
+        config: Config,
+        topology: Topology,
+        coordinator: Optional[Coordinator] = None,
+        data_plane: Optional[DataPlane] = None,
+    ):
+        self.config = config
+        self.topology = topology
+        self.coordinator = coordinator or SingleProcessCoordinator()
+        if data_plane is None:
+            if topology.size > 1:
+                # Refuse to run multi-rank eager collectives on the local
+                # (identity) plane — that would return silently wrong
+                # numerics. The multi-process XLA plane plugs in here.
+                raise NotImplementedError(
+                    f"Eager mode for size={topology.size} requires a "
+                    "multi-process data plane (coming with the launcher); "
+                    "use the compiled mode (horovod_tpu.jax) over a device "
+                    "mesh, or run single-process."
+                )
+            data_plane = LocalDataPlane()
+        self.data_plane = data_plane
+        self.tensor_queue = TensorQueue()
+        self.handle_manager = HandleManager()
+        self.timeline = Timeline()
+        self.stall_inspector = StallInspector(config)
+        self.joined = False
+        self._shutdown = threading.Event()
+        self._wake = threading.Event()
+        self._initialized = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle ---
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        if self.config.timeline_filename:
+            self.timeline.initialize(self.config.timeline_filename, self.topology.rank)
+        self._thread = threading.Thread(
+            target=self._background_loop, name="hvd_background", daemon=True
+        )
+        self._thread.start()
+        # Reference spin-waits initialization_done (operations.cc:627-629).
+        self._initialized.wait(timeout=60.0)
+
+    def shutdown(self) -> None:
+        if self._thread is None:
+            return
+        self._shutdown.set()
+        self._wake.set()
+        self._thread.join(timeout=30.0)
+        self._thread = None
+        self.tensor_queue.drain(SHUT_DOWN_ERROR)
+        self.coordinator.shutdown()
+        self.timeline.shutdown()
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and not self._shutdown.is_set()
+
+    # --- enqueue API (reference EnqueueTensor*, operations.cc:783-934) ---
+    def _enqueue(
+        self,
+        request_type: RequestType,
+        name: str,
+        tensor: Any,
+        *,
+        root_rank: int = -1,
+        reduce_op: ReduceOp = ReduceOp.SUM,
+        prescale_factor: float = 1.0,
+        postscale_factor: float = 1.0,
+        callback: Optional[Callable[[Status, Any], None]] = None,
+    ) -> int:
+        if self._shutdown.is_set() or self._thread is None:
+            raise RuntimeError(
+                "Horovod runtime is shut down or was never initialized; "
+                "call hvd.init() first."
+            )
+        handle = self.handle_manager.allocate()
+
+        def _done(status: Status, output: Any) -> None:
+            if callback is not None:
+                try:
+                    callback(status, output)
+                except Exception:  # noqa: BLE001
+                    logger.exception("callback for %s raised", name)
+            self.handle_manager.mark_done(handle, status, output)
+
+        dtype = dtype_from_array(tensor) if tensor is not None else 0
+        request = Request(
+            rank=self.topology.rank,
+            request_type=request_type,
+            tensor_name=name,
+            dtype=int(dtype),
+            shape=tuple(int(d) for d in getattr(tensor, "shape", ())),
+            root_rank=root_rank,
+            reduce_op=int(reduce_op),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+        entry = TensorTableEntry(
+            name=name,
+            tensor=tensor,
+            root_rank=root_rank,
+            callback=_done,
+            reduce_op=reduce_op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+        status = self.tensor_queue.add(request, entry)
+        if not status.ok():
+            self.handle_manager.mark_done(handle, status, None)
+            return handle
+        if self.timeline.initialized:
+            self.timeline.negotiate_start(name, request_type.name)
+        self._wake.set()
+        return handle
+
+    def enqueue_allreduce(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.ALLREDUCE, name, tensor, **kw)
+
+    def enqueue_adasum(self, name, tensor, **kw) -> int:
+        kw.setdefault("reduce_op", ReduceOp.ADASUM)
+        return self._enqueue(RequestType.ADASUM, name, tensor, **kw)
+
+    def enqueue_allgather(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.ALLGATHER, name, tensor, **kw)
+
+    def enqueue_broadcast(self, name, tensor, root_rank, **kw) -> int:
+        return self._enqueue(RequestType.BROADCAST, name, tensor, root_rank=root_rank, **kw)
+
+    def enqueue_alltoall(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.ALLTOALL, name, tensor, **kw)
+
+    def enqueue_join(self) -> int:
+        self.joined = True
+        return self._enqueue(RequestType.JOIN, f"join.{self.topology.rank}", None)
+
+    # --- background loop (reference RunLoopOnce, operations.cc:531-581) ---
+    def _background_loop(self) -> None:
+        self._initialized.set()
+        cycle_s = max(self.config.cycle_time_ms, 0.05) / 1000.0
+        while not self._shutdown.is_set():
+            self._wake.wait(timeout=cycle_s)
+            self._wake.clear()
+            if self._shutdown.is_set():
+                break
+            try:
+                self._run_cycle_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("background cycle raised; draining queue")
+                self.tensor_queue.drain(
+                    Status.UnknownError("background loop failure")
+                )
+        # Final drain so no handle hangs.
+        self.tensor_queue.drain(SHUT_DOWN_ERROR)
+
+    def _run_cycle_once(self) -> None:
+        if self.timeline.initialized and self.config.timeline_mark_cycles:
+            self.timeline.mark_cycle_start()
+        requests = self.tensor_queue.pop_requests()
+        self.stall_inspector.record([r.tensor_name for r in requests])
+        responses = self.coordinator.compute_response_list(
+            requests, self.tensor_queue, self.config
+        )
+        for response in responses:
+            self._perform_operation(response)
+        self.stall_inspector.check()
+        if self.stall_inspector.should_shutdown:
+            logger.error("Stall shutdown time exceeded; aborting runtime.")
+            self._shutdown.set()
+
+    def _perform_operation(self, response: Response) -> None:
+        # Reference PerformOperation (operations.cc:227-304).
+        if response.response_type == ResponseType.JOIN:
+            self.joined = False
+            self.stall_inspector.clear(response.tensor_names)
+            for name in response.tensor_names:
+                entry = self.tensor_queue.take_entry(name)
+                if entry and entry.callback:
+                    entry.callback(Status.OK(), None)
+            return
+        entries: List[TensorTableEntry] = []
+        for name in response.tensor_names:
+            entry = self.tensor_queue.take_entry(name)
+            if entry is not None:
+                entries.append(entry)
+        if not entries:
+            return
+        self.stall_inspector.clear([e.name for e in entries])
+        timeline_name = _REQ_TO_TIMELINE.get(
+            RequestType(int(response.response_type))
+            if int(response.response_type) <= int(RequestType.ADASUM)
+            else None,
+            "OP",
+        )
+        if self.timeline.initialized:
+            for e in entries:
+                self.timeline.negotiate_end(e.name, timeline_name.replace("XLA_", ""))
+                self.timeline.start(e.name, timeline_name)
+        if response.response_type == ResponseType.ERROR:
+            status = Status.PreconditionError(response.error_message)
+        else:
+            try:
+                status = self.data_plane.execute(response, entries, self.topology)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("data plane failure")
+                status = Status.UnknownError(str(exc))
+        if self.timeline.initialized:
+            for e in entries:
+                self.timeline.end(e.name, timeline_name)
+        for entry in entries:
+            if entry.callback is not None:
+                entry.callback(status, entry.output if status.ok() else None)
+
+    # --- sync helpers ---
+    def poll(self, handle: int) -> bool:
+        return self.handle_manager.poll(handle)
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None) -> Any:
+        status, output = self.handle_manager.wait(handle, timeout)
+        if status.in_progress():
+            raise TimeoutError("Horovod operation timed out")
+        if not status.ok():
+            raise RuntimeError(status.reason)
+        return output

@@ -1,0 +1,122 @@
+"""Device-mesh construction.
+
+The mesh is the TPU-native analogue of the reference's communicator set
+(``horovod/common/mpi/mpi_context.cc:149-158`` global/local/cross comms): a
+named axis of the mesh *is* a communicator, and XLA lowers collectives over
+it to ICI (intra-slice) or DCN (inter-slice) transfers automatically when the
+axis ordering follows the physical topology.
+
+Conventions:
+ - ``data`` — the data-parallel axis (Horovod's world communicator).
+ - ``local`` / ``cross`` — the two-level split used by hierarchical ops
+   (ICI within a slice, DCN across slices), mirroring the reference's
+   NCCL-local + MPI-cross structure (``nccl_operations.cc:151-346``).
+ - ``model`` / ``seq`` / ``expert`` — extension axes for TP/SP/EP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+LOCAL_AXIS = "local"
+CROSS_AXIS = "cross"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def parse_axes(spec: str) -> Dict[str, int]:
+    """Parse a ``"data:4,model:2"`` style axis spec. ``-1`` means "fill"."""
+    axes: Dict[str, int] = {}
+    if not spec:
+        return axes
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, n = part.split(":", 1)
+            axes[name.strip()] = int(n)
+        else:
+            axes[part] = -1
+    return axes
+
+
+def build_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` with the given named axis sizes.
+
+    With no spec, a single ``data`` axis spans every device — the pure-DP
+    configuration that matches the reference's world communicator. At most
+    one axis may be ``-1`` (filled with the remaining device count). Device
+    order follows ``mesh_utils.create_device_mesh`` so ICI neighbours stay
+    adjacent on TPU.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ndev = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: ndev}
+    axes = dict(axes)
+
+    fill_axes = [k for k, v in axes.items() if v == -1]
+    if len(fill_axes) > 1:
+        raise ValueError(f"At most one mesh axis may be -1 (fill): {axes}")
+    known = 1
+    for k, v in axes.items():
+        if v != -1:
+            known *= v
+    if fill_axes:
+        if ndev % known != 0:
+            raise ValueError(
+                f"Cannot fill axis {fill_axes[0]}: {ndev} devices not divisible "
+                f"by {known}"
+            )
+        axes[fill_axes[0]] = ndev // known
+    total = int(np.prod(list(axes.values())))
+    if total != ndev:
+        raise ValueError(
+            f"Mesh axes {axes} require {total} devices but {ndev} are available"
+        )
+
+    shape = tuple(axes.values())
+    names = tuple(axes.keys())
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            shape, devices=devices, allow_split_physical_axes=True
+        )
+    except Exception:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def build_hierarchical_mesh(
+    local_size: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Two-level ``(cross, local)`` mesh for hierarchical allreduce.
+
+    ``local`` spans the chips inside one slice/host (ICI) and ``cross``
+    spans slices (DCN) — the direct analogue of the reference's
+    NCCLHierarchicalAllreduce structure (``nccl_operations.cc:151-346``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    ndev = len(devices)
+    if ndev % local_size != 0:
+        raise ValueError(f"{ndev} devices not divisible by local_size={local_size}")
+    return build_mesh(
+        {CROSS_AXIS: ndev // local_size, LOCAL_AXIS: local_size}, devices
+    )
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return int(mesh.shape[DATA_AXIS]) if DATA_AXIS in mesh.axis_names else 1

@@ -1,0 +1,46 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test execution model (SURVEY.md §4): the reference
+runs pytest under ``mpirun -np 2`` to simulate multi-node on localhost; the
+TPU build simulates a multi-chip slice with
+``--xla_force_host_platform_device_count=8`` on the CPU backend, which
+exercises every collective's numerics over a real 8-way mesh in one process.
+"""
+
+import os
+
+# Must happen before the first JAX backend initialization.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment may pin an accelerator platform (e.g. a remote TPU plugin)
+# via jax_platforms; tests always run on the virtual CPU mesh.
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def hvd_session():
+    """Initialized single-process runtime, shut down after the test."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
