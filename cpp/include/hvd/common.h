@@ -1,0 +1,147 @@
+// Core types for the native control-plane runtime.
+//
+// TPU-native re-design of the reference's horovod/common/common.h. The
+// native core owns *metadata and coordination only*: tensor payloads stay in
+// the Python/XLA world (device HBM), so the types here carry names, shapes
+// and dtypes — never data pointers. The data plane is executed by the
+// embedding runtime (JAX) from plans this core emits.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class StatusCode : int32_t {
+  kOk = 0,
+  kUnknownError = 1,
+  kPreconditionError = 2,
+  kAborted = 3,
+  kInvalidArgument = 4,
+  kInProgress = 5,
+};
+
+struct Status {
+  StatusCode code = StatusCode::kOk;
+  std::string reason;
+  bool ok() const { return code == StatusCode::kOk; }
+  static Status OK() { return {}; }
+  static Status Error(StatusCode c, std::string r) { return {c, std::move(r)}; }
+};
+
+// Wire dtype ids — aligned with horovod_tpu.common.types.DataType (Python).
+enum class DataType : uint8_t {
+  kUint8 = 0, kInt8 = 1, kUint16 = 2, kInt16 = 3, kInt32 = 4, kInt64 = 5,
+  kFloat16 = 6, kFloat32 = 7, kFloat64 = 8, kBool = 9, kBfloat16 = 10,
+  kComplex64 = 11,
+};
+
+inline int64_t DataTypeSize(DataType d) {
+  switch (d) {
+    case DataType::kUint8: case DataType::kInt8: case DataType::kBool: return 1;
+    case DataType::kUint16: case DataType::kInt16: case DataType::kFloat16:
+    case DataType::kBfloat16: return 2;
+    case DataType::kInt32: case DataType::kFloat32: return 4;
+    case DataType::kInt64: case DataType::kFloat64: case DataType::kComplex64:
+      return 8;
+  }
+  return 4;
+}
+
+enum class RequestType : uint8_t {
+  kAllreduce = 0, kAllgather = 1, kBroadcast = 2, kJoin = 3, kAlltoall = 4,
+  kReducescatter = 5, kAdasum = 6,
+};
+
+enum class ResponseType : uint8_t {
+  kAllreduce = 0, kAllgather = 1, kBroadcast = 2, kJoin = 3, kAlltoall = 4,
+  kReducescatter = 5, kAdasum = 6, kError = 7,
+};
+
+enum class ReduceOp : int32_t {
+  kAverage = 1, kSum = 2, kAdasum = 3, kMin = 4, kMax = 5, kProduct = 6,
+};
+
+// Readiness announcement for one named tensor on one rank (the analogue of
+// the reference's Request message; shape/dtype travel so the coordinator
+// can validate cross-rank consistency).
+struct Request {
+  int32_t rank = 0;
+  RequestType type = RequestType::kAllreduce;
+  DataType dtype = DataType::kFloat32;
+  int32_t root_rank = -1;
+  int32_t reduce_op = static_cast<int32_t>(ReduceOp::kSum);
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::string name;
+  std::vector<int64_t> shape;
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  int64_t ByteSize() const { return NumElements() * DataTypeSize(dtype); }
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+  // Cache-hit bitvector for this cycle (response-cache coordination).
+  std::vector<uint8_t> cache_bits;
+};
+
+// Coordinator verdict: a fused group of tensors to execute together.
+struct Response {
+  ResponseType type = ResponseType::kAllreduce;
+  std::vector<std::string> names;
+  std::string error;
+  DataType dtype = DataType::kFloat32;
+  int32_t root_rank = -1;
+  int32_t reduce_op = static_cast<int32_t>(ReduceOp::kSum);
+  double prescale = 1.0;
+  double postscale = 1.0;
+  int64_t total_bytes = 0;
+  // Canonical per-entry shapes (coordinator-validated), so a Joined rank
+  // can substitute zero tensors it never submitted (reference join
+  // semantics: joined ranks participate with zeros).
+  std::vector<std::vector<int64_t>> entry_shapes;
+  // Allgather: first-dimension size per rank (displacement math).
+  std::vector<int64_t> rank_sizes;
+  // Number of ranks contributing real (non-zero-substituted) tensors —
+  // the correct Average divisor under Join.
+  int32_t participants = 0;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+  // Autotuned knobs broadcast from rank 0 (parameter manager sync).
+  double cycle_time_ms = 0.0;      // 0 = unchanged
+  int64_t fusion_threshold = 0;    // 0 = unchanged
+};
+
+struct CoreConfig {
+  int32_t rank = 0;
+  int32_t size = 1;
+  int32_t local_rank = 0;
+  int32_t local_size = 1;
+  int32_t cross_rank = 0;
+  int32_t cross_size = 1;
+  double cycle_time_ms = 5.0;
+  int64_t fusion_threshold = 64ll << 20;
+  int32_t cache_capacity = 1024;
+  int32_t stall_warning_sec = 60;
+  int32_t stall_shutdown_sec = 0;
+  int32_t autotune = 0;
+  int32_t autotune_warmup_samples = 3;
+  int32_t autotune_steps_per_sample = 10;
+  int32_t log_level = 2;  // 0=trace 1=debug 2=info 3=warn 4=error
+  char timeline_path[1024] = {0};
+  char coord_addr[256] = {0};  // empty => single-process controller
+  int32_t coord_port = 0;
+  char autotune_log[1024] = {0};
+};
+
+}  // namespace hvd

@@ -1,0 +1,287 @@
+// Native control-plane core: tensor table, negotiation, fusion, cache,
+// stall detection, timeline, autotune, and the background cycle loop.
+//
+// Architectural parity with the reference core (horovod/common/operations.cc
+// + controller.cc + global_state.h): one background thread per process owns
+// all coordination; framework threads are producers into a mutex-guarded
+// table. TPU-native difference: this core never touches tensor *data* —
+// it emits fused execution Plans that the embedding runtime (JAX) executes
+// as XLA collectives, reporting completion back (PlanDone) so the core can
+// drive its timeline/autotune/stall machinery.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <functional>
+#include <list>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+double NowSec();
+
+// ---------------------------------------------------------------- logging
+enum class LogLevel : int { kTrace = 0, kDebug, kInfo, kWarn, kError, kFatal };
+void LogSetLevel(int level);
+void LogSetRank(int rank);
+void Log(LogLevel level, const std::string& msg);
+#define HVD_LOG(lvl, msg) ::hvd::Log(::hvd::LogLevel::lvl, (msg))
+
+// ---------------------------------------------------------------- timeline
+// Chrome-tracing JSON writer with a dedicated writer thread (role parity
+// with the reference Timeline; events: negotiation phases, plan execution,
+// cycle marks).
+class Timeline {
+ public:
+  void Initialize(const std::string& path, int rank);
+  bool initialized() const { return initialized_.load(); }
+  void Shutdown();
+  void NegotiateStart(const std::string& tensor, const std::string& op);
+  void NegotiateRankReady(const std::string& tensor, int rank);
+  void NegotiateEnd(const std::string& tensor, const std::string& op);
+  void Begin(const std::string& tensor, const std::string& activity);
+  void End(const std::string& tensor, const std::string& activity);
+  void MarkCycle();
+
+ private:
+  int Tid(const std::string& tensor);
+  void Emit(const std::string& json);
+  void WriterLoop();
+  double NowUs();
+
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> stop_{false};
+  int rank_ = 0;
+  double start_ = 0;
+  FILE* file_ = nullptr;
+  bool first_event_ = true;
+  std::unordered_map<std::string, int> tids_;
+  int next_tid_ = 1;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::thread writer_;
+};
+
+// ---------------------------------------------------------------- cache
+// LRU cache of coordinator verdicts keyed by request signature, so steady-
+// state iterations skip the negotiation round (role parity with the
+// reference ResponseCache). Multi-process coherence rides the cycle
+// protocol: every rank sends hit-bitvectors; the coordinator ANDs them and
+// only commonly-hit entries execute from cache.
+class ResponseCache {
+ public:
+  void SetCapacity(size_t cap) { capacity_ = cap; }
+  size_t capacity() const { return capacity_; }
+  // Returns bit position if cached, -1 otherwise.
+  int32_t Lookup(const Request& r) const;
+  void Put(const Request& r, const Response& resp);
+  bool Get(int32_t bit, Response* out) const;
+  void Invalidate(const std::string& name);
+  size_t size() const { return entries_.size(); }
+  static std::string Key(const Request& r);
+
+ private:
+  struct Entry {
+    std::string key;
+    Response response;
+    uint64_t last_used = 0;
+  };
+  size_t capacity_ = 1024;
+  uint64_t tick_ = 0;
+  std::vector<Entry> entries_;                    // bit index -> entry
+  std::unordered_map<std::string, int32_t> index_;  // key -> bit
+  std::vector<int32_t> free_bits_;
+  mutable std::mutex mu_;
+};
+
+// ---------------------------------------------------------------- stall
+class StallInspector {
+ public:
+  void Configure(int warn_sec, int shutdown_sec) {
+    warn_sec_ = warn_sec;
+    shutdown_sec_ = shutdown_sec;
+  }
+  void Record(const std::string& name, int rank);
+  void Clear(const std::string& name);
+  // Returns true if shutdown threshold exceeded.
+  bool Check(int size);
+
+ private:
+  struct Info {
+    double first_seen = 0;
+    std::set<int> ranks;
+    bool warned = false;
+  };
+  int warn_sec_ = 60;
+  int shutdown_sec_ = 0;
+  std::map<std::string, Info> pending_;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------- autotune
+// Joint Bayesian optimization of (fusion_threshold, cycle_time) scored by
+// observed data-plane throughput — role parity with the reference
+// ParameterManager + optim/ (GP regressor + Expected Improvement).
+class ParameterManager {
+ public:
+  void Initialize(double cycle_ms, int64_t fusion_bytes, int warmup,
+                  int steps_per_sample, const std::string& log_path);
+  void SetEnabled(bool e) { enabled_ = e; }
+  bool enabled() const { return enabled_; }
+  // Record one executed plan (bytes moved). Returns true if params changed.
+  bool Update(int64_t bytes, double duration_s);
+  double cycle_time_ms() const { return cycle_ms_; }
+  int64_t fusion_threshold() const { return fusion_bytes_; }
+
+ private:
+  void Tune(double score);
+  bool enabled_ = false;
+  double cycle_ms_ = 5.0;
+  int64_t fusion_bytes_ = 64ll << 20;
+  int warmup_remaining_ = 3;
+  int steps_per_sample_ = 10;
+  int steps_in_sample_ = 0;
+  int64_t bytes_in_sample_ = 0;
+  double sample_start_ = 0;
+  std::vector<double> scores_;  // median-of-samples scoring
+  // GP observations: x = (log2 fusion, log2 cycle), y = score.
+  std::vector<std::pair<double, double>> xs_;
+  std::vector<double> ys_;
+  double best_score_ = 0;
+  double best_x1_ = 0, best_x2_ = 0;
+  std::string log_path_;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------- plans
+// A fused execution unit handed to the embedding runtime.
+struct Plan {
+  uint64_t id = 0;
+  Response response;
+};
+
+// ---------------------------------------------------------------- transport
+// Control-plane transport: rank 0 coordinates over TCP (role parity with
+// the reference's Gloo controller + HTTP rendezvous). Lockstep per cycle:
+// every worker sends its RequestList, rank 0 replies with the fused
+// ResponseList.
+class ControlTransport {
+ public:
+  virtual ~ControlTransport() = default;
+  virtual Status Init(const CoreConfig& cfg) = 0;
+  // Rank 0: gather each rank's RequestList (index 0 = self, passed in).
+  virtual Status Gather(const RequestList& mine,
+                        std::vector<RequestList>* all) = 0;
+  // Rank 0: broadcast the verdict; workers: exchange (send mine, recv out).
+  virtual Status Broadcast(const ResponseList& rl) = 0;
+  virtual Status Exchange(const RequestList& mine, ResponseList* out) = 0;
+  virtual void Close() = 0;
+};
+
+ControlTransport* NewTcpTransport();
+
+// ---------------------------------------------------------------- core
+class Core {
+ public:
+  static Core& Get();
+
+  Status Init(const CoreConfig& cfg);
+  void Shutdown();
+  bool initialized() const { return initialized_.load(); }
+  const CoreConfig& config() const { return cfg_; }
+
+  // Producer API (any thread). Returns ticket id (>0) or 0 on duplicate.
+  Status Enqueue(const Request& req, uint64_t* ticket);
+  Status EnqueueJoin(uint64_t* ticket);
+
+  // Executor API: block up to timeout for the next plan. Returns 1 when a
+  // plan was produced, 0 on timeout, -1 on shutdown.
+  int NextPlan(Plan* out, int timeout_ms);
+  void PlanDone(uint64_t plan_id, int status_code, const std::string& error,
+                double duration_s, int64_t bytes);
+
+  // Ticket status polling: 0 in-progress, 1 ok, <0 error code.
+  int TicketStatus(uint64_t ticket, std::string* error);
+
+  double cycle_time_ms() const { return params_.cycle_time_ms(); }
+  int64_t fusion_threshold() const { return params_.fusion_threshold(); }
+
+  Timeline& timeline() { return timeline_; }
+
+ private:
+  Core() = default;
+  void BackgroundLoop();
+  void RunCycleOnce();
+  // Coordinator-side: decide ready tensors, validate, fuse.
+  ResponseList Coordinate(std::vector<RequestList>& lists);
+  void FuseAndEmit(std::vector<Request>& ready, ResponseList* out);
+  void DispatchResponses(const ResponseList& rl);
+  void FailAll(const Status& s);
+
+  CoreConfig cfg_;
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shutdown_{false};
+  std::thread thread_;
+
+  // Pending tensor table (metadata only; payloads live in Python).
+  struct Pending {
+    Request request;
+    uint64_t ticket;
+  };
+  std::mutex table_mu_;
+  std::map<std::string, Pending> table_;
+  std::vector<Request> queued_;
+  std::condition_variable wake_cv_;
+  bool wake_ = false;
+  bool joined_ = false;
+  uint64_t join_ticket_ = 0;
+
+  // Coordinator state (rank 0): per-tensor readiness counting.
+  struct Negotiation {
+    Request request;
+    std::set<int32_t> ranks;
+    bool error = false;
+    std::string error_msg;
+  };
+  std::map<std::string, Negotiation> negotiating_;
+  std::set<int32_t> joined_ranks_;
+
+  // Plan queue to the executor. Tickets are captured at dispatch time so
+  // completion never resolves through names (a same-name tensor can be
+  // legally re-enqueued while its predecessor's plan is still executing).
+  struct Inflight {
+    Response response;
+    std::vector<uint64_t> tickets;
+  };
+  std::mutex plan_mu_;
+  std::condition_variable plan_cv_;
+  std::deque<Plan> plans_;
+  uint64_t next_plan_id_ = 1;
+  std::unordered_map<uint64_t, Inflight> inflight_;
+
+  // Ticket results.
+  std::mutex ticket_mu_;
+  std::condition_variable ticket_cv_;
+  uint64_t next_ticket_ = 1;
+  std::unordered_map<uint64_t, std::pair<int, std::string>> tickets_;
+
+  ResponseCache cache_;
+  StallInspector stall_;
+  ParameterManager params_;
+  Timeline timeline_;
+  ControlTransport* transport_ = nullptr;
+};
+
+}  // namespace hvd

@@ -1,0 +1,90 @@
+// Compact binary wire format for the control plane.
+//
+// Role parity with the reference's FlatBuffers-based message layer
+// (horovod/common/wire/message.fbs, message.cc) — re-designed as a plain
+// length-prefixed little-endian encoding: the control messages are tiny
+// (names + shapes), exchanged once per cycle, and a zero-dependency codec
+// keeps the native core self-contained.
+#pragma once
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+namespace wire {
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void U8(uint8_t v) { buf.push_back(v); }
+  void I32(int32_t v) { Raw(&v, 4); }
+  void U32(uint32_t v) { Raw(&v, 4); }
+  void I64(int64_t v) { Raw(&v, 8); }
+  void F64(double v) { Raw(&v, 8); }
+  void Str(const std::string& s) {
+    U32(static_cast<uint32_t>(s.size()));
+    Raw(s.data(), s.size());
+  }
+  void Bytes(const std::vector<uint8_t>& b) {
+    U32(static_cast<uint32_t>(b.size()));
+    Raw(b.data(), b.size());
+  }
+  void Raw(const void* p, size_t n) {
+    const uint8_t* c = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), c, c + n);
+  }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n) : p_(p), end_(p + n) {}
+  bool ok() const { return ok_; }
+  uint8_t U8() { uint8_t v = 0; Get(&v, 1); return v; }
+  int32_t I32() { int32_t v = 0; Get(&v, 4); return v; }
+  uint32_t U32() { uint32_t v = 0; Get(&v, 4); return v; }
+  int64_t I64() { int64_t v = 0; Get(&v, 8); return v; }
+  double F64() { double v = 0; Get(&v, 8); return v; }
+  std::string Str() {
+    uint32_t n = U32();
+    if (!Check(n)) return {};
+    std::string s(reinterpret_cast<const char*>(p_), n);
+    p_ += n;
+    return s;
+  }
+  std::vector<uint8_t> Bytes() {
+    uint32_t n = U32();
+    if (!Check(n)) return {};
+    std::vector<uint8_t> b(p_, p_ + n);
+    p_ += n;
+    return b;
+  }
+
+ private:
+  bool Check(size_t n) {
+    if (static_cast<size_t>(end_ - p_) < n) { ok_ = false; return false; }
+    return true;
+  }
+  void Get(void* out, size_t n) {
+    if (!Check(n)) return;
+    std::memcpy(out, p_, n);
+    p_ += n;
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+  bool ok_ = true;
+};
+
+void EncodeRequest(Writer& w, const Request& r);
+bool DecodeRequest(Reader& rd, Request* out);
+std::vector<uint8_t> EncodeRequestList(const RequestList& rl);
+bool DecodeRequestList(const uint8_t* p, size_t n, RequestList* out);
+void EncodeResponse(Writer& w, const Response& r);
+bool DecodeResponse(Reader& rd, Response* out);
+std::vector<uint8_t> EncodeResponseList(const ResponseList& rl);
+bool DecodeResponseList(const uint8_t* p, size_t n, ResponseList* out);
+
+}  // namespace wire
+}  // namespace hvd

@@ -1,0 +1,207 @@
+// Autotuning of (fusion_threshold, cycle_time) by Bayesian optimization.
+//
+// Role parity with the reference ParameterManager + optim/ (joint tuning of
+// fusion threshold and cycle time scored in bytes/sec, Gaussian-process
+// regression with Expected-Improvement acquisition). Re-implemented
+// dependency-free: RBF-kernel GP with a hand-rolled Cholesky solve (the
+// design space is 2-D and the sample count small), EI maximized over a
+// deterministic candidate grid instead of gradient ascent.
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <sstream>
+#include <vector>
+
+#include "hvd/core.h"
+
+namespace hvd {
+
+namespace {
+
+// Normalized design space: x1 = log2(fusion_bytes) in [16, 28],
+// x2 = log2(cycle_ms) in [-2, 6], both mapped to [0, 1].
+constexpr double kF0 = 16.0, kF1 = 28.0;
+constexpr double kC0 = -2.0, kC1 = 6.0;
+
+double Norm1(double log2_fusion) { return (log2_fusion - kF0) / (kF1 - kF0); }
+double Norm2(double log2_cycle) { return (log2_cycle - kC0) / (kC1 - kC0); }
+
+// Cholesky decomposition of a small SPD matrix (row-major n x n), in place.
+bool Cholesky(std::vector<double>& a, int n) {
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double sum = a[i * n + j];
+      for (int k = 0; k < j; ++k) sum -= a[i * n + k] * a[j * n + k];
+      if (i == j) {
+        if (sum <= 0) return false;
+        a[i * n + i] = std::sqrt(sum);
+      } else {
+        a[i * n + j] = sum / a[j * n + j];
+      }
+    }
+  }
+  return true;
+}
+
+// Solve L L^T x = b given the Cholesky factor (lower triangle of a).
+void CholSolve(const std::vector<double>& L, int n, std::vector<double>& b) {
+  for (int i = 0; i < n; ++i) {
+    double sum = b[i];
+    for (int k = 0; k < i; ++k) sum -= L[i * n + k] * b[k];
+    b[i] = sum / L[i * n + i];
+  }
+  for (int i = n - 1; i >= 0; --i) {
+    double sum = b[i];
+    for (int k = i + 1; k < n; ++k) sum -= L[k * n + i] * b[k];
+    b[i] = sum / L[i * n + i];
+  }
+}
+
+double Kernel(double x1, double y1, double x2, double y2) {
+  constexpr double kLength = 0.25;
+  double d = (x1 - x2) * (x1 - x2) + (y1 - y2) * (y1 - y2);
+  return std::exp(-d / (2 * kLength * kLength));
+}
+
+double NormCdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
+double NormPdf(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
+}  // namespace
+
+void ParameterManager::Initialize(double cycle_ms, int64_t fusion_bytes,
+                                  int warmup, int steps_per_sample,
+                                  const std::string& log_path) {
+  std::lock_guard<std::mutex> l(mu_);
+  cycle_ms_ = cycle_ms;
+  fusion_bytes_ = fusion_bytes;
+  warmup_remaining_ = warmup;
+  if (steps_per_sample > 0) steps_per_sample_ = steps_per_sample;
+  if (!log_path.empty()) log_path_ = log_path;
+  sample_start_ = 0;
+}
+
+bool ParameterManager::Update(int64_t bytes, double duration_s) {
+  if (!enabled_) return false;
+  std::lock_guard<std::mutex> l(mu_);
+  if (sample_start_ == 0) sample_start_ = NowSec();
+  bytes_in_sample_ += bytes;
+  steps_in_sample_ += 1;
+  if (steps_in_sample_ < steps_per_sample_) return false;
+  double elapsed = NowSec() - sample_start_;
+  double score = elapsed > 0 ? bytes_in_sample_ / elapsed : 0;
+  steps_in_sample_ = 0;
+  bytes_in_sample_ = 0;
+  sample_start_ = NowSec();
+  if (warmup_remaining_ > 0) {
+    --warmup_remaining_;
+    return false;
+  }
+  scores_.push_back(score);
+  // Median-of-5 scoring (reference scores a parameter point by the median
+  // of several samples to reject scheduler noise).
+  if (scores_.size() < 5) return false;
+  std::vector<double> s(scores_);
+  scores_.clear();
+  std::nth_element(s.begin(), s.begin() + s.size() / 2, s.end());
+  Tune(s[s.size() / 2]);
+  return true;
+}
+
+void ParameterManager::Tune(double median_score) {
+  double x1 = Norm1(std::log2(static_cast<double>(fusion_bytes_)));
+  double x2 = Norm2(std::log2(cycle_ms_));
+  xs_.emplace_back(x1, x2);
+  ys_.push_back(median_score);
+  if (median_score > best_score_) {
+    best_score_ = median_score;
+    best_x1_ = x1;
+    best_x2_ = x2;
+  }
+  if (!log_path_.empty()) {
+    if (FILE* f = std::fopen(log_path_.c_str(), "a")) {
+      std::fprintf(f, "%lld,%.3f,%.1f\n",
+                   static_cast<long long>(fusion_bytes_), cycle_ms_,
+                   median_score);
+      std::fclose(f);
+    }
+  }
+
+  int n = static_cast<int>(xs_.size());
+  // After enough samples, pin the best-known point (reference caps the
+  // bayes-opt sample budget and then freezes).
+  if (n >= 20) {
+    fusion_bytes_ = static_cast<int64_t>(
+        std::pow(2.0, kF0 + best_x1_ * (kF1 - kF0)));
+    cycle_ms_ = std::pow(2.0, kC0 + best_x2_ * (kC1 - kC0));
+    enabled_ = false;
+    HVD_LOG(kInfo, "autotune converged: fusion=" +
+                       std::to_string(fusion_bytes_) +
+                       " cycle_ms=" + std::to_string(cycle_ms_));
+    return;
+  }
+
+  // GP fit: K = k(X,X) + noise I, alpha = K^-1 y (y mean-centered,
+  // max-normalized).
+  double ymax = 1e-9;
+  for (double y : ys_) ymax = std::max(ymax, y);
+  std::vector<double> y(n);
+  double mean = 0;
+  for (int i = 0; i < n; ++i) {
+    y[i] = ys_[i] / ymax;
+    mean += y[i];
+  }
+  mean /= n;
+  for (auto& v : y) v -= mean;
+  std::vector<double> K(n * n);
+  constexpr double kNoise = 0.05;
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < n; ++j) {
+      K[i * n + j] = Kernel(xs_[i].first, xs_[i].second, xs_[j].first,
+                            xs_[j].second);
+    }
+    K[i * n + i] += kNoise;
+  }
+  std::vector<double> L = K;
+  if (!Cholesky(L, n)) return;
+  std::vector<double> alpha = y;
+  CholSolve(L, n, alpha);
+
+  // EI over a 17x17 candidate grid.
+  double best_ei = -1, cand1 = best_x1_, cand2 = best_x2_;
+  double fbest = *std::max_element(y.begin(), y.end());
+  for (int gi = 0; gi <= 16; ++gi) {
+    for (int gj = 0; gj <= 16; ++gj) {
+      double c1 = gi / 16.0, c2 = gj / 16.0;
+      std::vector<double> k(n);
+      for (int i = 0; i < n; ++i) {
+        k[i] = Kernel(c1, c2, xs_[i].first, xs_[i].second);
+      }
+      double mu = 0;
+      for (int i = 0; i < n; ++i) mu += k[i] * alpha[i];
+      std::vector<double> v = k;
+      CholSolve(L, n, v);
+      double var = Kernel(c1, c2, c1, c2) + kNoise;
+      for (int i = 0; i < n; ++i) var -= k[i] * v[i];
+      var = std::max(var, 1e-10);
+      double sigma = std::sqrt(var);
+      constexpr double kXi = 0.01;
+      double z = (mu - fbest - kXi) / sigma;
+      double ei = (mu - fbest - kXi) * NormCdf(z) + sigma * NormPdf(z);
+      if (ei > best_ei) {
+        best_ei = ei;
+        cand1 = c1;
+        cand2 = c2;
+      }
+    }
+  }
+  fusion_bytes_ =
+      static_cast<int64_t>(std::pow(2.0, kF0 + cand1 * (kF1 - kF0)));
+  cycle_ms_ = std::pow(2.0, kC0 + cand2 * (kC1 - kC0));
+  HVD_LOG(kDebug, "autotune step: trying fusion=" +
+                      std::to_string(fusion_bytes_) +
+                      " cycle_ms=" + std::to_string(cycle_ms_));
+}
+
+}  // namespace hvd

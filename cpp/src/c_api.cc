@@ -1,0 +1,217 @@
+// extern "C" surface loaded by horovod_tpu/common/basics.py via ctypes.
+// Role parity with the reference's C ABI (horovod_init/rank/size/...),
+// extended with the plan-queue handshake that lets the Python/JAX side
+// execute the data plane for the native control plane.
+#include <cstring>
+#include <sstream>
+#include <string>
+
+#include "hvd/core.h"
+
+using hvd::Core;
+using hvd::CoreConfig;
+using hvd::Plan;
+using hvd::Request;
+using hvd::Status;
+
+namespace {
+
+void FillErr(char* err, int errlen, const std::string& msg) {
+  if (!err || errlen <= 0) return;
+  std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+}
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+std::string PlanToJson(const Plan& p) {
+  const auto& r = p.response;
+  std::ostringstream os;
+  os << "{\"id\":" << p.id << ",\"type\":" << static_cast<int>(r.type)
+     << ",\"dtype\":" << static_cast<int>(r.dtype)
+     << ",\"root\":" << r.root_rank << ",\"op\":" << r.reduce_op
+     << ",\"prescale\":" << r.prescale << ",\"postscale\":" << r.postscale
+     << ",\"participants\":" << r.participants
+     << ",\"total_bytes\":" << r.total_bytes << ",\"error\":\""
+     << JsonEscape(r.error) << "\",\"names\":[";
+  for (size_t i = 0; i < r.names.size(); ++i) {
+    if (i) os << ',';
+    os << '"' << JsonEscape(r.names[i]) << '"';
+  }
+  os << "],\"shapes\":[";
+  for (size_t i = 0; i < r.entry_shapes.size(); ++i) {
+    if (i) os << ',';
+    os << '[';
+    for (size_t j = 0; j < r.entry_shapes[i].size(); ++j) {
+      if (j) os << ',';
+      os << r.entry_shapes[i][j];
+    }
+    os << ']';
+  }
+  os << "],\"rank_sizes\":[";
+  for (size_t i = 0; i < r.rank_sizes.size(); ++i) {
+    if (i) os << ',';
+    os << r.rank_sizes[i];
+  }
+  os << "]}";
+  return os.str();
+}
+
+}  // namespace
+
+extern "C" {
+
+int hvd_core_init(int rank, int size, int local_rank, int local_size,
+                  int cross_rank, int cross_size, double cycle_time_ms,
+                  long long fusion_threshold, int cache_capacity,
+                  int stall_warning_sec, int stall_shutdown_sec, int autotune,
+                  int autotune_warmup, int autotune_steps, int log_level,
+                  const char* timeline_path, const char* coord_addr,
+                  int coord_port, const char* autotune_log, char* err,
+                  int errlen) {
+  CoreConfig cfg;
+  cfg.rank = rank;
+  cfg.size = size;
+  cfg.local_rank = local_rank;
+  cfg.local_size = local_size;
+  cfg.cross_rank = cross_rank;
+  cfg.cross_size = cross_size;
+  cfg.cycle_time_ms = cycle_time_ms;
+  cfg.fusion_threshold = fusion_threshold;
+  cfg.cache_capacity = cache_capacity;
+  cfg.stall_warning_sec = stall_warning_sec;
+  cfg.stall_shutdown_sec = stall_shutdown_sec;
+  cfg.autotune = autotune;
+  cfg.autotune_warmup_samples = autotune_warmup;
+  cfg.autotune_steps_per_sample = autotune_steps;
+  cfg.log_level = log_level;
+  if (timeline_path) {
+    std::snprintf(cfg.timeline_path, sizeof(cfg.timeline_path), "%s",
+                  timeline_path);
+  }
+  if (coord_addr) {
+    std::snprintf(cfg.coord_addr, sizeof(cfg.coord_addr), "%s", coord_addr);
+  }
+  cfg.coord_port = coord_port;
+  if (autotune_log) {
+    std::snprintf(cfg.autotune_log, sizeof(cfg.autotune_log), "%s",
+                  autotune_log);
+  }
+  Status s = Core::Get().Init(cfg);
+  if (!s.ok()) {
+    FillErr(err, errlen, s.reason);
+    return -static_cast<int>(s.code);
+  }
+  return 0;
+}
+
+void hvd_core_shutdown() { Core::Get().Shutdown(); }
+
+int hvd_core_initialized() { return Core::Get().initialized() ? 1 : 0; }
+int hvd_core_rank() { return Core::Get().config().rank; }
+int hvd_core_size() { return Core::Get().config().size; }
+int hvd_core_local_rank() { return Core::Get().config().local_rank; }
+int hvd_core_local_size() { return Core::Get().config().local_size; }
+int hvd_core_cross_rank() { return Core::Get().config().cross_rank; }
+int hvd_core_cross_size() { return Core::Get().config().cross_size; }
+
+long long hvd_core_enqueue(int request_type, const char* name, int dtype,
+                           const long long* shape, int ndim, int root_rank,
+                           int reduce_op, double prescale, double postscale,
+                           char* err, int errlen) {
+  Request req;
+  req.rank = Core::Get().config().rank;
+  req.type = static_cast<hvd::RequestType>(request_type);
+  req.dtype = static_cast<hvd::DataType>(dtype);
+  req.root_rank = root_rank;
+  req.reduce_op = reduce_op;
+  req.prescale = prescale;
+  req.postscale = postscale;
+  req.name = name ? name : "";
+  for (int i = 0; i < ndim; ++i) req.shape.push_back(shape[i]);
+  uint64_t ticket = 0;
+  Status s = Core::Get().Enqueue(req, &ticket);
+  if (!s.ok()) {
+    FillErr(err, errlen, s.reason);
+    return -static_cast<long long>(s.code);
+  }
+  return static_cast<long long>(ticket);
+}
+
+long long hvd_core_enqueue_join(char* err, int errlen) {
+  uint64_t ticket = 0;
+  Status s = Core::Get().EnqueueJoin(&ticket);
+  if (!s.ok()) {
+    FillErr(err, errlen, s.reason);
+    return -static_cast<long long>(s.code);
+  }
+  return static_cast<long long>(ticket);
+}
+
+// Returns: >0 = JSON length written, 0 = timeout, -1 = shutdown,
+// -2 = buffer too small.
+int hvd_core_next_plan(char* buf, int buflen, int timeout_ms) {
+  Plan p;
+  int r = Core::Get().NextPlan(&p, timeout_ms);
+  if (r <= 0) return r;
+  std::string json = PlanToJson(p);
+  if (static_cast<int>(json.size()) + 1 > buflen) {
+    // Report failure back so tickets do not hang.
+    Core::Get().PlanDone(p.id, static_cast<int>(hvd::StatusCode::kUnknownError),
+                         "plan buffer too small", 0.0, 0);
+    return -2;
+  }
+  std::memcpy(buf, json.data(), json.size() + 1);
+  return static_cast<int>(json.size());
+}
+
+void hvd_core_plan_done(unsigned long long plan_id, int status,
+                        const char* error, double duration_s,
+                        long long bytes) {
+  Core::Get().PlanDone(plan_id, status, error ? error : "", duration_s, bytes);
+}
+
+// 0 = in-progress, 1 = complete-ok, <0 = -StatusCode (error text in err).
+int hvd_core_ticket_status(unsigned long long ticket, char* err, int errlen) {
+  std::string msg;
+  int r = Core::Get().TicketStatus(ticket, &msg);
+  if (r == static_cast<int>(hvd::StatusCode::kInProgress)) return 0;
+  if (r < 0) FillErr(err, errlen, msg);
+  return r;
+}
+
+double hvd_core_cycle_time_ms() { return Core::Get().cycle_time_ms(); }
+long long hvd_core_fusion_threshold() {
+  return Core::Get().fusion_threshold();
+}
+
+void hvd_core_timeline_activity(const char* tensor, const char* activity,
+                                int begin) {
+  if (!tensor || !activity) return;
+  if (begin) {
+    Core::Get().timeline().Begin(tensor, activity);
+  } else {
+    Core::Get().timeline().End(tensor, activity);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,799 @@
+// Core runtime implementation: logging, timeline, response cache, stall
+// inspector, and the background cycle loop with coordinator negotiation and
+// fusion (role parity with horovod/common/{operations,controller}.cc,
+// re-designed for a metadata-only control plane over an XLA data plane).
+#include "hvd/core.h"
+
+#include <algorithm>
+#include <cinttypes>
+#include <cstdarg>
+#include <cstring>
+#include <sstream>
+
+#include "hvd/message.h"
+
+namespace hvd {
+
+double NowSec() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch()).count();
+}
+
+// ------------------------------------------------------------ logging
+namespace {
+std::atomic<int> g_log_level{2};
+std::atomic<int> g_log_rank{0};
+}  // namespace
+
+void LogSetLevel(int level) { g_log_level = level; }
+void LogSetRank(int rank) { g_log_rank = rank; }
+
+void Log(LogLevel level, const std::string& msg) {
+  if (static_cast<int>(level) < g_log_level.load()) return;
+  static const char* names[] = {"TRACE", "DEBUG", "INFO", "WARN", "ERROR",
+                                "FATAL"};
+  std::fprintf(stderr, "[%s] [hvd rank %d] %s\n",
+               names[static_cast<int>(level)], g_log_rank.load(), msg.c_str());
+}
+
+// ------------------------------------------------------------ timeline
+void Timeline::Initialize(const std::string& path, int rank) {
+  if (initialized_.load() || path.empty()) return;
+  file_ = std::fopen(path.c_str(), "w");
+  if (!file_) {
+    HVD_LOG(kWarn, "timeline: cannot open " + path);
+    return;
+  }
+  rank_ = rank;
+  start_ = NowSec();
+  std::fputs("[\n", file_);
+  first_event_ = true;
+  stop_ = false;
+  writer_ = std::thread(&Timeline::WriterLoop, this);
+  initialized_ = true;
+  char buf[256];
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
+                "\"args\":{\"name\":\"rank %d\"}}",
+                rank_, rank_);
+  Emit(buf);
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_.load()) return;
+  {
+    std::lock_guard<std::mutex> l(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  std::fputs("\n]\n", file_);
+  std::fclose(file_);
+  file_ = nullptr;
+  initialized_ = false;
+}
+
+double Timeline::NowUs() { return (NowSec() - start_) * 1e6; }
+
+int Timeline::Tid(const std::string& tensor) {
+  auto it = tids_.find(tensor);
+  if (it != tids_.end()) return it->second;
+  int tid = next_tid_++;
+  tids_[tensor] = tid;
+  std::ostringstream os;
+  os << "{\"name\":\"thread_name\",\"ph\":\"M\",\"pid\":" << rank_
+     << ",\"tid\":" << tid << ",\"args\":{\"name\":\"" << tensor << "\"}}";
+  queue_.push_back(os.str());
+  return tid;
+}
+
+void Timeline::Emit(const std::string& json) {
+  std::lock_guard<std::mutex> l(mu_);
+  queue_.push_back(json);
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::unique_lock<std::mutex> l(mu_);
+  while (!stop_ || !queue_.empty()) {
+    if (queue_.empty()) {
+      cv_.wait_for(l, std::chrono::milliseconds(50));
+      continue;
+    }
+    std::string ev = std::move(queue_.front());
+    queue_.pop_front();
+    l.unlock();
+    if (!first_event_) std::fputs(",\n", file_);
+    first_event_ = false;
+    std::fputs(ev.c_str(), file_);
+    l.lock();
+    if (queue_.empty()) std::fflush(file_);
+  }
+}
+
+namespace {
+std::string DurEvent(const char* ph, int pid, int tid, double ts,
+                     const std::string& name) {
+  std::ostringstream os;
+  os << "{\"name\":\"" << name << "\",\"ph\":\"" << ph << "\",\"pid\":" << pid
+     << ",\"tid\":" << tid << ",\"ts\":" << ts << "}";
+  return os.str();
+}
+}  // namespace
+
+void Timeline::NegotiateStart(const std::string& tensor,
+                              const std::string& op) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  int tid = Tid(tensor);
+  queue_.push_back(DurEvent("B", rank_, tid, NowUs(), "NEGOTIATE_" + op));
+  cv_.notify_one();
+}
+
+void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  int tid = Tid(tensor);
+  std::ostringstream os;
+  os << "{\"name\":\"" << rank << "\",\"ph\":\"i\",\"s\":\"t\",\"pid\":"
+     << rank_ << ",\"tid\":" << tid << ",\"ts\":" << NowUs() << "}";
+  queue_.push_back(os.str());
+  cv_.notify_one();
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor, const std::string& op) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  int tid = Tid(tensor);
+  queue_.push_back(DurEvent("E", rank_, tid, NowUs(), "NEGOTIATE_" + op));
+  cv_.notify_one();
+}
+
+void Timeline::Begin(const std::string& tensor, const std::string& activity) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  int tid = Tid(tensor);
+  queue_.push_back(DurEvent("B", rank_, tid, NowUs(), activity));
+  cv_.notify_one();
+}
+
+void Timeline::End(const std::string& tensor, const std::string& activity) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  int tid = Tid(tensor);
+  queue_.push_back(DurEvent("E", rank_, tid, NowUs(), activity));
+  cv_.notify_one();
+}
+
+void Timeline::MarkCycle() {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  std::ostringstream os;
+  os << "{\"name\":\"CYCLE\",\"ph\":\"i\",\"s\":\"g\",\"pid\":" << rank_
+     << ",\"tid\":0,\"ts\":" << NowUs() << "}";
+  queue_.push_back(os.str());
+  cv_.notify_one();
+}
+
+// ------------------------------------------------------------ cache
+std::string ResponseCache::Key(const Request& r) {
+  std::ostringstream os;
+  os << r.name << '|' << static_cast<int>(r.type) << '|'
+     << static_cast<int>(r.dtype) << '|' << r.root_rank << '|' << r.reduce_op
+     << '|' << r.prescale << '|' << r.postscale << '|';
+  for (auto d : r.shape) os << d << ',';
+  return os.str();
+}
+
+int32_t ResponseCache::Lookup(const Request& r) const {
+  std::lock_guard<std::mutex> l(mu_);
+  auto it = index_.find(Key(r));
+  return it == index_.end() ? -1 : it->second;
+}
+
+void ResponseCache::Put(const Request& r, const Response& resp) {
+  if (capacity_ == 0) return;
+  std::lock_guard<std::mutex> l(mu_);
+  std::string key = Key(r);
+  auto it = index_.find(key);
+  if (it != index_.end()) {
+    entries_[it->second].response = resp;
+    entries_[it->second].last_used = ++tick_;
+    return;
+  }
+  int32_t bit;
+  if (!free_bits_.empty()) {
+    bit = free_bits_.back();
+    free_bits_.pop_back();
+  } else if (entries_.size() < capacity_) {
+    bit = static_cast<int32_t>(entries_.size());
+    entries_.emplace_back();
+  } else {
+    // Deterministic LRU eviction: last_used is only advanced by Put, which
+    // runs in coordinator-dispatch order — identical on every rank — so
+    // all ranks evict the same bit (the reference syncs evictions
+    // explicitly; determinism-by-construction avoids that round).
+    bit = 0;
+    uint64_t oldest = UINT64_MAX;
+    for (size_t i = 0; i < entries_.size(); ++i) {
+      if (entries_[i].last_used < oldest) {
+        oldest = entries_[i].last_used;
+        bit = static_cast<int32_t>(i);
+      }
+    }
+    index_.erase(entries_[bit].key);
+  }
+  entries_[bit] = Entry{key, resp, ++tick_};
+  index_[key] = bit;
+}
+
+bool ResponseCache::Get(int32_t bit, Response* out) const {
+  std::lock_guard<std::mutex> l(mu_);
+  if (bit < 0 || static_cast<size_t>(bit) >= entries_.size()) return false;
+  if (entries_[bit].key.empty()) return false;
+  *out = entries_[bit].response;
+  return true;
+}
+
+void ResponseCache::Invalidate(const std::string& name) {
+  std::lock_guard<std::mutex> l(mu_);
+  for (size_t i = 0; i < entries_.size(); ++i) {
+    auto& e = entries_[i];
+    if (!e.key.empty() && e.key.compare(0, name.size() + 1, name + "|") == 0) {
+      index_.erase(e.key);
+      e = Entry{};
+      free_bits_.push_back(static_cast<int32_t>(i));
+    }
+  }
+}
+
+// ------------------------------------------------------------ stall
+void StallInspector::Record(const std::string& name, int rank) {
+  std::lock_guard<std::mutex> l(mu_);
+  auto& info = pending_[name];
+  if (info.ranks.empty()) info.first_seen = NowSec();
+  info.ranks.insert(rank);
+}
+
+void StallInspector::Clear(const std::string& name) {
+  std::lock_guard<std::mutex> l(mu_);
+  pending_.erase(name);
+}
+
+bool StallInspector::Check(int size) {
+  if (warn_sec_ <= 0) return false;
+  std::lock_guard<std::mutex> l(mu_);
+  double now = NowSec();
+  bool shutdown = false;
+  std::vector<std::string> stalled;
+  for (auto& [name, info] : pending_) {
+    double waited = now - info.first_seen;
+    if (waited > warn_sec_ && !info.warned &&
+        static_cast<int>(info.ranks.size()) < size) {
+      stalled.push_back(name);
+      info.warned = true;
+    }
+    if (shutdown_sec_ > 0 && waited > shutdown_sec_ &&
+        static_cast<int>(info.ranks.size()) < size) {
+      shutdown = true;
+    }
+  }
+  if (!stalled.empty()) {
+    std::ostringstream os;
+    os << "One or more tensors were submitted to be reduced, gathered or "
+          "broadcasted by subset of ranks and are waiting for remainder of "
+          "ranks for more than "
+       << warn_sec_ << " seconds. Stalled ops:";
+    for (auto& s : stalled) os << ' ' << s;
+    HVD_LOG(kWarn, os.str());
+  }
+  return shutdown;
+}
+
+// ------------------------------------------------------------ core
+namespace {
+const char* ActivityName(ResponseType t) {
+  switch (t) {
+    case ResponseType::kAllreduce: return "XLA_ALLREDUCE";
+    case ResponseType::kAllgather: return "XLA_ALLGATHER";
+    case ResponseType::kBroadcast: return "XLA_BROADCAST";
+    case ResponseType::kJoin: return "JOIN";
+    case ResponseType::kAlltoall: return "XLA_ALLTOALL";
+    case ResponseType::kReducescatter: return "XLA_REDUCESCATTER";
+    case ResponseType::kAdasum: return "XLA_ADASUM";
+    case ResponseType::kError: return "ERROR";
+  }
+  return "EXEC";
+}
+}  // namespace
+
+Core& Core::Get() {
+  static Core* core = new Core();
+  return *core;
+}
+
+Status Core::Init(const CoreConfig& cfg) {
+  if (initialized_.load()) return Status::OK();
+  cfg_ = cfg;
+  LogSetLevel(cfg.log_level);
+  LogSetRank(cfg.rank);
+  cache_.SetCapacity(cfg.cache_capacity);
+  stall_.Configure(cfg.stall_warning_sec, cfg.stall_shutdown_sec);
+  params_.Initialize(cfg.cycle_time_ms, cfg.fusion_threshold,
+                     cfg.autotune_warmup_samples, cfg.autotune_steps_per_sample,
+                     cfg.autotune_log[0] ? cfg.autotune_log : "");
+  params_.SetEnabled(cfg.autotune != 0 && cfg.rank == 0);
+  if (cfg.timeline_path[0]) timeline_.Initialize(cfg.timeline_path, cfg.rank);
+  if (cfg.size > 1) {
+    if (!cfg.coord_addr[0] || cfg.coord_port == 0) {
+      return Status::Error(StatusCode::kInvalidArgument,
+                           "multi-rank core requires coord_addr/coord_port");
+    }
+    transport_ = NewTcpTransport();
+    Status s = transport_->Init(cfg);
+    if (!s.ok()) {
+      delete transport_;
+      transport_ = nullptr;
+      return s;
+    }
+  }
+  shutdown_ = false;
+  joined_ = false;
+  thread_ = std::thread(&Core::BackgroundLoop, this);
+  initialized_ = true;
+  HVD_LOG(kDebug, "core initialized");
+  return Status::OK();
+}
+
+void Core::Shutdown() {
+  if (!initialized_.load()) return;
+  shutdown_ = true;
+  {
+    std::lock_guard<std::mutex> l(table_mu_);
+    wake_ = true;
+  }
+  wake_cv_.notify_all();
+  if (thread_.joinable()) thread_.join();
+  FailAll(Status::Error(StatusCode::kAborted, "Horovod has been shut down."));
+  plan_cv_.notify_all();
+  if (transport_) {
+    transport_->Close();
+    delete transport_;
+    transport_ = nullptr;
+  }
+  timeline_.Shutdown();
+  // Reset state so a subsequent Init starts clean (tests re-init).
+  {
+    std::lock_guard<std::mutex> l(plan_mu_);
+    plans_.clear();
+    inflight_.clear();
+  }
+  negotiating_.clear();
+  joined_ranks_.clear();
+  initialized_ = false;
+}
+
+Status Core::Enqueue(const Request& req, uint64_t* ticket) {
+  if (!initialized_.load() || shutdown_.load()) {
+    return Status::Error(StatusCode::kAborted, "core is not running");
+  }
+  std::lock_guard<std::mutex> l(table_mu_);
+  if (table_.count(req.name)) {
+    return Status::Error(
+        StatusCode::kPreconditionError,
+        "Requested to process a tensor with the same name as another tensor "
+        "that is currently being processed: " + req.name);
+  }
+  uint64_t t;
+  {
+    std::lock_guard<std::mutex> tl(ticket_mu_);
+    t = next_ticket_++;
+    tickets_[t] = {static_cast<int>(StatusCode::kInProgress), ""};
+  }
+  table_[req.name] = Pending{req, t};
+  queued_.push_back(req);
+  // No wake: coordination happens on the cycle cadence (reference
+  // RunLoopOnce sleeps cycle_time between rounds), which batches
+  // concurrent submissions into one negotiation round.
+  *ticket = t;
+  return Status::OK();
+}
+
+Status Core::EnqueueJoin(uint64_t* ticket) {
+  Request req;
+  req.rank = cfg_.rank;
+  req.type = RequestType::kJoin;
+  req.name = "join." + std::to_string(cfg_.rank);
+  std::lock_guard<std::mutex> l(table_mu_);
+  if (joined_) {
+    return Status::Error(StatusCode::kPreconditionError, "already joined");
+  }
+  joined_ = true;
+  uint64_t t;
+  {
+    std::lock_guard<std::mutex> tl(ticket_mu_);
+    t = next_ticket_++;
+    tickets_[t] = {static_cast<int>(StatusCode::kInProgress), ""};
+  }
+  join_ticket_ = t;
+  queued_.push_back(req);
+  *ticket = t;
+  return Status::OK();
+}
+
+int Core::NextPlan(Plan* out, int timeout_ms) {
+  std::unique_lock<std::mutex> l(plan_mu_);
+  if (!plan_cv_.wait_for(l, std::chrono::milliseconds(timeout_ms),
+                         [&] { return !plans_.empty() || shutdown_.load(); })) {
+    return 0;
+  }
+  if (!plans_.empty()) {
+    *out = std::move(plans_.front());
+    plans_.pop_front();
+    return 1;
+  }
+  return shutdown_.load() ? -1 : 0;
+}
+
+void Core::PlanDone(uint64_t plan_id, int status_code, const std::string& error,
+                    double duration_s, int64_t bytes) {
+  Response resp;
+  std::vector<uint64_t> plan_tickets;
+  {
+    std::lock_guard<std::mutex> l(plan_mu_);
+    auto it = inflight_.find(plan_id);
+    if (it == inflight_.end()) return;
+    resp = std::move(it->second.response);
+    plan_tickets = std::move(it->second.tickets);
+    inflight_.erase(it);
+  }
+  for (const auto& name : resp.names) {
+    timeline_.End(name, ActivityName(resp.type));
+    stall_.Clear(name);
+  }
+  // Feed the autotuner with observed data-plane throughput.
+  if (status_code == 0 && resp.type != ResponseType::kJoin) {
+    params_.Update(bytes > 0 ? bytes : resp.total_bytes, duration_s);
+  }
+  // Resolve the tickets captured at dispatch time.
+  std::lock_guard<std::mutex> tl(ticket_mu_);
+  for (uint64_t t : plan_tickets) {
+    tickets_[t] = {status_code, error};
+  }
+  if (resp.type == ResponseType::kJoin && join_ticket_ != 0) {
+    tickets_[join_ticket_] = {status_code, error};
+    join_ticket_ = 0;
+  }
+  ticket_cv_.notify_all();
+}
+
+int Core::TicketStatus(uint64_t ticket, std::string* error) {
+  std::lock_guard<std::mutex> l(ticket_mu_);
+  auto it = tickets_.find(ticket);
+  // Unknown => already consumed by a prior status query: report complete.
+  if (it == tickets_.end()) return 1;
+  if (it->second.first == static_cast<int>(StatusCode::kInProgress)) {
+    return static_cast<int>(StatusCode::kInProgress);
+  }
+  int code = it->second.first;
+  if (error) *error = it->second.second;
+  tickets_.erase(it);
+  return code == 0 ? 1 : -code;  // 1 = done-ok, negative = error code
+}
+
+void Core::FailAll(const Status& s) {
+  std::vector<uint64_t> to_fail;
+  {
+    std::lock_guard<std::mutex> l(table_mu_);
+    for (auto& [name, p] : table_) to_fail.push_back(p.ticket);
+    table_.clear();
+    queued_.clear();
+    if (join_ticket_ != 0) to_fail.push_back(join_ticket_);
+    join_ticket_ = 0;
+  }
+  std::lock_guard<std::mutex> tl(ticket_mu_);
+  for (auto t : to_fail) {
+    tickets_[t] = {static_cast<int>(s.code), s.reason};
+  }
+  ticket_cv_.notify_all();
+}
+
+void Core::BackgroundLoop() {
+  while (!shutdown_.load()) {
+    double cycle_s = params_.cycle_time_ms() / 1000.0;
+    {
+      std::unique_lock<std::mutex> l(table_mu_);
+      wake_cv_.wait_for(
+          l, std::chrono::duration<double>(cycle_s),
+          [&] { return wake_ || shutdown_.load(); });
+      wake_ = false;
+    }
+    if (shutdown_.load()) break;
+    RunCycleOnce();
+  }
+  // Propagate shutdown to peers once (send a shutdown RequestList).
+  if (transport_) {
+    RequestList mine;
+    mine.shutdown = true;
+    if (cfg_.rank == 0) {
+      ResponseList rl;
+      rl.shutdown = true;
+      transport_->Broadcast(rl);
+    } else {
+      ResponseList ignored;
+      transport_->Exchange(mine, &ignored);
+    }
+  }
+}
+
+void Core::RunCycleOnce() {
+  timeline_.MarkCycle();
+  RequestList mine;
+  {
+    std::lock_guard<std::mutex> l(table_mu_);
+    mine.requests = std::move(queued_);
+    queued_.clear();
+  }
+  for (auto& r : mine.requests) {
+    if (r.type != RequestType::kJoin) {
+      timeline_.Begin(r.name, "QUEUE");
+    }
+  }
+
+  ResponseList verdict;
+  if (cfg_.size == 1) {
+    std::vector<RequestList> lists(1);
+    lists[0] = std::move(mine);
+    verdict = Coordinate(lists);
+  } else if (cfg_.rank == 0) {
+    std::vector<RequestList> lists;
+    Status s = transport_->Gather(mine, &lists);
+    if (!s.ok()) {
+      HVD_LOG(kError, "control gather failed: " + s.reason);
+      shutdown_ = true;
+      return;
+    }
+    verdict = Coordinate(lists);
+    s = transport_->Broadcast(verdict);
+    if (!s.ok()) {
+      HVD_LOG(kError, "control broadcast failed: " + s.reason);
+      shutdown_ = true;
+      return;
+    }
+  } else {
+    Status s = transport_->Exchange(mine, &verdict);
+    if (!s.ok()) {
+      HVD_LOG(kError, "control exchange failed: " + s.reason);
+      shutdown_ = true;
+      return;
+    }
+    if (verdict.cycle_time_ms > 0 || verdict.fusion_threshold > 0) {
+      params_.Initialize(
+          verdict.cycle_time_ms > 0 ? verdict.cycle_time_ms
+                                    : params_.cycle_time_ms(),
+          verdict.fusion_threshold > 0 ? verdict.fusion_threshold
+                                       : params_.fusion_threshold(),
+          0, 0, "");
+    }
+  }
+  if (verdict.shutdown) {
+    HVD_LOG(kInfo, "shutdown requested by a peer rank");
+    shutdown_ = true;
+    FailAll(Status::Error(StatusCode::kAborted,
+                          "Horovod has been shut down. This was caused by an "
+                          "exception on one of the ranks or an attempt to use "
+                          "a collective after one of the ranks finished."));
+    return;
+  }
+  DispatchResponses(verdict);
+}
+
+namespace {
+const char* TypeName(RequestType t) {
+  switch (t) {
+    case RequestType::kAllreduce: return "ALLREDUCE";
+    case RequestType::kAllgather: return "ALLGATHER";
+    case RequestType::kBroadcast: return "BROADCAST";
+    case RequestType::kJoin: return "JOIN";
+    case RequestType::kAlltoall: return "ALLTOALL";
+    case RequestType::kReducescatter: return "REDUCESCATTER";
+    case RequestType::kAdasum: return "ADASUM";
+  }
+  return "OP";
+}
+}  // namespace
+
+ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
+  ResponseList out;
+  std::vector<Request> ready;
+  for (auto& rl : lists) {
+    if (rl.shutdown) out.shutdown = true;
+    for (auto& req : rl.requests) {
+      if (req.type == RequestType::kJoin) {
+        joined_ranks_.insert(req.rank);
+        continue;
+      }
+      auto it = negotiating_.find(req.name);
+      if (it == negotiating_.end()) {
+        timeline_.NegotiateStart(req.name, TypeName(req.type));
+        auto& neg = negotiating_[req.name];
+        neg.request = req;
+        neg.ranks.insert(req.rank);
+        stall_.Record(req.name, req.rank);
+      } else {
+        auto& neg = it->second;
+        // Validation — reference ConstructResponse semantics: dtype, op
+        // type, shape (exact for allreduce/broadcast, non-0 dims for
+        // allgather), root consistency.
+        const Request& first = neg.request;
+        if (req.type != first.type) {
+          neg.error = true;
+          neg.error_msg = "Mismatched collective operations for tensor " +
+                          req.name;
+        } else if (req.dtype != first.dtype) {
+          neg.error = true;
+          neg.error_msg = "Mismatched data types for tensor " + req.name;
+        } else if (req.type == RequestType::kBroadcast &&
+                   req.root_rank != first.root_rank) {
+          neg.error = true;
+          neg.error_msg = "Mismatched root ranks for broadcast " + req.name;
+        } else if (req.type == RequestType::kAllgather) {
+          if (req.shape.size() != first.shape.size()) {
+            neg.error = true;
+            neg.error_msg = "Mismatched ranks for allgather " + req.name;
+          } else {
+            for (size_t d = 1; d < req.shape.size(); ++d) {
+              if (req.shape[d] != first.shape[d]) {
+                neg.error = true;
+                neg.error_msg =
+                    "Mismatched non-first dimensions for allgather " +
+                    req.name;
+              }
+            }
+          }
+        } else if (req.shape != first.shape) {
+          neg.error = true;
+          neg.error_msg = "Mismatched shapes for tensor " + req.name;
+        }
+        neg.ranks.insert(req.rank);
+        stall_.Record(req.name, req.rank);
+      }
+      timeline_.NegotiateRankReady(req.name, req.rank);
+    }
+  }
+
+  // A tensor is ready when announced by all non-joined ranks (reference:
+  // count == size - joined_size).
+  int needed = cfg_.size - static_cast<int>(joined_ranks_.size());
+  std::vector<std::string> done;
+  for (auto& [name, neg] : negotiating_) {
+    if (static_cast<int>(neg.ranks.size()) >= needed) {
+      done.push_back(name);
+    }
+  }
+  // Keep deterministic dispatch order across ranks: sort by name (the map
+  // is ordered already, but be explicit).
+  std::sort(done.begin(), done.end());
+  for (auto& name : done) {
+    auto& neg = negotiating_[name];
+    timeline_.NegotiateEnd(name, TypeName(neg.request.type));
+    if (neg.error) {
+      Response r;
+      r.type = ResponseType::kError;
+      r.names = {name};
+      r.error = neg.error_msg;
+      out.responses.push_back(std::move(r));
+    } else {
+      ready.push_back(neg.request);
+      if (neg.request.type == RequestType::kAllgather) {
+        // Collect per-rank dim0 (ordered by rank) for displacement math.
+        // With Join active, missing ranks contribute 0 rows.
+        // (Stored via negotiating_ below in FuseAndEmit.)
+      }
+    }
+    stall_.Clear(name);
+  }
+
+  FuseAndEmit(ready, &out);
+  for (auto& name : done) negotiating_.erase(name);
+
+  // All ranks joined => emit the JOIN barrier completion and reset.
+  if (!joined_ranks_.empty() &&
+      static_cast<int>(joined_ranks_.size()) >= cfg_.size) {
+    Response r;
+    r.type = ResponseType::kJoin;
+    out.responses.push_back(std::move(r));
+    joined_ranks_.clear();
+  }
+
+  if (stall_.Check(cfg_.size)) {
+    HVD_LOG(kError, "stall shutdown threshold exceeded; aborting");
+    out.shutdown = true;
+  }
+
+  // Autotuned knob sync (rank 0 -> workers).
+  if (params_.enabled()) {
+    out.cycle_time_ms = params_.cycle_time_ms();
+    out.fusion_threshold = params_.fusion_threshold();
+  }
+  return out;
+}
+
+void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
+  // Greedy same-signature fusion with lookahead (reference FuseResponses):
+  // allreduce/adasum responses pack up to the fusion threshold.
+  int64_t threshold = params_.fusion_threshold();
+  std::vector<bool> used(ready.size(), false);
+  int participants = cfg_.size - static_cast<int>(joined_ranks_.size());
+  for (size_t i = 0; i < ready.size(); ++i) {
+    if (used[i]) continue;
+    const Request& base = ready[i];
+    Response r;
+    r.type = static_cast<ResponseType>(static_cast<uint8_t>(base.type));
+    r.dtype = base.dtype;
+    r.root_rank = base.root_rank;
+    r.reduce_op = base.reduce_op;
+    r.prescale = base.prescale;
+    r.postscale = base.postscale;
+    r.participants = participants;
+    r.names.push_back(base.name);
+    r.entry_shapes.push_back(base.shape);
+    r.total_bytes = base.ByteSize();
+    used[i] = true;
+    bool fusable = base.type == RequestType::kAllreduce ||
+                   base.type == RequestType::kAdasum;
+    if (fusable) {
+      for (size_t j = i + 1; j < ready.size(); ++j) {
+        if (used[j]) continue;
+        const Request& cand = ready[j];
+        if (cand.type != base.type || cand.dtype != base.dtype ||
+            cand.reduce_op != base.reduce_op ||
+            cand.prescale != base.prescale ||
+            cand.postscale != base.postscale) {
+          continue;
+        }
+        if (r.total_bytes + cand.ByteSize() > threshold) continue;
+        r.names.push_back(cand.name);
+        r.entry_shapes.push_back(cand.shape);
+        r.total_bytes += cand.ByteSize();
+        used[j] = true;
+      }
+    }
+    out->responses.push_back(std::move(r));
+  }
+}
+
+void Core::DispatchResponses(const ResponseList& rl) {
+  for (const auto& resp : rl.responses) {
+    // Remove entries from the local table; names this rank never submitted
+    // (Join zero-substitution) stay absent — the executor fabricates zeros
+    // from entry_shapes.
+    std::vector<uint64_t> plan_tickets;
+    {
+      std::lock_guard<std::mutex> l(table_mu_);
+      for (const auto& name : resp.names) {
+        auto it = table_.find(name);
+        if (it != table_.end()) {
+          plan_tickets.push_back(it->second.ticket);
+          table_.erase(it);
+        }
+        // Absent => Join zero-substitution (this rank never submitted).
+      }
+      if (resp.type == ResponseType::kJoin) joined_ = false;
+    }
+    for (const auto& name : resp.names) {
+      timeline_.Begin(name, ActivityName(resp.type));
+    }
+    Plan p;
+    {
+      std::lock_guard<std::mutex> l(plan_mu_);
+      p.id = next_plan_id_++;
+      p.response = resp;
+      inflight_[p.id] = Inflight{resp, std::move(plan_tickets)};
+      plans_.push_back(std::move(p));
+    }
+    plan_cv_.notify_one();
+  }
+}
+
+}  // namespace hvd

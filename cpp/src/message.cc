@@ -1,0 +1,142 @@
+#include "hvd/message.h"
+
+namespace hvd {
+namespace wire {
+
+void EncodeRequest(Writer& w, const Request& r) {
+  w.I32(r.rank);
+  w.U8(static_cast<uint8_t>(r.type));
+  w.U8(static_cast<uint8_t>(r.dtype));
+  w.I32(r.root_rank);
+  w.I32(r.reduce_op);
+  w.F64(r.prescale);
+  w.F64(r.postscale);
+  w.Str(r.name);
+  w.U32(static_cast<uint32_t>(r.shape.size()));
+  for (auto d : r.shape) w.I64(d);
+}
+
+bool DecodeRequest(Reader& rd, Request* out) {
+  out->rank = rd.I32();
+  out->type = static_cast<RequestType>(rd.U8());
+  out->dtype = static_cast<DataType>(rd.U8());
+  out->root_rank = rd.I32();
+  out->reduce_op = rd.I32();
+  out->prescale = rd.F64();
+  out->postscale = rd.F64();
+  out->name = rd.Str();
+  uint32_t ndim = rd.U32();
+  if (ndim > 256) return false;
+  out->shape.clear();
+  for (uint32_t i = 0; i < ndim; ++i) out->shape.push_back(rd.I64());
+  return rd.ok();
+}
+
+std::vector<uint8_t> EncodeRequestList(const RequestList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.Bytes(rl.cache_bits);
+  w.U32(static_cast<uint32_t>(rl.requests.size()));
+  for (const auto& r : rl.requests) EncodeRequest(w, r);
+  return std::move(w.buf);
+}
+
+bool DecodeRequestList(const uint8_t* p, size_t n, RequestList* out) {
+  Reader rd(p, n);
+  out->shutdown = rd.U8() != 0;
+  out->cache_bits = rd.Bytes();
+  uint32_t count = rd.U32();
+  if (count > 1u << 20) return false;
+  out->requests.clear();
+  out->requests.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    Request r;
+    if (!DecodeRequest(rd, &r)) return false;
+    out->requests.push_back(std::move(r));
+  }
+  return rd.ok();
+}
+
+void EncodeResponse(Writer& w, const Response& r) {
+  w.U8(static_cast<uint8_t>(r.type));
+  w.U8(static_cast<uint8_t>(r.dtype));
+  w.I32(r.root_rank);
+  w.I32(r.reduce_op);
+  w.F64(r.prescale);
+  w.F64(r.postscale);
+  w.I64(r.total_bytes);
+  w.I32(r.participants);
+  w.Str(r.error);
+  w.U32(static_cast<uint32_t>(r.names.size()));
+  for (const auto& s : r.names) w.Str(s);
+  w.U32(static_cast<uint32_t>(r.entry_shapes.size()));
+  for (const auto& shape : r.entry_shapes) {
+    w.U32(static_cast<uint32_t>(shape.size()));
+    for (auto d : shape) w.I64(d);
+  }
+  w.U32(static_cast<uint32_t>(r.rank_sizes.size()));
+  for (auto s : r.rank_sizes) w.I64(s);
+}
+
+bool DecodeResponse(Reader& rd, Response* out) {
+  out->type = static_cast<ResponseType>(rd.U8());
+  out->dtype = static_cast<DataType>(rd.U8());
+  out->root_rank = rd.I32();
+  out->reduce_op = rd.I32();
+  out->prescale = rd.F64();
+  out->postscale = rd.F64();
+  out->total_bytes = rd.I64();
+  out->participants = rd.I32();
+  out->error = rd.Str();
+  uint32_t n = rd.U32();
+  if (n > 1u << 20) return false;
+  out->names.clear();
+  out->names.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) out->names.push_back(rd.Str());
+  uint32_t nshapes = rd.U32();
+  if (nshapes > 1u << 20) return false;
+  out->entry_shapes.clear();
+  out->entry_shapes.reserve(nshapes);
+  for (uint32_t i = 0; i < nshapes; ++i) {
+    uint32_t ndim = rd.U32();
+    if (ndim > 256) return false;
+    std::vector<int64_t> shape;
+    for (uint32_t j = 0; j < ndim; ++j) shape.push_back(rd.I64());
+    out->entry_shapes.push_back(std::move(shape));
+  }
+  uint32_t nsizes = rd.U32();
+  if (nsizes > 1u << 20) return false;
+  out->rank_sizes.clear();
+  for (uint32_t i = 0; i < nsizes; ++i) out->rank_sizes.push_back(rd.I64());
+  return rd.ok();
+}
+
+std::vector<uint8_t> EncodeResponseList(const ResponseList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.F64(rl.cycle_time_ms);
+  w.I64(rl.fusion_threshold);
+  w.U32(static_cast<uint32_t>(rl.responses.size()));
+  for (const auto& r : rl.responses) EncodeResponse(w, r);
+  return std::move(w.buf);
+}
+
+bool DecodeResponseList(const uint8_t* p, size_t n, ResponseList* out) {
+  Reader rd(p, n);
+  out->shutdown = rd.U8() != 0;
+  out->cycle_time_ms = rd.F64();
+  out->fusion_threshold = rd.I64();
+  uint32_t count = rd.U32();
+  if (count > 1u << 20) return false;
+  out->responses.clear();
+  out->responses.reserve(count);
+  for (uint32_t i = 0; i < count; ++i) {
+    Response r;
+    if (!DecodeResponse(rd, &r)) return false;
+    out->responses.push_back(std::move(r));
+  }
+  return rd.ok();
+}
+
+}  // namespace wire
+}  // namespace hvd

@@ -1,0 +1,217 @@
+// TCP control-plane transport: rank 0 coordinates; workers hold one
+// persistent connection each. Role parity with the reference's
+// Gloo-over-TCP controller (gather RequestLists to rank 0, broadcast the
+// ResponseList), with length-prefixed frames of the hvd::wire codec.
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "hvd/core.h"
+#include "hvd/message.h"
+
+namespace hvd {
+
+namespace {
+
+Status Errno(const std::string& what) {
+  return Status::Error(StatusCode::kUnknownError,
+                       what + ": " + std::strerror(errno));
+}
+
+Status SendAll(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return Errno("send");
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return Status::OK();
+}
+
+Status RecvAll(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r == 0) {
+      return Status::Error(StatusCode::kAborted, "peer closed connection");
+    }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return Errno("recv");
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return Status::OK();
+}
+
+Status SendFrame(int fd, const std::vector<uint8_t>& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  Status s = SendAll(fd, &len, 4);
+  if (!s.ok()) return s;
+  return SendAll(fd, payload.data(), payload.size());
+}
+
+Status RecvFrame(int fd, std::vector<uint8_t>* payload) {
+  uint32_t len = 0;
+  Status s = RecvAll(fd, &len, 4);
+  if (!s.ok()) return s;
+  if (len > (256u << 20)) {
+    return Status::Error(StatusCode::kUnknownError, "oversized control frame");
+  }
+  payload->resize(len);
+  return RecvAll(fd, payload->data(), len);
+}
+
+class TcpTransport : public ControlTransport {
+ public:
+  Status Init(const CoreConfig& cfg) override {
+    rank_ = cfg.rank;
+    size_ = cfg.size;
+    if (rank_ == 0) return InitServer(cfg);
+    return InitClient(cfg);
+  }
+
+  Status Gather(const RequestList& mine,
+                std::vector<RequestList>* all) override {
+    all->assign(size_, RequestList{});
+    (*all)[0] = mine;
+    for (int r = 1; r < size_; ++r) {
+      std::vector<uint8_t> frame;
+      Status s = RecvFrame(fds_[r], &frame);
+      if (!s.ok()) return s;
+      if (!wire::DecodeRequestList(frame.data(), frame.size(), &(*all)[r])) {
+        return Status::Error(StatusCode::kUnknownError,
+                             "bad RequestList from rank " + std::to_string(r));
+      }
+    }
+    return Status::OK();
+  }
+
+  Status Broadcast(const ResponseList& rl) override {
+    std::vector<uint8_t> frame = wire::EncodeResponseList(rl);
+    for (int r = 1; r < size_; ++r) {
+      Status s = SendFrame(fds_[r], frame);
+      if (!s.ok()) return s;
+    }
+    return Status::OK();
+  }
+
+  Status Exchange(const RequestList& mine, ResponseList* out) override {
+    Status s = SendFrame(fd0_, wire::EncodeRequestList(mine));
+    if (!s.ok()) return s;
+    std::vector<uint8_t> frame;
+    s = RecvFrame(fd0_, &frame);
+    if (!s.ok()) return s;
+    if (!wire::DecodeResponseList(frame.data(), frame.size(), out)) {
+      return Status::Error(StatusCode::kUnknownError, "bad ResponseList");
+    }
+    return Status::OK();
+  }
+
+  void Close() override {
+    for (int fd : fds_) {
+      if (fd >= 0) ::close(fd);
+    }
+    fds_.clear();
+    if (fd0_ >= 0) ::close(fd0_);
+    fd0_ = -1;
+    if (listen_fd_ >= 0) ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+
+  ~TcpTransport() override { Close(); }
+
+ private:
+  Status InitServer(const CoreConfig& cfg) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return Errno("socket");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(cfg.coord_port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      return Errno("bind");
+    }
+    if (::listen(listen_fd_, size_) < 0) return Errno("listen");
+    fds_.assign(size_, -1);
+    for (int i = 1; i < size_; ++i) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return Errno("accept");
+      int one2 = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one2, sizeof(one2));
+      int32_t peer_rank = -1;
+      Status s = RecvAll(fd, &peer_rank, 4);
+      if (!s.ok()) return s;
+      if (peer_rank < 1 || peer_rank >= size_ || fds_[peer_rank] != -1) {
+        return Status::Error(StatusCode::kUnknownError,
+                             "bad peer rank " + std::to_string(peer_rank));
+      }
+      fds_[peer_rank] = fd;
+    }
+    return Status::OK();
+  }
+
+  Status InitClient(const CoreConfig& cfg) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port = std::to_string(cfg.coord_port);
+    if (::getaddrinfo(cfg.coord_addr, port.c_str(), &hints, &res) != 0) {
+      return Status::Error(StatusCode::kUnknownError,
+                           std::string("getaddrinfo failed for ") +
+                               cfg.coord_addr);
+    }
+    Status last = Status::OK();
+    // Retry for up to ~60 s: rank 0 may still be starting.
+    for (int attempt = 0; attempt < 600; ++attempt) {
+      fd0_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd0_ < 0) {
+        last = Errno("socket");
+        break;
+      }
+      if (::connect(fd0_, res->ai_addr, res->ai_addrlen) == 0) {
+        int one = 1;
+        ::setsockopt(fd0_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        int32_t my_rank = rank_;
+        last = SendAll(fd0_, &my_rank, 4);
+        ::freeaddrinfo(res);
+        return last;
+      }
+      last = Errno("connect");
+      ::close(fd0_);
+      fd0_ = -1;
+      ::usleep(100000);
+    }
+    ::freeaddrinfo(res);
+    return last;
+  }
+
+  int rank_ = 0;
+  int size_ = 1;
+  int listen_fd_ = -1;
+  int fd0_ = -1;              // worker -> rank0 connection
+  std::vector<int> fds_;      // rank0: connection per worker rank
+};
+
+}  // namespace
+
+ControlTransport* NewTcpTransport() { return new TcpTransport(); }
+
+}  // namespace hvd

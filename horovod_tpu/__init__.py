@@ -56,6 +56,25 @@ def init(config: Optional[Config] = None) -> None:
             return
         cfg = config or Config.from_env()
         topo = _topology_mod.detect()
+        import os as _os
+
+        kind = _os.environ.get("HOROVOD_TPU_CORE", "native").lower()
+        if kind == "native":
+            try:
+                from .core.native_runtime import NativeRuntime
+
+                _runtime = NativeRuntime(cfg, topo)
+                return
+            except NotImplementedError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - build/load failure
+                import logging
+
+                logging.getLogger("horovod_tpu").warning(
+                    "native core unavailable (%s); using the pure-Python "
+                    "runtime",
+                    exc,
+                )
         _runtime = Runtime(cfg, topo)
         _runtime.start()
 

@@ -1,0 +1,324 @@
+"""Runtime backed by the native control-plane core (cpp/libhvd_core.so).
+
+Division of labor (TPU-native re-design of the reference architecture):
+the C++ core owns the background cycle loop, cross-rank negotiation,
+fusion planning, response cache, stall detection, timeline, and autotune —
+everything the reference keeps in ``horovod/common/*.cc``. Tensor payloads
+never cross the ABI: Python keeps the arrays, receives fused execution
+Plans, runs them on the XLA data plane, and reports completion (which feeds
+the core's autotuner and timeline).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..common.basics import NativeCore, _CoreError
+from ..common.env import Config
+from ..common.topology import Topology
+from ..common.types import (
+    DataType,
+    ReduceOp,
+    RequestType,
+    Status,
+    StatusType,
+    TensorTableEntry,
+    dtype_from_array,
+    dtype_name,
+)
+
+logger = logging.getLogger("horovod_tpu")
+
+_PLAN_ERROR = 7  # ResponseType::kError
+_PLAN_JOIN = 3
+
+
+class PlanExecutor:
+    """Executes one fused plan's entries; returns {name: output}."""
+
+    def execute(self, plan: dict, entries, topo: Topology) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class LocalPlanExecutor(PlanExecutor):
+    """size=1 executor: collectives are (scaled) identities."""
+
+    def execute(self, plan: dict, entries, topo: Topology) -> Dict[str, Any]:
+        outputs: Dict[str, Any] = {}
+        participants = max(int(plan.get("participants", 1)), 1)
+        for entry in entries:
+            t = entry.tensor
+            if plan["type"] in (0, 6):  # allreduce / adasum
+                factor = entry.prescale_factor * entry.postscale_factor
+                if entry.reduce_op == ReduceOp.AVERAGE:
+                    factor /= participants
+                outputs[entry.name] = t if factor == 1.0 else t * factor
+            else:
+                outputs[entry.name] = t
+        return outputs
+
+
+class NativeRuntime:
+    """Drop-in replacement for core.runtime.Runtime, backed by the C++
+    core. Same producer API; the executor thread replaces the Python
+    background loop."""
+
+    def __init__(
+        self,
+        config: Config,
+        topology: Topology,
+        executor: Optional[PlanExecutor] = None,
+        coord_addr: str = "",
+        coord_port: int = 0,
+    ):
+        self.config = config
+        self.topology = topology
+        if executor is None:
+            if topology.size > 1:
+                raise NotImplementedError(
+                    f"Eager mode for size={topology.size} requires a "
+                    "multi-process plan executor (launcher-provided); use "
+                    "the compiled mode (horovod_tpu.jax) or run "
+                    "single-process."
+                )
+            executor = LocalPlanExecutor()
+        self.executor = executor
+        self.core = NativeCore()
+        self.core.init(config, topology, coord_addr, coord_port)
+        # Per-name FIFO: a name may be legally re-enqueued while its
+        # predecessor's plan is still executing; the core dispatches plans
+        # in acceptance order, so popleft matches plan order.
+        self._entries: Dict[str, "deque[TensorTableEntry]"] = {}
+        self._entries_lock = threading.Lock()
+        self._outputs: Dict[str, 'deque'] = {}  # name -> FIFO of outputs
+        self._ticket_names: Dict[int, str] = {}
+        self._done: Dict[int, tuple] = {}
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._executor_loop, name="hvd_plan_executor", daemon=True
+        )
+        self._thread.start()
+
+    # --- lifecycle ---
+    def start(self) -> None:  # parity with python Runtime
+        pass
+
+    @property
+    def running(self) -> bool:
+        return not self._stop.is_set() and self.core.initialized()
+
+    def shutdown(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self.core.shutdown()
+        self._thread.join(timeout=30.0)
+        with self._cv:
+            for t, name in list(self._ticket_names.items()):
+                if t not in self._done:
+                    self._done[t] = (
+                        Status.Aborted("Horovod has been shut down."),
+                        None,
+                    )
+            self._cv.notify_all()
+
+    # --- enqueue API ---
+    def _enqueue(
+        self,
+        request_type: RequestType,
+        name: str,
+        tensor: Any,
+        *,
+        root_rank: int = -1,
+        reduce_op: ReduceOp = ReduceOp.SUM,
+        prescale_factor: float = 1.0,
+        postscale_factor: float = 1.0,
+        callback: Optional[Callable] = None,
+    ) -> int:
+        if not self.running:
+            raise RuntimeError(
+                "Horovod runtime is shut down or was never initialized; "
+                "call hvd.init() first."
+            )
+        entry = TensorTableEntry(
+            name=name,
+            tensor=tensor,
+            root_rank=root_rank,
+            callback=callback,
+            reduce_op=reduce_op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+        with self._entries_lock:
+            self._entries.setdefault(name, deque()).append(entry)
+        dtype = int(dtype_from_array(tensor)) if tensor is not None else 0
+        shape = [int(d) for d in getattr(tensor, "shape", ())]
+        try:
+            ticket = self.core.enqueue(
+                int(request_type), name, dtype, shape, root_rank,
+                int(reduce_op), prescale_factor, postscale_factor,
+            )
+        except _CoreError as e:
+            with self._entries_lock:
+                q = self._entries.get(name)
+                if q:
+                    q.remove(entry)
+                    if not q:
+                        del self._entries[name]
+            # Surface as a failed handle, like the reference's callback
+            # error path.
+            with self._cv:
+                fake = -int(time.monotonic_ns() % (1 << 62)) - 1
+                self._done[fake] = (
+                    Status(StatusType(e.code if 0 < e.code <= 5 else 1), str(e)),
+                    None,
+                )
+                return fake
+        with self._cv:
+            self._ticket_names[ticket] = name
+        return ticket
+
+    def enqueue_allreduce(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.ALLREDUCE, name, tensor, **kw)
+
+    def enqueue_adasum(self, name, tensor, **kw) -> int:
+        kw.setdefault("reduce_op", ReduceOp.ADASUM)
+        return self._enqueue(RequestType.ADASUM, name, tensor, **kw)
+
+    def enqueue_allgather(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.ALLGATHER, name, tensor, **kw)
+
+    def enqueue_broadcast(self, name, tensor, root_rank, **kw) -> int:
+        return self._enqueue(
+            RequestType.BROADCAST, name, tensor, root_rank=root_rank, **kw
+        )
+
+    def enqueue_alltoall(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.ALLTOALL, name, tensor, **kw)
+
+    def enqueue_join(self) -> int:
+        if not self.running:
+            raise RuntimeError("Horovod runtime is shut down.")
+        return self.core.enqueue_join()
+
+    # --- executor loop ---
+    def _executor_loop(self) -> None:
+        while not self._stop.is_set():
+            plan = self.core.next_plan(timeout_ms=100)
+            if plan == -1:
+                break
+            if plan in (0, -2):
+                continue
+            self._execute_plan(plan)
+        # drain: nothing further; core fails outstanding tickets itself.
+
+    def _execute_plan(self, plan: dict) -> None:
+        t0 = time.perf_counter()
+        names = plan.get("names", [])
+        shapes = plan.get("shapes", [])
+        entries = []
+        for i, name in enumerate(names):
+            with self._entries_lock:
+                q = self._entries.get(name)
+                entry = q.popleft() if q else None
+                if q is not None and not q:
+                    del self._entries[name]
+            if entry is None:
+                # Join zero-substitution: fabricate a zero tensor of the
+                # coordinator-validated shape (reference joined-rank
+                # behavior).
+                shape = tuple(shapes[i]) if i < len(shapes) else ()
+                np_dtype = dtype_name(DataType(plan["dtype"]))
+                entry = TensorTableEntry(
+                    name=name,
+                    tensor=np.zeros(shape, dtype=np_dtype),
+                    reduce_op=ReduceOp(plan["op"]) if plan.get("op") else ReduceOp.SUM,
+                    prescale_factor=plan.get("prescale", 1.0),
+                    postscale_factor=plan.get("postscale", 1.0),
+                )
+            entries.append(entry)
+
+        status_code = 0
+        error = ""
+        outputs: Dict[str, Any] = {}
+        if plan["type"] == _PLAN_ERROR:
+            status_code = int(StatusType.PRECONDITION_ERROR)
+            error = plan.get("error", "coordinator reported an error")
+        elif plan["type"] == _PLAN_JOIN:
+            pass
+        else:
+            try:
+                outputs = self.executor.execute(plan, entries, self.topology)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("plan execution failed")
+                status_code = int(StatusType.UNKNOWN_ERROR)
+                error = str(exc)
+        duration = time.perf_counter() - t0
+        status = (
+            Status.OK()
+            if status_code == 0
+            else Status(StatusType(status_code), error)
+        )
+        for entry in entries:
+            out = outputs.get(entry.name)
+            if entry.callback is not None:
+                try:
+                    entry.callback(status, out)
+                except Exception:  # noqa: BLE001
+                    logger.exception("callback for %s raised", entry.name)
+            if status.ok():
+                with self._cv:
+                    self._outputs.setdefault(entry.name, deque()).append(out)
+        self.core.plan_done(
+            int(plan["id"]), status_code, error, duration,
+            int(plan.get("total_bytes", 0)),
+        )
+        with self._cv:
+            self._cv.notify_all()
+
+    # --- sync helpers ---
+    def poll(self, handle: int) -> bool:
+        with self._cv:
+            if handle in self._done:
+                return True
+        state, err = self.core.ticket_status(handle)
+        if state == 0:
+            return False
+        with self._cv:
+            name = self._ticket_names.pop(handle, None)
+            if state == 1:
+                out = None
+                q = self._outputs.get(name) if name else None
+                if q:
+                    out = q.popleft()
+                    if not q:
+                        del self._outputs[name]
+                self._done[handle] = (Status.OK(), out)
+            else:
+                code = -state
+                self._done[handle] = (
+                    Status(StatusType(code if 0 < code <= 5 else 1), err),
+                    None,
+                )
+        return True
+
+    def synchronize(self, handle: int, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.poll(handle):
+                with self._cv:
+                    status, out = self._done.pop(handle)
+                if not status.ok():
+                    raise RuntimeError(status.reason)
+                return out
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("Horovod operation timed out")
+            with self._cv:
+                self._cv.wait(timeout=0.01)

@@ -1,0 +1,168 @@
+"""Direct tests of the native control-plane core (cpp/libhvd_core.so)
+through the C ABI: plan emission, fusion grouping, ticket lifecycle,
+duplicate rejection, autotune movement.
+"""
+
+import time
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import NativeCore, _CoreError
+from horovod_tpu.common.env import Config
+from horovod_tpu.common.topology import Topology
+
+
+SINGLE = Topology(rank=0, size=1, local_rank=0, local_size=1,
+                  cross_rank=0, cross_size=1)
+
+
+@pytest.fixture()
+def core():
+    hvd.shutdown()  # the C++ core is a per-process singleton
+    c = NativeCore()
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    c.init(cfg, SINGLE)
+    yield c
+    c.shutdown()
+
+
+def _drain_plans(core, max_plans=10, timeout_ms=500):
+    plans = []
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    while time.monotonic() < deadline and len(plans) < max_plans:
+        p = core.next_plan(timeout_ms=50)
+        if isinstance(p, dict):
+            plans.append(p)
+            core.plan_done(p["id"], 0, "", 0.001, int(p.get("total_bytes", 0)))
+        elif p == -1:
+            break
+    return plans
+
+
+def test_fusion_groups_same_dtype(core):
+    # 3 small f32 allreduces + 1 i32: expect 2 plans (f32 fused, i32 alone).
+    for i in range(3):
+        core.enqueue(0, f"t{i}", 7, [4, 4], -1, 2, 1.0, 1.0)
+    core.enqueue(0, "t_int", 4, [8], -1, 2, 1.0, 1.0)
+    plans = _drain_plans(core, max_plans=4)
+    by_names = {tuple(sorted(p["names"])): p for p in plans}
+    assert ("t0", "t1", "t2") in by_names, plans
+    assert ("t_int",) in by_names, plans
+    fused = by_names[("t0", "t1", "t2")]
+    assert fused["total_bytes"] == 3 * 16 * 4
+    assert fused["shapes"] == [[4, 4], [4, 4], [4, 4]]
+
+
+def test_fusion_respects_threshold():
+    hvd.shutdown()
+    c = NativeCore()
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.fusion_threshold_bytes = 100  # tiny: 2 x 16-float tensors don't fit
+    c.init(cfg, SINGLE)
+    try:
+        c.enqueue(0, "a", 7, [16], -1, 2, 1.0, 1.0)
+        c.enqueue(0, "b", 7, [16], -1, 2, 1.0, 1.0)
+        plans = _drain_plans(c, max_plans=2)
+        assert len(plans) == 2
+        assert all(len(p["names"]) == 1 for p in plans)
+    finally:
+        c.shutdown()
+
+
+def test_ticket_lifecycle(core):
+    t = core.enqueue(0, "x", 7, [2], -1, 2, 1.0, 1.0)
+    assert t > 0
+    state, _ = core.ticket_status(t)
+    # complete the plan
+    plans = _drain_plans(core, max_plans=1)
+    assert plans
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline:
+        state, err = core.ticket_status(t)
+        if state != 0:
+            break
+        time.sleep(0.005)
+    assert state == 1, (state, err)
+
+
+def test_ticket_error_propagates(core):
+    t = core.enqueue(0, "bad", 7, [2], -1, 2, 1.0, 1.0)
+    p = None
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not isinstance(p, dict):
+        p = core.next_plan(timeout_ms=50)
+    assert isinstance(p, dict)
+    core.plan_done(p["id"], 1, "boom", 0.0, 0)
+    deadline = time.monotonic() + 2
+    state = 0
+    while time.monotonic() < deadline:
+        state, err = core.ticket_status(t)
+        if state != 0:
+            break
+        time.sleep(0.005)
+    assert state < 0
+    assert "boom" in err
+
+
+def test_duplicate_name_rejected_at_core(core):
+    core.enqueue(0, "dup", 7, [2], -1, 2, 1.0, 1.0)
+    with pytest.raises(_CoreError):
+        core.enqueue(0, "dup", 7, [2], -1, 2, 1.0, 1.0)
+    _drain_plans(core, max_plans=1)
+
+
+def test_broadcast_not_fused(core):
+    core.enqueue(2, "b0", 7, [4], 0, 2, 1.0, 1.0)
+    core.enqueue(2, "b1", 7, [4], 0, 2, 1.0, 1.0)
+    plans = _drain_plans(core, max_plans=2)
+    assert len(plans) == 2
+    assert all(p["type"] == 2 and p["root"] == 0 for p in plans)
+
+
+def test_autotune_moves_params():
+    hvd.shutdown()
+    c = NativeCore()
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.autotune = True
+    cfg.autotune_warmup_samples = 0
+    cfg.autotune_steps_per_sample = 1
+    c.init(cfg, SINGLE)
+    try:
+        initial = (c.cycle_time_ms(), c.fusion_threshold())
+        changed = False
+        for i in range(40):
+            c.enqueue(0, f"at{i}", 7, [1024], -1, 2, 1.0, 1.0)
+            deadline = time.monotonic() + 2
+            p = None
+            while time.monotonic() < deadline and not isinstance(p, dict):
+                p = c.next_plan(timeout_ms=50)
+            assert isinstance(p, dict)
+            c.plan_done(p["id"], 0, "", 0.001, 4096)
+            if (c.cycle_time_ms(), c.fusion_threshold()) != initial:
+                changed = True
+                break
+        assert changed, "autotuner never proposed new parameters"
+    finally:
+        c.shutdown()
+
+
+def test_join_plan_roundtrip(core):
+    t = core.enqueue_join()
+    p = None
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not isinstance(p, dict):
+        p = core.next_plan(timeout_ms=50)
+    assert isinstance(p, dict) and p["type"] == 3
+    core.plan_done(p["id"], 0, "", 0.0, 0)
+    deadline = time.monotonic() + 2
+    state = 0
+    while time.monotonic() < deadline:
+        state, _ = core.ticket_status(t)
+        if state != 0:
+            break
+        time.sleep(0.005)
+    assert state == 1
