@@ -59,11 +59,42 @@ def init(config: Optional[Config] = None) -> None:
         import os as _os
 
         kind = _os.environ.get("HOROVOD_TPU_CORE", "native").lower()
+        executor = None
+        coord_addr = ""
+        coord_port = 0
+        if topo.size > 1:
+            coord_addr = _os.environ.get("HOROVOD_CONTROLLER_ADDR", "")
+            coord_port = int(_os.environ.get("HOROVOD_CONTROLLER_PORT", "0"))
+            jax_coord = _os.environ.get("HOROVOD_JAX_COORDINATOR", "")
+            if not coord_addr or not coord_port:
+                raise HorovodInternalError(
+                    f"size={topo.size} but HOROVOD_CONTROLLER_ADDR/PORT are "
+                    "not set — launch multi-rank jobs with hvdrun "
+                    "(python -m horovod_tpu.run)."
+                )
+            import jax as _jax
+
+            if jax_coord:
+                # Must run before any backend use; tolerate re-init.
+                try:
+                    _jax.distributed.initialize(
+                        jax_coord, num_processes=topo.size,
+                        process_id=topo.rank,
+                    )
+                except RuntimeError as exc:
+                    if "already" not in str(exc).lower():
+                        raise
+            from .core.xla_executor import XlaPlanExecutor
+
+            executor = XlaPlanExecutor(topo)
         if kind == "native":
             try:
                 from .core.native_runtime import NativeRuntime
 
-                _runtime = NativeRuntime(cfg, topo)
+                _runtime = NativeRuntime(
+                    cfg, topo, executor=executor,
+                    coord_addr=coord_addr, coord_port=coord_port,
+                )
                 return
             except NotImplementedError:
                 raise

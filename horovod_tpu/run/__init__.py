@@ -1,0 +1,1 @@
+from .run import run, run_commandline, parse_args, check_build  # noqa: F401

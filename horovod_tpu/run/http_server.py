@@ -1,0 +1,111 @@
+"""HTTP key-value rendezvous server.
+
+Role parity with the reference's ``run/http/http_server.py``
+(RendezvousHTTPServer / KVStoreServer): a scoped KV store over HTTP GET/PUT
+used by workers to exchange addresses and small blobs at startup, and by the
+``horovod_tpu.run.run()`` API to ship pickled functions/results.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlparse
+from urllib.request import Request, urlopen
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def do_GET(self):  # noqa: N802
+        key = urlparse(self.path).path
+        with self.server.kv_lock:
+            value = self.server.kv.get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):  # noqa: N802
+        key = urlparse(self.path).path
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.kv_lock:
+            self.server.kv[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):  # noqa: N802
+        key = urlparse(self.path).path
+        with self.server.kv_lock:
+            self.server.kv.pop(key, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVStoreServer:
+    """In-process threaded HTTP KV server."""
+
+    def __init__(self, port: int = 0):
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._server.kv = {}
+        self._server.kv_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="hvd_kv_server", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._server.server_close()
+
+
+class KVStoreClient:
+    def __init__(self, addr: str, port: int):
+        self._base = f"http://{addr}:{port}"
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        req = Request(
+            f"{self._base}/{scope}/{key}", data=value, method="PUT"
+        )
+        urlopen(req, timeout=30).read()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        try:
+            return urlopen(
+                f"{self._base}/{scope}/{key}", timeout=30
+            ).read()
+        except Exception:
+            return None
+
+    def wait(self, scope: str, key: str, timeout: float = 60.0) -> bytes:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            time.sleep(0.1)
+        raise TimeoutError(f"KV key {scope}/{key} not available")

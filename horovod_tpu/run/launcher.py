@@ -1,0 +1,256 @@
+"""Worker allocation and process fan-out.
+
+Role parity with the reference's Gloo launcher (``run/gloo_run.py``): slot
+allocation over hosts → SlotInfo{rank, local_rank, cross_rank, ...}; spawn
+each rank (locally or over ssh) with the full ``HOROVOD_*`` env; kill the
+remaining ranks when one fails; forward SIGINT/SIGTERM.
+
+TPU-native additions: every rank also receives the JAX distributed
+coordinator address (``HOROVOD_JAX_COORDINATOR``) so the eager data plane
+can stand up the global device mesh, and ``--tpu-pod`` mode derives the
+allocation from TPU slice metadata env (one process per host) instead of
+``-H`` host lists.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import safe_shell_exec
+
+LOCAL_HOST_NAMES = ("localhost", "127.0.0.1")
+
+
+@dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+
+
+def parse_hosts(hosts: str) -> List[Tuple[str, int]]:
+    """Parse ``host1:4,host2:4`` (reference ``-H`` format)."""
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            name, slots = part.rsplit(":", 1)
+            out.append((name, int(slots)))
+        else:
+            out.append((part, 1))
+    return out
+
+
+def parse_hostfile(path: str) -> List[Tuple[str, int]]:
+    """Parse hostfile lines ``hostname slots=N`` (reference format)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            slots = 1
+            for fld in fields[1:]:
+                if fld.startswith("slots="):
+                    slots = int(fld.split("=", 1)[1])
+            out.append((fields[0], slots))
+    return out
+
+
+def allocate(hosts: Sequence[Tuple[str, int]], np_: int) -> List[SlotInfo]:
+    """Fill hosts in order (reference _allocate): ranks get consecutive
+    local_ranks per host; cross_rank = index of the host among hosts that
+    have a worker at that local_rank."""
+    slots: List[Tuple[str, int]] = []  # (host, local_rank)
+    host_counts: Dict[str, int] = {}
+    for host, capacity in hosts:
+        for _ in range(capacity):
+            if len(slots) >= np_:
+                break
+            slots.append((host, host_counts.get(host, 0)))
+            host_counts[host] = host_counts.get(host, 0) + 1
+    if len(slots) < np_:
+        total = sum(c for _, c in hosts)
+        raise ValueError(
+            f"Requested {np_} processes but hosts supply only {total} slots"
+        )
+    local_sizes: Dict[str, int] = {}
+    for host, _ in slots:
+        local_sizes[host] = local_sizes.get(host, 0) + 1
+    # cross structure: ranks with the same local_rank across hosts
+    cross_groups: Dict[int, List[int]] = {}
+    infos: List[SlotInfo] = []
+    for rank, (host, local_rank) in enumerate(slots):
+        cross_groups.setdefault(local_rank, []).append(rank)
+    for rank, (host, local_rank) in enumerate(slots):
+        group = cross_groups[local_rank]
+        infos.append(
+            SlotInfo(
+                hostname=host,
+                rank=rank,
+                size=np_,
+                local_rank=local_rank,
+                local_size=local_sizes[host],
+                cross_rank=group.index(rank),
+                cross_size=len(group),
+            )
+        )
+    return infos
+
+
+def tpu_pod_allocation() -> Optional[List[SlotInfo]]:
+    """Derive allocation from TPU slice metadata env (one process per host):
+    TPU_WORKER_HOSTNAMES + TPU_WORKER_ID, as set by TPU VM runtimes. This
+    replaces ssh/MPI rendezvous on pods (BASELINE north star)."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if not hostnames:
+        return None
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    n = len(hosts)
+    return [
+        SlotInfo(hostname=h, rank=i, size=n, local_rank=0, local_size=1,
+                 cross_rank=i, cross_size=n)
+        for i, h in enumerate(hosts)
+    ]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _is_local(host: str) -> bool:
+    return host in LOCAL_HOST_NAMES or host == socket.gethostname()
+
+
+def build_rank_env(
+    slot: SlotInfo,
+    base_env: Dict[str, str],
+    controller_addr: str,
+    controller_port: int,
+    jax_coordinator: str,
+) -> Dict[str, str]:
+    env = dict(base_env)
+    env.update(
+        {
+            "HOROVOD_RANK": str(slot.rank),
+            "HOROVOD_SIZE": str(slot.size),
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+            "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+            "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+            "HOROVOD_CONTROLLER_ADDR": controller_addr,
+            "HOROVOD_CONTROLLER_PORT": str(controller_port),
+            "HOROVOD_JAX_COORDINATOR": jax_coordinator,
+        }
+    )
+    return env
+
+
+def launch_job(
+    command: List[str],
+    slots: List[SlotInfo],
+    env: Optional[Dict[str, str]] = None,
+    ssh_port: Optional[int] = None,
+    output_dir: Optional[str] = None,
+    verbose: bool = False,
+) -> int:
+    """Spawn every rank; return the first nonzero exit code (0 if all ok).
+    On any failure the remaining ranks are terminated (reference gloo_run
+    fan-out kill)."""
+    base_env = dict(env if env is not None else os.environ)
+    controller_addr = (
+        slots[0].hostname if not _is_local(slots[0].hostname) else "127.0.0.1"
+    )
+    controller_port = _free_port()
+    jax_coordinator = f"{controller_addr}:{_free_port()}"
+
+    procs: List[Tuple[SlotInfo, safe_shell_exec.ManagedProcess]] = []
+    outfiles = []
+    for slot in slots:
+        rank_env = build_rank_env(
+            slot, base_env, controller_addr, controller_port, jax_coordinator
+        )
+        if _is_local(slot.hostname):
+            cmd = command
+        else:
+            # ssh fan-out (reference get_remote_command): env must be
+            # inlined since ssh doesn't forward it.
+            env_str = " ".join(
+                f"{k}={_shquote(v)}"
+                for k, v in rank_env.items()
+                if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH",
+                                 "PYTHONPATH", "LD_LIBRARY"))
+            )
+            port_arg = f"-p {ssh_port}" if ssh_port else ""
+            cmd = [
+                "ssh", "-o", "StrictHostKeyChecking=no",
+                *( ["-p", str(ssh_port)] if ssh_port else [] ),
+                slot.hostname,
+                f"cd {_shquote(os.getcwd())} > /dev/null 2>&1 ; "
+                f"{env_str} {' '.join(_shquote(c) for c in command)}",
+            ]
+        stdout = stderr = None
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
+            stdout = open(os.path.join(output_dir, f"rank.{slot.rank}.out"), "wb")
+            stderr = open(os.path.join(output_dir, f"rank.{slot.rank}.err"), "wb")
+            outfiles += [stdout, stderr]
+        if verbose:
+            print(f"[hvdrun] rank {slot.rank} on {slot.hostname}: {cmd}")
+        procs.append(
+            (slot, safe_shell_exec.ManagedProcess(cmd, env=rank_env,
+                                                  stdout=stdout, stderr=stderr))
+        )
+
+    exit_code = 0
+    try:
+        done = set()
+        while len(done) < len(procs):
+            for slot, mp in procs:
+                if slot.rank in done:
+                    continue
+                rc = mp.poll()
+                if rc is not None:
+                    done.add(slot.rank)
+                    if rc != 0 and exit_code == 0:
+                        exit_code = rc
+                        print(
+                            f"[hvdrun] rank {slot.rank} failed with exit code "
+                            f"{rc}; terminating remaining ranks",
+                            file=sys.stderr,
+                        )
+                        for s2, m2 in procs:
+                            if s2.rank not in done:
+                                m2.terminate()
+            time.sleep(0.05)
+    except KeyboardInterrupt:
+        for _, mp in procs:
+            mp.terminate()
+        exit_code = 130
+    finally:
+        for f in outfiles:
+            f.close()
+    return exit_code
+
+
+def _shquote(s: str) -> str:
+    import shlex
+
+    return shlex.quote(s)

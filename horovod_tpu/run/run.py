@@ -1,0 +1,217 @@
+"""``hvdrun`` — the launcher CLI.
+
+Role parity with the reference ``horovodrun`` (``run/run.py``): ``-np``,
+``-H``/``--hostfile``, every runtime knob as a flag, YAML ``--config-file``
+with CLI-override precedence, ``--check-build``, and a ``run()`` Python API
+that ships a pickled function to every rank and gathers results.
+
+TPU-native: no MPI path — ranks are spawned directly (local/ssh) or derived
+from TPU pod metadata (``--tpu-pod``); the control plane is the native
+core's TCP coordinator and the data plane is XLA.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Any, Callable, List, Optional
+
+from . import config_parser, launcher
+
+
+def parse_args(argv: Optional[List[str]] = None):
+    parser = argparse.ArgumentParser(
+        "hvdrun", description="Launch a horovod_tpu training job."
+    )
+    parser.add_argument("-v", "--version", action="store_true")
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
+                        help="Total number of training processes.")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help='Host list, e.g. "host1:4,host2:4".')
+    parser.add_argument("--hostfile", default=None,
+                        help='Hostfile with lines "hostname slots=N".')
+    parser.add_argument("--tpu-pod", action="store_true",
+                        help="Derive allocation from TPU slice metadata "
+                             "(one process per pod host).")
+    parser.add_argument("-p", "--ssh-port", type=int, default=None)
+    parser.add_argument("--output-dir", default=None,
+                        help="Write per-rank stdout/stderr files here.")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("--check-build", action="store_true",
+                        help="Print build capabilities and exit.")
+    parser.add_argument("--config-file", default=None)
+    # runtime knobs (reference flag set)
+    parser.add_argument("--fusion-threshold-mb", type=int, default=None)
+    parser.add_argument("--cycle-time-ms", type=float, default=None)
+    parser.add_argument("--cache-capacity", type=int, default=None)
+    parser.add_argument("--hierarchical-allreduce", action="store_true",
+                        default=None)
+    parser.add_argument("--hierarchical-allgather", action="store_true",
+                        default=None)
+    parser.add_argument("--autotune", action="store_true", default=None)
+    parser.add_argument("--autotune-log-file", default=None)
+    parser.add_argument("--autotune-warmup-samples", type=int, default=None)
+    parser.add_argument("--autotune-steps-per-sample", type=int, default=None)
+    parser.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                        default=None)
+    parser.add_argument("--autotune-gaussian-process-noise", type=float,
+                        default=None)
+    parser.add_argument("--timeline-filename", default=None)
+    parser.add_argument("--timeline-mark-cycles", action="store_true",
+                        default=None)
+    parser.add_argument("--stall-check-disable", action="store_true",
+                        default=None)
+    parser.add_argument("--stall-check-time-seconds", type=float, default=None)
+    parser.add_argument("--stall-shutdown-time-seconds", type=float,
+                        default=None)
+    parser.add_argument("--log-level", default=None,
+                        choices=["trace", "debug", "info", "warning", "error"])
+    parser.add_argument("--mesh-axes", default=None,
+                        help='Compiled-mode mesh spec, e.g. "data:4,model:2".')
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="Training command to run on every rank.")
+    args = parser.parse_args(argv)
+
+    if args.config_file:
+        # CLI flags explicitly provided take precedence over YAML
+        # (reference override-tracking): anything non-None was set by CLI.
+        overridden = {
+            k for k, v in vars(args).items()
+            if v is not None and k in config_parser.ARG_TO_ENV
+        }
+        config_parser.parse_config_file(args.config_file, args, overridden)
+    return args
+
+
+def check_build() -> str:
+    from .. import __version__
+
+    lines = [
+        f"horovod_tpu v{__version__}:",
+        "",
+        "Available Frameworks:",
+        "    [X] JAX",
+        "    [{}] TensorFlow".format("X" if _importable("tensorflow") else " "),
+        "    [{}] PyTorch".format("X" if _importable("torch") else " "),
+        "    [{}] MXNet".format("X" if _importable("mxnet") else " "),
+        "",
+        "Available Controllers:",
+        "    [X] XLA/TCP (native core)",
+        "    [ ] MPI",
+        "    [ ] Gloo",
+        "",
+        "Available Tensor Operations:",
+        "    [X] XLA (psum / all_gather / ppermute over ICI+DCN)",
+        "    [ ] NCCL",
+        "    [ ] DDL",
+        "    [ ] MLSL",
+        "    [ ] MPI",
+        "    [ ] Gloo",
+    ]
+    return "\n".join(lines)
+
+
+def _importable(mod: str) -> bool:
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec(mod) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        from .. import __version__
+
+        print(__version__)
+        return 0
+    if args.check_build:
+        print(check_build())
+        return 0
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("hvdrun: no training command given", file=sys.stderr)
+        return 2
+
+    if args.tpu_pod:
+        slots = launcher.tpu_pod_allocation()
+        if slots is None:
+            print("hvdrun: --tpu-pod set but TPU_WORKER_HOSTNAMES is empty",
+                  file=sys.stderr)
+            return 2
+    else:
+        if args.num_proc is None:
+            print("hvdrun: -np is required", file=sys.stderr)
+            return 2
+        if args.hostfile:
+            hosts = launcher.parse_hostfile(args.hostfile)
+        elif args.hosts:
+            hosts = launcher.parse_hosts(args.hosts)
+        else:
+            hosts = [("localhost", args.num_proc)]
+        slots = launcher.allocate(hosts, args.num_proc)
+
+    env = dict(os.environ)
+    config_parser.set_env_from_args(env, args)
+    return launcher.launch_job(
+        command,
+        slots,
+        env=env,
+        ssh_port=args.ssh_port,
+        output_dir=args.output_dir,
+        verbose=args.verbose,
+    )
+
+
+# ---------------------------------------------------------------- run() API
+def run(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    np: int = 1,
+    hosts: Optional[str] = None,
+    env: Optional[dict] = None,
+    verbose: bool = False,
+) -> List[Any]:
+    """Run ``fn(*args, **kwargs)`` on ``np`` ranks and return the list of
+    per-rank results (parity with ``horovod.run.run()``,
+    ``run/run.py:863-949``). The function is shipped pickled via a scratch
+    directory and results are collected per rank."""
+    import pickle
+    import tempfile
+
+    kwargs = kwargs or {}
+    workdir = tempfile.mkdtemp(prefix="hvdrun_")
+    fn_path = os.path.join(workdir, "fn.pkl")
+    with open(fn_path, "wb") as f:
+        pickle.dump((fn, args, kwargs), f)
+
+    host_list = launcher.parse_hosts(hosts) if hosts else [("localhost", np)]
+    slots = launcher.allocate(host_list, np)
+    run_env = dict(os.environ)
+    if env:
+        run_env.update(env)
+    run_env["HOROVOD_RUN_FN_FILE"] = fn_path
+    run_env["HOROVOD_RUN_RESULT_DIR"] = workdir
+    command = [sys.executable, "-m", "horovod_tpu.run.task_runner"]
+    rc = launcher.launch_job(command, slots, env=run_env, verbose=verbose)
+    if rc != 0:
+        raise RuntimeError(f"hvdrun job failed with exit code {rc}")
+    results = []
+    for slot in slots:
+        with open(os.path.join(workdir, f"result.{slot.rank}.pkl"), "rb") as f:
+            results.append(pickle.load(f))
+    return results
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Process spawning with whole-tree cleanup.
+
+Role parity with the reference's ``run/common/util/safe_shell_exec.py``
+(middleman process group, graceful terminate then kill): each worker runs in
+its own process group; terminate() SIGTERMs the group, escalating to
+SIGKILL after a grace period.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import IO, Dict, List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+class ManagedProcess:
+    def __init__(
+        self,
+        command: List[str] | str,
+        env: Optional[Dict[str, str]] = None,
+        stdout: Optional[IO] = None,
+        stderr: Optional[IO] = None,
+        shell: bool = False,
+    ):
+        self.proc = subprocess.Popen(
+            command,
+            env=env,
+            stdout=stdout if stdout is not None else None,
+            stderr=stderr if stderr is not None else None,
+            shell=shell,
+            start_new_session=True,  # own process group for tree-kill
+        )
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        return self.proc.wait(timeout=timeout)
+
+    def terminate(self) -> None:
+        """SIGTERM the process group; SIGKILL after the grace period."""
+        try:
+            pgid = os.getpgid(self.proc.pid)
+        except ProcessLookupError:
+            return
+        try:
+            os.killpg(pgid, signal.SIGTERM)
+        except ProcessLookupError:
+            return
+        deadline = time.monotonic() + GRACEFUL_TERMINATION_TIME_S
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                return
+            time.sleep(0.1)
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
+def execute(
+    command: List[str] | str,
+    env: Optional[Dict[str, str]] = None,
+    stdout: Optional[IO] = None,
+    stderr: Optional[IO] = None,
+    shell: bool = False,
+) -> int:
+    """Run a command to completion, forwarding SIGINT/SIGTERM to its tree."""
+    mp = ManagedProcess(command, env=env, stdout=stdout, stderr=stderr,
+                        shell=shell)
+    forwarded = []
+
+    def handler(signum, frame):
+        forwarded.append(signum)
+        mp.terminate()
+
+    old_int = signal.signal(signal.SIGINT, handler)
+    old_term = signal.signal(signal.SIGTERM, handler)
+    try:
+        return mp.wait()
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
