@@ -1,0 +1,79 @@
+"""horovod_tpu.keras — Keras binding.
+
+API parity with ``horovod/keras/__init__.py`` + ``horovod/_keras/``:
+``DistributedOptimizer`` wrapper, broadcast/metric-average/LR-schedule
+callbacks, and ``load_model`` that rewraps saved optimizers.
+"""
+
+from __future__ import annotations
+
+from .. import (  # noqa: F401
+    Adasum,
+    Average,
+    Sum,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..tensorflow import (
+    DistributedOptimizer as _TfDistributedOptimizer,
+    allreduce as _tf_allreduce,
+    broadcast_variables,
+)
+from ..tensorflow.compression import Compression
+
+from . import callbacks  # noqa: E402,F401  (import after basics)
+
+
+def DistributedOptimizer(optimizer, name=None,  # noqa: N802
+                         device_dense="", device_sparse="",
+                         compression=Compression.none, op=None):
+    return _TfDistributedOptimizer(
+        optimizer, name=name, device_dense=device_dense,
+        device_sparse=device_sparse, compression=compression, op=op,
+    )
+
+
+def allreduce(value, name=None, average=True):
+    """Average a value (tensor or scalar) across ranks — used by metric
+    averaging (reference ``horovod/keras/__init__.py``)."""
+    import numpy as np
+    import tensorflow as tf
+
+    tensor = tf.convert_to_tensor(value)
+    return _tf_allreduce(tensor, average=average, name=name)
+
+
+def allgather(value, name=None):
+    from ..tensorflow import allgather as _ag
+
+    return _ag(value, name)
+
+
+def broadcast(value, root_rank, name=None):
+    from ..tensorflow import broadcast as _bc
+
+    return _bc(value, root_rank, name)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a Keras model and wrap its optimizer in DistributedOptimizer
+    (reference ``_keras/__init__.py:111+``)."""
+    import tensorflow as tf
+
+    model = tf.keras.models.load_model(
+        filepath, custom_objects=custom_objects, compile=True
+    )
+    if getattr(model, "optimizer", None) is not None:
+        wrapped = DistributedOptimizer(model.optimizer,
+                                       compression=compression)
+        model.compile(
+            optimizer=wrapped,
+            loss=model.loss,
+        )
+    return model

@@ -1,0 +1,193 @@
+"""Keras callbacks — parity with ``horovod/_keras/callbacks.py:20-181``:
+BroadcastGlobalVariables, MetricAverage, LearningRateSchedule/Warmup with
+momentum correction."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _keras():
+    import tensorflow as tf
+
+    return tf.keras
+
+
+class BroadcastGlobalVariablesCallback:
+    """Broadcast model + optimizer state from root at the start of training
+    so all ranks begin identical (reference
+    ``_keras/callbacks.py:20-45``)."""
+
+    def __init__(self, root_rank: int = 0, device=""):
+        self.root_rank = root_rank
+        self.broadcast_done = False
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done or self.model is None:
+            return
+        from ..tensorflow import broadcast_variables
+
+        broadcast_variables(self.model.variables, self.root_rank)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None and getattr(opt, "variables", None):
+            vars_ = opt.variables if not callable(opt.variables) \
+                else opt.variables()
+            broadcast_variables(vars_, self.root_rank)
+        self.broadcast_done = True
+
+    # no-op protocol methods so the object passes as a Keras callback
+    def __getattr__(self, item):
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
+
+
+class MetricAverageCallback:
+    """Average epoch metrics over ranks at epoch end (reference
+    ``_keras/callbacks.py:46-84``)."""
+
+    def __init__(self, device=""):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None:
+            return
+        import numpy as np
+
+        from .. import allreduce as _np_allreduce
+
+        for k, v in list(logs.items()):
+            if isinstance(v, (int, float, np.floating)):
+                logs[k] = float(
+                    np.asarray(
+                        _np_allreduce(
+                            np.asarray(v, dtype=np.float64),
+                            average=True,
+                            name=f"metric.{k}",
+                        )
+                    )
+                )
+
+    def __getattr__(self, item):
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
+
+
+class LearningRateScheduleCallback:
+    """Multiply the LR by ``multiplier`` within an epoch range (reference
+    ``_keras/callbacks.py:86-133``); with ``staircase`` the multiplier is a
+    function of epoch."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True, steps_per_epoch=None):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self.model = None
+        self.params = {}
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+        self._restore_momentum = None
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def _in_range(self, epoch) -> bool:
+        return epoch >= self.start_epoch and (
+            self.end_epoch is None or epoch < self.end_epoch
+        )
+
+    def _set_lr(self, lr: float) -> None:
+        opt = self.model.optimizer
+        if hasattr(opt, "learning_rate"):
+            try:
+                opt.learning_rate = lr
+            except Exception:
+                opt.learning_rate.assign(lr)
+
+    def _adjust_momentum(self, lr_ratio: float) -> None:
+        # Momentum correction (reference :120-133): scale momentum when LR
+        # changes mid-training so velocity stays consistent.
+        opt = self.model.optimizer
+        if not self.momentum_correction or not hasattr(opt, "momentum"):
+            return
+        if self._restore_momentum is None:
+            self._restore_momentum = float(
+                opt.momentum if not callable(opt.momentum) else opt.momentum()
+            )
+        try:
+            opt.momentum = self._restore_momentum * lr_ratio
+        except Exception:
+            pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.model is None or not self._in_range(epoch):
+            return
+        if self.staircase:
+            new_lr = self.initial_lr * self.multiplier(epoch)
+            self._set_lr(new_lr)
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.model is None or self.staircase \
+                or not self._in_range(self.current_epoch) \
+                or not self.steps_per_epoch:
+            return
+        frac_epoch = self.current_epoch + batch / self.steps_per_epoch
+        self._set_lr(self.initial_lr * self.multiplier(frac_epoch))
+
+    def __getattr__(self, item):
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup over the first epochs: scales from 1/size -> 1.0
+    of the target LR (reference ``_keras/callbacks.py:134-181``)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        from .. import size
+
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        n = size()
+
+        def multiplier(epoch):
+            # epoch may be fractional; ramp 1/n -> 1 across warmup_epochs
+            progress = min(max(epoch / max(warmup_epochs, 1e-9), 0.0), 1.0)
+            return 1.0 / n + progress * (1.0 - 1.0 / n)
+
+        super().__init__(
+            initial_lr, multiplier, start_epoch=0, end_epoch=warmup_epochs,
+            staircase=False, momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch,
+        )

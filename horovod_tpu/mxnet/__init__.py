@@ -1,0 +1,74 @@
+"""horovod_tpu.mxnet — MXNet binding (gated).
+
+The reference binds MXNet via its dependency engine
+(``horovod/mxnet/mpi_ops.cc:132-207``). MXNet has been archived upstream
+and is not present in this environment; the binding is gated on import and
+raises a clear error with the migration path. The surface mirrors the
+reference (``horovod/mxnet/__init__.py:40-108``) so a port is mechanical if
+MXNet is installed.
+"""
+
+from __future__ import annotations
+
+try:
+    import mxnet  # noqa: F401
+
+    _MXNET_AVAILABLE = True
+except ImportError:
+    _MXNET_AVAILABLE = False
+
+if not _MXNET_AVAILABLE:
+    _MSG = (
+        "MXNet is not installed in this environment (the project was "
+        "archived upstream). Use horovod_tpu.jax (recommended on TPU), "
+        "horovod_tpu.torch, or horovod_tpu.tensorflow instead."
+    )
+
+    def __getattr__(name):  # noqa: D103
+        raise ImportError(_MSG)
+else:  # pragma: no cover - exercised only where mxnet exists
+    import numpy as _np
+
+    from .. import (  # noqa: F401
+        Adasum, Average, Sum, init, is_initialized, local_rank, local_size,
+        rank, shutdown, size,
+    )
+    from .. import allreduce as _allreduce_np
+    from .. import broadcast as _broadcast_np
+
+    def allreduce(tensor, average=True, name=None, prescale_factor=1.0,
+                  postscale_factor=1.0):
+        out = _allreduce_np(tensor.asnumpy(), average=average, name=name,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+        return mxnet.nd.array(_np.asarray(out), ctx=tensor.context,
+                              dtype=tensor.dtype)
+
+    def broadcast(tensor, root_rank, name=None):
+        out = _broadcast_np(tensor.asnumpy(), root_rank, name=name)
+        return mxnet.nd.array(_np.asarray(out), ctx=tensor.context,
+                              dtype=tensor.dtype)
+
+    def broadcast_parameters(params, root_rank=0):
+        if isinstance(params, dict):
+            items = sorted(params.items())
+        else:
+            items = sorted(
+                (name, p.data()) for name, p in params.items()
+            )
+        for name, p in items:
+            p[:] = broadcast(p, root_rank, name=str(name))
+
+    class DistributedOptimizer(mxnet.optimizer.Optimizer):
+        """Wraps an mxnet optimizer; allreduces gradients before update
+        (reference horovod/mxnet/__init__.py:40-75)."""
+
+        def __init__(self, optimizer):
+            self._optimizer = optimizer
+
+        def __getattr__(self, item):
+            return getattr(self._optimizer, item)
+
+        def update(self, index, weight, grad, state):
+            reduced = allreduce(grad, average=True, name=f"grad.{index}")
+            self._optimizer.update(index, weight, reduced, state)

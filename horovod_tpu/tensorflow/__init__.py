@@ -1,0 +1,186 @@
+"""horovod_tpu.tensorflow — TensorFlow binding.
+
+API parity with ``horovod/tensorflow/__init__.py``: ``allreduce`` with
+Average/Sum/Adasum semantics and IndexedSlices-via-allgather,
+``broadcast_variables`` / ``broadcast_global_variables``,
+``DistributedGradientTape``, ``DistributedOptimizer`` (tf.compat.v1 +
+keras-optimizer styles), Compression.
+
+Eager-first: collectives run through the shared eager runtime (native
+control plane + XLA data plane) by converting EagerTensors to numpy at the
+boundary. Inside ``tf.function`` graphs the op is wrapped with
+``tf.py_function`` — correct, though the recommended high-throughput path
+on TPU is the JAX compiled mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import (  # noqa: F401 - basics re-exported like the reference
+    Adasum,
+    Average,
+    Sum,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from .. import allgather as _allgather_np
+from .. import allreduce as _allreduce_np
+from .. import alltoall as _alltoall_np
+from .. import broadcast as _broadcast_np
+from ..common.types import ReduceOp
+from .compression import Compression
+
+
+def _np_op(fn, tensor, *args, **kwargs):
+    """Run a numpy-level collective on a TF tensor, eagerly or inside a
+    graph via py_function."""
+    import tensorflow as tf
+
+    def run(t):
+        out = fn(t.numpy(), *args, **kwargs)
+        return tf.convert_to_tensor(np.asarray(out))
+
+    if tf.executing_eagerly() and not isinstance(tensor, tf.Tensor):
+        tensor = tf.convert_to_tensor(tensor)
+    if tf.executing_eagerly() and hasattr(tensor, "numpy"):
+        return run(tensor)
+    return tf.py_function(run, [tensor], Tout=tensor.dtype)
+
+
+def allreduce(tensor, average=None, device_dense="", device_sparse="",
+              compression=Compression.none, op=None,
+              prescale_factor=1.0, postscale_factor=1.0, name=None):
+    """Reference semantics (``tensorflow/__init__.py:44-118``): Average by
+    default; ``tf.IndexedSlices`` reduce as gathered values/indices."""
+    import tensorflow as tf
+
+    if op is None and average is None:
+        rop = ReduceOp.AVERAGE
+    elif op is not None:
+        rop = op
+    else:
+        rop = ReduceOp.AVERAGE if average else ReduceOp.SUM
+
+    if isinstance(tensor, tf.IndexedSlices):
+        # Sparse path: allgather values+indices; Average divides by size
+        # (reference tensorflow/__init__.py:75-91).
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        if rop == ReduceOp.AVERAGE:
+            values = values / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+
+    compressed, ctx = compression.compress(tensor)
+    out = _np_op(
+        _allreduce_np, compressed, op=rop, name=name,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name=None):
+    return _np_op(_allgather_np, tensor, name)
+
+
+def broadcast(tensor, root_rank, name=None):
+    return _np_op(_broadcast_np, tensor, root_rank, name)
+
+
+def alltoall(tensor, name=None):
+    return _np_op(_alltoall_np, tensor, name)
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable the root's value (reference
+    ``broadcast_variables``, ``tensorflow/__init__.py:139-227``)."""
+    for i, var in enumerate(variables):
+        var.assign(broadcast(var.read_value(), root_rank,
+                             name=f"bcast.var.{i}"))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    import tensorflow as tf
+
+    if hasattr(tf.compat.v1, "global_variables"):
+        broadcast_variables(tf.compat.v1.global_variables(), root_rank)
+
+
+class DistributedGradientTape:
+    """Wraps tf.GradientTape; ``gradient()`` allreduces the results
+    (reference ``tensorflow/__init__.py:473-530``)."""
+
+    def __init__(self, tape, device_dense="", device_sparse="",
+                 compression=Compression.none, op=None):
+        self._tape = tape
+        self._compression = compression
+        self._op = op if op is not None else Average
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return [
+            allreduce(g, compression=self._compression, op=self._op,
+                      name=f"DistributedGradientTape.grad.{i}")
+            if g is not None else None
+            for i, g in enumerate(grads)
+        ]
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,  # noqa: N802
+                         device_dense="", device_sparse="",
+                         compression=Compression.none, sparse_as_dense=False,
+                         op=None, backward_passes_per_step=1):
+    """Wrap a Keras optimizer so gradients are allreduced before apply
+    (API parity with ``tensorflow/__init__.py:409-470``)."""
+    import tensorflow as tf
+
+    reduce_op = op if op is not None else Average
+    base = optimizer.__class__
+
+    class _Distributed(base):  # type: ignore[valid-type, misc]
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            gv = [
+                (
+                    allreduce(g, compression=compression, op=reduce_op,
+                              name=f"DistributedOptimizer.grad.{i}")
+                    if g is not None else None,
+                    v,
+                )
+                for i, (g, v) in enumerate(grads_and_vars)
+            ]
+            return super().apply_gradients(gv, **kwargs)
+
+    # Fresh instance with the same config; Keras builds slots lazily on the
+    # first apply_gradients, so no state transfer is needed for a new model.
+    return _Distributed.from_config(optimizer.get_config())
+
+
+class BroadcastGlobalVariablesHook:
+    """tf.compat.v1 SessionRunHook parity shim: in eager/TF2 use
+    ``broadcast_variables`` or the Keras callback instead."""
+
+    def __init__(self, root_rank: int = 0, device=""):
+        self.root_rank = root_rank
+
+    def begin(self):
+        broadcast_global_variables(self.root_rank)

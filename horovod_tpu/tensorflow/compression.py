@@ -1,0 +1,70 @@
+"""Gradient compression for TF tensors — parity with
+``horovod/tensorflow/compression.py:46-74``."""
+
+from __future__ import annotations
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        import tensorflow as tf
+
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating and tensor.dtype.size > 2:
+            tensor = tf.cast(tensor, tf.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        import tensorflow as tf
+
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tf.cast(tensor, ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native: bf16 wire format."""
+
+    @staticmethod
+    def compress(tensor):
+        import tensorflow as tf
+
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating and tensor.dtype.size > 2:
+            tensor = tf.cast(tensor, tf.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        import tensorflow as tf
+
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tf.cast(tensor, ctx)
+        return tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

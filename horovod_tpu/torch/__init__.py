@@ -1,0 +1,266 @@
+"""horovod_tpu.torch — PyTorch binding.
+
+API parity with ``horovod/torch/__init__.py``: hook-driven
+``DistributedOptimizer`` (per-parameter grad hooks fire async allreduce;
+``step()`` synchronizes), ``broadcast_parameters`` /
+``broadcast_optimizer_state``, ``backward_passes_per_step`` local
+accumulation, Compression, and the full handle-based op surface re-exported
+from :mod:`.mpi_ops`.
+
+The data plane is the shared eager runtime (native C++ control plane + XLA
+executor); CPU torch tensors cross as zero-copy numpy views.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .. import (  # re-export basics (reference exposes these here too)
+    Adasum,
+    Average,
+    Sum,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from .compression import Compression
+from .mpi_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    alltoall_async,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    join,
+    poll,
+    synchronize,
+)
+
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer; mirrors the reference implementation
+    (``horovod/torch/__init__.py:54-209``): a post-accumulate-grad hook per
+    parameter fires an async in-place allreduce once
+    ``backward_passes_per_step`` microbatches have accumulated; ``step()``
+    synchronizes all outstanding handles, then steps the inner optimizer."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none, backward_passes_per_step=1,
+                 op=Average):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = []
+            i = 0
+            for group in optimizer.param_groups:
+                for p in group["params"]:
+                    named.append((f"param.{i}", p))
+                    i += 1
+        # Duplicate-name guard (reference raises on dups).
+        names = [n for n, _ in named]
+        if len(names) != len(set(names)):
+            raise ValueError(
+                "named_parameters contains duplicate parameter names"
+            )
+        self._param_names = {p: n for n, p in named}
+        self._handles: Dict[Any, Tuple[int, Any]] = {}
+        self._grad_accs: List[Any] = []
+        self._passes: Dict[Any, int] = {}
+        self._hook_handles = []
+        self._register_hooks()
+
+    # delegation
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    def _register_hooks(self) -> None:
+        import torch
+
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad:
+                    continue
+                self._passes[p] = 0
+
+                def hook(param):
+                    self._passes[param] += 1
+                    if self._passes[param] == self.backward_passes_per_step:
+                        self._passes[param] = 0
+                        self._allreduce_grad_async(param)
+
+                self._hook_handles.append(
+                    p.register_post_accumulate_grad_hook(hook)
+                )
+
+    def _allreduce_grad_async(self, p) -> None:
+        name = self._param_names.get(p, f"param.{id(p)}")
+        grad = p.grad
+        if self.backward_passes_per_step > 1:
+            grad = grad / self.backward_passes_per_step
+        compressed, ctx = self._compression.compress(grad)
+        handle = allreduce_async(
+            compressed, name=f"DistributedOptimizer.{name}", op=self._op
+        )
+        self._handles[p] = (handle, ctx)
+
+    def synchronize(self) -> None:
+        import torch
+
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if p not in self._handles and p.requires_grad \
+                        and p.grad is not None:
+                    # backward() was not run (or hook missed): reduce now,
+                    # matching the reference's missing-handle path.
+                    self._allreduce_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            out = synchronize(handle)
+            out = self._compression.decompress(out, ctx)
+            with torch.no_grad():
+                p.grad.copy_(out.reshape(p.grad.shape).to(p.grad.dtype))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize()."
+            )
+        return self._opt.zero_grad(*args, **kwargs)
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, *args, **kwargs):
+        return self._opt.load_state_dict(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,  # noqa: N802
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=Average):
+    """API parity with ``hvd.DistributedOptimizer``
+    (``horovod/torch/__init__.py:381-435``)."""
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters, compression=compression,
+        backward_passes_per_step=backward_passes_per_step, op=op,
+    )
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a state_dict or list of (name, tensor) from root
+    (reference ``horovod/torch/__init__.py:381-435`` broadcast_parameters):
+    every rank's tensors are overwritten in place with root's values."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        handles.append(broadcast_async_(p.data if hasattr(p, "data") else p,
+                                        root_rank, name=f"bcast.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast optimizer state from root (reference
+    ``horovod/torch/__init__.py:437-560``): scalars are wrapped as tensors,
+    broadcast, and written back via callbacks."""
+    import torch
+
+    if isinstance(optimizer, _DistributedOptimizer):
+        optimizer = optimizer._opt
+
+    state_dict = optimizer.state_dict()
+    # Newly constructed optimizers have no state: run a dummy step on zero
+    # grads to materialize it (reference does exactly this).
+    if not state_dict.get("state"):
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = torch.zeros_like(p)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    callbacks = []
+    handles = []
+
+    def _bcast_scalar(container, key, value, name):
+        t = torch.tensor([value], dtype=torch.float64)
+        h = broadcast_async_(t, root_rank, name=name)
+
+        def write_back():
+            synchronize(h)
+            casted = type(value)(t.item()) if not isinstance(value, bool) \
+                else bool(t.item())
+            container[key] = casted
+
+        callbacks.append(write_back)
+
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key, value in group.items():
+            if key == "params":
+                continue
+            if isinstance(value, (int, float)):
+                _bcast_scalar(group, key, value, f"opt.group{gi}.{key}")
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            name = f"opt.state.{pid}.{key}"
+            if torch.is_tensor(value):
+                handles.append(broadcast_async_(value, root_rank, name=name))
+            elif isinstance(value, (int, float)):
+                _bcast_scalar(pstate, key, value, name)
+    for h in handles:
+        synchronize(h)
+    for cb in callbacks:
+        cb()
+    optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast an arbitrary picklable object (later-reference API,
+    included for completeness)."""
+    import io
+    import pickle
+
+    import numpy as np
+    import torch
+
+    if rank() == root_rank:
+        data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        sz = torch.tensor([len(data)], dtype=torch.int64)
+    else:
+        sz = torch.tensor([0], dtype=torch.int64)
+    broadcast_(sz, root_rank, name=f"{name or 'bcast_obj'}.size")
+    if rank() == root_rank:
+        payload = torch.from_numpy(data)
+    else:
+        payload = torch.zeros(int(sz.item()), dtype=torch.uint8)
+    broadcast_(payload, root_rank, name=f"{name or 'bcast_obj'}.data")
+    return pickle.loads(payload.numpy().tobytes())

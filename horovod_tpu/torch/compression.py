@@ -1,0 +1,66 @@
+"""Gradient compression for torch tensors — parity with
+``horovod/torch/compression.py`` (fp16 on the wire)."""
+
+from __future__ import annotations
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        import torch
+
+        dtype = tensor.dtype
+        if dtype in (torch.float32, torch.float64):
+            tensor = tensor.half()
+        return tensor, dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tensor.to(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native addition: bf16 wire format."""
+
+    @staticmethod
+    def compress(tensor):
+        import torch
+
+        dtype = tensor.dtype
+        if dtype in (torch.float32, torch.float64):
+            tensor = tensor.bfloat16()
+        return tensor, dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            tensor = tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

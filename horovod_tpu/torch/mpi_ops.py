@@ -1,0 +1,168 @@
+"""Handle-based async collective ops on torch tensors.
+
+API parity with ``horovod/torch/mpi_ops.py`` (allreduce[_async][_],
+allgather, broadcast, poll, synchronize, join) — the divisor logic for
+Average and the in-place variants follow the reference
+(``mpi_ops.py:95-254``). The data path converts CPU torch tensors to numpy
+(zero-copy), runs the shared eager runtime (native core + XLA/host data
+plane), and converts back.
+
+bfloat16 note: numpy has no bf16; bf16 torch tensors ride the wire as their
+raw uint16 view is NOT valid for summation, so they are upcast to fp32 for
+the collective and cast back (the compiled JAX mode handles bf16 natively).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import _auto_name, _resolve_op, _rt
+from ..common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
+
+# handle -> (input_tensor_or_None, ctx) for in-place/average post-ops
+_handle_meta: dict = {}
+
+
+def _to_numpy(tensor):
+    import torch
+
+    t = tensor.detach()
+    if t.dtype == torch.bfloat16:
+        t = t.float()
+    return t.cpu().numpy()
+
+
+def _from_numpy(arr, like):
+    import torch
+
+    out = torch.from_numpy(np.ascontiguousarray(arr))
+    if like is not None and out.dtype != like.dtype:
+        out = out.to(like.dtype)
+    return out
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+    rop = _resolve_op(average, op)
+    arr = _to_numpy(tensor)
+    rt = _rt()
+    tensor_name = _auto_name("allreduce.torch", name)
+    if rop == ReduceOp.ADASUM:
+        handle = rt.enqueue_adasum(
+            tensor_name, arr, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+    else:
+        handle = rt.enqueue_allreduce(
+            tensor_name, arr, reduce_op=rop,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+    _handle_meta[handle] = (None, tensor)
+    return handle
+
+
+def allreduce(tensor, average=None, name=None, compression=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    from .compression import Compression
+
+    compression = compression or Compression.none
+    compressed, ctx = compression.compress(tensor)
+    handle = allreduce_async(compressed, average=average, name=name, op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor)
+    return compression.decompress(synchronize(handle), ctx)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """In-place async allreduce: on synchronize, the result is copied back
+    into ``tensor`` (reference allreduce_async_)."""
+    handle = allreduce_async(tensor, average=average, name=name, op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor)
+    _handle_meta[handle] = (tensor, tensor)
+    return handle
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0):
+    return synchronize(
+        allreduce_async_(tensor, average=average, name=name, op=op,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+    )
+
+
+def allgather_async(tensor, name=None) -> int:
+    arr = _to_numpy(tensor)
+    handle = _rt().enqueue_allgather(_auto_name("allgather.torch", name), arr)
+    _handle_meta[handle] = (None, tensor)
+    return handle
+
+
+def allgather(tensor, name=None):
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    arr = _to_numpy(tensor)
+    handle = _rt().enqueue_broadcast(
+        _auto_name("broadcast.torch", name), arr, root_rank
+    )
+    _handle_meta[handle] = (None, tensor)
+    return handle
+
+
+def broadcast(tensor, root_rank, name=None):
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    handle = broadcast_async(tensor, root_rank, name)
+    _handle_meta[handle] = (tensor, tensor)
+    return handle
+
+
+def broadcast_(tensor, root_rank, name=None):
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall_async(tensor, name=None) -> int:
+    arr = _to_numpy(tensor)
+    handle = _rt().enqueue_alltoall(_auto_name("alltoall.torch", name), arr)
+    _handle_meta[handle] = (None, tensor)
+    return handle
+
+
+def alltoall(tensor, name=None):
+    return synchronize(alltoall_async(tensor, name))
+
+
+def poll(handle: int) -> bool:
+    return _rt().poll(handle)
+
+
+def synchronize(handle: int):
+    out = _rt().synchronize(handle)
+    inplace_target, like = _handle_meta.pop(handle, (None, None))
+    result = _from_numpy(np.asarray(out), like)
+    if inplace_target is not None:
+        with _no_grad():
+            inplace_target.copy_(result.reshape(inplace_target.shape))
+        return inplace_target
+    return result
+
+
+def _no_grad():
+    import torch
+
+    return torch.no_grad()
+
+
+def join() -> None:
+    from .. import join as _join
+
+    _join()

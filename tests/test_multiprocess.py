@@ -219,3 +219,38 @@ def _worker_fn():
     result = (hvd.rank(), hvd.size(), np.asarray(out).tolist())
     hvd.shutdown()
     return result
+
+
+def test_torch_distributed_optimizer_two_ranks():
+    """Hook-driven torch DistributedOptimizer across 2 real ranks: both
+    ranks must converge to identical weights (grads averaged)."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import torch
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        # different data per rank
+        torch.manual_seed(hvd.rank() + 1)
+        X = torch.randn(16, 4); y = torch.randn(16, 1)
+        for _ in range(5):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X), y)
+            loss.backward()
+            opt.step()
+        w = model.weight.detach().numpy().round(6).tolist()
+        print("W", w)
+        hvd.shutdown()
+        """
+    )
+    w0 = [l for l in outs[0].splitlines() if l.startswith("W ")]
+    w1 = [l for l in outs[1].splitlines() if l.startswith("W ")]
+    assert w0 and w1
+    assert w0 == w1, (w0, w1)

@@ -1,0 +1,125 @@
+"""TensorFlow/Keras binding tests — modeled on the reference
+``test/test_tensorflow.py`` + ``test/test_keras.py`` (single-process
+degenerate)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd
+from horovod_tpu.tensorflow.compression import Compression
+
+
+@pytest.fixture(autouse=True)
+def _session():
+    hvd.init()
+    yield
+
+
+def test_allreduce_eager():
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+    out = hvd.allreduce(x)  # default average
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+
+
+def test_allreduce_indexed_slices():
+    values = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    indices = tf.constant([0, 2], dtype=tf.int64)
+    slices = tf.IndexedSlices(values, indices, dense_shape=(4, 2))
+    out = hvd.allreduce(slices, op=hvd.Sum)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_allclose(out.values.numpy(), values.numpy())
+
+
+def test_allreduce_compression():
+    x = tf.linspace(0.0, 1.0, 16)
+    out = hvd.allreduce(x, compression=Compression.fp16, op=hvd.Sum)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-3)
+
+
+def test_allgather_broadcast():
+    x = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    np.testing.assert_allclose(hvd.allgather(x).numpy(), x.numpy())
+    np.testing.assert_allclose(
+        hvd.broadcast(x, root_rank=0).numpy(), x.numpy()
+    )
+
+
+def test_allreduce_inside_tf_function():
+    @tf.function
+    def fn(t):
+        return hvd.allreduce(t, op=hvd.Sum)
+
+    x = tf.constant([5.0, 6.0])
+    np.testing.assert_allclose(fn(x).numpy(), [5.0, 6.0])
+
+
+def test_distributed_gradient_tape():
+    w = tf.Variable([[2.0]])
+    x = tf.constant([[3.0]])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        y = tf.matmul(x, w)
+        loss = tf.reduce_sum(y * y)
+    grads = tape.gradient(loss, [w])
+    np.testing.assert_allclose(grads[0].numpy(), [[36.0]])
+
+
+def test_broadcast_variables():
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    hvd.broadcast_variables([v1, v2], root_rank=0)
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(v2.numpy(), [[3.0]])
+
+
+def test_keras_model_trains():
+    import horovod_tpu.keras as hvdk
+
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(8, activation="relu", input_shape=(4,)),
+         tf.keras.layers.Dense(1)]
+    )
+    opt = hvdk.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+    model.compile(optimizer=opt, loss="mse")
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    y = (X @ rng.randn(4, 1)).astype(np.float32)
+    hist = model.fit(
+        X, y, epochs=5, batch_size=16, verbose=0,
+        callbacks=[
+            hvdk.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvdk.callbacks.MetricAverageCallback(),
+        ],
+    )
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], losses
+
+
+def test_keras_lr_warmup_callback():
+    import horovod_tpu.keras as hvdk
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1, input_shape=(2,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    cb = hvdk.callbacks.LearningRateWarmupCallback(
+        initial_lr=0.1, warmup_epochs=2, steps_per_epoch=4
+    )
+    cb.set_model(model)
+    cb.on_epoch_begin(0)
+    cb.on_batch_begin(0)
+    lr0 = float(model.optimizer.learning_rate)
+    cb.on_epoch_begin(1)
+    cb.on_batch_begin(3)
+    lr1 = float(model.optimizer.learning_rate)
+    # size=1: multiplier is 1 throughout; just verify LR stays set/finite
+    assert 0 < lr0 <= 0.1 + 1e-6 and 0 < lr1 <= 0.1 + 1e-6
+
+
+def test_mxnet_stub_raises():
+    import horovod_tpu.mxnet as hvdm
+
+    with pytest.raises(ImportError, match="horovod_tpu.jax"):
+        hvdm.allreduce

@@ -1,0 +1,165 @@
+"""PyTorch binding tests — modeled on the reference ``test/test_torch.py``
+(op surface, in-place variants, DistributedOptimizer hooks,
+broadcast_parameters / broadcast_optimizer_state, compression,
+backward_passes_per_step). Single-process degenerate, like the reference
+under plain pytest."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd
+
+
+@pytest.fixture(autouse=True)
+def _session():
+    hvd.init()
+    yield
+
+
+def test_allreduce_ops():
+    x = torch.arange(6, dtype=torch.float32)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert torch.allclose(out, x)
+    out = hvd.allreduce(x, average=True)
+    assert torch.allclose(out, x)
+    assert out.dtype == torch.float32
+
+
+def test_allreduce_inplace():
+    x = torch.ones(4)
+    y = hvd.allreduce_(x, op=hvd.Sum)
+    assert y is x
+    assert torch.allclose(x, torch.ones(4))
+
+
+def test_allreduce_async_poll():
+    x = torch.ones(3)
+    h = hvd.allreduce_async(x, name="t_async")
+    out = hvd.synchronize(h)
+    assert torch.allclose(out, x)
+    assert hvd.poll(h)
+
+
+def test_allgather_broadcast():
+    x = torch.arange(4, dtype=torch.int32).reshape(2, 2)
+    g = hvd.allgather(x)
+    assert torch.equal(g, x)
+    b = hvd.broadcast(x, root_rank=0)
+    assert torch.equal(b, x)
+    y = torch.zeros(2, 2, dtype=torch.int32)
+    hvd.broadcast_(y, root_rank=0)
+    assert torch.equal(y, torch.zeros(2, 2, dtype=torch.int32))
+
+
+def test_fp16_compression():
+    x = torch.linspace(0, 1, 10)
+    out = hvd.allreduce(x, compression=hvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, x, rtol=1e-3)
+
+
+def test_bf16_tensor_allreduce():
+    x = torch.linspace(0, 1, 8, dtype=torch.bfloat16)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    assert out.dtype == torch.bfloat16
+
+
+def _make_model():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1)
+    )
+
+
+def test_distributed_optimizer_trains():
+    model = _make_model()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    opt = hvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters()
+    )
+    torch.manual_seed(1)
+    X = torch.randn(32, 4)
+    w = torch.randn(4, 1)
+    y = X @ w
+    losses = []
+    for _ in range(30):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(X), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.3, losses
+
+
+def test_distributed_optimizer_backward_passes_per_step():
+    model = _make_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2,
+    )
+    X = torch.randn(8, 4)
+    y = torch.randn(8, 1)
+    # two backwards per step: hooks fire the reduce on the 2nd pass
+    loss1 = torch.nn.functional.mse_loss(model(X), y)
+    loss1.backward()
+    loss2 = torch.nn.functional.mse_loss(model(X), y)
+    loss2.backward()
+    opt.step()
+    opt.zero_grad()
+
+
+def test_distributed_optimizer_duplicate_names_rejected():
+    model = _make_model()
+    named = [("p", p) for p in model.parameters()]
+    with pytest.raises(ValueError):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=named,
+        )
+
+
+def test_zero_grad_with_pending_handles_raises():
+    model = _make_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    X = torch.randn(4, 4)
+    y = torch.randn(4, 1)
+    loss = torch.nn.functional.mse_loss(model(X), y)
+    loss.backward()
+    with pytest.raises(AssertionError):
+        opt.zero_grad()
+    opt.synchronize()
+    opt.zero_grad()
+
+
+def test_broadcast_parameters():
+    model = _make_model()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_parameters(list(model.named_parameters()), root_rank=0)
+
+
+def test_broadcast_optimizer_state():
+    model = _make_model()
+    opt = torch.optim.SGD(model.parameters(), lr=0.25, momentum=0.9)
+    # run one real step so state exists
+    loss = model(torch.randn(4, 4)).sum()
+    loss.backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.25)
+    assert opt.param_groups[0]["momentum"] == pytest.approx(0.9)
+
+
+def test_broadcast_object():
+    obj = {"epoch": 7, "best": 0.123}
+    out = hvd.broadcast_object(obj, root_rank=0)
+    assert out == obj
+
+
+def test_join():
+    hvd.join()
