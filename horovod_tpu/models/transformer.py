@@ -1,0 +1,85 @@
+"""Decoder-only Transformer LM (flax), TPU-first, with pluggable attention.
+
+The long-context flagship: ``attn_fn`` can be the dense reference, ring
+attention, or Ulysses (``horovod_tpu.parallel.ring_attention``), letting the
+same module run single-chip or sequence-parallel inside a shard_map without
+code changes. bfloat16 compute with fp32 logits; positions are passed in so
+sequence-sharded shards can feed their global offsets.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel.ring_attention import reference_attention
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        H = self.n_heads
+        D = C // H
+        attn = self.attn_fn or partial(reference_attention, causal=True)
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        a = attn(q, k, v).reshape(B, T, C)
+        x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(a)
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(C, dtype=self.dtype)(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        """tokens: [B, T_local]; positions: [B, T_local] global positions
+        (defaults to arange — only valid unsharded)."""
+        B, T = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        tok_emb = nn.Embed(self.vocab_size, self.d_model,
+                           dtype=self.dtype)(tokens)
+        pos_emb = nn.Embed(self.max_len, self.d_model,
+                           dtype=self.dtype)(positions)
+        x = tok_emb + pos_emb
+        block = Block
+        if self.remat:
+            block = nn.remat(Block)
+        for _ in range(self.n_layers):
+            x = block(
+                d_model=self.d_model, n_heads=self.n_heads,
+                dtype=self.dtype, attn_fn=self.attn_fn,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False,
+                          dtype=jnp.float32)(x)
+        return logits

@@ -1,0 +1,180 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference framework has no sequence-parallel support (SURVEY.md §2.3 —
+its op set is allreduce/allgather/broadcast only); long-context parallelism
+is a TPU-native extension of this framework, built on the same mesh
+machinery as the data plane.
+
+Two schemes, both SPMD over a named ``seq`` axis:
+
+- **Ring attention** (`ring_attention`): K/V blocks rotate around the ring
+  via ``lax.ppermute`` while each device keeps its Q shard, accumulating
+  attention with the online-softmax (flash) recurrence — memory per device
+  is O(T/n), communication overlaps with compute on ICI, and arbitrary
+  context lengths scale linearly with the ring size.
+- **Ulysses** (`ulysses_attention`): ``all_to_all`` re-shards from
+  sequence-sharded to head-sharded, runs dense local attention, and
+  re-shards back — cheaper at moderate T when heads >= ring size.
+
+Causality is handled with global-position masks; blocks that are entirely
+masked are skipped numerically by the online-softmax guard (they contribute
+exp(-inf)=0).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import SEQ_AXIS
+
+
+def _block_attn(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One online-softmax accumulation step.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; bias: [Tq, Tk] additive mask.
+    Carries m (rowmax), l (denominator), o (unnormalized numerator).
+    """
+    compute = jnp.float32
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(compute), k.astype(compute)
+    ) * scale
+    scores = scores + bias[None, None, :, :]
+    m_cur = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Guard fully-masked rows (m == -inf): keep them at zero contribution.
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(scores - safe_m[..., None])
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    corr = jnp.where(
+        jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0
+    )
+    l_new = l_prev * corr + jnp.sum(p, axis=-1)
+    o_new = o_prev * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(compute)
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Blockwise ring attention over a named mesh axis (call inside
+    shard_map). q/k/v: [batch, seq_local, heads, head_dim], sequence-sharded
+    on ``axis_name``. Returns [batch, seq_local, heads, head_dim]."""
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    q_offset = rank * T
+
+    compute = jnp.float32
+    m0 = jnp.full((B, H, T), -jnp.inf, compute)
+    l0 = jnp.zeros((B, H, T), compute)
+    o0 = jnp.zeros((B, H, T, D), compute)
+
+    # Ring: after s steps this rank holds the K/V block originally owned by
+    # rank (rank - s) mod n. Source i sends to (i+1) mod n each step.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_pos = q_offset + jnp.arange(T)
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, o = carry
+        src = (rank - s) % n
+        k_pos = src * T + jnp.arange(T)
+        if causal:
+            bias = jnp.where(
+                k_pos[None, :] > q_pos[:, None], -jnp.inf, 0.0
+            ).astype(compute)
+        else:
+            bias = jnp.zeros((T, T), compute)
+        m, l, o = _block_attn(q, k_blk, v_blk, bias, m, l, o, scale)
+        # Rotate for the next step. XLA schedules this ppermute concurrently
+        # with the block compute on TPU (collective-compute overlap on ICI).
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o), None
+
+    (k_f, v_f, m, l, o), _ = lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).astype(q.dtype)  # [B, H, T, D]
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ulysses all-to-all sequence parallelism (call inside shard_map):
+    re-shard [B, T/n, H, D] -> [B, T, H/n, D], dense local attention, then
+    re-shard back. Requires heads % axis_size == 0."""
+    n = lax.axis_size(axis_name)
+    B, T, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by axis ({n})")
+
+    def seq_to_heads(x):
+        # [B, Tl, H, D] -> [B, Tl*n(=T), H/n, D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    Tg = qg.shape[1]
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(D)
+    compute = jnp.float32
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", qg.astype(compute), kg.astype(compute)
+    ) * scale_v
+    if causal:
+        pos = jnp.arange(Tg)
+        scores = jnp.where(
+            pos[None, None, None, :] > pos[None, None, :, None],
+            -jnp.inf, scores,
+        )
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vg.astype(compute))
+    return heads_to_seq(out.astype(q.dtype))
+
+
+def reference_attention(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Dense single-device reference (for tests)."""
+    B, T, H, D = q.shape
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(D)
+    compute = jnp.float32
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(compute), k.astype(compute)
+    ) * scale_v
+    if causal:
+        pos = jnp.arange(T)
+        scores = jnp.where(
+            pos[None, None, None, :] > pos[None, None, :, None],
+            -jnp.inf, scores,
+        )
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(compute))
+    return out.astype(q.dtype)

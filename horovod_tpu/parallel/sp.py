@@ -1,0 +1,73 @@
+"""Sequence-parallel (long-context) training step builder.
+
+Combines data parallelism and sequence/context parallelism on one mesh:
+the batch dimension shards over ``data`` and the sequence dimension over
+``seq``; gradients reduce over BOTH axes (params are replicated). The
+attention inside the model must be ring/Ulysses attention bound to the
+``seq`` axis (see ``models/transformer.py`` attn_fn).
+
+This is a TPU-native extension beyond the reference framework (which is
+model-agnostic DP only, SURVEY.md §2.3) — required for long-context
+workloads where one chip cannot hold a full sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..common.types import Average, ReduceOp
+from .mesh import DATA_AXIS, SEQ_AXIS
+
+
+def make_sp_train_step(
+    loss_fn: Callable,
+    optimizer,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQ_AXIS,
+    fusion_threshold_bytes: int = 64 * 1024 * 1024,
+    donate: bool = True,
+):
+    """Build a jitted DP×SP train step.
+
+    ``loss_fn(params, tokens, labels, positions) -> scalar`` runs on the
+    local [B/nd, T/ns] shard; ``positions`` carries global sequence offsets
+    for the shard. Batch arrays are [B, T] sharded P(data, seq).
+    """
+    import optax
+
+    from ..jax import _shard_map, allreduce_gradients
+
+    axes = (data_axis, seq_axis)
+
+    def step(params, opt_state, tokens, labels):
+        B, T = tokens.shape
+        seq_idx = lax.axis_index(seq_axis)
+        positions = jnp.broadcast_to(
+            seq_idx * T + jnp.arange(T), (B, T)
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, labels, positions
+        )
+        grads = allreduce_gradients(
+            grads, op=Average, axis_name=axes,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+        )
+        loss = lax.pmean(loss, axes)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    fn = _shard_map(
+        step,
+        mesh,
+        in_specs=(P(), P(), P(data_axis, seq_axis), P(data_axis, seq_axis)),
+        out_specs=P(),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,105 @@
+"""End-to-end sequence-parallel training: DP(2) x SP(4) mesh with ring
+attention inside the transformer, checked against the dense single-device
+computation."""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.models.transformer import TransformerLM
+from horovod_tpu.parallel.mesh import build_mesh
+from horovod_tpu.parallel.ring_attention import ring_attention
+from horovod_tpu.parallel.sp import make_sp_train_step
+
+VOCAB = 64
+
+
+def _data(B=4, T=32, seed=0):
+    rng = np.random.RandomState(seed)
+    tokens = rng.randint(0, VOCAB, (B, T)).astype(np.int32)
+    labels = np.roll(tokens, -1, axis=1).astype(np.int32)
+    return jnp.asarray(tokens), jnp.asarray(labels)
+
+
+def _loss_fn(model):
+    def loss(params, tokens, labels, positions):
+        logits = model.apply({"params": params}, tokens, positions=positions)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    return loss
+
+
+def test_sp_training_matches_dense():
+    mesh = build_mesh({"data": 2, "seq": 4})
+    sp_model = TransformerLM(
+        vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, max_len=64,
+        dtype=jnp.float32,
+        attn_fn=partial(ring_attention, axis_name="seq", causal=True),
+    )
+    dense_model = TransformerLM(
+        vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, max_len=64,
+        dtype=jnp.float32,
+    )
+    tokens, labels = _data()
+    params = dense_model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+
+    step = make_sp_train_step(_loss_fn(sp_model), tx, mesh, donate=False)
+
+    # dense reference step on the full batch
+    @jax.jit
+    def dense_step(p, s, tokens, labels):
+        def loss(p):
+            logits = dense_model.apply({"params": p}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+
+        l, g = jax.value_and_grad(loss)(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    dp = jax.tree.map(lambda x: x, params)
+    ds = tx.init(dp)
+    for i in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        dp, ds, dloss = dense_step(dp, ds, tokens, labels)
+        np.testing.assert_allclose(float(loss), float(dloss), rtol=1e-4)
+
+    flat_a = jax.tree.leaves(params)
+    flat_b = jax.tree.leaves(dp)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+        )
+
+
+def test_sp_training_bf16_converges():
+    mesh = build_mesh({"data": 2, "seq": 4})
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, max_len=64,
+        dtype=jnp.bfloat16, remat=True,
+        attn_fn=partial(ring_attention, axis_name="seq", causal=True),
+    )
+    # init with a dense twin: attn_fn doesn't affect the param structure,
+    # and ring attention needs a bound mesh axis that init (outside
+    # shard_map) doesn't have.
+    init_model = model.clone(attn_fn=None)
+    tokens, labels = _data(seed=1)
+    params = init_model.init(jax.random.PRNGKey(1), tokens[:1])["params"]
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step = make_sp_train_step(_loss_fn(model), tx, mesh, donate=False)
+    losses = []
+    for _ in range(15):
+        params, opt_state, loss = step(params, opt_state, tokens, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
