@@ -26,9 +26,9 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch-size", type=int, default=32, help="per-chip batch")
     parser.add_argument("--image-size", type=int, default=224)
-    parser.add_argument("--num-warmup-batches", type=int, default=3)
-    parser.add_argument("--num-batches-per-iter", type=int, default=10)
-    parser.add_argument("--num-iters", type=int, default=3)
+    parser.add_argument("--num-warmup-batches", type=int, default=5)
+    parser.add_argument("--num-batches-per-iter", type=int, default=50)
+    parser.add_argument("--num-iters", type=int, default=2)
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument(
         "--smoke", action="store_true", help="tiny shapes for CPU sanity runs"
@@ -106,7 +106,7 @@ def main() -> int:
         params, batch_stats, opt_state, loss = fn(
             params, batch_stats, opt_state, images, labels
         )
-    jax.block_until_ready(loss)
+    float(loss)  # full device->host roundtrip barrier
 
     img_secs = []
     for _ in range(args.num_iters):
@@ -115,7 +115,12 @@ def main() -> int:
             params, batch_stats, opt_state, loss = fn(
                 params, batch_stats, opt_state, images, labels
             )
-        jax.block_until_ready(loss)
+        # Fetch a value that depends on the *updated params* of the final
+        # step, not just its forward pass: guarantees every queued step
+        # fully executed before the clock stops (async dispatch can
+        # otherwise flatter the number).
+        first_param = jax.tree.leaves(params)[0]
+        np.asarray(jax.device_get(first_param[..., :1]))
         dt = time.perf_counter() - t0
         img_secs.append(global_batch * args.num_batches_per_iter / dt)
 

@@ -88,6 +88,9 @@ class ResponseCache {
   int32_t Lookup(const Request& r) const;
   void Put(const Request& r, const Response& resp);
   bool Get(int32_t bit, Response* out) const;
+  // Recover the canonical Request a bit stands for (coordinator side:
+  // a cache bit on the wire is a compressed re-announcement).
+  bool GetRequest(int32_t bit, Request* out) const;
   void Invalidate(const std::string& name);
   size_t size() const { return entries_.size(); }
   static std::string Key(const Request& r);
@@ -95,6 +98,7 @@ class ResponseCache {
  private:
   struct Entry {
     std::string key;
+    Request request;
     Response response;
     uint64_t last_used = 0;
   };
@@ -219,6 +223,7 @@ class Core {
   int64_t fusion_threshold() const { return params_.fusion_threshold(); }
 
   Timeline& timeline() { return timeline_; }
+  size_t cache_size() const { return cache_.size(); }
 
  private:
   Core() = default;

@@ -200,6 +200,9 @@ int hvd_core_ticket_status(unsigned long long ticket, char* err, int errlen) {
 }
 
 double hvd_core_cycle_time_ms() { return Core::Get().cycle_time_ms(); }
+long long hvd_core_cache_size() {
+  return static_cast<long long>(Core::Get().cache_size());
+}
 long long hvd_core_fusion_threshold() {
   return Core::Get().fusion_threshold();
 }

@@ -223,7 +223,7 @@ void ResponseCache::Put(const Request& r, const Response& resp) {
     }
     index_.erase(entries_[bit].key);
   }
-  entries_[bit] = Entry{key, resp, ++tick_};
+  entries_[bit] = Entry{key, r, resp, ++tick_};
   index_[key] = bit;
 }
 
@@ -232,6 +232,14 @@ bool ResponseCache::Get(int32_t bit, Response* out) const {
   if (bit < 0 || static_cast<size_t>(bit) >= entries_.size()) return false;
   if (entries_[bit].key.empty()) return false;
   *out = entries_[bit].response;
+  return true;
+}
+
+bool ResponseCache::GetRequest(int32_t bit, Request* out) const {
+  std::lock_guard<std::mutex> l(mu_);
+  if (bit < 0 || static_cast<size_t>(bit) >= entries_.size()) return false;
+  if (entries_[bit].key.empty()) return false;
+  *out = entries_[bit].request;
   return true;
 }
 
@@ -526,6 +534,24 @@ void Core::BackgroundLoop() {
   }
 }
 
+namespace {
+void SetBit(std::vector<uint8_t>& bits, int32_t b) {
+  size_t byte = static_cast<size_t>(b) / 8;
+  if (bits.size() <= byte) bits.resize(byte + 1, 0);
+  bits[byte] |= static_cast<uint8_t>(1u << (b % 8));
+}
+
+std::vector<int32_t> BitsToList(const std::vector<uint8_t>& bits) {
+  std::vector<int32_t> out;
+  for (size_t i = 0; i < bits.size(); ++i) {
+    for (int j = 0; j < 8; ++j) {
+      if (bits[i] & (1u << j)) out.push_back(static_cast<int32_t>(i * 8 + j));
+    }
+  }
+  return out;
+}
+}  // namespace
+
 void Core::RunCycleOnce() {
   timeline_.MarkCycle();
   RequestList mine;
@@ -533,6 +559,22 @@ void Core::RunCycleOnce() {
     std::lock_guard<std::mutex> l(table_mu_);
     mine.requests = std::move(queued_);
     queued_.clear();
+  }
+  if (cache_.capacity() > 0) {
+    // Response-cache fast path (reference controller.cc:157-186): an
+    // already-seen request signature travels as one bit instead of the
+    // full Request; the coordinator reconstructs it from its own
+    // (deterministically identical) cache.
+    std::vector<Request> full;
+    for (auto& req : mine.requests) {
+      int32_t bit = req.type == RequestType::kJoin ? -1 : cache_.Lookup(req);
+      if (bit >= 0) {
+        SetBit(mine.cache_bits, bit);
+      } else {
+        full.push_back(std::move(req));
+      }
+    }
+    mine.requests = std::move(full);
   }
   for (auto& r : mine.requests) {
     if (r.type != RequestType::kJoin) {
@@ -606,6 +648,20 @@ const char* TypeName(RequestType t) {
 ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
   ResponseList out;
   std::vector<Request> ready;
+  for (size_t rank_i = 0; rank_i < lists.size(); ++rank_i) {
+    auto& rl = lists[rank_i];
+    for (int32_t bit : BitsToList(rl.cache_bits)) {
+      Request req;
+      if (cache_.GetRequest(bit, &req)) {
+        req.rank = static_cast<int32_t>(rank_i);
+        rl.requests.push_back(std::move(req));
+      } else {
+        HVD_LOG(kWarn, "rank " + std::to_string(rank_i) +
+                           " announced unknown cache bit " +
+                           std::to_string(bit));
+      }
+    }
+  }
   for (auto& rl : lists) {
     if (rl.shutdown) out.shutdown = true;
     for (auto& req : rl.requests) {
@@ -765,6 +821,37 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
 
 void Core::DispatchResponses(const ResponseList& rl) {
   for (const auto& resp : rl.responses) {
+    if (cache_.capacity() > 0) {
+      if (resp.type == ResponseType::kError) {
+        for (const auto& name : resp.names) cache_.Invalidate(name);
+      } else if (resp.type != ResponseType::kJoin) {
+        // Per-name (pre-fusion) entries, in dispatch order — identical on
+        // all ranks, so bit numbering stays coherent without an explicit
+        // eviction-sync round.
+        for (size_t i = 0; i < resp.names.size(); ++i) {
+          Request req;
+          req.type = static_cast<RequestType>(
+              static_cast<uint8_t>(resp.type));
+          req.dtype = resp.dtype;
+          req.root_rank = resp.root_rank;
+          req.reduce_op = resp.reduce_op;
+          req.prescale = resp.prescale;
+          req.postscale = resp.postscale;
+          req.name = resp.names[i];
+          if (i < resp.entry_shapes.size()) req.shape = resp.entry_shapes[i];
+          Response single;
+          single.type = resp.type;
+          single.dtype = resp.dtype;
+          single.root_rank = resp.root_rank;
+          single.reduce_op = resp.reduce_op;
+          single.prescale = resp.prescale;
+          single.postscale = resp.postscale;
+          single.names = {resp.names[i]};
+          single.entry_shapes = {req.shape};
+          cache_.Put(req, single);
+        }
+      }
+    }
     // Remove entries from the local table; names this rank never submitted
     // (Join zero-substitution) stay absent — the executor fabricates zeros
     // from entry_shapes.

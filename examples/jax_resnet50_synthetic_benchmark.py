@@ -1,0 +1,20 @@
+"""ResNet-50 synthetic benchmark — compiled mode (the flagship path).
+
+The analogue of the reference's ``examples/tensorflow2_synthetic_benchmark.py``
+re-designed TPU-first: the whole step (fwd + bwd + fused gradient allreduce
++ update) is one XLA program over the device mesh. Delegates to ``bench.py``
+at the repo root (the driver-run variant) — same flags.
+
+Usage:
+  python examples/jax_resnet50_synthetic_benchmark.py [--batch-size 32] [--smoke]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(bench.main())

@@ -1,0 +1,66 @@
+"""tf.keras MNIST with DistributedOptimizer + callbacks.
+
+The analogue of the reference's ``examples/tensorflow2_keras_mnist.py``:
+wrapped optimizer, broadcast callback, metric averaging, LR warmup.
+Synthetic data for hermetic runs.
+
+Run:  python -m horovod_tpu.run -np 2 python examples/tensorflow2_keras_mnist.py
+"""
+
+import os as _os
+import sys as _sys
+
+try:  # allow running from a source checkout without installation
+    import horovod_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    hvd.init()
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((28, 28, 1)),
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+
+    scaled_lr = 0.001 * hvd.size()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.Adam(scaled_lr))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(512, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, (512,)).astype(np.int32)
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=scaled_lr, warmup_epochs=2, steps_per_epoch=16
+        ),
+    ]
+    model.fit(x, y, batch_size=32, epochs=3,
+              verbose=1 if hvd.rank() == 0 else 0, callbacks=callbacks)
+
+    if hvd.rank() == 0:
+        model.save("/tmp/hvd_tpu_keras_mnist.keras")
+        print("model saved")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

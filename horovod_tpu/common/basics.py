@@ -84,6 +84,7 @@ def load() -> ctypes.CDLL:
         ctypes.c_ulonglong, ctypes.c_char_p, ctypes.c_int,
     ]
     lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_core_cache_size.restype = ctypes.c_longlong
     lib.hvd_core_fusion_threshold.restype = ctypes.c_longlong
     lib.hvd_core_timeline_activity.restype = None
     lib.hvd_core_timeline_activity.argtypes = [
@@ -178,6 +179,9 @@ class NativeCore:
 
     def fusion_threshold(self) -> int:
         return int(self.lib.hvd_core_fusion_threshold())
+
+    def cache_size(self) -> int:
+        return int(self.lib.hvd_core_cache_size())
 
     def timeline_activity(self, tensor: str, activity: str, begin: bool):
         self.lib.hvd_core_timeline_activity(

@@ -1,0 +1,98 @@
+"""Checkpoint/resume helpers.
+
+The reference delegates checkpointing to the frameworks and supplies the
+*consistency* primitives (broadcast of restored state + rank-0-saves
+convention; SURVEY.md §5 "Checkpoint / resume"). This module packages that
+pattern for JAX pytrees: orbax-backed when available, npz fallback, with
+``restore_checkpoint(..., broadcast=True)`` ensuring every rank resumes
+from identical state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+def _flatten(tree: Any):
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    return leaves, treedef
+
+
+def save_checkpoint(path: str, tree: Any, step: int = 0,
+                    use_orbax: Optional[bool] = None) -> str:
+    """Save a pytree. Call from rank 0 only (the reference convention:
+    'save only on rank 0')."""
+    if use_orbax is None:
+        try:
+            import orbax.checkpoint  # noqa: F401
+
+            use_orbax = True
+        except ImportError:
+            use_orbax = False
+    os.makedirs(path, exist_ok=True)
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckpt_dir = os.path.join(os.path.abspath(path), f"step_{step}")
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(ckpt_dir, tree, force=True)
+    else:
+        import jax
+
+        leaves, _ = _flatten(tree)
+        np.savez(
+            os.path.join(path, f"step_{step}.npz"),
+            **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+        )
+    with open(os.path.join(path, "latest.json"), "w") as f:
+        json.dump({"step": step, "orbax": use_orbax}, f)
+    return path
+
+
+def latest_step(path: str) -> Optional[int]:
+    meta = os.path.join(path, "latest.json")
+    if not os.path.exists(meta):
+        return None
+    with open(meta) as f:
+        return int(json.load(f)["step"])
+
+
+def restore_checkpoint(path: str, target: Any, step: Optional[int] = None,
+                       broadcast: bool = True, root_rank: int = 0) -> Any:
+    """Restore a pytree saved by ``save_checkpoint``. With
+    ``broadcast=True`` (default) the restored state is broadcast from
+    ``root_rank`` so ranks that resumed from stale/missing files still end
+    up consistent — the reference's restart pattern."""
+    meta_path = os.path.join(path, "latest.json")
+    tree = target
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        step = meta["step"] if step is None else step
+        if meta.get("orbax"):
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.PyTreeCheckpointer()
+            tree = ckptr.restore(
+                os.path.join(os.path.abspath(path), f"step_{step}"),
+                item=target,
+            )
+        else:
+            import jax
+
+            data = np.load(os.path.join(path, f"step_{step}.npz"))
+            leaves, treedef = _flatten(target)
+            restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
+            tree = jax.tree.unflatten(treedef, restored)
+    if broadcast:
+        import horovod_tpu as hvd
+
+        if hvd.is_initialized() and hvd.size() > 1:
+            tree = hvd.broadcast_variables(tree, root_rank=root_rank)
+    return tree

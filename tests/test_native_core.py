@@ -166,3 +166,24 @@ def test_join_plan_roundtrip(core):
             break
         time.sleep(0.005)
     assert state == 1
+
+
+def test_response_cache_roundtrip(core):
+    """Second submission of the same signature rides the cache-bit path and
+    still completes with a correct plan."""
+    core.enqueue(0, "cached", 7, [8], -1, 2, 1.0, 1.0)
+    plans = _drain_plans(core, max_plans=1)
+    assert plans and core.cache_size() >= 1
+    # same name+shape+op again: travels as a cache bit this time
+    t = core.enqueue(0, "cached", 7, [8], -1, 2, 1.0, 1.0)
+    plans = _drain_plans(core, max_plans=1)
+    assert plans and plans[0]["names"] == ["cached"]
+    assert plans[0]["shapes"] == [[8]]
+    deadline = time.monotonic() + 2
+    state = 0
+    while time.monotonic() < deadline:
+        state, _ = core.ticket_status(t)
+        if state != 0:
+            break
+        time.sleep(0.005)
+    assert state == 1
