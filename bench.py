@@ -33,6 +33,11 @@ def main() -> int:
     parser.add_argument(
         "--smoke", action="store_true", help="tiny shapes for CPU sanity runs"
     )
+    parser.add_argument(
+        "--scan", action="store_true",
+        help="fold each iter's batches into one on-device lax.scan "
+             "(removes host dispatch from the measurement)",
+    )
     args = parser.parse_args()
 
     if args.smoke:
@@ -101,20 +106,55 @@ def main() -> int:
         donate_argnums=(0, 1, 2),
     )
 
+    if args.scan:
+        # Train-loop-on-device: one jit runs num_batches_per_iter steps via
+        # lax.scan (the idiomatic TPU shape — zero host round-trips inside
+        # the timed region).
+        def scan_steps(p, bs, s, x, y):
+            def body(carry, _):
+                p, bs, s = carry
+                p, bs, s, loss = step(p, bs, s, x, y)
+                return (p, bs, s), loss
+
+            (p, bs, s), losses = jax.lax.scan(
+                body, (p, bs, s), None, length=args.num_batches_per_iter
+            )
+            return p, bs, s, losses[-1]
+
+        fn_scan = jax.jit(
+            _shard_map(
+                scan_steps,
+                mesh,
+                in_specs=(P(), P(), P(), P("data"), P("data")),
+                out_specs=P(),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+
     # Warmup (includes compile).
-    for _ in range(args.num_warmup_batches):
-        params, batch_stats, opt_state, loss = fn(
+    if args.scan:
+        params, batch_stats, opt_state, loss = fn_scan(
             params, batch_stats, opt_state, images, labels
         )
+    else:
+        for _ in range(args.num_warmup_batches):
+            params, batch_stats, opt_state, loss = fn(
+                params, batch_stats, opt_state, images, labels
+            )
     float(loss)  # full device->host roundtrip barrier
 
     img_secs = []
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            params, batch_stats, opt_state, loss = fn(
+        if args.scan:
+            params, batch_stats, opt_state, loss = fn_scan(
                 params, batch_stats, opt_state, images, labels
             )
+        else:
+            for _ in range(args.num_batches_per_iter):
+                params, batch_stats, opt_state, loss = fn(
+                    params, batch_stats, opt_state, images, labels
+                )
         # Fetch a value that depends on the *updated params* of the final
         # step, not just its forward pass: guarantees every queued step
         # fully executed before the clock stops (async dispatch can

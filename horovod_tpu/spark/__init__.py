@@ -1,0 +1,124 @@
+"""horovod_tpu.spark — Spark integration (gated).
+
+The reference runs the training stack inside Spark executors
+(``horovod/spark/__init__.py:36-235``: driver service collects task host
+hashes, launches ranks through the Spark task service, returns per-task
+results). PySpark is not installed in this environment, so the module is
+import-gated; when PySpark is present, ``run(fn)`` drives the same flow as
+the reference by mapping a barrier-stage job onto the ``horovod_tpu.run``
+launcher primitives (slot allocation from executor hosts, env plumbing,
+pickled fn shipping, per-task result collection).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+try:
+    import pyspark  # noqa: F401
+
+    _SPARK_AVAILABLE = True
+except ImportError:
+    _SPARK_AVAILABLE = False
+
+_MSG = (
+    "PySpark is not installed in this environment. horovod_tpu.spark.run() "
+    "requires pyspark; use horovod_tpu.run.run() (process fan-out) or "
+    "hvdrun for non-Spark clusters."
+)
+
+
+def run(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    num_proc: Optional[int] = None,
+    env: Optional[dict] = None,
+    verbose: int = 1,
+) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` Spark tasks (reference
+    ``horovod.spark.run`` signature)."""
+    if not _SPARK_AVAILABLE:
+        raise ImportError(_MSG)
+    import socket
+
+    from pyspark import SparkContext, TaskContext
+
+    from ..run import launcher
+    from ..run.http_server import KVStoreClient, KVStoreServer
+
+    kwargs = kwargs or {}
+    sc = SparkContext.getOrCreate()
+    if num_proc is None:
+        num_proc = max(int(sc.defaultParallelism), 1)
+
+    # Rendezvous KV on the driver: tasks register their hosts, then wait
+    # for their rank env and run fn (the reference's driver/task service
+    # handshake collapsed onto the HTTP KV store).
+    server = KVStoreServer()
+    port = server.start()
+    driver_addr = socket.gethostbyname(socket.gethostname())
+
+    import pickle
+
+    fn_blob = pickle.dumps((fn, args, kwargs))
+
+    def task(index):
+        client = KVStoreClient(driver_addr, port)
+        client.put("hosts", str(index), socket.gethostname().encode())
+        slot_blob = client.wait("slots", str(index), timeout=120)
+        slot_env = pickle.loads(slot_blob)
+        import os
+
+        os.environ.update(slot_env)
+        f, a, kw = pickle.loads(fn_blob)
+        result = f(*a, **kw)
+        client.put("results", str(index), pickle.dumps(result))
+        return [index]
+
+    import threading
+
+    def allocator():
+        client = KVStoreClient("127.0.0.1", port)
+        hosts = {}
+        while len(hosts) < num_proc:
+            for i in range(num_proc):
+                v = client.get("hosts", str(i))
+                if v is not None:
+                    hosts[i] = v.decode()
+        host_counts: dict = {}
+        for i in sorted(hosts):
+            host_counts[hosts[i]] = host_counts.get(hosts[i], 0) + 1
+        slots = launcher.allocate(list(host_counts.items()), num_proc)
+        controller_port = launcher._free_port()
+        jax_port = launcher._free_port()
+        by_host: dict = {}
+        for i in sorted(hosts):
+            h = hosts[i]
+            slot = slots[len(by_host.setdefault("_all", []))]
+            by_host["_all"].append(i)
+            env = launcher.build_rank_env(
+                slot, {}, hosts[0], controller_port,
+                f"{hosts[0]}:{jax_port}",
+            )
+            client.put("slots", str(i), pickle.dumps(env))
+
+    t = threading.Thread(target=allocator, daemon=True)
+    t.start()
+    try:
+        sc.parallelize(range(num_proc), num_proc).barrier().mapPartitions(
+            lambda it: task(next(it))
+        ).collect()
+        client = KVStoreClient("127.0.0.1", port)
+        return [
+            pickle.loads(client.wait("results", str(i), timeout=60))
+            for i in range(num_proc)
+        ]
+    finally:
+        server.stop()
+
+
+def __getattr__(name):
+    if not _SPARK_AVAILABLE and name not in ("run", "_SPARK_AVAILABLE"):
+        raise ImportError(_MSG)
+    raise AttributeError(name)

@@ -254,3 +254,81 @@ def test_torch_distributed_optimizer_two_ranks():
     w1 = [l for l in outs[1].splitlines() if l.startswith("W ")]
     assert w0 and w1
     assert w0 == w1, (w0, w1)
+
+
+def test_adasum_eager_two_ranks():
+    """Eager op=Adasum across 2 real ranks vs the NumPy VHDD reference."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        from horovod_tpu.ops.adasum import adasum_allreduce_reference
+        hvd.init()
+        import jax.numpy as jnp
+        vecs = [np.linspace(1, 2, 8).astype(np.float32),
+                np.linspace(-1, 1, 8).astype(np.float32)]
+        mine = jnp.asarray(vecs[hvd.rank()])
+        out = hvd.allreduce(mine, op=hvd.Adasum, name="adasum0")
+        expected = adasum_allreduce_reference(vecs)
+        ok = np.allclose(np.asarray(out), expected, rtol=1e-5)
+        print("ADASUM_OK", bool(ok))
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "ADASUM_OK True" in out, outs
+
+
+def test_alltoall_two_ranks():
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        r = hvd.rank()
+        # rank r holds rows [r*2, r*2+1] -> after alltoall holds row r from
+        # each rank
+        x = jnp.asarray(np.arange(r * 2, r * 2 + 2, dtype=np.float32))
+        out = hvd.alltoall(x.reshape(2, 1))
+        print("A2A", np.asarray(out).reshape(-1).tolist())
+        hvd.shutdown()
+        """
+    )
+    assert "A2A [0.0, 2.0]" in outs[0], outs
+    assert "A2A [1.0, 3.0]" in outs[1], outs
+
+
+def test_timeline_two_ranks(tmp_path):
+    """Each rank writes its own chrome-trace via the C++ writer."""
+    import json
+
+    td = str(tmp_path)
+    outs = _run_workers(
+        f"""
+        import os, numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        os.environ['HOROVOD_TIMELINE'] = (
+            '{td}/tl.' + os.environ['HOROVOD_RANK'] + '.json')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        hvd.allreduce(jnp.ones((4,), jnp.float32), name='tl_t')
+        hvd.shutdown()
+        print('TL_DONE')
+        """
+    )
+    for r in range(2):
+        with open(f"{td}/tl.{r}.json") as f:
+            events = json.load(f)
+        names = {e.get("name") for e in events}
+        assert "XLA_ALLREDUCE" in names, (r, sorted(names))
+
+
+def test_spark_gated():
+    import horovod_tpu.spark as hvds
+
+    with pytest.raises(ImportError, match="pyspark"):
+        hvds.run(lambda: 0)
