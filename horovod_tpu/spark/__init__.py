@@ -7,11 +7,12 @@ results). PySpark is not installed in this environment, so the module is
 import-gated; when PySpark is present, ``run(fn)`` drives the same flow as
 the reference by mapping a barrier-stage job onto the ``horovod_tpu.run``
 launcher primitives (slot allocation from executor hosts, env plumbing,
-pickled fn shipping, per-task result collection).
+per-task result collection).
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, List, Optional
 
 try:
@@ -27,6 +28,8 @@ _MSG = (
     "hvdrun for non-Spark clusters."
 )
 
+_ERROR_KEY = "__hvd_allocator_error__"
+
 
 def run(
     fn: Callable,
@@ -37,17 +40,20 @@ def run(
     verbose: int = 1,
 ) -> List[Any]:
     """Run ``fn`` on ``num_proc`` Spark tasks (reference
-    ``horovod.spark.run`` signature)."""
+    ``horovod.spark.run`` signature). ``env`` is the base environment
+    merged under the per-rank HOROVOD_* variables on every task."""
     if not _SPARK_AVAILABLE:
         raise ImportError(_MSG)
+    import pickle
     import socket
 
-    from pyspark import SparkContext, TaskContext
+    from pyspark import SparkContext
 
     from ..run import launcher
     from ..run.http_server import KVStoreClient, KVStoreServer
 
     kwargs = kwargs or {}
+    base_env = dict(env or {})
     sc = SparkContext.getOrCreate()
     if num_proc is None:
         num_proc = max(int(sc.defaultParallelism), 1)
@@ -59,49 +65,71 @@ def run(
     port = server.start()
     driver_addr = socket.gethostbyname(socket.gethostname())
 
-    import pickle
-
-    fn_blob = pickle.dumps((fn, args, kwargs))
-
+    # fn/args/kwargs ride inside the task closure so Spark's cloudpickle
+    # serializes them (stdlib pickle rejects lambdas and local functions,
+    # which are the common Spark-notebook case).
     def task(index):
+        import os
+        import pickle as _p
+
         client = KVStoreClient(driver_addr, port)
         client.put("hosts", str(index), socket.gethostname().encode())
         slot_blob = client.wait("slots", str(index), timeout=120)
-        slot_env = pickle.loads(slot_blob)
-        import os
-
+        slot_env = _p.loads(slot_blob)
+        if _ERROR_KEY in slot_env:
+            raise RuntimeError(
+                f"slot allocation failed on the driver: {slot_env[_ERROR_KEY]}"
+            )
         os.environ.update(slot_env)
-        f, a, kw = pickle.loads(fn_blob)
-        result = f(*a, **kw)
-        client.put("results", str(index), pickle.dumps(result))
+        result = fn(*args, **kwargs)
+        client.put("results", str(index), _p.dumps(result))
         return [index]
 
     import threading
 
+    alloc_error: list = []
+
     def allocator():
         client = KVStoreClient("127.0.0.1", port)
-        hosts = {}
-        while len(hosts) < num_proc:
+        try:
+            hosts: dict = {}
+            while len(hosts) < num_proc:
+                progress = False
+                for i in range(num_proc):
+                    if i in hosts:
+                        continue
+                    v = client.get("hosts", str(i))
+                    if v is not None:
+                        hosts[i] = v.decode()
+                        progress = True
+                if not progress:
+                    time.sleep(0.1)
+            host_counts: dict = {}
+            for i in sorted(hosts):
+                host_counts[hosts[i]] = host_counts.get(hosts[i], 0) + 1
+            slots = launcher.allocate(list(host_counts.items()), num_proc)
+            # allocate() groups slots by host; hand each task index a slot
+            # on the host it actually runs on.
+            slots_by_host: dict = {}
+            for slot in slots:
+                slots_by_host.setdefault(slot.hostname, []).append(slot)
+            controller_port = launcher._free_port()
+            jax_port = launcher._free_port()
+            for i in sorted(hosts):
+                slot = slots_by_host[hosts[i]].pop(0)
+                rank_env = launcher.build_rank_env(
+                    slot, dict(base_env), hosts[0], controller_port,
+                    f"{hosts[0]}:{jax_port}",
+                )
+                client.put("slots", str(i), pickle.dumps(rank_env))
+        except Exception as e:  # propagate: fail tasks fast, re-raise on driver
+            alloc_error.append(e)
+            blob = pickle.dumps({_ERROR_KEY: repr(e)})
             for i in range(num_proc):
-                v = client.get("hosts", str(i))
-                if v is not None:
-                    hosts[i] = v.decode()
-        host_counts: dict = {}
-        for i in sorted(hosts):
-            host_counts[hosts[i]] = host_counts.get(hosts[i], 0) + 1
-        slots = launcher.allocate(list(host_counts.items()), num_proc)
-        controller_port = launcher._free_port()
-        jax_port = launcher._free_port()
-        by_host: dict = {}
-        for i in sorted(hosts):
-            h = hosts[i]
-            slot = slots[len(by_host.setdefault("_all", []))]
-            by_host["_all"].append(i)
-            env = launcher.build_rank_env(
-                slot, {}, hosts[0], controller_port,
-                f"{hosts[0]}:{jax_port}",
-            )
-            client.put("slots", str(i), pickle.dumps(env))
+                try:
+                    client.put("slots", str(i), blob)
+                except Exception:
+                    pass
 
     t = threading.Thread(target=allocator, daemon=True)
     t.start()
@@ -109,16 +137,16 @@ def run(
         sc.parallelize(range(num_proc), num_proc).barrier().mapPartitions(
             lambda it: task(next(it))
         ).collect()
+        if alloc_error:
+            raise alloc_error[0]
         client = KVStoreClient("127.0.0.1", port)
         return [
             pickle.loads(client.wait("results", str(i), timeout=60))
             for i in range(num_proc)
         ]
+    except Exception:
+        if alloc_error:
+            raise alloc_error[0]
+        raise
     finally:
         server.stop()
-
-
-def __getattr__(name):
-    if not _SPARK_AVAILABLE and name not in ("run", "_SPARK_AVAILABLE"):
-        raise ImportError(_MSG)
-    raise AttributeError(name)
