@@ -178,6 +178,19 @@ def launch_job(
     controller_addr = (
         slots[0].hostname if not _is_local(slots[0].hostname) else "127.0.0.1"
     )
+    # HOROVOD_IFACE (explicit flag or ring-probe result, reference
+    # NCCL_SOCKET_IFNAME/gloo-iface role): bind the control plane to the
+    # first routable interface's address instead of the hostname default.
+    iface = base_env.get("HOROVOD_IFACE", "").split(",")[0]
+    if iface and _is_local(slots[0].hostname):
+        from . import network as _network
+
+        try:
+            addr = _network.interface_address(iface)
+        except Exception:
+            addr = None  # enumeration unavailable; keep the hostname default
+        if addr:
+            controller_addr = addr
     controller_port = _free_port()
     jax_coordinator = f"{controller_addr}:{_free_port()}"
 

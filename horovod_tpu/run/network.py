@@ -1,0 +1,663 @@
+"""HMAC-authenticated TCP request/response services for launcher-time
+coordination: task registration, ring-wise NIC reachability probing, and
+remote command execution.
+
+Parity surface (behavior, not code) with the reference launcher's probe
+plane:
+
+- secret key + HMAC-SHA256 digest framing — ``run/common/util/secret.py:26-36``
+- ``Wire`` message format (digest | length | pickled body) —
+  ``run/common/util/network.py`` ``Wire`` class
+- driver service collecting per-task addresses and host hashes, task
+  services pinged ring-wise with *interface matching* to weed out NAT'ed /
+  unroutable interfaces — ``run/driver/driver_service.py``,
+  ``run/task/task_service.py``, ``run/task_fn.py:1-67``
+
+The TPU-native deviation: on TPU pods the launcher usually already knows the
+topology from slice metadata (``launcher.tpu_pod_allocation``), so this
+probe plane is only engaged for the generic multi-host ssh path, and the
+discovered interface set is exported as ``HOROVOD_IFACE`` for the
+rendezvous/control plane rather than feeding an MPI ``-mca btl_tcp_if``
+flag.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SECRET_LENGTH = 32
+DIGEST_LENGTH = 32
+SECRET_ENV = "HOROVOD_SECRET_KEY"
+MAX_MESSAGE_BYTES = 64 * 1024 * 1024
+
+
+def make_secret_key() -> bytes:
+    return os.urandom(SECRET_LENGTH)
+
+
+def compute_digest(key: bytes, message: bytes) -> bytes:
+    return hmac.new(key, message, hashlib.sha256).digest()
+
+
+def check_digest(key: bytes, message: bytes, digest: bytes) -> bool:
+    return hmac.compare_digest(compute_digest(key, message), digest)
+
+
+def encode_key(key: bytes) -> str:
+    return key.hex()
+
+
+def decode_key(text: str) -> bytes:
+    return bytes.fromhex(text)
+
+
+class WireError(Exception):
+    """Digest mismatch or malformed frame."""
+
+
+class Wire:
+    """digest(32) | body_len(4, network order) | pickled body.
+
+    Every frame is authenticated with HMAC-SHA256 before unpickling — an
+    unauthenticated peer cannot reach the pickle layer.
+    """
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def write(self, obj: Any, wfile) -> None:
+        body = pickle.dumps(obj)
+        wfile.write(compute_digest(self._key, body))
+        wfile.write(struct.pack("!I", len(body)))
+        wfile.write(body)
+        wfile.flush()
+
+    def read(self, rfile) -> Any:
+        digest = _read_exact(rfile, DIGEST_LENGTH)
+        (length,) = struct.unpack("!I", _read_exact(rfile, 4))
+        if length > MAX_MESSAGE_BYTES:
+            raise WireError(f"frame too large: {length} bytes")
+        body = _read_exact(rfile, length)
+        if not check_digest(self._key, body, digest):
+            raise WireError("security error: digest did not match the message")
+        return pickle.loads(body)
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = rfile.read(remaining)
+        if not chunk:
+            raise EOFError("connection closed mid-frame")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+# --- messages -------------------------------------------------------------
+
+
+class PingRequest:
+    pass
+
+
+@dataclass
+class PingResponse:
+    service_name: str
+    source_address: str
+
+
+@dataclass
+class AckResponse:
+    pass
+
+
+@dataclass
+class RegisterTaskRequest:
+    index: int
+    addresses: Dict[str, List[Tuple[str, int]]]
+    host_hash: str
+
+
+@dataclass
+class AllTaskAddressesRequest:
+    index: int
+
+
+@dataclass
+class AllTaskAddressesResponse:
+    addresses: Dict[str, List[Tuple[str, int]]]
+
+
+@dataclass
+class RegisterTaskToTaskAddressesRequest:
+    index: int
+    addresses: Dict[str, List[Tuple[str, int]]]
+
+
+@dataclass
+class AddressCheckFinishedSignal:
+    index: int
+
+
+@dataclass
+class RunCommandRequest:
+    command: str
+    env: Dict[str, str]
+
+
+@dataclass
+class CommandExitCodeRequest:
+    pass
+
+
+@dataclass
+class CommandExitCodeResponse:
+    terminated: bool
+    exit_code: Optional[int]
+
+
+class NoValidAddressesFound(Exception):
+    pass
+
+
+# --- interface enumeration ------------------------------------------------
+
+
+def local_addresses(nic: Optional[str] = None) -> Dict[str, List[Tuple[str, int]]]:
+    """Map interface name → [(ipv4_addr, port)] for a given bound port.
+
+    Port is filled in by the service; this returns addr stubs with port 0.
+    Mirrors the psutil enumeration the reference services use to advertise
+    every candidate interface; falls back to an ioctl(SIOCGIFADDR)
+    enumeration when psutil is absent (it is not a hard dependency).
+    """
+    result: Dict[str, List[Tuple[str, int]]] = {}
+    try:
+        import psutil
+
+        for intf, addrs in psutil.net_if_addrs().items():
+            if nic and intf != nic:
+                continue
+            for a in addrs:
+                if a.family == socket.AF_INET:
+                    result.setdefault(intf, []).append((a.address, 0))
+        return result
+    except ImportError:
+        pass
+    import fcntl
+
+    for _, intf in socket.if_nameindex():
+        if nic and intf != nic:
+            continue
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            try:
+                packed = fcntl.ioctl(
+                    s.fileno(),
+                    0x8915,  # SIOCGIFADDR
+                    struct.pack("256s", intf.encode()[:15]),
+                )
+                addr = socket.inet_ntoa(packed[20:24])
+                result.setdefault(intf, []).append((addr, 0))
+            except OSError:
+                continue  # interface without an IPv4 address
+    return result
+
+
+# --- services -------------------------------------------------------------
+
+
+class BasicService:
+    """Threaded TCP server answering one authenticated request per
+    connection. Subclasses extend ``_handle``."""
+
+    def __init__(self, service_name: str, key: bytes, nic: Optional[str] = None):
+        self._service_name = service_name
+        self._wire = Wire(key)
+        self._nic = nic
+        service = self
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    req = service._wire.read(self.rfile)
+                    resp = service._handle(req, self.client_address)
+                    if resp is None:
+                        raise RuntimeError("handler returned no response")
+                    service._wire.write(resp, self.wfile)
+                except (EOFError, WireError):
+                    pass  # unauthenticated / truncated client; drop quietly
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server(("0.0.0.0", 0), _Handler)
+        self._port = self._server.socket.getsockname()[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self._cond = threading.Condition()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def addresses(self) -> Dict[str, List[Tuple[str, int]]]:
+        out = {}
+        for intf, addrs in local_addresses(self._nic).items():
+            out[intf] = [(a, self._port) for a, _ in addrs]
+        return out
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, PingRequest):
+            return PingResponse(self._service_name, client_address[0])
+        raise RuntimeError(
+            f"{self._service_name}: unknown request {type(req).__name__}"
+        )
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class BasicClient:
+    """Connects to the first reachable advertised address; with
+    ``match_intf=True`` keeps only interfaces whose service-visible source
+    address proves a working route (the reference's NAT-weeding check)."""
+
+    def __init__(
+        self,
+        service_name: str,
+        addresses: Dict[str, List[Tuple[str, int]]],
+        key: bytes,
+        match_intf: bool = False,
+        retries: int = 3,
+        timeout: float = 5.0,
+    ):
+        self._service_name = service_name
+        self._wire = Wire(key)
+        self._timeout = timeout
+        self._addresses = self._probe(addresses, match_intf, retries)
+        if not self._addresses:
+            raise NoValidAddressesFound(
+                f"no usable address for {service_name!r} among {addresses}"
+            )
+
+    def addresses(self) -> Dict[str, List[Tuple[str, int]]]:
+        return self._addresses
+
+    def _probe(self, addresses, match_intf: bool, retries: int):
+        usable: Dict[str, List[Tuple[str, int]]] = {}
+        local = local_addresses() if match_intf else {}
+        for intf, addrs in addresses.items():
+            for addr in addrs:
+                for _ in range(retries):
+                    try:
+                        resp = self._request(PingRequest(), addr)
+                    except (OSError, EOFError, WireError):
+                        continue
+                    if not isinstance(resp, PingResponse):
+                        continue
+                    if resp.service_name != self._service_name:
+                        break  # a different service answered; wrong port
+                    if match_intf:
+                        # NAT weeding (reference network.py match_intf):
+                        # the source address the *server* saw must belong
+                        # to our own same-named interface — i.e. reaching
+                        # the peer's intf X must route out of our intf X.
+                        own = {a for a, _ in local.get(intf, [])}
+                        if resp.source_address not in own:
+                            break
+                    usable.setdefault(intf, []).append(addr)
+                    break
+            if match_intf and intf in usable and len(usable[intf]) != len(addrs):
+                del usable[intf]
+        return usable
+
+    def _request(self, req: Any, addr: Tuple[str, int]) -> Any:
+        with socket.create_connection(addr, timeout=self._timeout) as sock:
+            rfile = sock.makefile("rb")
+            wfile = sock.makefile("wb")
+            self._wire.write(req, wfile)
+            return self._wire.read(rfile)
+
+    def send(self, req: Any) -> Any:
+        last_err: Optional[Exception] = None
+        for addrs in self._addresses.values():
+            for addr in addrs:
+                try:
+                    return self._request(req, addr)
+                except (OSError, EOFError, WireError) as e:
+                    # EOF = server handler raised and closed without a
+                    # response; try the remaining advertised addresses.
+                    last_err = e
+        raise last_err or NoValidAddressesFound(self._service_name)
+
+
+class DriverService(BasicService):
+    """Collects per-task registrations (addresses + host hash) and
+    task→next-task verified addresses (``run/driver/driver_service.py``
+    semantics)."""
+
+    NAME = "horovod_tpu driver service"
+
+    def __init__(self, num_tasks: int, key: bytes, nic: Optional[str] = None):
+        super().__init__(self.NAME, key, nic)
+        self._num_tasks = num_tasks
+        self._task_addrs: Dict[int, Dict[str, List[Tuple[str, int]]]] = {}
+        self._task_to_task_addrs: Dict[int, Dict[str, List[Tuple[str, int]]]] = {}
+        self._host_hashes: Dict[int, str] = {}
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, RegisterTaskRequest):
+            with self._cond:
+                self._task_addrs[req.index] = req.addresses
+                self._host_hashes[req.index] = req.host_hash
+                self._cond.notify_all()
+            return AckResponse()
+        if isinstance(req, AllTaskAddressesRequest):
+            with self._cond:
+                while req.index not in self._task_addrs:
+                    if not self._cond.wait(timeout=60):
+                        break
+                addrs = self._task_addrs.get(req.index)
+            if addrs is None:
+                raise RuntimeError(f"task {req.index} never registered")
+            return AllTaskAddressesResponse(addrs)
+        if isinstance(req, RegisterTaskToTaskAddressesRequest):
+            with self._cond:
+                self._task_to_task_addrs[req.index] = req.addresses
+                self._cond.notify_all()
+            return AckResponse()
+        return super()._handle(req, client_address)
+
+    def wait_for_initial_registration(self, timeout: float = 60.0) -> None:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: len(self._task_addrs) >= self._num_tasks, timeout=timeout
+            )
+        if not ok:
+            missing = sorted(
+                set(range(self._num_tasks)) - set(self._task_addrs)
+            )
+            raise TimeoutError(f"tasks never registered: {missing}")
+
+    def wait_for_task_to_task_addresses(self, timeout: float = 60.0) -> None:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: len(self._task_to_task_addrs) >= self._num_tasks,
+                timeout=timeout,
+            )
+        if not ok:
+            raise TimeoutError("ring address checks did not complete")
+
+    def task_addresses_for(self, index: int):
+        with self._cond:
+            return dict(self._task_addrs.get(index, {}))
+
+    def host_hashes(self) -> Dict[int, str]:
+        with self._cond:
+            return dict(self._host_hashes)
+
+    def common_interfaces(self) -> List[str]:
+        """Interfaces proven routable on every ring hop — the intersection
+        the reference computes in ``run/run.py:198-268``."""
+        with self._cond:
+            sets = [set(v.keys()) for v in self._task_to_task_addrs.values()]
+        if not sets:
+            return []
+        common = set.intersection(*sets)
+        return sorted(common)
+
+
+class TaskService(BasicService):
+    """Per-task probe service: answers pings (interface matching), relays
+    the ring 'address check finished' signal, and can run a shell command
+    on behalf of the driver (``run/common/service/task_service.py``
+    semantics — used by the Spark integration's rsh agent)."""
+
+    NAME_FORMAT = "horovod_tpu task service #%d"
+
+    def __init__(self, index: int, key: bytes, nic: Optional[str] = None):
+        super().__init__(self.NAME_FORMAT % index, key, nic)
+        self.index = index
+        self._check_finished = False
+        self._command_exit: Optional[int] = None
+        self._command_started = False
+
+    def _handle(self, req: Any, client_address) -> Any:
+        if isinstance(req, AddressCheckFinishedSignal):
+            with self._cond:
+                self._check_finished = True
+                self._cond.notify_all()
+            return AckResponse()
+        if isinstance(req, RunCommandRequest):
+            self._start_command(req.command, req.env)
+            return AckResponse()
+        if isinstance(req, CommandExitCodeRequest):
+            with self._cond:
+                return CommandExitCodeResponse(
+                    terminated=self._command_started
+                    and self._command_exit is not None,
+                    exit_code=self._command_exit,
+                )
+        return super()._handle(req, client_address)
+
+    def _start_command(self, command: str, env: Dict[str, str]) -> None:
+        from . import safe_shell_exec
+
+        def _run():
+            # ManagedProcess directly: safe_shell_exec.execute() installs
+            # signal handlers, which is main-thread-only.
+            mp = safe_shell_exec.ManagedProcess(
+                command, env={**os.environ, **env}, shell=True
+            )
+            code = mp.wait()
+            with self._cond:
+                self._command_exit = code
+                self._cond.notify_all()
+
+        with self._cond:
+            self._command_started = True
+        threading.Thread(target=_run, daemon=True).start()
+
+    def wait_for_address_check_finished(self, timeout: float = 60.0) -> None:
+        with self._cond:
+            ok = self._cond.wait_for(lambda: self._check_finished, timeout=timeout)
+        if not ok:
+            raise TimeoutError(f"task {self.index}: ring check signal missing")
+
+    def wait_for_command_exit(self, timeout: Optional[float] = None) -> int:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._command_exit is not None, timeout=timeout
+            )
+        if not ok:
+            raise TimeoutError("command did not terminate")
+        return int(self._command_exit)  # type: ignore[arg-type]
+
+
+class DriverClient(BasicClient):
+    def __init__(self, addresses, key, match_intf: bool = False, retries: int = 3):
+        super().__init__(DriverService.NAME, addresses, key, match_intf, retries)
+
+    def register_task(self, index, addresses, host_hash) -> None:
+        self.send(RegisterTaskRequest(index, addresses, host_hash))
+
+    def all_task_addresses(self, index):
+        return self.send(AllTaskAddressesRequest(index)).addresses
+
+    def register_task_to_task_addresses(self, index, addresses) -> None:
+        self.send(RegisterTaskToTaskAddressesRequest(index, addresses))
+
+
+class TaskClient(BasicClient):
+    def __init__(self, index, addresses, key, match_intf=False, retries=3):
+        super().__init__(
+            TaskService.NAME_FORMAT % index, addresses, key, match_intf, retries
+        )
+        self.index = index
+
+    def signal_address_check_finished(self) -> None:
+        self.send(AddressCheckFinishedSignal(self.index))
+
+    def run_command(self, command: str, env: Dict[str, str]) -> None:
+        self.send(RunCommandRequest(command, env))
+
+    def command_exit_code(self) -> CommandExitCodeResponse:
+        return self.send(CommandExitCodeRequest())
+
+
+def host_hash() -> str:
+    """Stable identifier grouping tasks that share a host (the reference
+    hashes hostname; same-hash tasks share local_rank space)."""
+    return hashlib.md5(socket.gethostname().encode()).hexdigest()
+
+
+def run_task_probe(
+    index: int,
+    num_tasks: int,
+    driver_addresses: Dict[str, List[Tuple[str, int]]],
+    key: bytes,
+    nic: Optional[str] = None,
+    timeout: float = 60.0,
+) -> None:
+    """One task's side of the ring NIC probe (``run/task_fn.py:23-53``):
+    register with the driver, ping the next task with interface matching,
+    report the verified addresses, pass the baton."""
+    task = TaskService(index, key, nic)
+    try:
+        driver = DriverClient(driver_addresses, key)
+        driver.register_task(index, task.addresses(), host_hash())
+        next_index = (index + 1) % num_tasks
+        next_addresses = driver.all_task_addresses(next_index)
+        next_task = TaskClient(
+            next_index, next_addresses, key, match_intf=True, retries=10
+        )
+        driver.register_task_to_task_addresses(
+            next_index, next_task.addresses()
+        )
+        next_task.signal_address_check_finished()
+        task.wait_for_address_check_finished(timeout)
+    finally:
+        task.shutdown()
+
+
+def interface_address(name: str) -> Optional[str]:
+    """First IPv4 address bound to interface ``name`` (None if absent)."""
+    addrs = local_addresses(name).get(name)
+    return addrs[0][0] if addrs else None
+
+
+def discover_common_interfaces(
+    hosts: Sequence[str],
+    *,
+    key: Optional[bytes] = None,
+    ssh_launch=None,
+    ssh_port: Optional[int] = None,
+    timeout: float = 60.0,
+) -> List[str]:
+    """Driver-side orchestration: start a DriverService, launch one probe
+    task per host (via ``ssh_launch(host, command_argv, env)`` or locally),
+    and return the interface names routable around the whole ring."""
+    import subprocess
+    import sys
+
+    key = key or make_secret_key()
+    driver = DriverService(len(hosts), key)
+    procs = []
+    try:
+        addrs = driver.addresses()
+        for i, host in enumerate(hosts):
+            argv = [
+                sys.executable,
+                "-m",
+                "horovod_tpu.run.probe",
+                str(i),
+                str(len(hosts)),
+            ]
+            env = {
+                **os.environ,
+                SECRET_ENV: encode_key(key),
+                "HOROVOD_PROBE_DRIVER_ADDRS": repr_addresses(addrs),
+            }
+            from .launcher import _is_local
+
+            if _is_local(host):
+                procs.append(subprocess.Popen(argv, env=env))
+            elif ssh_launch is not None:
+                procs.append(ssh_launch(host, argv, env))
+            else:
+                import shlex
+
+                # The secret is shipped over ssh's stdin, never on the
+                # command line — argv is visible to every user via ps.
+                env_str = " ".join(
+                    f"{k}={shlex.quote(v)}"
+                    for k, v in env.items()
+                    if k != SECRET_ENV
+                    and k.startswith(("HOROVOD_", "PATH", "PYTHONPATH"))
+                )
+                remote = (
+                    f"IFS= read -r _HVDKEY; {env_str} {SECRET_ENV}=\"$_HVDKEY\" "
+                    f"{' '.join(shlex.quote(a) for a in argv)}"
+                )
+                port_args = ["-p", str(ssh_port)] if ssh_port else []
+                p = subprocess.Popen(
+                    ["ssh", "-o", "StrictHostKeyChecking=no", *port_args,
+                     host, remote],
+                    stdin=subprocess.PIPE,
+                )
+                p.stdin.write((encode_key(key) + "\n").encode())
+                p.stdin.close()
+                procs.append(p)
+        driver.wait_for_initial_registration(timeout)
+        driver.wait_for_task_to_task_addresses(timeout)
+        return driver.common_interfaces()
+    finally:
+        deadline = 3.0  # grace for clean exits, shared across all procs
+        import time as _time
+
+        t0 = _time.monotonic()
+        for p in procs:
+            remaining = max(0.0, deadline - (_time.monotonic() - t0))
+            try:
+                p.wait(timeout=remaining)
+            except Exception:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=5)  # reap; no zombies for the driver lifetime
+            except Exception:
+                pass
+        driver.shutdown()
+
+
+def repr_addresses(addrs: Dict[str, List[Tuple[str, int]]]) -> str:
+    return ";".join(
+        f"{intf}={','.join(f'{a}:{p}' for a, p in lst)}"
+        for intf, lst in addrs.items()
+    )
+
+
+def parse_addresses(text: str) -> Dict[str, List[Tuple[str, int]]]:
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for part in filter(None, text.split(";")):
+        intf, _, rest = part.partition("=")
+        for item in filter(None, rest.split(",")):
+            host, _, port = item.rpartition(":")
+            out.setdefault(intf, []).append((host, int(port)))
+    return out

@@ -67,6 +67,9 @@ def parse_args(argv: Optional[List[str]] = None):
                         default=None)
     parser.add_argument("--log-level", default=None,
                         choices=["trace", "debug", "info", "warning", "error"])
+    parser.add_argument("--network-interfaces", default=None,
+                        help="Comma-separated NICs to use for the control "
+                             "plane; skips the automatic ring probe.")
     parser.add_argument("--mesh-axes", default=None,
                         help='Compiled-mode mesh spec, e.g. "data:4,model:2".')
     parser.add_argument("command", nargs=argparse.REMAINDER,
@@ -158,6 +161,32 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     env = dict(os.environ)
     config_parser.set_env_from_args(env, args)
+
+    # NIC selection for the multi-host control plane (reference
+    # run/run.py:198-268 driver/task ring probe): explicit flag wins; with
+    # multiple distinct remote hosts we probe ring-wise over the
+    # HMAC-authed services and export the routable intersection.
+    if args.network_interfaces:
+        env["HOROVOD_IFACE"] = args.network_interfaces
+    elif not args.tpu_pod:
+        # TPU pods know their topology from slice metadata and have no
+        # inter-worker ssh; the ring probe is only for the generic path.
+        hostnames = sorted({s.hostname for s in slots})
+        if len(hostnames) > 1:
+            from . import network
+
+            try:
+                common = network.discover_common_interfaces(
+                    hostnames, ssh_port=args.ssh_port
+                )
+                if common:
+                    env["HOROVOD_IFACE"] = ",".join(common)
+                    if args.verbose:
+                        print(f"[hvdrun] routable interfaces: {common}")
+            except Exception as e:  # probe is best-effort
+                print(f"[hvdrun] NIC probe failed ({e}); continuing without",
+                      file=sys.stderr)
+
     return launcher.launch_job(
         command,
         slots,
