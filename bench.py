@@ -34,9 +34,10 @@ def main() -> int:
         "--smoke", action="store_true", help="tiny shapes for CPU sanity runs"
     )
     parser.add_argument(
-        "--scan", action="store_true",
+        "--scan", action=argparse.BooleanOptionalAction, default=True,
         help="fold each iter's batches into one on-device lax.scan "
-             "(removes host dispatch from the measurement)",
+             "(removes host dispatch from the measurement; --no-scan "
+             "times per-step host dispatch instead)",
     )
     args = parser.parse_args()
 

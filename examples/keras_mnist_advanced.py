@@ -1,0 +1,88 @@
+"""tf.keras MNIST "advanced": full callback stack + rank-0 checkpointing.
+
+The analogue of the reference's ``examples/keras_mnist_advanced.py``:
+BroadcastGlobalVariables + MetricAverage + LearningRateWarmup callbacks,
+checkpoints written only on rank 0, and resume via ``hvd.load_model`` so the
+restored optimizer comes back distributed. Synthetic data for hermetic runs.
+
+Run:  python -m horovod_tpu.run -np 2 python examples/keras_mnist_advanced.py
+"""
+
+import os as _os
+import sys as _sys
+import tempfile
+
+try:  # allow running from a source checkout without installation
+    import horovod_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+
+def build_model(scaled_lr):
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((28, 28, 1)),
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dropout(0.25),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.Adam(scaled_lr))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True),
+        metrics=["accuracy"],
+    )
+    return model
+
+
+def main():
+    hvd.init()
+
+    scaled_lr = 0.001 * hvd.size()
+    model = build_model(scaled_lr)
+
+    rng = np.random.RandomState(hvd.rank())
+    x = rng.rand(256, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(256,)).astype(np.int64)
+
+    callbacks = [
+        # Sync initial state across ranks (reference keras_mnist_advanced.py).
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        # Average validation metrics across ranks.
+        hvd.callbacks.MetricAverageCallback(),
+        # Ramp LR from base to scaled over warmup epochs.
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=scaled_lr, warmup_epochs=2, steps_per_epoch=8,
+            verbose=hvd.rank() == 0,
+        ),
+    ]
+
+    ckpt_dir = tempfile.mkdtemp(prefix="hvd_keras_ckpt_")
+    ckpt_path = _os.path.join(ckpt_dir, "checkpoint.keras")
+    if hvd.rank() == 0:
+        # Save checkpoints only on rank 0 to avoid corruption (reference
+        # convention; see SURVEY.md §5 checkpoint/resume).
+        callbacks.append(tf.keras.callbacks.ModelCheckpoint(ckpt_path))
+
+    model.fit(
+        x, y, batch_size=32, epochs=3,
+        callbacks=callbacks,
+        verbose=1 if hvd.rank() == 0 else 0,
+    )
+
+    if hvd.rank() == 0:
+        restored = hvd.load_model(ckpt_path)
+        print("restored optimizer:", type(restored.optimizer).__name__,
+              "distributed:", getattr(type(restored.optimizer),
+                                      "_hvd_distributed", False))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,18 +62,44 @@ def broadcast(value, root_rank, name=None):
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,
                compression=Compression.none):
-    """Load a Keras model and wrap its optimizer in DistributedOptimizer
-    (reference ``_keras/__init__.py:111+``)."""
+    """Load a Keras model with its optimizer re-wrapped as a
+    DistributedOptimizer (reference ``_keras/__init__.py:111+``: every known
+    optimizer class name is remapped to a distributed subclass so the saved
+    optimizer config — including one saved *from* a wrapped optimizer, which
+    serializes under the base class name — deserializes directly into the
+    wrapper)."""
     import tensorflow as tf
 
+    from ..tensorflow import _make_distributed_optimizer_class
+
+    opt_classes = set()
+    for attr in dir(tf.keras.optimizers):
+        obj = getattr(tf.keras.optimizers, attr, None)
+        if (isinstance(obj, type)
+                and issubclass(obj, tf.keras.optimizers.Optimizer)
+                and obj is not tf.keras.optimizers.Optimizer):
+            opt_classes.add(obj)
+    if custom_optimizers:
+        opt_classes.update(custom_optimizers)
+
+    hvd_objects = {
+        cls.__name__: _make_distributed_optimizer_class(
+            cls, compression=compression
+        )
+        for cls in opt_classes
+    }
+    if custom_objects:
+        hvd_objects.update(custom_objects)
+
     model = tf.keras.models.load_model(
-        filepath, custom_objects=custom_objects, compile=True
+        filepath, custom_objects=hvd_objects, compile=True
     )
-    if getattr(model, "optimizer", None) is not None:
-        wrapped = DistributedOptimizer(model.optimizer,
-                                       compression=compression)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(type(opt), "_hvd_distributed", False):
+        # An optimizer deserialized through user custom_objects (not one of
+        # the remapped classes) still needs the distributed wrapper.
         model.compile(
-            optimizer=wrapped,
+            optimizer=DistributedOptimizer(opt, compression=compression),
             loss=model.loss,
         )
     return model

@@ -72,3 +72,49 @@ else:  # pragma: no cover - exercised only where mxnet exists
         def update(self, index, weight, grad, state):
             reduced = allreduce(grad, average=True, name=f"grad.{index}")
             self._optimizer.update(index, weight, reduced, state)
+
+    class DistributedTrainer(mxnet.gluon.Trainer):
+        """Gluon trainer that allreduces gradients in ``_allreduce_grads``
+        (reference horovod/mxnet/__init__.py:76-108: overrides
+        ``_allreduce_grads``; the optimizer's rescale_grad is divided by
+        size so the reduced SUM becomes an average)."""
+
+        def __init__(self, params, optimizer, optimizer_params=None):
+            if isinstance(optimizer, DistributedOptimizer):
+                optimizer = optimizer._optimizer
+            super().__init__(
+                params, optimizer, optimizer_params, kvstore=None
+            )
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for grad in param.list_grad():
+                        grad[:] = allreduce(
+                            grad, average=False,
+                            name=f"gradient.{i}.{param.name}",
+                        )
+
+    def broadcast_object(obj, root_rank=0, name=None):
+        """Pickle-based object broadcast using this module's own numpy
+        path (no torch dependency): length first, then the padded
+        uint8 payload."""
+        import pickle
+
+        from .. import rank as _rank
+
+        name = name or "broadcast_object"
+        payload = pickle.dumps(obj) if _rank() == root_rank else b""
+        n = _broadcast_np(
+            _np.array([len(payload)], dtype=_np.int64), root_rank,
+            name=f"{name}.len",
+        )
+        n = int(_np.asarray(n)[0])
+        buf = _np.zeros(n, dtype=_np.uint8)
+        if _rank() == root_rank:
+            buf[:] = _np.frombuffer(payload, dtype=_np.uint8)
+        out = _np.asarray(
+            _broadcast_np(buf, root_rank, name=f"{name}.data")
+        )
+        return pickle.loads(out.tobytes())

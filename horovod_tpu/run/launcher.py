@@ -178,19 +178,26 @@ def launch_job(
     controller_addr = (
         slots[0].hostname if not _is_local(slots[0].hostname) else "127.0.0.1"
     )
-    # HOROVOD_IFACE (explicit flag or ring-probe result, reference
-    # NCCL_SOCKET_IFNAME/gloo-iface role): bind the control plane to the
-    # first routable interface's address instead of the hostname default.
-    iface = base_env.get("HOROVOD_IFACE", "").split(",")[0]
-    if iface and _is_local(slots[0].hostname):
-        from . import network as _network
+    if base_env.get("HOROVOD_PROBED_CONTROLLER_ADDR"):
+        # Ring-probe result for a *remote* rank 0 (run.py NIC discovery).
+        # Deliberately a different variable from the per-rank
+        # HOROVOD_CONTROLLER_ADDR export below: ranks inherit that one, and
+        # a nested launch must not dial the parent job's controller.
+        controller_addr = base_env.pop("HOROVOD_PROBED_CONTROLLER_ADDR")
+    elif _is_local(slots[0].hostname):
+        # HOROVOD_IFACE (explicit flag or ring-probe result, reference
+        # NCCL_SOCKET_IFNAME/gloo-iface role): bind the control plane to
+        # the first routable interface's address, not the hostname default.
+        iface = base_env.get("HOROVOD_IFACE", "").split(",")[0]
+        if iface:
+            from . import network as _network
 
-        try:
-            addr = _network.interface_address(iface)
-        except Exception:
-            addr = None  # enumeration unavailable; keep the hostname default
-        if addr:
-            controller_addr = addr
+            try:
+                addr = _network.interface_address(iface)
+            except Exception:
+                addr = None  # enumeration unavailable; keep hostname default
+            if addr:
+                controller_addr = addr
     controller_port = _free_port()
     jax_coordinator = f"{controller_addr}:{_free_port()}"
 

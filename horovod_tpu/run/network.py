@@ -23,6 +23,7 @@ flag.
 
 from __future__ import annotations
 
+import concurrent.futures
 import hashlib
 import hmac
 import os
@@ -242,13 +243,19 @@ class BasicService:
             allow_reuse_address = True
             daemon_threads = True
 
+        self._cond = threading.Condition()
         self._server = _Server(("0.0.0.0", 0), _Handler)
         self._port = self._server.socket.getsockname()[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True
         )
-        self._thread.start()
-        self._cond = threading.Condition()
+        # NOT started here: a request racing in before a subclass finished
+        # initializing its own state would crash the handler. Subclass
+        # __init__ (or the creator, for a bare BasicService) calls start().
+
+    def start(self) -> None:
+        if not self._thread.is_alive():
+            self._thread.start()
 
     @property
     def port(self) -> int:
@@ -270,7 +277,8 @@ class BasicService:
     def shutdown(self) -> None:
         self._server.shutdown()
         self._server.server_close()
-        self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
 
 
 class BasicClient:
@@ -300,46 +308,80 @@ class BasicClient:
         return self._addresses
 
     def _probe(self, addresses, match_intf: bool, retries: int):
-        usable: Dict[str, List[Tuple[str, int]]] = {}
+        """Probe every advertised address concurrently so one dead NIC
+        (the exact case match_intf exists to weed out) costs max-over-
+        addresses wall-clock, not sum — sequential retries x 5s against
+        two unroutable interfaces would blow the callers' 60s barriers."""
         local = local_addresses() if match_intf else {}
-        for intf, addrs in addresses.items():
-            for addr in addrs:
-                for _ in range(retries):
-                    try:
-                        resp = self._request(PingRequest(), addr)
-                    except (OSError, EOFError, WireError):
-                        continue
-                    if not isinstance(resp, PingResponse):
-                        continue
-                    if resp.service_name != self._service_name:
-                        break  # a different service answered; wrong port
-                    if match_intf:
-                        # NAT weeding (reference network.py match_intf):
-                        # the source address the *server* saw must belong
-                        # to our own same-named interface — i.e. reaching
-                        # the peer's intf X must route out of our intf X.
-                        own = {a for a, _ in local.get(intf, [])}
-                        if resp.source_address not in own:
-                            break
-                    usable.setdefault(intf, []).append(addr)
-                    break
-            if match_intf and intf in usable and len(usable[intf]) != len(addrs):
-                del usable[intf]
+        # Unreachable addresses time out on connect; a short connect
+        # budget per attempt keeps the worst case well under the ring
+        # barriers while reachable peers answer in milliseconds.
+        probe_timeout = min(self._timeout, 2.0)
+
+        def probe_one(intf, addr):
+            for _ in range(retries):
+                try:
+                    with socket.create_connection(
+                        addr, timeout=probe_timeout
+                    ) as sock:
+                        sock.settimeout(self._timeout)
+                        rfile = sock.makefile("rb")
+                        wfile = sock.makefile("wb")
+                        self._wire.write(PingRequest(), wfile)
+                        resp = self._wire.read(rfile)
+                except (OSError, EOFError, WireError):
+                    continue
+                if not isinstance(resp, PingResponse):
+                    continue
+                if resp.service_name != self._service_name:
+                    return False  # a different service answered; wrong port
+                if match_intf:
+                    # NAT weeding (reference network.py match_intf): the
+                    # source address the *server* saw must belong to our
+                    # own same-named interface — i.e. reaching the peer's
+                    # intf X must route out of our intf X.
+                    own = {a for a, _ in local.get(intf, [])}
+                    if resp.source_address not in own:
+                        return False
+                return True
+            return False
+
+        flat = [(intf, addr) for intf, addrs in addresses.items()
+                for addr in addrs]
+        if not flat:
+            return {}
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(flat))
+        ) as pool:
+            results = list(pool.map(lambda ia: probe_one(*ia), flat))
+
+        usable: Dict[str, List[Tuple[str, int]]] = {}
+        for (intf, addr), ok in zip(flat, results):
+            if ok:
+                usable.setdefault(intf, []).append(addr)
+        # Keep the verified subset even when some advertised addresses on
+        # an interface failed (e.g. a stale alias): every address in
+        # `usable` proved a working route, which is what callers need.
         return usable
 
-    def _request(self, req: Any, addr: Tuple[str, int]) -> Any:
+    def _request(self, req: Any, addr: Tuple[str, int],
+                 timeout: Optional[float] = None) -> Any:
         with socket.create_connection(addr, timeout=self._timeout) as sock:
+            # A request the server intentionally blocks on (e.g. the
+            # driver's wait-for-peer-registration) needs a read window
+            # longer than the connect default.
+            sock.settimeout(timeout if timeout is not None else self._timeout)
             rfile = sock.makefile("rb")
             wfile = sock.makefile("wb")
             self._wire.write(req, wfile)
             return self._wire.read(rfile)
 
-    def send(self, req: Any) -> Any:
+    def send(self, req: Any, timeout: Optional[float] = None) -> Any:
         last_err: Optional[Exception] = None
         for addrs in self._addresses.values():
             for addr in addrs:
                 try:
-                    return self._request(req, addr)
+                    return self._request(req, addr, timeout=timeout)
                 except (OSError, EOFError, WireError) as e:
                     # EOF = server handler raised and closed without a
                     # response; try the remaining advertised addresses.
@@ -360,6 +402,7 @@ class DriverService(BasicService):
         self._task_addrs: Dict[int, Dict[str, List[Tuple[str, int]]]] = {}
         self._task_to_task_addrs: Dict[int, Dict[str, List[Tuple[str, int]]]] = {}
         self._host_hashes: Dict[int, str] = {}
+        self.start()
 
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, RegisterTaskRequest):
@@ -437,6 +480,7 @@ class TaskService(BasicService):
         self._check_finished = False
         self._command_exit: Optional[int] = None
         self._command_started = False
+        self.start()
 
     def _handle(self, req: Any, client_address) -> Any:
         if isinstance(req, AddressCheckFinishedSignal):
@@ -498,7 +542,9 @@ class DriverClient(BasicClient):
         self.send(RegisterTaskRequest(index, addresses, host_hash))
 
     def all_task_addresses(self, index):
-        return self.send(AllTaskAddressesRequest(index)).addresses
+        # The driver blocks up to 60s waiting for the peer to register
+        # (slow ssh spawn); the read window must outlast that wait.
+        return self.send(AllTaskAddressesRequest(index), timeout=65.0).addresses
 
     def register_task_to_task_addresses(self, index, addresses) -> None:
         self.send(RegisterTaskToTaskAddressesRequest(index, addresses))
@@ -569,10 +615,16 @@ def discover_common_interfaces(
     ssh_launch=None,
     ssh_port: Optional[int] = None,
     timeout: float = 60.0,
-) -> List[str]:
+    return_addresses: bool = False,
+) -> Any:
     """Driver-side orchestration: start a DriverService, launch one probe
     task per host (via ``ssh_launch(host, command_argv, env)`` or locally),
-    and return the interface names routable around the whole ring."""
+    and return the interface names routable around the whole ring.
+
+    With ``return_addresses=True`` also returns each host's registered
+    per-interface addresses, ``{host: {intf: [(addr, port), ...]}}`` —
+    the launcher uses these to dial rank 0's controller by its probed
+    routable address rather than its (possibly unresolvable) hostname."""
     import subprocess
     import sys
 
@@ -626,7 +678,14 @@ def discover_common_interfaces(
                 procs.append(p)
         driver.wait_for_initial_registration(timeout)
         driver.wait_for_task_to_task_addresses(timeout)
-        return driver.common_interfaces()
+        common = driver.common_interfaces()
+        if return_addresses:
+            host_addrs = {
+                host: driver.task_addresses_for(i)
+                for i, host in enumerate(hosts)
+            }
+            return common, host_addrs
+        return common
     finally:
         deadline = 3.0  # grace for clean exits, shared across all procs
         import time as _time

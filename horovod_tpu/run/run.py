@@ -176,11 +176,20 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             from . import network
 
             try:
-                common = network.discover_common_interfaces(
-                    hostnames, ssh_port=args.ssh_port
+                common, host_addrs = network.discover_common_interfaces(
+                    hostnames, ssh_port=args.ssh_port, return_addresses=True
                 )
                 if common:
                     env["HOROVOD_IFACE"] = ",".join(common)
+                    # Rank 0's probed address on the first ring-routable
+                    # interface: lets the launcher dial the controller even
+                    # when its hostname doesn't resolve from the workers.
+                    addrs0 = host_addrs.get(slots[0].hostname, {})
+                    for intf in common:
+                        if addrs0.get(intf):
+                            env["HOROVOD_PROBED_CONTROLLER_ADDR"] = \
+                                addrs0[intf][0][0]
+                            break
                     if args.verbose:
                         print(f"[hvdrun] routable interfaces: {common}")
             except Exception as e:  # probe is best-effort
@@ -209,16 +218,23 @@ def run(
 ) -> List[Any]:
     """Run ``fn(*args, **kwargs)`` on ``np`` ranks and return the list of
     per-rank results (parity with ``horovod.run.run()``,
-    ``run/run.py:863-949``). The function is shipped pickled via a scratch
-    directory and results are collected per rank."""
+    ``run/run.py:863-949``). The function is shipped cloudpickled (as the
+    reference does — plain pickle cannot ship closures or
+    interactively-defined functions) via a scratch directory and results
+    are collected per rank."""
     import pickle
     import tempfile
+
+    try:
+        import cloudpickle as _pickler
+    except ImportError:  # pragma: no cover - cloudpickle ships with pyspark
+        import pickle as _pickler
 
     kwargs = kwargs or {}
     workdir = tempfile.mkdtemp(prefix="hvdrun_")
     fn_path = os.path.join(workdir, "fn.pkl")
     with open(fn_path, "wb") as f:
-        pickle.dump((fn, args, kwargs), f)
+        _pickler.dump((fn, args, kwargs), f)
 
     host_list = launcher.parse_hosts(hosts) if hosts else [("localhost", np)]
     slots = launcher.allocate(host_list, np)

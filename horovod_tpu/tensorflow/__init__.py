@@ -104,9 +104,13 @@ def alltoall(tensor, name=None):
 def broadcast_variables(variables, root_rank: int = 0) -> None:
     """Assign every variable the root's value (reference
     ``broadcast_variables``, ``tensorflow/__init__.py:139-227``)."""
+    import tensorflow as tf
+
     for i, var in enumerate(variables):
-        var.assign(broadcast(var.read_value(), root_rank,
-                             name=f"bcast.var.{i}"))
+        # tf.Variable has read_value(); Keras-3 backend variables expose
+        # .value instead — convert_to_tensor covers both.
+        value = tf.convert_to_tensor(var)
+        var.assign(broadcast(value, root_rank, name=f"bcast.var.{i}"))
 
 
 def broadcast_global_variables(root_rank: int = 0) -> None:
@@ -152,12 +156,32 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,  # noqa: N802
                          op=None, backward_passes_per_step=1):
     """Wrap a Keras optimizer so gradients are allreduced before apply
     (API parity with ``tensorflow/__init__.py:409-470``)."""
-    import tensorflow as tf
+    cls = _make_distributed_optimizer_class(
+        optimizer.__class__, compression=compression, op=op
+    )
+    # Fresh instance with the same config; Keras builds slots lazily on the
+    # first apply_gradients, so no state transfer is needed for a new model.
+    return cls.from_config(optimizer.get_config())
 
+
+def _make_distributed_optimizer_class(base, compression=Compression.none,
+                                      op=None):
+    """Subclass ``base`` so gradients are allreduced before apply.
+
+    The subclass keeps the base class name (as the reference does when
+    building the wrapper type) so a saved model's optimizer config remains
+    deserializable; ``horovod_tpu.keras.load_model`` maps saved class names
+    back onto these wrappers (reference ``_keras/__init__.py:111+``)."""
     reduce_op = op if op is not None else Average
-    base = optimizer.__class__
+
+    # Never stack wrappers: subclassing an already-distributed class would
+    # allreduce twice per step (and square the size factor under op=Sum).
+    while getattr(base, "_hvd_distributed", False):
+        base = base.__bases__[0]
 
     class _Distributed(base):  # type: ignore[valid-type, misc]
+        _hvd_distributed = True
+
         def apply_gradients(self, grads_and_vars, **kwargs):
             gv = [
                 (
@@ -170,9 +194,9 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,  # noqa: N802
             ]
             return super().apply_gradients(gv, **kwargs)
 
-    # Fresh instance with the same config; Keras builds slots lazily on the
-    # first apply_gradients, so no state transfer is needed for a new model.
-    return _Distributed.from_config(optimizer.get_config())
+    _Distributed.__name__ = base.__name__
+    _Distributed.__qualname__ = base.__qualname__
+    return _Distributed
 
 
 class BroadcastGlobalVariablesHook:

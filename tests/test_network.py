@@ -47,6 +47,7 @@ def test_wire_roundtrip_and_tamper():
 def test_ping_and_wrong_key():
     key = net.make_secret_key()
     svc = net.BasicService("svc", key)
+    svc.start()
     try:
         addrs = {"lo": [("127.0.0.1", svc.port)]}
         client = net.BasicClient("svc", addrs, key)
