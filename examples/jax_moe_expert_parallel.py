@@ -1,0 +1,83 @@
+"""Mixture-of-Experts training with expert parallelism (DP x EP).
+
+TPU-native extension beyond the reference framework (which has no alltoall
+op and no model-structure code — SURVEY.md §2.3): experts shard over the
+``expert`` mesh axis, tokens shard over both axes, and Switch-style top-1
+routing dispatches token shards to expert owners with ``lax.all_to_all``
+riding ICI.
+
+Run:  python examples/jax_moe_expert_parallel.py          # 8-dev CPU mesh
+"""
+
+import os as _os
+import sys as _sys
+
+_flags = _os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+try:  # allow running from a source checkout without installation
+    import horovod_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import jax
+
+# Pin the CPU backend unless the user explicitly wants the real chip
+# (querying the default backend would itself initialize the platform).
+if not _os.environ.get("HOROVOD_EXAMPLE_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from horovod_tpu.parallel.ep import init_moe_params, make_ep_train_step, moe_ffn
+from horovod_tpu.parallel.mesh import build_mesh
+
+
+def main():
+    n = len(jax.devices())
+    ep = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    mesh = build_mesh({"data": n // ep, "expert": ep})
+    print(f"mesh: data={n // ep} x expert={ep} on {jax.default_backend()}")
+
+    d_model, d_hidden, num_experts = 32, 64, 8
+    rng = jax.random.PRNGKey(0)
+    params = {
+        "moe": init_moe_params(
+            rng, d_model=d_model, d_hidden=d_hidden,
+            num_experts=num_experts, num_expert_shards=ep,
+        ),
+        "head": jnp.zeros((d_model, 1)),
+    }
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        h, aux = moe_ffn(
+            p["moe"], xb, expert_axis="expert", capacity_factor=2.0
+        )
+        pred = (xb + h) @ p["head"]  # residual around the MoE block
+        return jnp.mean((pred - yb) ** 2), aux
+
+    step = make_ep_train_step(loss_fn, tx, mesh, params, opt_state)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(128, d_model).astype(np.float32)
+    w_true = rs.randn(d_model, 1).astype(np.float32)
+    y = np.tanh(x) @ w_true
+    batch = (jnp.asarray(x), jnp.asarray(y))
+
+    for i in range(100):
+        params, opt_state, loss = step(params, opt_state, batch)
+        if i % 20 == 0:
+            print(f"step {i:3d}  loss {float(loss):.5f}")
+    print(f"final loss {float(loss):.5f}")
+
+
+if __name__ == "__main__":
+    main()

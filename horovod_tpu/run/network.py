@@ -275,7 +275,11 @@ class BasicService:
         )
 
     def shutdown(self) -> None:
-        self._server.shutdown()
+        if self._thread.is_alive():
+            # socketserver.shutdown() blocks on an event that only
+            # serve_forever() sets — calling it on a never-started server
+            # would deadlock; just close the socket in that case.
+            self._server.shutdown()
         self._server.server_close()
         if self._thread.is_alive():
             self._thread.join(timeout=5)
@@ -321,14 +325,9 @@ class BasicClient:
         def probe_one(intf, addr):
             for _ in range(retries):
                 try:
-                    with socket.create_connection(
-                        addr, timeout=probe_timeout
-                    ) as sock:
-                        sock.settimeout(self._timeout)
-                        rfile = sock.makefile("rb")
-                        wfile = sock.makefile("wb")
-                        self._wire.write(PingRequest(), wfile)
-                        resp = self._wire.read(rfile)
+                    resp = self._request(
+                        PingRequest(), addr, connect_timeout=probe_timeout
+                    )
                 except (OSError, EOFError, WireError):
                     continue
                 if not isinstance(resp, PingResponse):
@@ -365,8 +364,13 @@ class BasicClient:
         return usable
 
     def _request(self, req: Any, addr: Tuple[str, int],
-                 timeout: Optional[float] = None) -> Any:
-        with socket.create_connection(addr, timeout=self._timeout) as sock:
+                 timeout: Optional[float] = None,
+                 connect_timeout: Optional[float] = None) -> Any:
+        with socket.create_connection(
+            addr,
+            timeout=connect_timeout if connect_timeout is not None
+            else self._timeout,
+        ) as sock:
             # A request the server intentionally blocks on (e.g. the
             # driver's wait-for-peer-registration) needs a read window
             # longer than the connect default.
