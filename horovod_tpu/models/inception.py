@@ -1,0 +1,174 @@
+"""Inception V3 (flax), TPU-first.
+
+Inception V3 is one of the reference's three headline scaling benchmarks
+(90% efficiency at 512 GPUs, ``README.rst:79`` /
+``docs/benchmarks.rst:13``). Structure follows the Szegedy et al. 2015
+architecture (stem -> 3x InceptionA -> reduction -> 4x InceptionB ->
+reduction -> 2x InceptionC -> pool -> head); bfloat16 compute, fp32
+params/logits, NHWC, no aux head (train-time aux classifiers don't change
+the throughput benchmark and the reference scripts run synthetic data).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.features, self.kernel, self.strides, padding=self.padding,
+            use_bias=False, dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=self.dtype,
+        )(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = lambda f, k=(1, 1), s=(1, 1): ConvBN(  # noqa: E731
+            f, k, s, dtype=self.dtype
+        )
+        b1 = cbn(64)(x, train)
+        b2 = cbn(48)(x, train)
+        b2 = cbn(64, (5, 5))(b2, train)
+        b3 = cbn(64)(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(self.pool_features)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = lambda f, k=(1, 1), s=(1, 1), p="SAME": ConvBN(  # noqa: E731
+            f, k, s, padding=p, dtype=self.dtype
+        )
+        b1 = cbn(384, (3, 3), (2, 2), "VALID")(x, train)
+        b2 = cbn(64)(x, train)
+        b2 = cbn(96, (3, 3))(b2, train)
+        b2 = cbn(96, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        c = self.channels_7x7
+        cbn = lambda f, k=(1, 1): ConvBN(f, k, dtype=self.dtype)  # noqa: E731
+        b1 = cbn(192)(x, train)
+        b2 = cbn(c)(x, train)
+        b2 = cbn(c, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b3 = cbn(c)(x, train)
+        b3 = cbn(c, (7, 1))(b3, train)
+        b3 = cbn(c, (1, 7))(b3, train)
+        b3 = cbn(c, (7, 1))(b3, train)
+        b3 = cbn(192, (1, 7))(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(192)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = lambda f, k=(1, 1), s=(1, 1), p="SAME": ConvBN(  # noqa: E731
+            f, k, s, padding=p, dtype=self.dtype
+        )
+        b1 = cbn(192)(x, train)
+        b1 = cbn(320, (3, 3), (2, 2), "VALID")(b1, train)
+        b2 = cbn(192)(x, train)
+        b2 = cbn(192, (1, 7))(b2, train)
+        b2 = cbn(192, (7, 1))(b2, train)
+        b2 = cbn(192, (3, 3), (2, 2), "VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = lambda f, k=(1, 1): ConvBN(f, k, dtype=self.dtype)  # noqa: E731
+        b1 = cbn(320)(x, train)
+        b2 = cbn(384)(x, train)
+        b2 = jnp.concatenate(
+            [cbn(384, (1, 3))(b2, train), cbn(384, (3, 1))(b2, train)],
+            axis=-1,
+        )
+        b3 = cbn(448)(x, train)
+        b3 = cbn(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate(
+            [cbn(384, (1, 3))(b3, train), cbn(384, (3, 1))(b3, train)],
+            axis=-1,
+        )
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(192)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        x = x.astype(d)
+        cbn = lambda f, k, s=(1, 1), p="VALID": ConvBN(  # noqa: E731
+            f, k, s, padding=p, dtype=d
+        )
+        # Stem (299 -> 35 spatial at standard input size).
+        x = cbn(32, (3, 3), (2, 2))(x, train)
+        x = cbn(32, (3, 3))(x, train)
+        x = cbn(64, (3, 3), p="SAME")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1))(x, train)
+        x = cbn(192, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(32, dtype=d)(x, train)
+        x = InceptionA(64, dtype=d)(x, train)
+        x = InceptionA(64, dtype=d)(x, train)
+        x = ReductionA(dtype=d)(x, train)
+        x = InceptionB(128, dtype=d)(x, train)
+        x = InceptionB(160, dtype=d)(x, train)
+        x = InceptionB(160, dtype=d)(x, train)
+        x = InceptionB(192, dtype=d)(x, train)
+        x = ReductionB(dtype=d)(x, train)
+        x = InceptionC(dtype=d)(x, train)
+        x = InceptionC(dtype=d)(x, train)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

@@ -51,11 +51,42 @@ class BottleneckBlock(nn.Module):
         return nn.relu(residual + y)
 
 
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            self.norm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    block: ModuleDef = BottleneckBlock
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -81,7 +112,7 @@ class ResNet(nn.Module):
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(
+                x = self.block(
                     filters=self.num_filters * 2**i,
                     strides=strides,
                     dtype=self.dtype,
@@ -94,4 +125,5 @@ class ResNet(nn.Module):
 ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
 ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
 ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
-ResNet18 = None  # basic-block variants can be added when needed
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block=BasicBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block=BasicBlock)

@@ -1,0 +1,48 @@
+"""VGG family (flax), TPU-first.
+
+VGG-16 is one of the reference's three headline scaling benchmarks (68%
+efficiency at 512 GPUs, ``README.rst:79`` / ``docs/benchmarks.rst:14``) —
+its large dense layers make it the communication-bound stress case for
+gradient fusion. bfloat16 compute, fp32 params/logits, NHWC.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class VGG(nn.Module):
+    stage_sizes: Sequence[int]        # convs per stage (5 stages)
+    num_classes: int = 1000
+    num_filters: int = 64
+    dense_features: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for i, n_convs in enumerate(self.stage_sizes):
+            filters = min(self.num_filters * 2**i, 512)
+            for j in range(n_convs):
+                x = nn.Conv(
+                    filters, (3, 3), padding="SAME", dtype=self.dtype,
+                    name=f"conv{i}_{j}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.dense_features, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(self.dense_features, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, stage_sizes=[1, 1, 2, 2, 2])
+VGG16 = partial(VGG, stage_sizes=[2, 2, 3, 3, 3])
+VGG19 = partial(VGG, stage_sizes=[2, 2, 4, 4, 4])
