@@ -1,0 +1,191 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer/long-context path, written for the TPU
+memory hierarchy: Q/K/V blocks stream HBM -> VMEM, scores and the online-
+softmax state live in VMEM scratch, and the [block_q, block_k] score
+matmul + [block_k, d] value matmul hit the MXU. O(T) memory instead of
+materializing the [T, T] probability matrix.
+
+The reference framework has no kernels at all (it is gradient plumbing;
+SURVEY.md §2.3) — this powers the model-side extensions (transformer
+models, ring attention's per-block compute). Backward is a custom VJP
+that recomputes probabilities blockwise in plain XLA (the standard
+rematerialization trade: no [T, T] residual is ever stored).
+
+Interpret mode (``interpret=True``) runs the same kernel on CPU and is
+what the tests exercise on the virtual mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                sm_scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)   # [Bq, D]
+    k = k_ref[0].astype(jnp.float32)   # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)   # [Bk, D]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale                        # [Bq, Bk]
+
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = q_pos >= k_pos
+        s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_ref[:, :1]                       # [Bq, 1]
+    l_prev = l_ref[:, :1]
+    m_curr = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_curr)
+    p = jnp.exp(s - m_curr)                     # [Bq, Bk]
+    if causal:
+        # A fully-masked row has m_curr == _NEG_INF and would turn the
+        # masked entries into exp(0) = 1; re-apply the mask to p.
+        p = jnp.where(mask, p, 0.0)
+    l_curr = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = jnp.broadcast_to(m_curr, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_curr, l_ref.shape)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)         # fully-masked rows -> 0 out
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, *, sm_scale, causal, block_q, block_k,
+                    interpret):
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    if t_q % block_q or t_k % block_k:
+        raise ValueError(
+            f"sequence lengths ({t_q}, {t_k}) must divide by blocks "
+            f"({block_q}, {block_k})"
+        )
+    grid = (bh, t_q // block_q, t_k // block_k)
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _attention_dense(q, k, v, sm_scale, causal):
+    """Plain-XLA reference used by the recompute backward."""
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        t_q, t_k = s.shape[-2:]
+        mask = (
+            jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        )
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    return _flash_fwd_impl(
+        q, k, v, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    o = _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v = res
+
+    def f(q, k, v):
+        return _attention_dense(q, k, v, sm_scale, causal).astype(q.dtype)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(do)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused attention over ``[..., T, D]`` (leading dims fold into one
+    batch x heads grid axis). Differentiable; backward rematerializes.
+
+    ``interpret`` defaults to True off-TPU so the same code runs in tests
+    on the virtual CPU mesh.
+    """
+    if q.ndim < 3:
+        raise ValueError("expected [..., T, D] with at least one batch dim")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = q.shape[:-2]
+    t_q, d = q.shape[-2:]
+    t_k = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    qf = q.reshape((-1, t_q, d))
+    kf = k.reshape((-1, t_k, d))
+    vf = v.reshape((-1, t_k, d))
+    out = _flash(qf, kf, vf, scale, causal, block_q, block_k, interpret)
+    return out.reshape(*lead, t_q, d)
