@@ -259,6 +259,9 @@ class Core {
     std::set<int32_t> ranks;
     bool error = false;
     std::string error_msg;
+    // Allgather: per-rank first-dimension sizes (displacement math,
+    // reference MPI_Allgatherv mpi_operations.cc:83-162).
+    std::map<int32_t, int64_t> dim0;
   };
   std::map<std::string, Negotiation> negotiating_;
   std::set<int32_t> joined_ranks_;

@@ -675,6 +675,9 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
         auto& neg = negotiating_[req.name];
         neg.request = req;
         neg.ranks.insert(req.rank);
+        if (req.type == RequestType::kAllgather) {
+          neg.dim0[req.rank] = req.shape.empty() ? 0 : req.shape[0];
+        }
         stall_.Record(req.name, req.rank);
       } else {
         auto& neg = it->second;
@@ -712,6 +715,9 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
           neg.error_msg = "Mismatched shapes for tensor " + req.name;
         }
         neg.ranks.insert(req.rank);
+        if (req.type == RequestType::kAllgather) {
+          neg.dim0[req.rank] = req.shape.empty() ? 0 : req.shape[0];
+        }
         stall_.Record(req.name, req.rank);
       }
       timeline_.NegotiateRankReady(req.name, req.rank);
@@ -795,6 +801,19 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
     r.names.push_back(base.name);
     r.entry_shapes.push_back(base.shape);
     r.total_bytes = base.ByteSize();
+    if (base.type == RequestType::kAllgather) {
+      // Per-rank dim0 (ordered by rank) for the executor's displacement
+      // math; ranks that never submitted (Join zero-substitution) gather
+      // the canonical zero tensor, so they contribute base dim0 rows.
+      auto nit = negotiating_.find(base.name);
+      int64_t canonical = base.shape.empty() ? 0 : base.shape[0];
+      r.rank_sizes.assign(cfg_.size, canonical);
+      if (nit != negotiating_.end()) {
+        for (auto& [rk, d0] : nit->second.dim0) {
+          if (rk >= 0 && rk < cfg_.size) r.rank_sizes[rk] = d0;
+        }
+      }
+    }
     used[i] = true;
     bool fusable = base.type == RequestType::kAllreduce ||
                    base.type == RequestType::kAdasum;

@@ -86,7 +86,7 @@ def init(config: Optional[Config] = None) -> None:
                         raise
             from .core.xla_executor import XlaPlanExecutor
 
-            executor = XlaPlanExecutor(topo)
+            executor = XlaPlanExecutor(topo, config=cfg)
         if kind == "native":
             try:
                 from .core.native_runtime import NativeRuntime

@@ -30,10 +30,12 @@ from .native_runtime import PlanExecutor
 logger = logging.getLogger("horovod_tpu")
 
 _RANK_AXIS = "hvd_ranks"
+_CROSS_AXIS = "hvd_cross"
+_LOCAL_AXIS = "hvd_local"
 
 
 class XlaPlanExecutor(PlanExecutor):
-    def __init__(self, topology: Topology, device=None):
+    def __init__(self, topology: Topology, device=None, config=None):
         import jax
         from jax.sharding import Mesh
 
@@ -63,19 +65,69 @@ class XlaPlanExecutor(PlanExecutor):
         self._mesh = Mesh(np.array(mesh_devices), (_RANK_AXIS,))
         self._local_device = device or mesh_devices[topology.rank]
         self._topo = topology
+        self._config = config
+        # Two-level (cross, local) mesh for the hierarchical lowerings —
+        # the ICI/DCN analogue of the reference's LOCAL/CROSS communicator
+        # pair (nccl_operations.cc:151-346, mpi_operations.cc:168-321).
+        # Requires a homogeneous grid with ranks laid out
+        # rank = cross_rank * local_size + local_rank.
+        self._mesh2 = None
+        if (
+            topology.is_homogeneous
+            and topology.local_size > 1
+            and topology.cross_size > 1
+            and topology.local_size * topology.cross_size == topology.size
+        ):
+            self._mesh2 = Mesh(
+                np.array(mesh_devices).reshape(
+                    topology.cross_size, topology.local_size
+                ),
+                (_CROSS_AXIS, _LOCAL_AXIS),
+            )
         self._fn_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
 
+    def _knob(self, name: str) -> bool:
+        return bool(getattr(self._config, name, False)) if self._config else False
+
+    def _wrap(self, body, hier: bool):
+        """shard_map+jit a plan body over the flat rank mesh or the
+        (cross, local) grid."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from ..jax import _shard_map
+
+        if hier:
+            fn = _shard_map(
+                body, self._mesh2,
+                in_specs=(P(_CROSS_AXIS, _LOCAL_AXIS),), out_specs=P(),
+            )
+        else:
+            fn = _shard_map(
+                body, self._mesh, in_specs=(P(_RANK_AXIS),), out_specs=P()
+            )
+        return jax.jit(fn)
+
     # --- helpers ---
-    def _global_array(self, local_np: np.ndarray):
-        """Build a global array of shape (size, *local) with one shard per
-        rank from this process's local data."""
+    def _global_array(self, local_np: np.ndarray, hierarchical: bool = False):
+        """Build a global array of shape (size, *local) — or
+        (cross, local, *local) on the 2-D mesh — with one shard per rank
+        from this process's local data."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        sharding = NamedSharding(self._mesh, P(_RANK_AXIS))
-        gshape = (self._topo.size,) + local_np.shape
-        local = jax.device_put(local_np[None, ...], self._local_device)
+        if hierarchical:
+            sharding = NamedSharding(self._mesh2, P(_CROSS_AXIS, _LOCAL_AXIS))
+            gshape = (
+                self._topo.cross_size, self._topo.local_size
+            ) + local_np.shape
+            local = jax.device_put(
+                local_np[None, None, ...], self._local_device
+            )
+        else:
+            sharding = NamedSharding(self._mesh, P(_RANK_AXIS))
+            gshape = (self._topo.size,) + local_np.shape
+            local = jax.device_put(local_np[None, ...], self._local_device)
         return jax.make_array_from_single_device_arrays(
             gshape, sharding, [local]
         )
@@ -133,16 +185,54 @@ class XlaPlanExecutor(PlanExecutor):
         pre = float(plan.get("prescale", 1.0))
         post = float(plan.get("postscale", 1.0))
         participants = max(int(plan.get("participants", self._topo.size)), 1)
-        key = ("ar", dtype, buf.size, int(op), adasum, pre, post, participants)
+        adasum = adasum or op == ReduceOp.ADASUM
+        # Hierarchical op selection, the analogue of the reference picking
+        # NCCLHierarchicalAllreduce / AdasumCudaAllreduce at op-manager build
+        # (operations.cc:142-223, nccl_operations.cc:348-355): honored in
+        # eager mode whenever the knob is set and a (cross, local) grid
+        # exists. MIN/MAX stay flat (reference hierarchy covers sums only).
+        hier = (
+            self._mesh2 is not None
+            and (
+                (not adasum and self._knob("hierarchical_allreduce")
+                 and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
+                # Adasum on a multi-level grid is always hierarchical, like
+                # the reference's CUDA variant (adasum_cuda_operations.cc).
+                or adasum
+            )
+        )
+        key = ("ar", dtype, buf.size, int(op), adasum, pre, post,
+               participants, hier)
 
         def build():
             def body(x):
-                # x: (1, L) local shard of the (size, L) global array.
-                v = x[0]
+                # x: local shard — (1, L) flat or (1, 1, L) hierarchical.
+                v = x[0] if not hier else x[0, 0]
                 if pre != 1.0:
                     v = v * np.asarray(pre, dtype=v.dtype)
-                if adasum or op == ReduceOp.ADASUM:
-                    r = adasum_allreduce(v, axis_name=_RANK_AXIS)
+                if adasum:
+                    if hier:
+                        from ..ops.adasum import hierarchical_adasum_allreduce
+
+                        # 1/local_size so the local reduce-scatter yields the
+                        # node *average* and VHDD of identical inputs is the
+                        # identity, matching flat VHDD semantics (the
+                        # reference applies this divisor in the framework
+                        # layer, tensorflow/__init__.py:98-106).
+                        v = (v / self._topo.local_size).astype(v.dtype)
+                        r = hierarchical_adasum_allreduce(
+                            v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
+                        )
+                    else:
+                        r = adasum_allreduce(v, axis_name=_RANK_AXIS)
+                elif hier:
+                    from ..ops.collectives import hierarchical_allreduce
+
+                    r = hierarchical_allreduce(
+                        v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
+                    )
+                    if op == ReduceOp.AVERAGE:
+                        r = (r / participants).astype(r.dtype)
                 elif op == ReduceOp.AVERAGE:
                     # Divide by the participant count (Join-aware divisor),
                     # not the axis size.
@@ -158,12 +248,9 @@ class XlaPlanExecutor(PlanExecutor):
                     r = r * np.asarray(post, dtype=r.dtype)
                 return r
 
-            fn = _shard_map(
-                body, self._mesh, in_specs=(P(_RANK_AXIS),), out_specs=P()
-            )
-            return jax.jit(fn)
+            return self._wrap(body, hier)
 
-        garr = self._global_array(buf)
+        garr = self._global_array(buf, hierarchical=hier)
         out = self._compiled(key, build)(garr)
         return self._unpack(self._local_out(out), entries, shapes)
 
@@ -173,24 +260,53 @@ class XlaPlanExecutor(PlanExecutor):
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
 
-        # Allgather entries are not fused (one tensor per plan).
+        # Per-rank dim0 sizes from the coordinator (the reference's
+        # Allgatherv sizes/displacements, mpi_operations.cc:83-162). Equal
+        # sizes take the direct tiled all_gather; uneven sizes pad to the
+        # max, gather, and compact on the host (XLA needs static shapes).
+        rank_sizes = [int(s) for s in plan.get("rank_sizes", [])]
+        uneven = bool(rank_sizes) and len(set(rank_sizes)) > 1
+        hier = self._mesh2 is not None and self._knob("hierarchical_allgather")
+
         outputs: Dict[str, Any] = {}
         for e in entries:
             local = np.asarray(e.tensor)
-            key = ("ag", str(local.dtype), local.shape)
+            max_dim0 = max(rank_sizes) if uneven else (
+                local.shape[0] if local.ndim else 0
+            )
+            if uneven:
+                pad = [(0, max_dim0 - local.shape[0])] + [(0, 0)] * (local.ndim - 1)
+                send = np.pad(local, pad)
+            else:
+                send = local
+            key = ("ag", str(send.dtype), send.shape, hier)
 
             def build():
                 def body(x):
+                    if hier:
+                        # Two-stage gather: ICI within the node, DCN across
+                        # node leaders — the TPU re-expression of the
+                        # reference's MPIHierarchicalAllgather (shared-memory
+                        # window + cross-node allgatherv by one rank per
+                        # node, mpi_operations.cc:168-321). Rank order
+                        # rank = cross*local_size + local keeps the
+                        # concatenation identical to the flat op.
+                        v = x[0, 0]
+                        g = lax.all_gather(v, _LOCAL_AXIS, tiled=True)
+                        return lax.all_gather(g, _CROSS_AXIS, tiled=True)
                     return lax.all_gather(x[0], _RANK_AXIS, tiled=True)
 
-                fn = _shard_map(
-                    body, self._mesh, in_specs=(P(_RANK_AXIS),), out_specs=P()
-                )
-                return jax.jit(fn)
+                return self._wrap(body, hier)
 
-            garr = self._global_array(local)
+            garr = self._global_array(send, hierarchical=hier)
             out = self._compiled(key, build)(garr)
-            outputs[e.name] = self._local_out(out)
+            gathered = self._local_out(out)
+            if uneven:
+                gathered = np.concatenate([
+                    gathered[i * max_dim0: i * max_dim0 + rank_sizes[i]]
+                    for i in range(self._topo.size)
+                ])
+            outputs[e.name] = gathered
         return outputs
 
     def _broadcast(self, plan, entries) -> Dict[str, Any]:

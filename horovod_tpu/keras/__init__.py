@@ -60,6 +60,21 @@ def broadcast(value, root_rank, name=None):
     return _bc(value, root_rank, name)
 
 
+def _deserialize_compile_arg(key, value):
+    """Turn a saved compile-config entry (possibly a serialized keras object
+    or a nested list/dict of them) back into something ``compile`` accepts."""
+    import tensorflow as tf
+
+    if isinstance(value, dict) and "class_name" in value:
+        mod = tf.keras.losses if key == "loss" else tf.keras.metrics
+        return mod.deserialize(value)
+    if isinstance(value, (list, tuple)):
+        return [_deserialize_compile_arg(key, v) for v in value]
+    if isinstance(value, dict):
+        return {k: _deserialize_compile_arg(key, v) for k, v in value.items()}
+    return value
+
+
 def load_model(filepath, custom_optimizers=None, custom_objects=None,
                compression=Compression.none):
     """Load a Keras model with its optimizer re-wrapped as a
@@ -97,9 +112,18 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
     opt = getattr(model, "optimizer", None)
     if opt is not None and not getattr(type(opt), "_hvd_distributed", False):
         # An optimizer deserialized through user custom_objects (not one of
-        # the remapped classes) still needs the distributed wrapper.
-        model.compile(
-            optimizer=DistributedOptimizer(opt, compression=compression),
-            loss=model.loss,
-        )
+        # the remapped classes) still needs the distributed wrapper. Carry
+        # over the full saved compile config (metrics, loss_weights, ...) —
+        # re-compiling with only loss would silently drop them.
+        dist_opt = DistributedOptimizer(opt, compression=compression)
+        try:
+            cfg = dict(model.get_compile_config() or {})
+            kwargs = {}
+            for key in ("loss", "metrics", "weighted_metrics", "loss_weights"):
+                if cfg.get(key) is not None:
+                    kwargs[key] = _deserialize_compile_arg(key, cfg[key])
+            kwargs.setdefault("loss", model.loss)
+            model.compile(optimizer=dist_opt, **kwargs)
+        except Exception:  # pragma: no cover - keras version drift
+            model.compile(optimizer=dist_opt, loss=model.loss)
     return model

@@ -85,7 +85,11 @@ else:  # pragma: no cover - exercised only where mxnet exists
             super().__init__(
                 params, optimizer, optimizer_params, kvstore=None
             )
-            self._scale /= size()
+            # gluon-internal attribute; guard against mxnet version drift.
+            if hasattr(self, "_scale"):
+                self._scale /= size()
+            else:  # pragma: no cover - newer gluon keeps it on the optimizer
+                self._optimizer.rescale_grad /= size()
 
         def _allreduce_grads(self):
             for i, param in enumerate(self._params):

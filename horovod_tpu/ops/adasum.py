@@ -94,25 +94,96 @@ def adasum_allreduce_reference(vectors: List[Any]) -> Any:
     return vecs[0]
 
 
+def hierarchical_adasum_reference(vectors: List[Any], local_size: int) -> Any:
+    """NumPy reference for the hierarchical variant: node sums are
+    reduce-scattered into ``local_size`` contiguous chunks, VHDD combines
+    each chunk independently across nodes (per-chunk dot products, exactly
+    what each local rank computes on its shard), and the chunks concatenate
+    back. Mirrors ``adasum_cuda_operations.cc`` semantics; rank order is
+    rank = cross * local_size + local."""
+    import numpy as np
+
+    vecs = [np.asarray(v, dtype=np.float64).reshape(-1) for v in vectors]
+    assert len(vecs) % local_size == 0
+    cross = len(vecs) // local_size
+    node_sums = [
+        np.sum(vecs[c * local_size:(c + 1) * local_size], axis=0)
+        for c in range(cross)
+    ]
+    n = node_sums[0].size
+    pad = (-n) % local_size
+    if pad:
+        node_sums = [np.concatenate([v, np.zeros(pad)]) for v in node_sums]
+    chunk = (n + pad) // local_size
+    out_chunks = [
+        adasum_allreduce_reference(
+            [v[s * chunk:(s + 1) * chunk] for v in node_sums]
+        )
+        for s in range(local_size)
+    ]
+    return np.concatenate(out_chunks)[:n].reshape(np.asarray(vectors[0]).shape)
+
+
+def hierarchical_adasum_allreduce(
+    x: jax.Array,
+    *,
+    local_axis: str = "local",
+    cross_axis: str = "cross",
+) -> jax.Array:
+    """Hierarchical Adasum on a (cross, local) mesh — the TPU re-expression
+    of the reference's CUDA variant (``adasum_cuda_operations.cc:1-321``):
+    NCCL reduce-scatter within the node → VHDD across nodes on the shards →
+    NCCL allgather, with the D2H/H2D staging deleted because the cross hop
+    rides DCN directly.
+
+    Each node therefore contributes the *sum* of its local ranks' vectors
+    and the adaptive combine runs between node sums; like the reference,
+    dividing by local_size to turn the node sum into a node average is the
+    framework layer's job (``horovod/tensorflow/__init__.py:98-106``).
+    """
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    local_size = lax.axis_size(local_axis)
+    pad = (-n) % local_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
+    shard = adasum_allreduce(shard, axis_name=cross_axis)
+    full = lax.all_gather(shard, local_axis, tiled=True)
+    if pad:
+        full = full[:n]
+    return full.reshape(x.shape)
+
+
 def adasum_reduce_fn(
     x: jax.Array,
     *,
     op=None,
-    axis_name: str = DATA_AXIS,
+    axis_name=DATA_AXIS,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
 ) -> jax.Array:
     """Signature-compatible drop-in for ``collectives.allreduce`` so the
-    fusion pass can route op=Adasum buckets here."""
-    if not isinstance(axis_name, str):
-        raise ValueError(
-            "Adasum runs over a single named axis (the ppermute schedule is "
-            f"1-D); got axis_name={axis_name!r}. Use a flat data axis, or "
-            "the hierarchical Adasum variant once available."
-        )
+    fusion pass can route op=Adasum buckets here.
+
+    ``axis_name`` may be a single named axis (flat VHDD) or a
+    ``(cross_axis, local_axis)`` tuple for the hierarchical variant
+    (local reduce-scatter → cross VHDD → local allgather)."""
     if prescale_factor != 1.0:
         x = x * prescale_factor
-    out = adasum_allreduce(x, axis_name=axis_name)
+    if isinstance(axis_name, str):
+        out = adasum_allreduce(x, axis_name=axis_name)
+    else:
+        try:
+            cross_axis, local_axis = axis_name
+        except (TypeError, ValueError):
+            raise ValueError(
+                "Adasum axis_name must be a named axis or a "
+                f"(cross, local) pair; got {axis_name!r}"
+            ) from None
+        out = hierarchical_adasum_allreduce(
+            x, local_axis=local_axis, cross_axis=cross_axis
+        )
     if postscale_factor != 1.0:
         out = out * postscale_factor
     return out

@@ -218,7 +218,6 @@ def launch_job(
                 if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH",
                                  "PYTHONPATH", "LD_LIBRARY"))
             )
-            port_arg = f"-p {ssh_port}" if ssh_port else ""
             cmd = [
                 "ssh", "-o", "StrictHostKeyChecking=no",
                 *( ["-p", str(ssh_port)] if ssh_port else [] ),

@@ -191,3 +191,45 @@ def test_mesh_axis_spec_parsing():
     assert parse_axes("") == {}
     m = build_mesh({"data": -1, "model": 2})
     assert m.shape["data"] == 4 and m.shape["model"] == 2
+
+
+def test_hierarchical_lowering_contains_reduce_scatter():
+    """The hierarchical lowering must actually change the program: its
+    StableHLO contains a reduce_scatter stage, the flat op's does not
+    (VERDICT round-1 next-step #2 'assert via jaxpr/HLO')."""
+    from horovod_tpu.jax import _shard_map
+
+    mesh = build_hierarchical_mesh(local_size=4)
+    x = jnp.zeros((8, 16), jnp.float32)
+
+    hier = jax.jit(_shard_map(
+        lambda t: C.hierarchical_allreduce(t[0])[None],
+        mesh, in_specs=(P(("cross", "local")),),
+        out_specs=P(("cross", "local")),
+    ))
+    flat = jax.jit(_shard_map(
+        lambda t: C.allreduce(t[0], axis_name=("cross", "local"))[None],
+        mesh, in_specs=(P(("cross", "local")),),
+        out_specs=P(("cross", "local")),
+    ))
+    hier_text = hier.lower(x).as_text()
+    flat_text = flat.lower(x).as_text()
+    assert "reduce_scatter" in hier_text
+    assert "reduce_scatter" not in flat_text
+
+
+def test_hierarchical_adasum_lowering_contains_reduce_scatter():
+    from horovod_tpu.jax import _shard_map
+    from horovod_tpu.ops.adasum import hierarchical_adasum_allreduce
+
+    mesh = build_hierarchical_mesh(local_size=4)
+    x = jnp.zeros((8, 16), jnp.float32)
+    fn = jax.jit(_shard_map(
+        lambda t: hierarchical_adasum_allreduce(
+            t[0], local_axis="local", cross_axis="cross")[None],
+        mesh, in_specs=(P(("cross", "local")),),
+        out_specs=P(("cross", "local")),
+    ))
+    text = fn.lower(x).as_text()
+    assert "reduce_scatter" in text
+    assert "collective_permute" in text  # the cross-axis VHDD schedule

@@ -301,6 +301,119 @@ def test_alltoall_two_ranks():
     assert "A2A [1.0, 3.0]" in outs[1], outs
 
 
+_FAKE_GRID_PROLOGUE = """
+        import os
+        # Fake a 2-host x 2-rank grid on localhost so the (cross, local)
+        # mesh exists — the eager analogue of the reference's LOCAL/CROSS
+        # communicator pair (mpi_context.cc:149-158).
+        _r = int(os.environ['HOROVOD_RANK'])
+        os.environ['HOROVOD_LOCAL_SIZE'] = '2'
+        os.environ['HOROVOD_LOCAL_RANK'] = str(_r % 2)
+        os.environ['HOROVOD_CROSS_SIZE'] = '2'
+        os.environ['HOROVOD_CROSS_RANK'] = str(_r // 2)
+"""
+
+
+def test_hierarchical_allreduce_eager_four_ranks():
+    """HOROVOD_HIERARCHICAL_ALLREDUCE flips the eager lowering to
+    RS->cross-psum->AG on the (cross, local) mesh (reference op selection,
+    operations.cc:142-223 / nccl_operations.cc:348-355) with identical
+    numerics to the flat op."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        """ + _FAKE_GRID_PROLOGUE + """
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        r = hvd.rank()
+        x = jnp.arange(6, dtype=jnp.float32) + r
+        s = hvd.allreduce(x, op=hvd.Sum, name="hier_sum")
+        a = hvd.allreduce(x, op=hvd.Average, name="hier_avg")
+        # hierarchical mesh really exists in the executor
+        from horovod_tpu import _runtime
+        print("MESH2", _runtime.executor._mesh2 is not None)
+        print("SUM", np.asarray(s).tolist())
+        print("AVG", np.asarray(a).tolist())
+        hvd.shutdown()
+        """,
+        np_=4,
+        extra_env={"HOROVOD_HIERARCHICAL_ALLREDUCE": "1"},
+        timeout=240,
+    )
+    # sum over r in 0..3 of (i + r) = 4i + 6
+    expected_sum = [4.0 * i + 6.0 for i in range(6)]
+    expected_avg = [i + 1.5 for i in range(6)]
+    for out in outs:
+        assert "MESH2 True" in out, outs
+        assert f"SUM {expected_sum}" in out, outs
+        assert f"AVG {expected_avg}" in out, outs
+
+
+def test_hierarchical_allgather_and_adasum_four_ranks():
+    """HOROVOD_HIERARCHICAL_ALLGATHER two-stage gather keeps rank order;
+    eager Adasum on the grid runs the hierarchical variant (local RS ->
+    cross VHDD -> local AG, reference adasum_cuda_operations.cc) and
+    matches the NumPy reference."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        """ + _FAKE_GRID_PROLOGUE + """
+        import horovod_tpu as hvd
+        from horovod_tpu.ops.adasum import hierarchical_adasum_reference
+        hvd.init()
+        import jax.numpy as jnp
+        r = hvd.rank()
+        g = hvd.allgather(jnp.full((2, 2), float(r), jnp.float32))
+        print("GATHER", np.asarray(g)[:, 0].tolist())
+        vecs = [np.linspace(1, 2, 8).astype(np.float32) * (i + 1)
+                for i in range(4)]
+        out = hvd.allreduce(jnp.asarray(vecs[r]), op=hvd.Adasum,
+                            name="hadasum")
+        # Executor prescales by 1/local_size so VHDD runs on node averages
+        # (flat-consistent semantics; reference framework-layer divisor).
+        expected = hierarchical_adasum_reference(
+            [v / 2.0 for v in vecs], local_size=2)
+        print("ADASUM_OK", bool(np.allclose(np.asarray(out), expected,
+                                            rtol=1e-4)))
+        hvd.shutdown()
+        """,
+        np_=4,
+        extra_env={"HOROVOD_HIERARCHICAL_ALLGATHER": "1"},
+        timeout=240,
+    )
+    gather = [0.0, 0.0, 1.0, 1.0, 2.0, 2.0, 3.0, 3.0]
+    for out in outs:
+        assert f"GATHER {gather}" in out, outs
+        assert "ADASUM_OK True" in out, outs
+
+
+def test_uneven_allgather_two_ranks():
+    """Different dim0 per rank: the coordinator's rank_sizes drive the
+    pad+compact Allgatherv path (reference mpi_operations.cc:83-162)."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        r = hvd.rank()
+        rows = 1 if r == 0 else 3
+        x = jnp.full((rows, 2), float(r + 1), jnp.float32)
+        g = hvd.allgather(x, name="uneven")
+        print("SHAPE", list(np.asarray(g).shape))
+        print("COL", np.asarray(g)[:, 0].tolist())
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "SHAPE [4, 2]" in out, outs
+        assert "COL [1.0, 2.0, 2.0, 2.0]" in out, outs
+
+
 def test_timeline_two_ranks(tmp_path):
     """Each rank writes its own chrome-trace via the C++ writer."""
     import json
