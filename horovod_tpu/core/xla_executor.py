@@ -7,8 +7,21 @@ jitted XLA collectives over a global device mesh spanning all processes
 global array sharded one-shard-per-rank, run a compiled
 ``shard_map(psum/all_gather/...)``, and take the local shard back. Compiled
 executables are cached per (op, dtype, total-elements) signature, so
-steady-state training reuses one executable per fusion bucket — the analogue
-of the reference's persistent fusion buffer, with XLA owning the memory.
+steady-state training reuses one executable per fusion bucket.
+
+Fusion-buffer strategy (the analogue of the reference's persistent
+``FusionBufferManager``, ``fusion_buffer_manager.cc:21-50``, re-expressed
+for XLA's immutable-buffer model):
+
+ - *Host path* (numpy inputs): the packed carrier array is **donated** to
+   the compiled executable, so XLA aliases the input buffer into the output
+   — steady state runs in one persistent buffer per fusion signature
+   instead of allocating a fresh pair every call.
+ - *Device path* (jax-array inputs): pack, collective, and unpack are all
+   traced into ONE executable — entries go in as device arrays, outputs
+   come back as device arrays, and the flat fusion buffer exists only as an
+   XLA temporary that the compiler places and reuses. No ``device_put``,
+   no ``np.asarray``, zero host↔device traffic.
 
 On a TPU pod the mesh axis rides ICI/DCN; on CPU test clusters it rides the
 gloo-backed CPU collectives. Either way the executor code is identical.
@@ -34,6 +47,26 @@ _CROSS_AXIS = "hvd_cross"
 _LOCAL_AXIS = "hvd_local"
 
 
+def rank_mesh_devices(devices=None) -> list:
+    """One device per rank: process r contributes its first local device.
+
+    (TPU pods with multiple chips per process combine eager rank collectives
+    with in-process compiled-mode meshes; the eager plane uses the leading
+    chip.) Shared by the executor and the micro benchmark so both measure
+    the same mesh.
+    """
+    import jax
+
+    devices = devices if devices is not None else jax.devices()
+    by_proc: Dict[int, list] = {}
+    for d in devices:
+        by_proc.setdefault(d.process_index, []).append(d)
+    return [
+        sorted(by_proc[p], key=lambda d: d.id)[0]
+        for p in sorted(by_proc.keys())
+    ]
+
+
 class XlaPlanExecutor(PlanExecutor):
     def __init__(self, topology: Topology, device=None, config=None):
         import jax
@@ -46,17 +79,7 @@ class XlaPlanExecutor(PlanExecutor):
                 f"XlaPlanExecutor needs one device per rank: "
                 f"{len(devices)} global devices < size {topology.size}"
             )
-        # One device per rank: process r contributes its first local device.
-        # (TPU pods with multiple chips per process combine eager rank
-        # collectives with in-process compiled-mode meshes; the eager plane
-        # uses the leading chip.)
-        by_proc: Dict[int, list] = {}
-        for d in devices:
-            by_proc.setdefault(d.process_index, []).append(d)
-        mesh_devices = [
-            sorted(by_proc[p], key=lambda d: d.id)[0]
-            for p in sorted(by_proc.keys())
-        ]
+        mesh_devices = rank_mesh_devices(devices)
         if len(mesh_devices) != topology.size:
             raise RuntimeError(
                 f"process count {len(mesh_devices)} != horovod size "
@@ -84,29 +107,38 @@ class XlaPlanExecutor(PlanExecutor):
                 ),
                 (_CROSS_AXIS, _LOCAL_AXIS),
             )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._sharding = NamedSharding(self._mesh, P(_RANK_AXIS))
+        self._sharding2 = (
+            NamedSharding(self._mesh2, P(_CROSS_AXIS, _LOCAL_AXIS))
+            if self._mesh2 is not None else None
+        )
         self._fn_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
 
     def _knob(self, name: str) -> bool:
         return bool(getattr(self._config, name, False)) if self._config else False
 
-    def _wrap(self, body, hier: bool):
+    def _wrap(self, body, hier: bool, n_in: int = 1, n_out: int = 1,
+              donate: bool = False):
         """shard_map+jit a plan body over the flat rank mesh or the
-        (cross, local) grid."""
+        (cross, local) grid. ``donate`` aliases the carrier buffer into the
+        output (persistent-fusion-buffer behavior); only set it when the
+        executor owns the input arrays."""
         import jax
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
 
-        if hier:
-            fn = _shard_map(
-                body, self._mesh2,
-                in_specs=(P(_CROSS_AXIS, _LOCAL_AXIS),), out_specs=P(),
-            )
-        else:
-            fn = _shard_map(
-                body, self._mesh, in_specs=(P(_RANK_AXIS),), out_specs=P()
-            )
-        return jax.jit(fn)
+        in_spec = P(_CROSS_AXIS, _LOCAL_AXIS) if hier else P(_RANK_AXIS)
+        fn = _shard_map(
+            body, self._mesh2 if hier else self._mesh,
+            in_specs=(in_spec,) * n_in,
+            out_specs=P() if n_out == 1 else (P(),) * n_out,
+        )
+        return jax.jit(
+            fn, donate_argnums=tuple(range(n_in)) if donate else ()
+        )
 
     # --- helpers ---
     def _global_array(self, local_np: np.ndarray, hierarchical: bool = False):
@@ -114,10 +146,9 @@ class XlaPlanExecutor(PlanExecutor):
         (cross, local, *local) on the 2-D mesh — with one shard per rank
         from this process's local data."""
         import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         if hierarchical:
-            sharding = NamedSharding(self._mesh2, P(_CROSS_AXIS, _LOCAL_AXIS))
+            sharding = self._sharding2
             gshape = (
                 self._topo.cross_size, self._topo.local_size
             ) + local_np.shape
@@ -125,9 +156,41 @@ class XlaPlanExecutor(PlanExecutor):
                 local_np[None, None, ...], self._local_device
             )
         else:
-            sharding = NamedSharding(self._mesh, P(_RANK_AXIS))
+            sharding = self._sharding
             gshape = (self._topo.size,) + local_np.shape
             local = jax.device_put(local_np[None, ...], self._local_device)
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, [local]
+        )
+
+    def _device_resident(self, t) -> bool:
+        """True when ``t`` is a jax array living wholly on this rank's eager
+        device — the zero-copy fast path applies."""
+        try:
+            return (
+                isinstance(t, self._jax.Array)
+                and not isinstance(t, self._jax.core.Tracer)
+                and len(t.devices()) == 1
+                and next(iter(t.devices())) == self._local_device
+            )
+        except Exception:
+            return False
+
+    def _global_from_device(self, x, hierarchical: bool = False):
+        """Wrap this rank's device-resident array as its shard of the global
+        array — no host round-trip; the reshape stays on device."""
+        import jax
+
+        lead = (1, 1) if hierarchical else (1,)
+        local = x.reshape(lead + x.shape)
+        if hierarchical:
+            gshape = (
+                self._topo.cross_size, self._topo.local_size
+            ) + tuple(x.shape)
+            sharding = self._sharding2
+        else:
+            gshape = (self._topo.size,) + tuple(x.shape)
+            sharding = self._sharding
         return jax.make_array_from_single_device_arrays(
             gshape, sharding, [local]
         )
@@ -173,14 +236,53 @@ class XlaPlanExecutor(PlanExecutor):
             offset += n
         return outputs
 
-    def _allreduce(self, plan, entries, adasum: bool) -> Dict[str, Any]:
-        import jax
+    def _reduce_flat(self, v, *, op, adasum, hier, pre, post, participants):
+        """Collective math on one flat per-rank vector; traced inside the
+        compiled plan executable by both the host and device paths."""
         from jax import lax
-        from jax.sharding import PartitionSpec as P
-        from ..jax import _shard_map
         from ..ops.adasum import adasum_allreduce
 
-        buf, shapes, dtype = self._pack(entries)
+        if pre != 1.0:
+            v = v * np.asarray(pre, dtype=v.dtype)
+        if adasum:
+            if hier:
+                from ..ops.adasum import hierarchical_adasum_allreduce
+
+                # 1/local_size so the local reduce-scatter yields the
+                # node *average* and VHDD of identical inputs is the
+                # identity, matching flat VHDD semantics (the
+                # reference applies this divisor in the framework
+                # layer, tensorflow/__init__.py:98-106).
+                v = (v / self._topo.local_size).astype(v.dtype)
+                r = hierarchical_adasum_allreduce(
+                    v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
+                )
+            else:
+                r = adasum_allreduce(v, axis_name=_RANK_AXIS)
+        elif hier:
+            from ..ops.collectives import hierarchical_allreduce
+
+            r = hierarchical_allreduce(
+                v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
+            )
+            if op == ReduceOp.AVERAGE:
+                r = (r / participants).astype(r.dtype)
+        elif op == ReduceOp.AVERAGE:
+            # Divide by the participant count (Join-aware divisor),
+            # not the axis size.
+            s = lax.psum(v, _RANK_AXIS)
+            r = (s / participants).astype(s.dtype)
+        elif op == ReduceOp.MIN:
+            r = lax.pmin(v, _RANK_AXIS)
+        elif op == ReduceOp.MAX:
+            r = lax.pmax(v, _RANK_AXIS)
+        else:
+            r = lax.psum(v, _RANK_AXIS)
+        if post != 1.0:
+            r = r * np.asarray(post, dtype=r.dtype)
+        return r
+
+    def _allreduce(self, plan, entries, adasum: bool) -> Dict[str, Any]:
         op = ReduceOp(plan.get("op", int(ReduceOp.SUM)))
         pre = float(plan.get("prescale", 1.0))
         post = float(plan.get("postscale", 1.0))
@@ -201,6 +303,18 @@ class XlaPlanExecutor(PlanExecutor):
                 or adasum
             )
         )
+        kw = dict(op=op, adasum=adasum, hier=hier, pre=pre, post=post,
+                  participants=participants)
+        if (
+            all(self._device_resident(e.tensor) for e in entries)
+            and len({str(e.tensor.dtype) for e in entries}) == 1
+        ):
+            return self._allreduce_device(entries, **kw)
+        return self._allreduce_host(entries, **kw)
+
+    def _allreduce_host(self, entries, *, op, adasum, hier, pre, post,
+                        participants) -> Dict[str, Any]:
+        buf, shapes, dtype = self._pack(entries)
         key = ("ar", dtype, buf.size, int(op), adasum, pre, post,
                participants, hier)
 
@@ -208,51 +322,71 @@ class XlaPlanExecutor(PlanExecutor):
             def body(x):
                 # x: local shard — (1, L) flat or (1, 1, L) hierarchical.
                 v = x[0] if not hier else x[0, 0]
-                if pre != 1.0:
-                    v = v * np.asarray(pre, dtype=v.dtype)
-                if adasum:
-                    if hier:
-                        from ..ops.adasum import hierarchical_adasum_allreduce
+                return self._reduce_flat(
+                    v, op=op, adasum=adasum, hier=hier, pre=pre, post=post,
+                    participants=participants,
+                )
 
-                        # 1/local_size so the local reduce-scatter yields the
-                        # node *average* and VHDD of identical inputs is the
-                        # identity, matching flat VHDD semantics (the
-                        # reference applies this divisor in the framework
-                        # layer, tensorflow/__init__.py:98-106).
-                        v = (v / self._topo.local_size).astype(v.dtype)
-                        r = hierarchical_adasum_allreduce(
-                            v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
-                        )
-                    else:
-                        r = adasum_allreduce(v, axis_name=_RANK_AXIS)
-                elif hier:
-                    from ..ops.collectives import hierarchical_allreduce
-
-                    r = hierarchical_allreduce(
-                        v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
-                    )
-                    if op == ReduceOp.AVERAGE:
-                        r = (r / participants).astype(r.dtype)
-                elif op == ReduceOp.AVERAGE:
-                    # Divide by the participant count (Join-aware divisor),
-                    # not the axis size.
-                    s = lax.psum(v, _RANK_AXIS)
-                    r = (s / participants).astype(s.dtype)
-                elif op == ReduceOp.MIN:
-                    r = lax.pmin(v, _RANK_AXIS)
-                elif op == ReduceOp.MAX:
-                    r = lax.pmax(v, _RANK_AXIS)
-                else:
-                    r = lax.psum(v, _RANK_AXIS)
-                if post != 1.0:
-                    r = r * np.asarray(post, dtype=r.dtype)
-                return r
-
-            return self._wrap(body, hier)
+            # The carrier is executor-owned: donate it so XLA aliases the
+            # buffer across calls (persistent fusion buffer).
+            return self._wrap(body, hier, donate=True)
 
         garr = self._global_array(buf, hierarchical=hier)
         out = self._compiled(key, build)(garr)
         return self._unpack(self._local_out(out), entries, shapes)
+
+    def _allreduce_device(self, entries, *, op, adasum, hier, pre, post,
+                          participants) -> Dict[str, Any]:
+        """Zero-host-copy path: entries are device-resident jax arrays, so
+        pack + collective + unpack trace into one executable and outputs
+        stay on device. The flat fusion buffer is an XLA temporary — the
+        compiler, not the host, owns its placement and reuse."""
+        import jax.numpy as jnp
+
+        shapes = tuple(tuple(int(d) for d in e.tensor.shape) for e in entries)
+        dtype = str(entries[0].tensor.dtype)
+        key = ("ar_dev", dtype, shapes, int(op), adasum, pre, post,
+               participants, hier)
+
+        def build():
+            def body(*xs):
+                vs = [(x[0, 0] if hier else x[0]).reshape(-1) for x in xs]
+                v = vs[0] if len(vs) == 1 else jnp.concatenate(vs)
+                r = self._reduce_flat(
+                    v, op=op, adasum=adasum, hier=hier, pre=pre, post=post,
+                    participants=participants,
+                )
+                if len(shapes) == 1:
+                    return r.reshape(shapes[0])
+                outs, off = [], 0
+                for shp in shapes:
+                    n = int(np.prod(shp)) if shp else 1
+                    outs.append(r[off:off + n].reshape(shp))
+                    off += n
+                return tuple(outs)
+
+            return self._wrap(
+                body, hier, n_in=len(entries), n_out=len(entries)
+            )
+
+        garrs = [
+            self._global_from_device(e.tensor, hierarchical=hier)
+            for e in entries
+        ]
+        outs = self._compiled(key, build)(*garrs)
+        if len(entries) == 1:
+            outs = (outs,)
+        return {
+            e.name: self._local_view(o) for e, o in zip(entries, outs)
+        }
+
+    def _local_view(self, garr):
+        """This rank's single-device view of a replicated output — a jax
+        array, not a host copy."""
+        for s in garr.addressable_shards:
+            if s.device == self._local_device:
+                return s.data
+        return garr.addressable_shards[0].data
 
     def _allgather(self, plan, entries) -> Dict[str, Any]:
         import jax

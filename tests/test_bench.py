@@ -1,0 +1,71 @@
+"""bench.py must be able to validate itself without a TPU.
+
+Round-2 verdict weak #2: two rounds produced no perf artifact because the
+harness could only run against the (flaky) real chip. These tests pin the
+escape hatch: ``--platform cpu`` forces the backend at the jax-config level
+(the env var alone loses to a sitecustomize hook) and the supervisor emits
+machine-readable JSON on both success and failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(__file__), os.pardir, "bench.py")
+
+
+def _run(args, timeout=540):
+    env = dict(os.environ)
+    # The bench must do its own platform forcing; don't inherit the test
+    # harness's virtual-mesh XLA_FLAGS or any pinned JAX_PLATFORMS.
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_BACKOFF_S"] = "0.5"
+    return subprocess.run(
+        [sys.executable, BENCH] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        timeout=timeout, text=True, env=env,
+    )
+
+
+def _json_line(stdout: str) -> dict:
+    lines = [l for l in stdout.splitlines() if l.strip().startswith("{")]
+    assert lines, f"no JSON line in stdout: {stdout!r}"
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_smoke_cpu_end_to_end():
+    proc = _run([
+        "--smoke", "--platform", "cpu", "--cpu-devices", "2",
+        "--model", "resnet18", "--num-classes", "10",
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = _json_line(proc.stdout)
+    assert out["metric"] == "resnet18_synthetic_images_per_sec_per_chip"
+    assert out["value"] and out["value"] > 0
+    assert out["unit"] == "img/s/chip"
+    assert out["detail"]["platform"] == "cpu"
+    assert out["detail"]["n_chips"] == 2
+    # FLOPs cost analysis populated => MFU is computable on TPU.
+    assert out["detail"]["flops_per_step"], out["detail"]
+
+
+def test_failure_emits_structured_json():
+    """A worker that fails deterministically must still produce one parseable
+    JSON line (the round-2 capture died rc=124 with ``parsed: null``)."""
+    proc = _run([
+        # No --smoke: smoke mode overrides batch-size, and the negative
+        # batch must reach the worker to crash it (ValueError from randn)
+        # before any compile happens.
+        "--platform", "cpu", "--cpu-devices", "1",
+        "--model", "resnet18", "--batch-size", "-1", "--image-size", "8",
+        "--deadline", "240", "--attempt-timeout", "60",
+    ], timeout=300)
+    assert proc.returncode != 0
+    out = _json_line(proc.stdout)
+    assert out["value"] is None
+    assert "error" in out and out["error"]
