@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..parallel.ring_attention import reference_attention
+from ..ops.pallas_attention import flash_attention_bthd
 
 
 class Block(nn.Module):
@@ -31,7 +31,10 @@ class Block(nn.Module):
         B, T, C = x.shape
         H = self.n_heads
         D = C // H
-        attn = self.attn_fn or partial(reference_attention, causal=True)
+        # Default attention is the fused Pallas flash kernel (interpret
+        # mode off-TPU); callers plug ring/Ulysses attention in via attn_fn
+        # for sequence parallelism.
+        attn = self.attn_fn or partial(flash_attention_bthd, causal=True)
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
         qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype)(h)

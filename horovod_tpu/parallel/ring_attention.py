@@ -69,24 +69,71 @@ def ring_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: bool = True,
 ) -> jax.Array:
     """Blockwise ring attention over a named mesh axis (call inside
     shard_map). q/k/v: [batch, seq_local, heads, head_dim], sequence-sharded
-    on ``axis_name``. Returns [batch, seq_local, heads, head_dim]."""
+    on ``axis_name``. Returns [batch, seq_local, heads, head_dim].
+
+    The per-block compute is the Pallas flash kernel
+    (``ops/pallas_attention.flash_attention_block``): each ring step runs
+    the fused block on the resident K/V shard, and the returned
+    ``(o_unnorm, m, l)`` triples are merged with the standard online-softmax
+    log-sum-exp combination. ``use_flash=False`` falls back to the dense
+    jnp block (kept for A/B numerics testing).
+    """
     n = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    q_offset = rank * T
+    # Ring: after s steps this rank holds the K/V block originally owned by
+    # rank (rank - s) mod n. Source i sends to (i+1) mod n each step.
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
+    if use_flash:
+        from ..ops.pallas_attention import _NEG_INF, flash_attention_block
+
+        # Fold heads into the kernel batch axis once; K/V rotate folded.
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        qf, kf, vf = fold(q), fold(k), fold(v)
+        m0 = jnp.full((B * H, T), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B * H, T), jnp.float32)
+        o0 = jnp.zeros((B * H, T, D), jnp.float32)
+
+        def step(carry, s):
+            k_blk, v_blk, m, l, o = carry
+            src = (rank - s) % n
+            # K block's global origin minus Q's: positions k_pos + delta.
+            delta = ((src - rank) * T).astype(jnp.float32)
+            o_s, m_s, l_s = flash_attention_block(
+                qf, k_blk, v_blk, delta, sm_scale=scale, causal=causal,
+            )
+            # Online-softmax merge of two partial blocks (finite -1e30
+            # sentinel: fully-masked blocks contribute exp(-huge) = 0).
+            m_new = jnp.maximum(m, m_s)
+            c = jnp.exp(m - m_new)
+            c_s = jnp.exp(m_s - m_new)
+            o = o * c[..., None] + o_s * c_s[..., None]
+            l = l * c + l_s * c_s
+            # Rotate for the next step. XLA schedules this ppermute
+            # concurrently with the block compute on TPU (collective-compute
+            # overlap on ICI).
+            k_nxt = lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = lax.ppermute(v_blk, axis_name, perm)
+            return (k_nxt, v_nxt, m_new, l, o), None
+
+        (k_f, v_f, m, l, o), _ = lax.scan(
+            step, (kf, vf, m0, l0, o0), jnp.arange(n)
+        )
+        l = jnp.where(l == 0.0, 1.0, l)
+        out = (o / l[..., None]).astype(q.dtype)      # [BH, T, D]
+        return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    q_offset = rank * T
     compute = jnp.float32
     m0 = jnp.full((B, H, T), -jnp.inf, compute)
     l0 = jnp.zeros((B, H, T), compute)
     o0 = jnp.zeros((B, H, T, D), compute)
-
-    # Ring: after s steps this rank holds the K/V block originally owned by
-    # rank (rank - s) mod n. Source i sends to (i+1) mod n each step.
-    perm = [(i, (i + 1) % n) for i in range(n)]
     q_pos = q_offset + jnp.arange(T)
 
     def step(carry, s):
@@ -100,8 +147,6 @@ def ring_attention(
         else:
             bias = jnp.zeros((T, T), compute)
         m, l, o = _block_attn(q, k_blk, v_blk, bias, m, l, o, scale)
-        # Rotate for the next step. XLA schedules this ppermute concurrently
-        # with the block compute on TPU (collective-compute overlap on ICI).
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, m, l, o), None
