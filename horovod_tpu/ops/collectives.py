@@ -67,12 +67,33 @@ def allreduce(
     elif op == ReduceOp.MAX:
         out = lax.pmax(x, axis_name)
     elif op == ReduceOp.PRODUCT:
-        # No lax.pprod; exp/log is lossy — use log-space for positive only,
-        # so instead reduce via all_gather + prod (axis sizes are small).
-        out = jnp.prod(lax.all_gather(x, axis_name), axis=0)
+        out = _product_allreduce(x, axis_name)
     else:
         raise ValueError(f"Unsupported reduce op: {op}")
     return _maybe_scale(out, postscale_factor)
+
+
+def _product_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """Allreduce with a product: recursive-doubling butterfly of
+    ``ppermute`` + multiply — O(bytes) memory and exact fp products (every
+    rank applies the identical association), log2(n) rounds. There is no
+    ``lax.pprod``; the earlier ``all_gather``+``prod`` formulation held
+    n copies of the tensor live. Non-power-of-2 axes fall back to the
+    gather (rare: TPU slices are power-of-2)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        return jnp.prod(lax.all_gather(x, axis_name), axis=0)
+    out = x
+    t = 1
+    while t < n:
+        # XOR pairing is a symmetric permutation: each rank both sends to
+        # and receives from its butterfly partner.
+        perm = [(i, i ^ t) for i in range(n)]
+        out = out * lax.ppermute(out, axis_name, perm)
+        t *= 2
+    return out
 
 
 def allgather(x: jax.Array, *, axis_name: str = DATA_AXIS) -> jax.Array:
@@ -106,11 +127,33 @@ def allgatherv(
 def broadcast(
     x: jax.Array, *, root_rank: int = 0, axis_name: str = DATA_AXIS
 ) -> jax.Array:
-    """Every rank receives the root's value. Lowered as a masked psum —
-    on TPU this becomes a one-to-all ICI broadcast after XLA optimization."""
-    idx = lax.axis_index(axis_name)
-    contrib = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
-    return lax.psum(contrib, axis_name)
+    """Every rank receives the root's value (reference ``MPI_Bcast``,
+    ``mpi_operations.cc:326-356``).
+
+    Lowered as a binomial-tree one-to-all over ``ppermute``: ceil(log2(n))
+    rounds in which every rank that already holds the root's value forwards
+    it one doubling step further (in root-shifted virtual rank space). Moves
+    O(bytes) per link with log-depth latency — unlike the earlier masked
+    ``psum``, which paid a full ring allreduce (O(size x bytes) ICI
+    traffic) to move one rank's tensor."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    # Virtual rank: root is 0; holders after round t are vr < 2^(t+1).
+    vr = (lax.axis_index(axis_name) - root_rank) % n
+    out = x
+    t = 1
+    while t < n:
+        count = min(t, n - t)  # senders this round: vr in [0, count)
+        perm = [
+            ((v + root_rank) % n, (v + t + root_rank) % n)
+            for v in range(count)
+        ]
+        received = lax.ppermute(out, axis_name, perm)
+        is_receiver = (vr >= t) & (vr < t + count)
+        out = jnp.where(is_receiver, received, out)
+        t *= 2
+    return out
 
 
 def alltoall(
