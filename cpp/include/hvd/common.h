@@ -120,6 +120,9 @@ struct ResponseList {
   // Autotuned knobs broadcast from rank 0 (parameter manager sync).
   double cycle_time_ms = 0.0;      // 0 = unchanged
   int64_t fusion_threshold = 0;    // 0 = unchanged
+  // Categorical knobs: bit0 hierarchical_allreduce, bit1
+  // hierarchical_allgather, bit2 cache_enabled; -1 = unchanged.
+  int32_t tuned_flags = -1;
 };
 
 struct CoreConfig {
@@ -137,6 +140,9 @@ struct CoreConfig {
   int32_t autotune = 0;
   int32_t autotune_warmup_samples = 3;
   int32_t autotune_steps_per_sample = 10;
+  // Initial categorical knob values (env: HOROVOD_HIERARCHICAL_*).
+  int32_t hierarchical_allreduce = 0;
+  int32_t hierarchical_allgather = 0;
   int32_t log_level = 2;  // 0=trace 1=debug 2=info 3=warn 4=error
   char timeline_path[1024] = {0};
   char coord_addr[256] = {0};  // empty => single-process controller

@@ -10,6 +10,7 @@
 // drive its timeline/autotune/stall machinery.
 #pragma once
 
+#include <array>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -135,38 +136,63 @@ class StallInspector {
 };
 
 // ---------------------------------------------------------------- autotune
-// Joint Bayesian optimization of (fusion_threshold, cycle_time) scored by
-// observed data-plane throughput — role parity with the reference
-// ParameterManager + optim/ (GP regressor + Expected Improvement).
+// Joint Bayesian optimization of (fusion_threshold, cycle_time) plus the
+// categorical knobs (hierarchical_allreduce, hierarchical_allgather,
+// cache_enabled), scored by observed data-plane throughput — role parity
+// with the reference ParameterManager + optim/ (GP regressor + Expected
+// Improvement; the reference's joint categorical tuning is
+// parameter_manager.h:42-246). Categoricals embed as {0,1} dimensions of
+// the same RBF GP.
 class ParameterManager {
  public:
   void Initialize(double cycle_ms, int64_t fusion_bytes, int warmup,
                   int steps_per_sample, const std::string& log_path);
+  // Initial categorical values + whether the tuner may explore them
+  // (hierarchical dims are only explorable when a (cross, local) grid
+  // exists; cache_enabled is always explorable when autotune is on).
+  void SetCategorical(bool hier_allreduce, bool hier_allgather,
+                      bool cache_enabled, bool tune_hierarchical);
+  // Worker-side sync of the rank-0 verdict's flag bitmask (-1 = no-op).
+  void ApplyFlags(int flags);
+  // Bitmask for the verdict: bit0 hier_allreduce, bit1 hier_allgather,
+  // bit2 cache_enabled. Locked: Tune()/ApplyFlags() write concurrently.
+  int Flags() const;
+  bool cache_enabled() const {
+    std::lock_guard<std::mutex> l(mu_);
+    return cache_enabled_;
+  }
   void SetEnabled(bool e) { enabled_ = e; }
   bool enabled() const { return enabled_; }
   // Record one executed plan (bytes moved). Returns true if params changed.
   bool Update(int64_t bytes, double duration_s);
   double cycle_time_ms() const { return cycle_ms_; }
   int64_t fusion_threshold() const { return fusion_bytes_; }
+  bool hierarchical_allreduce() const { return hier_allreduce_; }
+  bool hierarchical_allgather() const { return hier_allgather_; }
 
  private:
   void Tune(double score);
   bool enabled_ = false;
   double cycle_ms_ = 5.0;
   int64_t fusion_bytes_ = 64ll << 20;
+  bool hier_allreduce_ = false;
+  bool hier_allgather_ = false;
+  bool cache_enabled_ = true;
+  bool tune_hierarchical_ = false;
   int warmup_remaining_ = 3;
   int steps_per_sample_ = 10;
   int steps_in_sample_ = 0;
   int64_t bytes_in_sample_ = 0;
   double sample_start_ = 0;
   std::vector<double> scores_;  // median-of-samples scoring
-  // GP observations: x = (log2 fusion, log2 cycle), y = score.
-  std::vector<std::pair<double, double>> xs_;
+  // GP observations: x = (log2 fusion, log2 cycle, hier_ar, hier_ag,
+  // cache), y = score.
+  std::vector<std::array<double, 5>> xs_;
   std::vector<double> ys_;
   double best_score_ = 0;
-  double best_x1_ = 0, best_x2_ = 0;
+  std::array<double, 5> best_x_ = {0, 0, 0, 0, 1};
   std::string log_path_;
-  std::mutex mu_;
+  mutable std::mutex mu_;
 };
 
 // ---------------------------------------------------------------- plans
@@ -174,6 +200,10 @@ class ParameterManager {
 struct Plan {
   uint64_t id = 0;
   Response response;
+  // Autotuned categorical knobs in force when this plan was dispatched
+  // (stamped from the delivering verdict so every rank compiles the same
+  // lowering); -1 = autotune off, use env-config knobs.
+  int32_t tuned_flags = -1;
 };
 
 // ---------------------------------------------------------------- transport
@@ -221,6 +251,7 @@ class Core {
 
   double cycle_time_ms() const { return params_.cycle_time_ms(); }
   int64_t fusion_threshold() const { return params_.fusion_threshold(); }
+  int tuned_flags() const { return params_.Flags(); }
 
   Timeline& timeline() { return timeline_; }
   size_t cache_size() const { return cache_.size(); }

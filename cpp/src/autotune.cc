@@ -1,12 +1,17 @@
-// Autotuning of (fusion_threshold, cycle_time) by Bayesian optimization.
+// Autotuning of (fusion_threshold, cycle_time) plus the categorical knobs
+// (hierarchical_allreduce, hierarchical_allgather, cache_enabled) by
+// Bayesian optimization.
 //
 // Role parity with the reference ParameterManager + optim/ (joint tuning of
 // fusion threshold and cycle time scored in bytes/sec, Gaussian-process
-// regression with Expected-Improvement acquisition). Re-implemented
+// regression with Expected-Improvement acquisition; the categorical joint
+// tuning mirrors parameter_manager.h:42-246). Re-implemented
 // dependency-free: RBF-kernel GP with a hand-rolled Cholesky solve (the
-// design space is 2-D and the sample count small), EI maximized over a
-// deterministic candidate grid instead of gradient ascent.
+// design space is 5-D — two continuous, three {0,1} embedded — and the
+// sample count small), EI maximized over a deterministic candidate grid
+// instead of gradient ascent.
 #include <algorithm>
+#include <array>
 #include <cmath>
 #include <cstdio>
 #include <sstream>
@@ -19,7 +24,8 @@ namespace hvd {
 namespace {
 
 // Normalized design space: x1 = log2(fusion_bytes) in [16, 28],
-// x2 = log2(cycle_ms) in [-2, 6], both mapped to [0, 1].
+// x2 = log2(cycle_ms) in [-2, 6], both mapped to [0, 1]; x3..x5 are the
+// categorical knobs embedded as {0, 1}.
 constexpr double kF0 = 16.0, kF1 = 28.0;
 constexpr double kC0 = -2.0, kC1 = 6.0;
 
@@ -57,10 +63,19 @@ void CholSolve(const std::vector<double>& L, int n, std::vector<double>& b) {
   }
 }
 
-double Kernel(double x1, double y1, double x2, double y2) {
+double Kernel(const std::array<double, 5>& a, const std::array<double, 5>& b) {
+  // Continuous dims use a 0.25 length scale; categorical {0,1} dims use a
+  // longer one (a flip is informative but should not decorrelate totally).
   constexpr double kLength = 0.25;
-  double d = (x1 - x2) * (x1 - x2) + (y1 - y2) * (y1 - y2);
-  return std::exp(-d / (2 * kLength * kLength));
+  constexpr double kCatLength = 0.75;
+  double d = 0;
+  for (int i = 0; i < 2; ++i) {
+    d += (a[i] - b[i]) * (a[i] - b[i]) / (kLength * kLength);
+  }
+  for (int i = 2; i < 5; ++i) {
+    d += (a[i] - b[i]) * (a[i] - b[i]) / (kCatLength * kCatLength);
+  }
+  return std::exp(-d / 2);
 }
 
 double NormCdf(double z) { return 0.5 * std::erfc(-z / std::sqrt(2.0)); }
@@ -80,6 +95,33 @@ void ParameterManager::Initialize(double cycle_ms, int64_t fusion_bytes,
   if (steps_per_sample > 0) steps_per_sample_ = steps_per_sample;
   if (!log_path.empty()) log_path_ = log_path;
   sample_start_ = 0;
+}
+
+void ParameterManager::SetCategorical(bool hier_allreduce, bool hier_allgather,
+                                      bool cache_enabled,
+                                      bool tune_hierarchical) {
+  std::lock_guard<std::mutex> l(mu_);
+  hier_allreduce_ = hier_allreduce;
+  hier_allgather_ = hier_allgather;
+  cache_enabled_ = cache_enabled;
+  tune_hierarchical_ = tune_hierarchical;
+  best_x_[2] = hier_allreduce ? 1.0 : 0.0;
+  best_x_[3] = hier_allgather ? 1.0 : 0.0;
+  best_x_[4] = cache_enabled ? 1.0 : 0.0;
+}
+
+void ParameterManager::ApplyFlags(int flags) {
+  if (flags < 0) return;
+  std::lock_guard<std::mutex> l(mu_);
+  hier_allreduce_ = (flags & 1) != 0;
+  hier_allgather_ = (flags & 2) != 0;
+  cache_enabled_ = (flags & 4) != 0;
+}
+
+int ParameterManager::Flags() const {
+  std::lock_guard<std::mutex> l(mu_);
+  return (hier_allreduce_ ? 1 : 0) | (hier_allgather_ ? 2 : 0) |
+         (cache_enabled_ ? 4 : 0);
 }
 
 bool ParameterManager::Update(int64_t bytes, double duration_s) {
@@ -110,35 +152,52 @@ bool ParameterManager::Update(int64_t bytes, double duration_s) {
 }
 
 void ParameterManager::Tune(double median_score) {
-  double x1 = Norm1(std::log2(static_cast<double>(fusion_bytes_)));
-  double x2 = Norm2(std::log2(cycle_ms_));
-  xs_.emplace_back(x1, x2);
+  std::array<double, 5> x = {
+      Norm1(std::log2(static_cast<double>(fusion_bytes_))),
+      Norm2(std::log2(cycle_ms_)),
+      hier_allreduce_ ? 1.0 : 0.0,
+      hier_allgather_ ? 1.0 : 0.0,
+      cache_enabled_ ? 1.0 : 0.0,
+  };
+  xs_.push_back(x);
   ys_.push_back(median_score);
   if (median_score > best_score_) {
     best_score_ = median_score;
-    best_x1_ = x1;
-    best_x2_ = x2;
+    best_x_ = x;
   }
   if (!log_path_.empty()) {
     if (FILE* f = std::fopen(log_path_.c_str(), "a")) {
-      std::fprintf(f, "%lld,%.3f,%.1f\n",
+      std::fprintf(f, "%lld,%.3f,%d,%d,%d,%.1f\n",
                    static_cast<long long>(fusion_bytes_), cycle_ms_,
-                   median_score);
+                   hier_allreduce_ ? 1 : 0, hier_allgather_ ? 1 : 0,
+                   cache_enabled_ ? 1 : 0, median_score);
       std::fclose(f);
     }
   }
 
+  auto apply = [this](const std::array<double, 5>& c) {
+    fusion_bytes_ =
+        static_cast<int64_t>(std::pow(2.0, kF0 + c[0] * (kF1 - kF0)));
+    cycle_ms_ = std::pow(2.0, kC0 + c[1] * (kC1 - kC0));
+    hier_allreduce_ = c[2] > 0.5;
+    hier_allgather_ = c[3] > 0.5;
+    cache_enabled_ = c[4] > 0.5;
+  };
+
   int n = static_cast<int>(xs_.size());
   // After enough samples, pin the best-known point (reference caps the
-  // bayes-opt sample budget and then freezes).
-  if (n >= 20) {
-    fusion_bytes_ = static_cast<int64_t>(
-        std::pow(2.0, kF0 + best_x1_ * (kF1 - kF0)));
-    cycle_ms_ = std::pow(2.0, kC0 + best_x2_ * (kC1 - kC0));
+  // bayes-opt sample budget and then freezes); the categorical dims widen
+  // the space, so give them a slightly larger budget.
+  int budget = tune_hierarchical_ ? 28 : 24;
+  if (n >= budget) {
+    apply(best_x_);
     enabled_ = false;
     HVD_LOG(kInfo, "autotune converged: fusion=" +
                        std::to_string(fusion_bytes_) +
-                       " cycle_ms=" + std::to_string(cycle_ms_));
+                       " cycle_ms=" + std::to_string(cycle_ms_) +
+                       " hier_allreduce=" + std::to_string(hier_allreduce_) +
+                       " hier_allgather=" + std::to_string(hier_allgather_) +
+                       " cache=" + std::to_string(cache_enabled_));
     return;
   }
 
@@ -158,8 +217,7 @@ void ParameterManager::Tune(double median_score) {
   constexpr double kNoise = 0.05;
   for (int i = 0; i < n; ++i) {
     for (int j = 0; j < n; ++j) {
-      K[i * n + j] = Kernel(xs_[i].first, xs_[i].second, xs_[j].first,
-                            xs_[j].second);
+      K[i * n + j] = Kernel(xs_[i], xs_[j]);
     }
     K[i * n + i] += kNoise;
   }
@@ -168,40 +226,59 @@ void ParameterManager::Tune(double median_score) {
   std::vector<double> alpha = y;
   CholSolve(L, n, alpha);
 
-  // EI over a 17x17 candidate grid.
-  double best_ei = -1, cand1 = best_x1_, cand2 = best_x2_;
-  double fbest = *std::max_element(y.begin(), y.end());
-  for (int gi = 0; gi <= 16; ++gi) {
-    for (int gj = 0; gj <= 16; ++gj) {
-      double c1 = gi / 16.0, c2 = gj / 16.0;
-      std::vector<double> k(n);
-      for (int i = 0; i < n; ++i) {
-        k[i] = Kernel(c1, c2, xs_[i].first, xs_[i].second);
-      }
-      double mu = 0;
-      for (int i = 0; i < n; ++i) mu += k[i] * alpha[i];
-      std::vector<double> v = k;
-      CholSolve(L, n, v);
-      double var = Kernel(c1, c2, c1, c2) + kNoise;
-      for (int i = 0; i < n; ++i) var -= k[i] * v[i];
-      var = std::max(var, 1e-10);
-      double sigma = std::sqrt(var);
-      constexpr double kXi = 0.01;
-      double z = (mu - fbest - kXi) / sigma;
-      double ei = (mu - fbest - kXi) * NormCdf(z) + sigma * NormPdf(z);
-      if (ei > best_ei) {
-        best_ei = ei;
-        cand1 = c1;
-        cand2 = c2;
+  // EI over a 9x9 continuous grid x categorical combinations. The cache
+  // dim is always explorable under autotune; the hierarchical dims only
+  // when a (cross, local) grid exists.
+  std::vector<std::array<double, 3>> cats;
+  for (int br = 0; br <= 1; ++br) {
+    for (int bg = 0; bg <= 1; ++bg) {
+      for (int bc = 0; bc <= 1; ++bc) {
+        if (!tune_hierarchical_ &&
+            (br != (hier_allreduce_ ? 1 : 0) ||
+             bg != (hier_allgather_ ? 1 : 0))) {
+          continue;
+        }
+        cats.push_back({static_cast<double>(br), static_cast<double>(bg),
+                        static_cast<double>(bc)});
       }
     }
   }
-  fusion_bytes_ =
-      static_cast<int64_t>(std::pow(2.0, kF0 + cand1 * (kF1 - kF0)));
-  cycle_ms_ = std::pow(2.0, kC0 + cand2 * (kC1 - kC0));
+  double best_ei = -1;
+  std::array<double, 5> cand = best_x_;
+  double fbest = *std::max_element(y.begin(), y.end());
+  for (int gi = 0; gi <= 8; ++gi) {
+    for (int gj = 0; gj <= 8; ++gj) {
+      for (const auto& cat : cats) {
+        std::array<double, 5> c = {gi / 8.0, gj / 8.0, cat[0], cat[1],
+                                   cat[2]};
+        std::vector<double> k(n);
+        for (int i = 0; i < n; ++i) k[i] = Kernel(c, xs_[i]);
+        double mu = 0;
+        for (int i = 0; i < n; ++i) mu += k[i] * alpha[i];
+        std::vector<double> v = k;
+        CholSolve(L, n, v);
+        double var = Kernel(c, c) + kNoise;
+        for (int i = 0; i < n; ++i) var -= k[i] * v[i];
+        var = std::max(var, 1e-10);
+        double sigma = std::sqrt(var);
+        constexpr double kXi = 0.01;
+        double z = (mu - fbest - kXi) / sigma;
+        double ei = (mu - fbest - kXi) * NormCdf(z) + sigma * NormPdf(z);
+        if (ei > best_ei) {
+          best_ei = ei;
+          cand = c;
+        }
+      }
+    }
+  }
+  apply(cand);
+  // Inline bitmask (NOT Flags(): the caller already holds mu_).
+  int flags = (hier_allreduce_ ? 1 : 0) | (hier_allgather_ ? 2 : 0) |
+              (cache_enabled_ ? 4 : 0);
   HVD_LOG(kDebug, "autotune step: trying fusion=" +
                       std::to_string(fusion_bytes_) +
-                      " cycle_ms=" + std::to_string(cycle_ms_));
+                      " cycle_ms=" + std::to_string(cycle_ms_) +
+                      " flags=" + std::to_string(flags));
 }
 
 }  // namespace hvd

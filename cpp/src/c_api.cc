@@ -51,6 +51,7 @@ std::string PlanToJson(const Plan& p) {
      << ",\"root\":" << r.root_rank << ",\"op\":" << r.reduce_op
      << ",\"prescale\":" << r.prescale << ",\"postscale\":" << r.postscale
      << ",\"participants\":" << r.participants
+     << ",\"tuned_flags\":" << p.tuned_flags
      << ",\"total_bytes\":" << r.total_bytes << ",\"error\":\""
      << JsonEscape(r.error) << "\",\"names\":[";
   for (size_t i = 0; i < r.names.size(); ++i) {
@@ -86,8 +87,9 @@ int hvd_core_init(int rank, int size, int local_rank, int local_size,
                   int stall_warning_sec, int stall_shutdown_sec, int autotune,
                   int autotune_warmup, int autotune_steps, int log_level,
                   const char* timeline_path, const char* coord_addr,
-                  int coord_port, const char* autotune_log, char* err,
-                  int errlen) {
+                  int coord_port, const char* autotune_log,
+                  int hierarchical_allreduce, int hierarchical_allgather,
+                  char* err, int errlen) {
   CoreConfig cfg;
   cfg.rank = rank;
   cfg.size = size;
@@ -116,6 +118,8 @@ int hvd_core_init(int rank, int size, int local_rank, int local_size,
     std::snprintf(cfg.autotune_log, sizeof(cfg.autotune_log), "%s",
                   autotune_log);
   }
+  cfg.hierarchical_allreduce = hierarchical_allreduce;
+  cfg.hierarchical_allgather = hierarchical_allgather;
   Status s = Core::Get().Init(cfg);
   if (!s.ok()) {
     FillErr(err, errlen, s.reason);
@@ -200,6 +204,7 @@ int hvd_core_ticket_status(unsigned long long ticket, char* err, int errlen) {
 }
 
 double hvd_core_cycle_time_ms() { return Core::Get().cycle_time_ms(); }
+int hvd_core_tuned_flags() { return Core::Get().tuned_flags(); }
 long long hvd_core_cache_size() {
   return static_cast<long long>(Core::Get().cache_size());
 }

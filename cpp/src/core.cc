@@ -331,6 +331,14 @@ Status Core::Init(const CoreConfig& cfg) {
                      cfg.autotune_warmup_samples, cfg.autotune_steps_per_sample,
                      cfg.autotune_log[0] ? cfg.autotune_log : "");
   params_.SetEnabled(cfg.autotune != 0 && cfg.rank == 0);
+  // Categorical dims: hierarchical knobs start from the env config and are
+  // only explorable when a (cross, local) grid exists (the lowerings need
+  // it); cache_enabled starts from cache_capacity.
+  bool grid = cfg.local_size > 1 && cfg.cross_size > 1 &&
+              cfg.local_size * cfg.cross_size == cfg.size;
+  params_.SetCategorical(cfg.hierarchical_allreduce != 0,
+                         cfg.hierarchical_allgather != 0,
+                         cfg.cache_capacity > 0, grid);
   if (cfg.timeline_path[0]) timeline_.Initialize(cfg.timeline_path, cfg.rank);
   if (cfg.size > 1) {
     if (!cfg.coord_addr[0] || cfg.coord_port == 0) {
@@ -560,7 +568,7 @@ void Core::RunCycleOnce() {
     mine.requests = std::move(queued_);
     queued_.clear();
   }
-  if (cache_.capacity() > 0) {
+  if (cache_.capacity() > 0 && params_.cache_enabled()) {
     // Response-cache fast path (reference controller.cc:157-186): an
     // already-seen request signature travels as one bit instead of the
     // full Request; the coordinator reconstructs it from its own
@@ -617,6 +625,7 @@ void Core::RunCycleOnce() {
                                        : params_.fusion_threshold(),
           0, 0, "");
     }
+    params_.ApplyFlags(verdict.tuned_flags);
   }
   if (verdict.shutdown) {
     HVD_LOG(kInfo, "shutdown requested by a peer rank");
@@ -773,10 +782,13 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
     out.shutdown = true;
   }
 
-  // Autotuned knob sync (rank 0 -> workers).
-  if (params_.enabled()) {
+  // Autotuned knob sync (rank 0 -> workers). Keeps flowing after
+  // convergence (enabled_ drops) so workers land on the PINNED best values
+  // rather than the last explored point, and late plans stay consistent.
+  if (cfg_.autotune != 0) {
     out.cycle_time_ms = params_.cycle_time_ms();
     out.fusion_threshold = params_.fusion_threshold();
+    out.tuned_flags = params_.Flags();
   }
   return out;
 }
@@ -843,7 +855,13 @@ void Core::DispatchResponses(const ResponseList& rl) {
     if (cache_.capacity() > 0) {
       if (resp.type == ResponseType::kError) {
         for (const auto& name : resp.names) cache_.Invalidate(name);
-      } else if (resp.type != ResponseType::kJoin) {
+      } else if (resp.type != ResponseType::kJoin &&
+                 (rl.tuned_flags >= 0 ? (rl.tuned_flags & 4) != 0
+                                      : params_.cache_enabled())) {
+        // Gate on the DELIVERING VERDICT's flags, not live tuner state:
+        // rank 0's tuner can flip cache_enabled between building the
+        // verdict and dispatching it, and a Put skew would desynchronize
+        // cache bit numbering across ranks.
         // Per-name (pre-fusion) entries, in dispatch order — identical on
         // all ranks, so bit numbering stays coherent without an explicit
         // eviction-sync round.
@@ -895,6 +913,7 @@ void Core::DispatchResponses(const ResponseList& rl) {
       std::lock_guard<std::mutex> l(plan_mu_);
       p.id = next_plan_id_++;
       p.response = resp;
+      p.tuned_flags = rl.tuned_flags;
       inflight_[p.id] = Inflight{resp, std::move(plan_tickets)};
       plans_.push_back(std::move(p));
     }

@@ -116,6 +116,7 @@ std::vector<uint8_t> EncodeResponseList(const ResponseList& rl) {
   w.U8(rl.shutdown ? 1 : 0);
   w.F64(rl.cycle_time_ms);
   w.I64(rl.fusion_threshold);
+  w.I64(rl.tuned_flags);
   w.U32(static_cast<uint32_t>(rl.responses.size()));
   for (const auto& r : rl.responses) EncodeResponse(w, r);
   return std::move(w.buf);
@@ -126,6 +127,7 @@ bool DecodeResponseList(const uint8_t* p, size_t n, ResponseList* out) {
   out->shutdown = rd.U8() != 0;
   out->cycle_time_ms = rd.F64();
   out->fusion_threshold = rd.I64();
+  out->tuned_flags = static_cast<int32_t>(rd.I64());
   uint32_t count = rd.U32();
   if (count > 1u << 20) return false;
   out->responses.clear();

@@ -54,6 +54,7 @@ def load() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_int,
     ]
     lib.hvd_core_shutdown.restype = None
@@ -84,6 +85,7 @@ def load() -> ctypes.CDLL:
         ctypes.c_ulonglong, ctypes.c_char_p, ctypes.c_int,
     ]
     lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
+    lib.hvd_core_tuned_flags.restype = ctypes.c_int
     lib.hvd_core_cache_size.restype = ctypes.c_longlong
     lib.hvd_core_fusion_threshold.restype = ctypes.c_longlong
     lib.hvd_core_timeline_activity.restype = None
@@ -122,6 +124,8 @@ class NativeCore:
             coord_addr.encode(),
             coord_port,
             cfg.autotune_log_file.encode(),
+            1 if cfg.hierarchical_allreduce else 0,
+            1 if cfg.hierarchical_allgather else 0,
             err, self.ERRBUF,
         )
         if rc != 0:
@@ -179,6 +183,11 @@ class NativeCore:
 
     def fusion_threshold(self) -> int:
         return int(self.lib.hvd_core_fusion_threshold())
+
+    def tuned_flags(self) -> int:
+        """Autotuned categorical bitmask: bit0 hierarchical_allreduce,
+        bit1 hierarchical_allgather, bit2 cache_enabled."""
+        return int(self.lib.hvd_core_tuned_flags())
 
     def cache_size(self) -> int:
         return int(self.lib.hvd_core_cache_size())

@@ -120,6 +120,17 @@ class XlaPlanExecutor(PlanExecutor):
     def _knob(self, name: str) -> bool:
         return bool(getattr(self._config, name, False)) if self._config else False
 
+    def _plan_knob(self, plan: dict, name: str, bit: int) -> bool:
+        """Categorical op-selection knob for one plan: the autotuner's
+        verdict-stamped flags win (identical on every rank by construction
+        — the coordinator broadcasts them with the plan's verdict,
+        core.cc tuned_flags); -1 means autotune off, fall back to the env
+        config knob."""
+        flags = int(plan.get("tuned_flags", -1))
+        if flags >= 0:
+            return bool(flags & bit)
+        return self._knob(name)
+
     def _wrap(self, body, hier: bool, n_in: int = 1, n_out: int = 1,
               donate: bool = False):
         """shard_map+jit a plan body over the flat rank mesh or the
@@ -296,7 +307,8 @@ class XlaPlanExecutor(PlanExecutor):
         hier = (
             self._mesh2 is not None
             and (
-                (not adasum and self._knob("hierarchical_allreduce")
+                (not adasum
+                 and self._plan_knob(plan, "hierarchical_allreduce", 1)
                  and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
                 # Adasum on a multi-level grid is always hierarchical, like
                 # the reference's CUDA variant (adasum_cuda_operations.cc).
@@ -400,7 +412,10 @@ class XlaPlanExecutor(PlanExecutor):
         # max, gather, and compact on the host (XLA needs static shapes).
         rank_sizes = [int(s) for s in plan.get("rank_sizes", [])]
         uneven = bool(rank_sizes) and len(set(rank_sizes)) > 1
-        hier = self._mesh2 is not None and self._knob("hierarchical_allgather")
+        hier = (
+            self._mesh2 is not None
+            and self._plan_knob(plan, "hierarchical_allgather", 2)
+        )
 
         outputs: Dict[str, Any] = {}
         for e in entries:

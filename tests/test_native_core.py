@@ -187,3 +187,48 @@ def test_response_cache_roundtrip(core):
             break
         time.sleep(0.005)
     assert state == 1
+
+
+def test_autotune_categorical_flags_in_plans_and_convergence():
+    """The tuner explores the categorical dims (cache always; hierarchical
+    needs a grid) and the verdict stamps every plan with tuned_flags
+    (reference jointly tunes hierarchical_allreduce/hierarchical_allgather/
+    cache_enabled, parameter_manager.h:42-246). After the sample budget the
+    tuner freezes and the pinned flags keep flowing."""
+    hvd.shutdown()
+    c = NativeCore()
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.autotune = True
+    cfg.autotune_warmup_samples = 0
+    cfg.autotune_steps_per_sample = 1
+    c.init(cfg, SINGLE)
+    try:
+        seen_flags = set()
+        # 24 GP samples x 5 scores/median = 120 plans to convergence.
+        for i in range(140):
+            c.enqueue(0, f"cat{i}", 7, [256], -1, 2, 1.0, 1.0)
+            deadline = time.monotonic() + 2
+            p = None
+            while time.monotonic() < deadline and not isinstance(p, dict):
+                p = c.next_plan(timeout_ms=50)
+            assert isinstance(p, dict)
+            assert p["tuned_flags"] >= 0, p  # autotune on => flags stamped
+            seen_flags.add(p["tuned_flags"])
+            c.plan_done(p["id"], 0, "", 0.001, 1024)
+        # cache dim explored: both cache-on and cache-off must have been
+        # proposed at least once across the sweep.
+        assert len(seen_flags) > 1, seen_flags
+        final = c.tuned_flags()
+        # Converged: flags stable from here on.
+        for i in range(5):
+            c.enqueue(0, f"post{i}", 7, [256], -1, 2, 1.0, 1.0)
+            deadline = time.monotonic() + 2
+            p = None
+            while time.monotonic() < deadline and not isinstance(p, dict):
+                p = c.next_plan(timeout_ms=50)
+            assert isinstance(p, dict)
+            assert p["tuned_flags"] == final, (p, final)
+            c.plan_done(p["id"], 0, "", 0.001, 1024)
+    finally:
+        c.shutdown()
