@@ -1,0 +1,36 @@
+# horovod_tpu runtime image — role parity with the reference's
+# Dockerfile.cpu/Dockerfile.gpu (reference builds MPI+NCCL+frameworks; the
+# TPU build needs only the jax TPU stack plus the native control-plane
+# toolchain).
+#
+# Build:  docker build -t horovod-tpu .
+# Run  :  docker run --privileged horovod-tpu \
+#             python -m horovod_tpu.run -np 4 python examples/keras_mnist.py
+# (TPU VMs: --privileged exposes /dev/accel*; on GKE use the TPU device
+# plugin instead.)
+FROM python:3.12-slim-bookworm
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make git openssh-client \
+    && rm -rf /var/lib/apt/lists/*
+
+# jax[tpu] pulls libtpu via the Google releases index.
+RUN pip install --no-cache-dir \
+        'jax[tpu]' -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+        flax optax orbax-checkpoint chex einops numpy
+
+# Framework bindings are optional extras; install the ones you use.
+ARG WITH_TF=0
+ARG WITH_TORCH=0
+RUN if [ "$WITH_TF" = "1" ]; then pip install --no-cache-dir tensorflow-cpu; fi
+RUN if [ "$WITH_TORCH" = "1" ]; then \
+        pip install --no-cache-dir torch --index-url https://download.pytorch.org/whl/cpu; fi
+
+WORKDIR /horovod_tpu
+COPY . .
+# Build the native control-plane core and install the package.
+RUN make -C cpp && pip install --no-cache-dir -e .
+
+# Launcher entrypoint (hvdrun analogue of horovodrun).
+ENTRYPOINT []
+CMD ["python", "-m", "horovod_tpu.run", "--help"]

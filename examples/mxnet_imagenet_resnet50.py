@@ -1,0 +1,66 @@
+"""MXNet ImageNet ResNet-50 — CLI-parity stub for the reference
+``examples/mxnet_imagenet_resnet50.py``.
+
+MXNet is not part of this image (the project is archived upstream and has
+no py3.12 wheels); ``horovod_tpu.mxnet`` is import-gated the same way. The
+script keeps the reference CLI so launcher configs stay drop-in, and exits
+with a clear message when MXNet is absent.
+"""
+
+import argparse
+import sys
+
+parser = argparse.ArgumentParser(
+    description="MXNet ImageNet Example",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+)
+parser.add_argument("--use-rec", action="store_true", default=False,
+                    help="use image RecordIO iterator")
+parser.add_argument("--data-nthreads", type=int, default=2,
+                    help="number of threads for data decoding")
+parser.add_argument("--rec-train", type=str, default="",
+                    help="training RecordIO path")
+parser.add_argument("--rec-val", type=str, default="",
+                    help="validation RecordIO path")
+parser.add_argument("--batch-size", type=int, default=128,
+                    help="per-worker batch size")
+parser.add_argument("--dtype", type=str, default="float32",
+                    help="training precision")
+parser.add_argument("--num-epochs", type=int, default=90,
+                    help="number of training epochs")
+parser.add_argument("--lr", type=float, default=0.05,
+                    help="learning rate for a single worker")
+parser.add_argument("--momentum", type=float, default=0.9,
+                    help="momentum of the optimizer")
+parser.add_argument("--wd", type=float, default=0.0001,
+                    help="weight decay")
+parser.add_argument("--use-adasum", action="store_true", default=False,
+                    help="use the Adasum reducer")
+args = parser.parse_args()
+
+
+def main():
+    try:
+        import mxnet  # noqa: F401
+    except ImportError:
+        print(
+            "MXNet is not available in this build (archived upstream, no "
+            "py3.12 wheels). The horovod_tpu.mxnet binding activates "
+            "automatically when an mxnet installation is present; use the "
+            "JAX (examples/jax_resnet50_synthetic_benchmark.py), TF2 or "
+            "PyTorch ResNet-50 configs instead.",
+            file=sys.stderr,
+        )
+        raise SystemExit(3)
+
+    import horovod_tpu.mxnet as hvd  # noqa: F401
+
+    hvd.init()
+    raise SystemExit(
+        "mxnet present but this environment was never exercised; see "
+        "horovod_tpu/mxnet/__init__.py for the binding"
+    )
+
+
+if __name__ == "__main__":
+    main()

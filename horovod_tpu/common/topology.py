@@ -7,14 +7,19 @@ same global/LOCAL/CROSS triple is derived, in priority order, from:
 
 1. ``HOROVOD_RANK``/``HOROVOD_SIZE``/... env vars set by the launcher
    (parity with ``horovod/common/gloo/gloo_context.cc:113-157``),
-2. an already-initialized ``jax.distributed`` runtime (TPU pod slices: one
-   process per host; local = chips on this host; cross = same chip index on
-   other hosts — exactly the ICI/DCN split the hierarchical ops need),
+2. an already-initialized ``jax.distributed`` runtime: LOCAL = processes in
+   this process's TPU *slice* (one ICI domain, possibly spanning hosts),
+   CROSS = across slices over DCN (``topology_from_slice_metadata``),
 3. single-process fallback: rank 0 of 1.
 
-The LOCAL axis maps onto ICI (within a slice/host) and the CROSS axis onto
-DCN (across slices/hosts) — the analogue of the reference's NCCL-local /
-MPI-cross communicator pair (``horovod/common/common.h:110-114``).
+The LOCAL axis maps onto ICI and the CROSS axis onto DCN — the analogue of
+the reference's NCCL-local / MPI-cross communicator pair
+(``horovod/common/common.h:110-114``). NOTE a deliberate parity deviation:
+the reference's ``local_rank`` means "ranks on this host" (shared memory);
+here it means "ranks in this ICI domain", which on a multi-host single
+slice spans hosts. Host-scoped logic (e.g. dataset caching) should key on
+hostname, not ``local_rank``, in this framework; the env path (1) remains
+host-scoped when the launcher says so.
 """
 
 from __future__ import annotations
@@ -75,6 +80,59 @@ def _from_env() -> Optional[Topology]:
     )
 
 
+def topology_from_slice_metadata(process_index: int,
+                                 proc_slices) -> Topology:
+    """Derive the (rank, LOCAL, CROSS) triple from TPU slice metadata.
+
+    ``proc_slices``: iterable of (process_index, slice_index) pairs, one
+    per process — what ``jax.devices()`` exposes as ``d.process_index`` /
+    ``d.slice_index`` on (multi-slice) pods. Processes sharing a slice
+    communicate over ICI and form the LOCAL axis; slices talk over DCN and
+    form the CROSS axis — the analogue of the reference deriving local
+    ranks from an MPI shared-memory split and cross ranks from splitting by
+    local rank (``mpi_context.cc:149-158`` / ``mpi_controller.cc:25-81``).
+
+    A single-slice pod therefore yields local = all processes, cross = 1
+    (everything rides ICI); N equal slices yield local = procs-per-slice,
+    cross = N.
+
+    The hierarchical executor additionally assumes the block layout
+    ``rank == cross_rank * local_size + local_rank`` when it reshapes the
+    rank-ordered device list into a (cross, local) grid
+    (``xla_executor.py``); process indices interleaved across slices (JAX
+    assigns them by coordinator registration order) would silently put a
+    "local" mesh row across DCN, so non-contiguous layouts are marked
+    non-homogeneous, which keeps the executor on the flat lowering.
+    """
+    by_slice: dict = {}
+    for p, s in sorted(set(proc_slices)):
+        by_slice.setdefault(s, []).append(p)
+    slices = sorted(by_slice)
+    my_slice = next(
+        s for s, procs in by_slice.items() if process_index in procs
+    )
+    local_procs = by_slice[my_slice]
+    sizes = {len(v) for v in by_slice.values()}
+    size = sum(len(v) for v in by_slice.values())
+    # Block-layout invariant: slice k (in slice-id order) must own exactly
+    # the contiguous process range [k*local, (k+1)*local).
+    contiguous = all(
+        by_slice[s] == list(range(k * len(by_slice[s]),
+                                  (k + 1) * len(by_slice[s])))
+        for k, s in enumerate(slices)
+    )
+    return Topology(
+        rank=process_index,
+        size=size,
+        local_rank=local_procs.index(process_index),
+        local_size=len(local_procs),
+        cross_rank=slices.index(my_slice),
+        cross_size=len(slices),
+        is_homogeneous=(len(sizes) == 1 and contiguous),
+        source="slice-metadata",
+    )
+
+
 def _from_jax_distributed() -> Optional[Topology]:
     try:
         import jax
@@ -87,19 +145,20 @@ def _from_jax_distributed() -> Optional[Topology]:
     if nproc <= 1:
         return None
     rank = jax.process_index()
-    # One process per host; every process contributes the same number of
-    # local devices on TPU slices, which makes the topology homogeneous.
-    local_size = 1
-    return Topology(
-        rank=rank,
-        size=nproc,
-        local_rank=0,
-        local_size=local_size,
-        cross_rank=rank,
-        cross_size=nproc,
-        is_homogeneous=True,
-        source="jax.distributed",
-    )
+    try:
+        # Multi-slice pods expose d.slice_index; a single slice (or a CPU
+        # test cluster) groups every process into one ICI domain.
+        pairs = {
+            (d.process_index, getattr(d, "slice_index", 0) or 0)
+            for d in jax.devices()
+        }
+        return topology_from_slice_metadata(rank, pairs)
+    except Exception:
+        return Topology(
+            rank=rank, size=nproc, local_rank=0, local_size=1,
+            cross_rank=rank, cross_size=nproc, is_homogeneous=True,
+            source="jax.distributed",
+        )
 
 
 def detect() -> Topology:

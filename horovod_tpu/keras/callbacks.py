@@ -1,34 +1,32 @@
 """Keras callbacks — parity with ``horovod/_keras/callbacks.py:20-181``:
 BroadcastGlobalVariables, MetricAverage, LearningRateSchedule/Warmup with
-momentum correction."""
+momentum correction.
+
+Real ``keras.callbacks.Callback`` subclasses: Keras 3's CallbackList only
+dispatches the hooks the base class declares (``on_train_batch_end`` etc.),
+so a duck-typed object's legacy ``on_batch_end`` silently never fires —
+which under multi-rank training means the initial broadcast never happens
+and ranks train from different inits.
+"""
 
 from __future__ import annotations
 
 from typing import Optional
 
+import tensorflow as tf
 
-def _keras():
-    import tensorflow as tf
-
-    return tf.keras
+_Callback = tf.keras.callbacks.Callback
 
 
-class BroadcastGlobalVariablesCallback:
-    """Broadcast model + optimizer state from root at the start of training
-    so all ranks begin identical (reference
-    ``_keras/callbacks.py:20-45``)."""
+class BroadcastGlobalVariablesCallback(_Callback):
+    """Broadcast model + optimizer state from root at the end of the first
+    batch (after Keras has built the optimizer slots), so all ranks train
+    identically (reference ``_keras/callbacks.py:20-45``)."""
 
     def __init__(self, root_rank: int = 0, device=""):
+        super().__init__()
         self.root_rank = root_rank
         self.broadcast_done = False
-        self.model = None
-        self.params = {}
-
-    def set_model(self, model):
-        self.model = model
-
-    def set_params(self, params):
-        self.params = params
 
     def on_batch_end(self, batch, logs=None):
         if self.broadcast_done or self.model is None:
@@ -43,26 +41,17 @@ class BroadcastGlobalVariablesCallback:
             broadcast_variables(vars_, self.root_rank)
         self.broadcast_done = True
 
-    # no-op protocol methods so the object passes as a Keras callback
-    def __getattr__(self, item):
-        if item.startswith("on_") or item.startswith("set_"):
-            return lambda *a, **k: None
-        raise AttributeError(item)
+    def on_train_batch_end(self, batch, logs=None):
+        # Keras 3 dispatches the train-specific hook, not on_batch_end.
+        self.on_batch_end(batch, logs)
 
 
-class MetricAverageCallback:
+class MetricAverageCallback(_Callback):
     """Average epoch metrics over ranks at epoch end (reference
     ``_keras/callbacks.py:46-84``)."""
 
     def __init__(self, device=""):
-        self.model = None
-        self.params = {}
-
-    def set_model(self, model):
-        self.model = model
-
-    def set_params(self, params):
-        self.params = params
+        super().__init__()
 
     def on_epoch_end(self, epoch, logs=None):
         if logs is None:
@@ -83,13 +72,8 @@ class MetricAverageCallback:
                     )
                 )
 
-    def __getattr__(self, item):
-        if item.startswith("on_") or item.startswith("set_"):
-            return lambda *a, **k: None
-        raise AttributeError(item)
 
-
-class LearningRateScheduleCallback:
+class LearningRateScheduleCallback(_Callback):
     """Multiply the LR by ``multiplier`` within an epoch range (reference
     ``_keras/callbacks.py:86-133``); with ``staircase`` the multiplier is a
     function of epoch."""
@@ -97,6 +81,7 @@ class LearningRateScheduleCallback:
     def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
                  end_epoch: Optional[int] = None, staircase: bool = True,
                  momentum_correction: bool = True, steps_per_epoch=None):
+        super().__init__()
         self.initial_lr = initial_lr
         self.start_epoch = start_epoch
         self.end_epoch = end_epoch
@@ -104,19 +89,11 @@ class LearningRateScheduleCallback:
         self.momentum_correction = momentum_correction
         self.steps_per_epoch = steps_per_epoch
         self.current_epoch = 0
-        self.model = None
-        self.params = {}
         if callable(multiplier):
             self.multiplier = multiplier
         else:
             self.multiplier = lambda epoch: multiplier
         self._restore_momentum = None
-
-    def set_model(self, model):
-        self.model = model
-
-    def set_params(self, params):
-        self.params = params
 
     def _in_range(self, epoch) -> bool:
         return epoch >= self.start_epoch and (
@@ -162,10 +139,8 @@ class LearningRateScheduleCallback:
         frac_epoch = self.current_epoch + batch / self.steps_per_epoch
         self._set_lr(self.initial_lr * self.multiplier(frac_epoch))
 
-    def __getattr__(self, item):
-        if item.startswith("on_") or item.startswith("set_"):
-            return lambda *a, **k: None
-        raise AttributeError(item)
+    def on_train_batch_begin(self, batch, logs=None):
+        self.on_batch_begin(batch, logs)
 
 
 class LearningRateWarmupCallback(LearningRateScheduleCallback):

@@ -6,11 +6,16 @@ Average/Sum/Adasum semantics and IndexedSlices-via-allgather,
 ``DistributedGradientTape``, ``DistributedOptimizer`` (tf.compat.v1 +
 keras-optimizer styles), Compression.
 
-Eager-first: collectives run through the shared eager runtime (native
-control plane + XLA data plane) by converting EagerTensors to numpy at the
-boundary. Inside ``tf.function`` graphs the op is wrapped with
-``tf.py_function`` — correct, though the recommended high-throughput path
-on TPU is the JAX compiled mode.
+Data path (the role of the reference's graph-native HorovodAllreduceOp,
+``tensorflow/mpi_ops.cc:287-339``): EagerTensors hand their buffer to the
+XLA data plane **zero-copy via DLPack** — no ``.numpy()`` host copy — and
+ride the eager executor's device-resident fast path; results come back the
+same way. Inside ``tf.function`` graphs the op body runs under
+``tf.py_function`` (whose EagerTensors take the identical DLPack path), and
+every collective carries a registered gradient via ``tf.custom_gradient``
+(parity with the reference's RegisterGradient set,
+``tensorflow/mpi_ops.py:107-198``), so allreduce/allgather/broadcast are
+differentiable in both eager and graph mode.
 """
 
 from __future__ import annotations
@@ -41,20 +46,71 @@ from ..common.types import ReduceOp
 from .compression import Compression
 
 
-def _np_op(fn, tensor, *args, **kwargs):
-    """Run a numpy-level collective on a TF tensor, eagerly or inside a
-    graph via py_function."""
+def _to_jax(t):
+    """EagerTensor -> jax array, zero-copy via the DLPack protocol (falls
+    back to a numpy copy for dtypes/layouts DLPack rejects)."""
+    import jax
+
+    try:
+        return jax.dlpack.from_dlpack(t)
+    except Exception:
+        return t.numpy()
+
+
+def _from_jax(out):
+    """Collective result -> TF tensor; zero-copy for jax arrays (the
+    executor's device-resident path returns them)."""
+    import jax
+    import tensorflow as tf
+
+    if isinstance(out, jax.Array):
+        try:
+            return tf.experimental.dlpack.from_dlpack(out.__dlpack__())
+        except Exception:
+            pass
+    return tf.convert_to_tensor(np.asarray(out))
+
+
+def _np_op(fn, tensor, *args, keep_shape=True, **kwargs):
+    """Run an eager-runtime collective on a TF tensor, eagerly or inside a
+    graph via py_function. Either way the payload crosses frameworks via
+    DLPack, never a host copy (reference role: mpi_ops.cc:287-339 gets the
+    buffer out of TF without staging).
+
+    ``keep_shape``: py_function erases static shapes; allreduce/broadcast/
+    alltoall are shape-preserving (the reference graph ops declare this via
+    shape inference), so restore it — Keras optimizers require known
+    gradient shapes. allgather passes False (dim 0 grows)."""
     import tensorflow as tf
 
     def run(t):
-        out = fn(t.numpy(), *args, **kwargs)
-        return tf.convert_to_tensor(np.asarray(out))
+        out = _from_jax(fn(_to_jax(t), *args, **kwargs))
+        if out.dtype != t.dtype:
+            # jax (x64 disabled) narrows 64-bit ints/floats; restore the
+            # caller's dtype — TF optimizer counters are int64 scalars.
+            # Int payloads that do not survive the 32-bit round trip must
+            # fail loudly, not wrap silently; float64 loses precision by
+            # design (the data plane computes in float32).
+            if t.dtype.is_integer and not bool(
+                tf.reduce_all(tf.cast(tf.cast(t, out.dtype), t.dtype) == t)
+            ):
+                raise ValueError(
+                    f"{t.dtype.name} payload exceeds {out.dtype.name} "
+                    "range: the XLA data plane runs with x64 disabled"
+                )
+            out = tf.cast(out, t.dtype)
+        return out
 
     if tf.executing_eagerly() and not isinstance(tensor, tf.Tensor):
         tensor = tf.convert_to_tensor(tensor)
     if tf.executing_eagerly() and hasattr(tensor, "numpy"):
         return run(tensor)
-    return tf.py_function(run, [tensor], Tout=tensor.dtype)
+    out = tf.py_function(run, [tensor], Tout=tensor.dtype)
+    if keep_shape:
+        out.set_shape(tensor.shape)
+    elif tensor.shape.rank is not None:
+        out.set_shape([None] + list(tensor.shape)[1:])
+    return out
 
 
 def allreduce(tensor, average=None, device_dense="", device_sparse="",
@@ -82,23 +138,102 @@ def allreduce(tensor, average=None, device_dense="", device_sparse="",
                                 dense_shape=tensor.dense_shape)
 
     compressed, ctx = compression.compress(tensor)
-    out = _np_op(
-        _allreduce_np, compressed, op=rop, name=name,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-    )
+
+    @tf.custom_gradient
+    def _ar(x):
+        y = _np_op(
+            _allreduce_np, x, op=rop, name=name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+
+        def grad(dy):
+            # Adjoint of the WHOLE wrapped op (which, unlike the reference,
+            # includes the Average divisor and scale factors inside):
+            # y_j = post * (1/N?) sum_i (pre * x_i)  =>  dx = same op on dy.
+            # The reference reaches the same math by sum-allreducing the
+            # gradient and letting autodiff handle its separate /size op
+            # (mpi_ops.py:107-118). Adasum's adjoint is intractable; follow
+            # the reference in using a plain SUM for it.
+            grad_op = (ReduceOp.AVERAGE if rop == ReduceOp.AVERAGE
+                       else ReduceOp.SUM)
+            return _np_op(
+                _allreduce_np, dy, op=grad_op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                name=f"{name}.grad" if name else None,
+            )
+
+        return y, grad
+
+    out = _ar(compressed)
     return compression.decompress(out, ctx)
 
 
 def allgather(tensor, name=None):
-    return _np_op(_allgather_np, tensor, name)
+    import tensorflow as tf
+
+    @tf.custom_gradient
+    def _ag(x):
+        y = _np_op(_allgather_np, x, name, keep_shape=False)
+
+        def grad(dy):
+            # Reference gradient (mpi_ops.py:140-163): sum the upstream
+            # gradient across ranks, then take this rank's row range of the
+            # concatenation (ranks may contribute different dim-0 sizes).
+            dsum = _np_op(_allreduce_np, dy, op=ReduceOp.SUM,
+                          name=f"{name}.grad" if name else None)
+            d0 = tf.reshape(tf.cast(tf.shape(x)[0], tf.int32), [1])
+            sizes = tf.reshape(
+                _np_op(_allgather_np, d0,
+                       f"{name}.grad.sizes" if name else None,
+                       keep_shape=False),
+                [size()],
+            )
+            return tf.split(dsum, num_or_size_splits=sizes, axis=0)[rank()]
+
+        return y, grad
+
+    return _ag(tensor)
 
 
 def broadcast(tensor, root_rank, name=None):
-    return _np_op(_broadcast_np, tensor, root_rank, name)
+    import tensorflow as tf
+
+    @tf.custom_gradient
+    def _bc(x):
+        y = _np_op(_broadcast_np, x, root_rank, name)
+
+        def grad(dy):
+            # Reference gradient (mpi_ops.py:185-198): allreduce the
+            # upstream gradient; non-root ranks contribute zero input so
+            # their gradient is zeroed.
+            g = _np_op(_allreduce_np, dy, op=ReduceOp.SUM,
+                       name=f"{name}.grad" if name else None)
+            return g if rank() == root_rank else g * 0
+
+        return y, grad
+
+    return _bc(tensor)
 
 
 def alltoall(tensor, name=None):
-    return _np_op(_alltoall_np, tensor, name)
+    import tensorflow as tf
+
+    @tf.custom_gradient
+    def _a2a(x):
+        y = _np_op(_alltoall_np, x, name)
+
+        def grad(dy):
+            # alltoall with equal splits is an involution: routing the
+            # upstream gradient back through it returns each shard home
+            # (TPU-native extension; the reference has no alltoall).
+            return _np_op(_alltoall_np, dy,
+                          f"{name}.grad" if name else None)
+
+        return y, grad
+
+    return _a2a(tensor)
 
 
 def broadcast_variables(variables, root_rank: int = 0) -> None:

@@ -3,13 +3,15 @@
 API parity with ``horovod/torch/mpi_ops.py`` (allreduce[_async][_],
 allgather, broadcast, poll, synchronize, join) — the divisor logic for
 Average and the in-place variants follow the reference
-(``mpi_ops.py:95-254``). The data path converts CPU torch tensors to numpy
-(zero-copy), runs the shared eager runtime (native core + XLA/host data
-plane), and converts back.
+(``mpi_ops.py:95-254``). The data path hands torch tensors to the XLA data
+plane **zero-copy via DLPack** (the role of the reference's
+``mpi_lib_v2`` C extension getting at the tensor buffer,
+``torch/mpi_ops.cc``), which also routes them through the eager executor's
+device-resident fast path; results come back the same way. Tensors DLPack
+rejects fall back to the numpy bridge (zero-copy for contiguous CPU).
 
-bfloat16 note: numpy has no bf16; bf16 torch tensors ride the wire as their
-raw uint16 view is NOT valid for summation, so they are upcast to fp32 for
-the collective and cast back (the compiled JAX mode handles bf16 natively).
+bfloat16 rides DLPack natively (jax understands bf16); only the numpy
+fallback upcasts to fp32 (numpy has no bf16).
 """
 
 from __future__ import annotations
@@ -25,22 +27,47 @@ from ..common.types import Adasum, Average, ReduceOp, Sum  # noqa: F401
 _handle_meta: dict = {}
 
 
-def _to_numpy(tensor):
+def _to_plane(tensor):
+    """torch -> data plane, preferring a zero-copy DLPack handoff to a jax
+    array (activates the executor's device-resident path)."""
     import torch
 
     t = tensor.detach()
-    if t.dtype == torch.bfloat16:
-        t = t.float()
-    return t.cpu().numpy()
+    try:
+        import jax
+
+        return jax.dlpack.from_dlpack(t.contiguous())
+    except Exception:
+        if t.dtype == torch.bfloat16:
+            t = t.float()
+        return t.cpu().numpy()
 
 
-def _from_numpy(arr, like):
+# Back-compat alias (tests and older callers).
+_to_numpy = _to_plane
+
+
+def _from_plane(out, like):
+    """Data-plane result -> torch tensor; zero-copy for jax arrays."""
     import torch
 
-    out = torch.from_numpy(np.ascontiguousarray(arr))
-    if like is not None and out.dtype != like.dtype:
-        out = out.to(like.dtype)
-    return out
+    if not isinstance(out, np.ndarray):
+        try:
+            result = torch.from_dlpack(out)
+            if like is not None and result.dtype != like.dtype:
+                result = result.to(like.dtype)
+            return result
+        except Exception:
+            pass
+    out = np.ascontiguousarray(np.asarray(out))
+    result = torch.from_numpy(out)
+    if like is not None and result.dtype != like.dtype:
+        result = result.to(like.dtype)
+    return result
+
+
+def _from_numpy(arr, like):  # back-compat alias
+    return _from_plane(arr, like)
 
 
 def allreduce_async(tensor, average=None, name=None, op=None,
@@ -148,7 +175,7 @@ def poll(handle: int) -> bool:
 def synchronize(handle: int):
     out = _rt().synchronize(handle)
     inplace_target, like = _handle_meta.pop(handle, (None, None))
-    result = _from_numpy(np.asarray(out), like)
+    result = _from_plane(out, like)
     if inplace_target is not None:
         with _no_grad():
             inplace_target.copy_(result.reshape(inplace_target.shape))

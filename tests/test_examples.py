@@ -1,0 +1,84 @@
+"""BASELINE.json config-parity smoke tests: every example named in the
+baseline configs runs end-to-end under the launcher at -np 2 (the
+reference CI runs its examples under ``mpirun -np 2``)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.multiproc, pytest.mark.slow]
+
+
+def _run_example(script, args, np_=2, timeout=420):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    with tempfile.TemporaryDirectory() as td:
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+             "--output-dir", td, sys.executable,
+             os.path.join(REPO, "examples", script)] + args,
+            env=env, cwd=td, capture_output=True, timeout=timeout,
+            text=True,
+        )
+        outs = []
+        for r in range(np_):
+            p = os.path.join(td, f"rank.{r}.out")
+            outs.append(open(p).read() if os.path.exists(p) else "")
+        errs = []
+        for r in range(np_):
+            p = os.path.join(td, f"rank.{r}.err")
+            errs.append(open(p).read()[-1500:] if os.path.exists(p) else "")
+    return proc, outs, errs
+
+
+def test_keras_mnist():
+    proc, outs, errs = _run_example(
+        "keras_mnist.py",
+        ["--synthetic", "--epochs", "2", "--batch-size", "64",
+         "--steps-per-epoch", "3"],
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, errs)
+    assert any("Test accuracy:" in o for o in outs), (outs, errs)
+
+
+def test_tensorflow2_synthetic_benchmark():
+    proc, outs, errs = _run_example(
+        "tensorflow2_synthetic_benchmark.py",
+        ["--image-size", "64", "--batch-size", "4",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "2"],
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, errs)
+    joined = "\n".join(outs)
+    assert "Img/sec per worker:" in joined, (outs, errs)
+    assert "Total img/sec on 2 worker(s):" in joined, (outs, errs)
+
+
+def test_pytorch_imagenet_resnet50_synthetic():
+    proc, outs, errs = _run_example(
+        "pytorch_imagenet_resnet50.py",
+        ["--epochs", "1", "--synthetic-batches", "2", "--batch-size", "4",
+         "--image-size", "64", "--warmup-epochs", "1"],
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, errs)
+    assert any("val_acc" in o for o in outs), (outs, errs)
+
+
+def test_mxnet_example_gates_cleanly():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "mxnet_imagenet_resnet50.py")],
+        capture_output=True, timeout=60, text=True,
+    )
+    assert proc.returncode == 3
+    assert "MXNet is not available" in proc.stderr
