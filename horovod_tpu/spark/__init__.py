@@ -72,8 +72,26 @@ def run(
         import os
         import pickle as _p
 
-        client = KVStoreClient(driver_addr, port)
-        client.put("hosts", str(index), socket.gethostname().encode())
+        # The driver's resolved address may not be routable from every
+        # executor network namespace (and on a single-host test cluster
+        # hostname resolution itself can stall); the first successful PUT
+        # pins the working address, falling back to loopback for
+        # driver-local tasks.
+        client = None
+        last = None
+        for addr in (driver_addr, "127.0.0.1"):
+            cand = KVStoreClient(addr, port)
+            try:
+                cand.put("hosts", str(index),
+                         socket.gethostname().encode())
+                client = cand
+                break
+            except Exception as e:  # noqa: BLE001
+                last = e
+        if client is None:
+            raise RuntimeError(
+                f"cannot reach driver KV at {driver_addr}:{port}: {last}"
+            )
         slot_blob = client.wait("slots", str(index), timeout=120)
         slot_env = _p.loads(slot_blob)
         if _ERROR_KEY in slot_env:

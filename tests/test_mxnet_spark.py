@@ -12,7 +12,7 @@ import textwrap
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_sub(body: str, timeout=180):
+def _run_sub(body: str, timeout=300):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -168,7 +168,7 @@ def test_spark_run_with_mock_engine():
             procs = [ctx.Process(target=lambda i=i: list(self._f(iter([i]))))
                      for i in self.data]
             for p in procs: p.start()
-            for p in procs: p.join(120)
+            for p in procs: p.join(90)
             bad = [p.exitcode for p in procs if p.exitcode != 0]
             assert not bad, f"task exit codes: {bad}"
             return self.data
