@@ -250,6 +250,7 @@ class Core {
   int TicketStatus(uint64_t ticket, std::string* error);
 
   double cycle_time_ms() const { return params_.cycle_time_ms(); }
+  bool eager_wakeup() const { return eager_wakeup_; }
   int64_t fusion_threshold() const { return params_.fusion_threshold(); }
   int tuned_flags() const { return params_.Flags(); }
 
@@ -281,6 +282,9 @@ class Core {
   std::vector<Request> queued_;
   std::condition_variable wake_cv_;
   bool wake_ = false;
+  bool eager_wakeup_ = true;
+  double linger_s_ = 0.0;
+  double last_enqueue_ = 0.0;  // guarded by table_mu_
   bool joined_ = false;
   uint64_t join_ticket_ = 0;
 

@@ -6,6 +6,7 @@
 
 #include <algorithm>
 #include <cinttypes>
+#include <cstdlib>
 #include <cstdarg>
 #include <cstring>
 #include <sstream>
@@ -339,6 +340,16 @@ Status Core::Init(const CoreConfig& cfg) {
   params_.SetCategorical(cfg.hierarchical_allreduce != 0,
                          cfg.hierarchical_allgather != 0,
                          cfg.cache_capacity > 0, grid);
+  // Event-driven cycle wakeup (HOROVOD_TPU_EAGER_WAKEUP=0 restores the
+  // reference's pure fixed-cadence behavior); linger defaults to a
+  // quarter cycle, capped at 500us.
+  if (const char* e = std::getenv("HOROVOD_TPU_EAGER_WAKEUP")) {
+    eager_wakeup_ = std::string(e) != "0";
+  }
+  linger_s_ = std::min(cfg.cycle_time_ms / 1000.0 * 0.5, 2e-3);
+  if (const char* e = std::getenv("HOROVOD_TPU_LINGER_US")) {
+    linger_s_ = std::atof(e) * 1e-6;
+  }
   if (cfg.timeline_path[0]) timeline_.Initialize(cfg.timeline_path, cfg.rank);
   if (cfg.size > 1) {
     if (!cfg.coord_addr[0] || cfg.coord_port == 0) {
@@ -408,9 +419,15 @@ Status Core::Enqueue(const Request& req, uint64_t* ticket) {
   }
   table_[req.name] = Pending{req, t};
   queued_.push_back(req);
-  // No wake: coordination happens on the cycle cadence (reference
-  // RunLoopOnce sleeps cycle_time between rounds), which batches
-  // concurrent submissions into one negotiation round.
+  last_enqueue_ = NowSec();
+  // Event-driven wake (a TPU-build improvement over the reference, whose
+  // RunLoopOnce always sleeps cycle_time between rounds): the background
+  // loop wakes as soon as work exists, then lingers briefly so
+  // near-simultaneous submissions (a backward pass) still fuse into one
+  // negotiation round. SPMD ranks enqueue together, so all ranks wake
+  // together and the whole round completes at enqueue+linger instead of
+  // the next cycle boundary.
+  if (eager_wakeup_) wake_cv_.notify_one();
   *ticket = t;
   return Status::OK();
 }
@@ -433,6 +450,8 @@ Status Core::EnqueueJoin(uint64_t* ticket) {
   }
   join_ticket_ = t;
   queued_.push_back(req);
+  last_enqueue_ = NowSec();
+  if (eager_wakeup_) wake_cv_.notify_one();
   *ticket = t;
   return Status::OK();
 }
@@ -517,14 +536,36 @@ void Core::FailAll(const Status& s) {
 void Core::BackgroundLoop() {
   while (!shutdown_.load()) {
     double cycle_s = params_.cycle_time_ms() / 1000.0;
+    bool woke_early = false;
     {
       std::unique_lock<std::mutex> l(table_mu_);
-      wake_cv_.wait_for(
+      woke_early = wake_cv_.wait_for(
           l, std::chrono::duration<double>(cycle_s),
-          [&] { return wake_ || shutdown_.load(); });
+          [&] {
+            return wake_ || shutdown_.load() ||
+                   (eager_wakeup_ && !queued_.empty());
+          });
       wake_ = false;
     }
     if (shutdown_.load()) break;
+    if (woke_early && linger_s_ > 0) {
+      // Quiescence-based fusion window: wait until no new submission has
+      // arrived for linger_s_ (each arrival restarts the window), bounded
+      // by one cycle_time — a burst with gaps under the linger always
+      // fuses fully, which the fixed-cadence design only guaranteed when
+      // the burst happened to fit the remaining cycle phase.
+      double start = NowSec();
+      while (!shutdown_.load() && NowSec() - start < cycle_s) {
+        double since;
+        {
+          std::lock_guard<std::mutex> l(table_mu_);
+          since = NowSec() - last_enqueue_;
+        }
+        if (since >= linger_s_) break;
+        std::this_thread::sleep_for(
+            std::chrono::duration<double>(linger_s_ - since));
+      }
+    }
     RunCycleOnce();
   }
   // Propagate shutdown to peers once (send a shutdown RequestList).

@@ -58,6 +58,35 @@ def _pick_block(t: int, pref: int) -> int:
     return b
 
 
+def flashable(t_q: int, t_k: int, block_q: int = 128,
+              block_k: int = 128) -> bool:
+    """Whether the kernel accepts these sequence lengths (callers with
+    arbitrary shapes use this to fall back to dense attention instead of
+    crashing on prime/odd lengths)."""
+    try:
+        _pick_block(t_q, block_q)
+        _pick_block(t_k, block_k)
+        return True
+    except ValueError:
+        return False
+
+
+def _dense_full(q, k, v, causal, sm_scale):
+    """Dense [BH, T, D] attention — the graceful fallback for shapes the
+    kernel's block constraint rejects."""
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sm_scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bqk,bkd->bqd", p, v.astype(jnp.float32)
+    ).astype(q.dtype)
+
+
 def _fwd_kernel(delta_ref, q_ref, k_ref, v_ref,
                 o_ref, m_out_ref, l_out_ref,
                 acc_ref, m_ref, l_ref, *,
@@ -287,13 +316,19 @@ def flash_attention_bthd(
 ) -> jax.Array:
     """Layout adapter for the transformer's ``[B, T, H, D]`` attention
     signature (``models/transformer.py``): fold heads into the kernel's
-    batch axis, run the fused kernel, unfold."""
+    batch axis, run the fused kernel, unfold. Sequence lengths the kernel's
+    block constraint rejects (prime/odd T) take a dense fallback instead of
+    raising, so the default attention accepts any shape."""
     B, T, H, D = q.shape
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
-    out = flash_attention(
-        fold(q), fold(k), fold(v), causal=causal, sm_scale=sm_scale,
-        interpret=interpret,
-    )
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    if flashable(T, k.shape[1]):
+        out = flash_attention(
+            qf, kf, vf, causal=causal, sm_scale=scale, interpret=interpret,
+        )
+    else:
+        out = _dense_full(qf, kf, vf, causal, scale)
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
 

@@ -167,10 +167,12 @@ def ulysses_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: bool = True,
 ) -> jax.Array:
     """Ulysses all-to-all sequence parallelism (call inside shard_map):
-    re-shard [B, T/n, H, D] -> [B, T, H/n, D], dense local attention, then
-    re-shard back. Requires heads % axis_size == 0."""
+    re-shard [B, T/n, H, D] -> [B, T, H/n, D], local attention over the
+    full sequence (the Pallas flash kernel by default), then re-shard
+    back. Requires heads % axis_size == 0."""
     n = lax.axis_size(axis_name)
     B, T, H, D = q.shape
     if H % n != 0:
@@ -188,8 +190,15 @@ def ulysses_attention(
         )
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    Tg = qg.shape[1]
     scale_v = scale if scale is not None else 1.0 / math.sqrt(D)
+    if use_flash:
+        from ..ops.pallas_attention import flash_attention_bthd
+
+        out = flash_attention_bthd(
+            qg, kg, vg, causal=causal, sm_scale=scale_v
+        )
+        return heads_to_seq(out)
+    Tg = qg.shape[1]
     compute = jnp.float32
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", qg.astype(compute), kg.astype(compute)

@@ -138,3 +138,18 @@ def test_block_grad_flows():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4
         )
+
+
+def test_odd_length_falls_back_to_dense():
+    """Prime sequence lengths can't satisfy the kernel's block constraint;
+    the [B,T,H,D] adapter (transformer default / Ulysses local attention)
+    must fall back to dense instead of raising."""
+    rng = np.random.RandomState(5)
+    B, T, H, D = 1, 131, 2, 8  # 131 is prime
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.5)
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention_bthd(q, k, v, causal=True)
+    expected = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-5
+    )

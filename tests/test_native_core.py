@@ -3,6 +3,7 @@ through the C ABI: plan emission, fusion grouping, ticket lifecycle,
 duplicate rejection, autotune movement.
 """
 
+import os
 import time
 
 import pytest
@@ -232,3 +233,41 @@ def test_autotune_categorical_flags_in_plans_and_convergence():
             c.plan_done(p["id"], 0, "", 0.001, 1024)
     finally:
         c.shutdown()
+
+
+def test_eager_wakeup_beats_cycle_cadence():
+    """Event-driven wakeup (TPU-build improvement over the reference's
+    fixed RunLoopOnce cadence): with a deliberately huge cycle time, an
+    enqueued tensor must still produce a plan almost immediately when
+    wakeup is on, and only at the cycle boundary when forced off."""
+    hvd.shutdown()
+
+    def time_to_plan(env):
+        for k, v in env.items():
+            os.environ[k] = v
+        try:
+            c = NativeCore()
+            cfg = Config()
+            cfg.cycle_time_ms = 1000.0
+            c.init(cfg, SINGLE)
+            try:
+                t0 = time.monotonic()
+                c.enqueue(0, "wake", 7, [4], -1, 2, 1.0, 1.0)
+                deadline = time.monotonic() + 3
+                p = None
+                while time.monotonic() < deadline and not isinstance(p, dict):
+                    p = c.next_plan(timeout_ms=50)
+                assert isinstance(p, dict)
+                dt = time.monotonic() - t0
+                c.plan_done(p["id"], 0, "", 0.001, 16)
+                return dt
+            finally:
+                c.shutdown()
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+
+    fast = time_to_plan({})  # wakeup defaults on
+    slow = time_to_plan({"HOROVOD_TPU_EAGER_WAKEUP": "0"})
+    assert fast < 0.5, f"eager wakeup did not fire: {fast:.3f}s"
+    assert slow > 0.5, f"cadence path returned too early: {slow:.3f}s"
