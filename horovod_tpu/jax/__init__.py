@@ -42,19 +42,27 @@ from ..parallel.mesh import (
     build_mesh,
 )
 
-def _shard_map(fn, mesh, *, in_specs, out_specs):
+def _shard_map(fn, mesh, *, in_specs, out_specs, check: bool = False):
     """shard_map with version compatibility (check_vma in jax>=0.7,
-    check_rep before; module moved from jax.experimental to jax core)."""
+    check_rep before; module moved from jax.experimental to jax core).
+
+    ``check=True`` enables replication/varying-ness tracking — REQUIRED
+    when differentiating through an in-body ``psum`` (e.g. the tensor-
+    parallel row-parallel matmul): without it the psum transpose cannot
+    see that the cotangent is replicated and multiplies gradients by the
+    axis size. The default stays off for the collective-executor bodies,
+    whose hand-written patterns predate the vma checker.
+    """
     try:
         from jax import shard_map as _sm
     except ImportError:  # pragma: no cover - older jax
         from jax.experimental.shard_map import shard_map as _sm
     try:
         return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_vma=False)
+                   check_vma=check)
     except TypeError:  # pragma: no cover - older jax
         return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_rep=False)
+                   check_rep=check)
 
 
 # In-jit primitives (usable inside shard_map/pmap bodies).

@@ -1,0 +1,43 @@
+"""Shared plumbing for shard-stacked parameter layouts (TP's ``[n_model,
+...]`` and PP's ``[n_stages, ...]`` leading dims placed over a mesh axis).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax import lax
+
+
+def init_stacked_state(optimizer, params_stacked):
+    """Optimizer state for [n, ...]-stacked params: one state per shard
+    (vmapped init), so it places alongside the params' axis spec."""
+    return jax.vmap(optimizer.init)(params_stacked)
+
+
+def stacked_train_update(optimizer, params, opt_state, value_and_grad_fn,
+                         data_axis: str):
+    """One update on stacked shards, inside a vma-checked shard_map:
+    strip the leading shard dim, differentiate, normalize the data-axis
+    gradient sum, apply, restack.
+
+    Under vma-checked shard_map the transpose ALREADY psums cotangents
+    over every axis the parameter is invariant on (the data axis here) —
+    an explicit pmean would double-count; dividing by the axis size turns
+    that sum into the data-average.
+    """
+    import optax
+
+    p_local = jax.tree.map(lambda t: t[0], params)
+    s_local = jax.tree.map(lambda t: t[0], opt_state)
+    loss, grads = value_and_grad_fn(p_local)
+    nd = lax.axis_size(data_axis)
+    grads = jax.tree.map(lambda g: g / nd, grads)
+    updates, s_local = optimizer.update(grads, s_local, p_local)
+    p_local = optax.apply_updates(p_local, updates)
+    return (
+        jax.tree.map(lambda t: t[None], p_local),
+        jax.tree.map(lambda t: t[None], s_local),
+        loss,
+    )

@@ -1,0 +1,148 @@
+"""Pipeline (stage) parallelism: GPipe-style microbatch pipelining over a
+``stage`` mesh axis.
+
+TPU-native extension beyond the reference framework (which is
+model-agnostic DP only, SURVEY.md §2.3): each device owns one pipeline
+stage's parameters; microbatches flow stage -> stage over ``lax.ppermute``
+inside a ``lax.scan`` of n_micro + n_stages - 1 ticks (fill + steady +
+drain). Because the whole schedule is traced functional code, jax autodiff
+derives the backward pipeline (cotangents flow through the ppermute
+transpose in the reverse direction) — no hand-written 1F1B schedule is
+needed for correctness, and XLA overlaps each tick's compute with the
+next's ICI transfer.
+
+Layout: stage parameters enter with a leading [n_stages, ...] dim placed
+``P(stage)``; every stage must map activations of one shape to the same
+shape (the classic homogeneous-pipeline constraint; embed/head layers
+belong on stages 0 / n-1 inside ``stage_fn``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+STAGE_AXIS = "stage"
+
+
+def _pvary(x, axis_name):
+    """Mark a replicated value as device-varying over ``axis_name`` (vma
+    bookkeeping only — the values are unchanged). Needed so the pipeline
+    scan's carry has a consistent varying type across iterations."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except AttributeError:  # pragma: no cover - pre-pcast jax
+        return lax.pvary(x, (axis_name,))
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    x_micro: jax.Array,
+    *,
+    axis_name: str = STAGE_AXIS,
+) -> jax.Array:
+    """Run microbatches through the pipeline; call inside shard_map.
+
+    ``stage_fn(params, x, stage_index)`` maps [mb, ...] -> [mb, ...] with
+    this device's stage params; ``x_micro``: [n_micro, mb, ...] (the full
+    input, present on every stage — stage 0 ingests it). Returns the last
+    stage's outputs [n_micro, mb, ...] (zeros elsewhere; the caller
+    typically psums or masks by stage).
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    x_micro = _pvary(x_micro, axis_name)
+    n_micro = x_micro.shape[0]
+    ticks = n_micro + n_stages - 1
+    state0 = jnp.zeros_like(x_micro[0])
+    outs0 = jnp.zeros_like(x_micro)
+    # Send each stage's output one hop down the line; stage n-1's output
+    # is dropped by the permutation (it exits via `outs`).
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outs = carry
+        # Stage 0 ingests microbatch t (clamped: beyond n_micro it runs
+        # garbage that never reaches an output slot).
+        feed = x_micro[jnp.minimum(t, n_micro - 1)]
+        x_in = jnp.where(s == 0, feed, state)
+        y = stage_fn(stage_params, x_in, s)
+        # Last stage emits microbatch t-(n_stages-1) at ticks >= n-1.
+        out_idx = t - (n_stages - 1)
+        is_emit = jnp.logical_and(s == n_stages - 1, out_idx >= 0)
+        outs = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(is_emit, y, lax.dynamic_index_in_dim(
+                outs, jnp.maximum(out_idx, 0), 0, keepdims=False)),
+            jnp.maximum(out_idx, 0), 0,
+        )
+        state_next = lax.ppermute(y, axis_name, perm)
+        return (state_next, outs), None
+
+    (state, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
+    return outs
+
+
+def make_pp_train_step(
+    loss_fn: Callable,
+    stage_fn: Callable,
+    optimizer,
+    mesh: Mesh,
+    *,
+    stage_axis: str = STAGE_AXIS,
+    data_axis: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build a jitted DP×PP train step.
+
+    ``stage_fn(params, x, stage_index)``: one stage's forward.
+    ``loss_fn(y_micro, labels_micro) -> scalar``: loss on the pipeline
+    output (runs on the last stage's values; every stage computes it on
+    the psum-broadcast outputs so the graph stays SPMD).
+
+    Params enter stacked [n_stages, ...] placed P(stage). Batches are
+    PRE-SHAPED [n_micro, mb, ...]: dim 0 is the microbatch index
+    (unsharded), dim 1 the per-microbatch batch, sharded over ``data`` and
+    replicated across stages (in_specs P(None, data)).
+    """
+    from ..jax import _shard_map
+    from ._stacked import stacked_train_update
+
+    def step(params, opt_state, x_micro, y_micro):
+        def local_loss(p):
+            outs = pipeline_apply(
+                stage_fn, p, x_micro, axis_name=stage_axis
+            )
+            # Outputs live on the last stage; share them so the loss (and
+            # its gradient wiring) is SPMD-identical on every stage.
+            n_stages = lax.axis_size(stage_axis)
+            mask = (lax.axis_index(stage_axis) == n_stages - 1).astype(
+                outs.dtype
+            )
+            outs = lax.psum(outs * mask, stage_axis)
+            return loss_fn(outs, y_micro)
+
+        params, opt_state, loss = stacked_train_update(
+            optimizer, params, opt_state,
+            jax.value_and_grad(local_loss), data_axis,
+        )
+        loss = lax.pmean(loss, data_axis)
+        return params, opt_state, loss
+
+    fn = _shard_map(
+        step, mesh, check=True,
+        in_specs=(P(stage_axis), P(stage_axis), P(None, data_axis),
+                  P(None, data_axis)),
+        out_specs=(P(stage_axis), P(stage_axis), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+
+from ._stacked import init_stacked_state as init_pp_state  # noqa: E402

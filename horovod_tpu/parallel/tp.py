@@ -1,0 +1,150 @@
+"""Tensor (model) parallelism: Megatron-style column/row-parallel layers.
+
+TPU-native extension beyond the reference framework (which is
+model-agnostic DP only, SURVEY.md §2.3): weight matrices shard over a
+``model`` mesh axis and activations stay sharded between the column- and
+row-parallel halves of each block, so the only collective per MLP/attention
+block is ONE psum on the row-parallel output — the classic Megatron
+schedule, expressed with ``shard_map`` + ``lax.psum`` so XLA lays the
+reduction onto ICI.
+
+Layout (per device, axis size n):
+  - column-parallel: W1 [D, F/n]; y = x @ W1 — output feature-sharded,
+    no communication (the gelu runs sharded too);
+  - row-parallel: W2 [F/n, D]; z = psum(y @ W2) — one allreduce brings the
+    block output back replicated.
+
+The same pair implements attention head sharding (QKV projection is
+column-parallel over heads, the output projection row-parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+MODEL_AXIS = "model"
+
+
+def column_parallel(x: jax.Array, w_shard: jax.Array,
+                    b_shard=None) -> jax.Array:
+    """y = x @ W[:, shard] (+ b[shard]): output is feature-sharded; no
+    communication. Call inside shard_map."""
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel(x_shard: jax.Array, w_shard: jax.Array, b_shard=None, *,
+                 axis_name: str = MODEL_AXIS) -> jax.Array:
+    """z = psum_i(x_i @ W[shard_i, :] + scatter_i(b_i)): the one collective
+    of the Megatron block.
+
+    The bias is genuinely SHARDED ([D/n] per rank, scattered to its offset
+    inside the reduction) rather than replicated: a replicated-but-stacked
+    bias would be typed device-varying by shard_map's replication checker,
+    which flips the psum transpose from pbroadcast back to a sum and
+    scales every upstream gradient by the axis size."""
+    y = x_shard @ w_shard
+    if b_shard is not None:
+        n = lax.axis_size(axis_name)
+        f = b_shard.shape[-1]
+        if f * n != w_shard.shape[-1]:
+            # A full-size bias would silently be added n times (the
+            # scatter offset clamps); fail at trace time instead.
+            raise ValueError(
+                f"row_parallel bias must be the [D/n] shard: got {f} "
+                f"features for D={w_shard.shape[-1]} over n={n} shards"
+            )
+        i = lax.axis_index(axis_name)
+        full = jnp.zeros((w_shard.shape[-1],), b_shard.dtype)
+        full = lax.dynamic_update_slice(full, b_shard, (i * f,))
+        y = y + full
+    return lax.psum(y, axis_name)
+
+
+def tp_mlp(params: dict, x: jax.Array, *,
+           axis_name: str = MODEL_AXIS,
+           activation: Callable = jax.nn.gelu) -> jax.Array:
+    """One Megatron MLP block on sharded weights:
+    ``params = {"w1": [D, F/n], "b1": [F/n], "w2": [F/n, D], "b2": [D/n]}``
+    (every parameter is a true shard — see :func:`row_parallel` on why the
+    output bias shards too).
+    """
+    h = activation(column_parallel(x, params["w1"], params.get("b1")))
+    return row_parallel(h, params["w2"], params.get("b2"),
+                        axis_name=axis_name)
+
+
+def shard_mlp_params(rng, d_model: int, d_hidden: int, n_shards: int,
+                     dtype=jnp.float32) -> dict:
+    """Initialize full MLP weights and return them with a leading shard
+    dim [n, ...] for placement via P(model) — rank i trains shard i."""
+    k1, k2 = jax.random.split(rng)
+    w1 = jax.random.normal(k1, (d_model, d_hidden), dtype) * (
+        d_model ** -0.5
+    )
+    w2 = jax.random.normal(k2, (d_hidden, d_model), dtype) * (
+        d_hidden ** -0.5
+    )
+    if d_hidden % n_shards or d_model % n_shards:
+        raise ValueError(
+            f"d_hidden ({d_hidden}) and d_model ({d_model}) must divide "
+            f"by n_shards ({n_shards})"
+        )
+    f = d_hidden // n_shards
+    return {
+        "w1": jnp.stack([w1[:, i * f:(i + 1) * f] for i in range(n_shards)]),
+        "b1": jnp.zeros((n_shards, f), dtype),
+        "w2": jnp.stack([w2[i * f:(i + 1) * f, :] for i in range(n_shards)]),
+        "b2": jnp.zeros((n_shards, d_model // n_shards), dtype),
+    }
+
+
+from ._stacked import init_stacked_state as init_tp_state  # noqa: E402
+
+
+def make_tp_train_step(
+    loss_fn: Callable,
+    optimizer,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+    donate: bool = True,
+):
+    """Build a jitted DP×TP train step.
+
+    ``loss_fn(params_shard, batch_shard) -> scalar`` runs on the local
+    (batch/nd, weight-shard) pair, calling :func:`tp_mlp`-style layers
+    bound to ``model_axis``. Params enter with a leading shard dim
+    [n_model, ...] placed P(model); batches [B, ...] placed P(data).
+
+    Gradient reduction: sharded weights reduce over ``data`` only (each
+    model rank owns its shard); the loss/replicated stats reduce over both
+    axes.
+    """
+    from ..jax import _shard_map
+    from ._stacked import stacked_train_update
+
+    def step(params, opt_state, batch):
+        params, opt_state, loss = stacked_train_update(
+            optimizer, params, opt_state,
+            jax.value_and_grad(lambda p: loss_fn(p, batch)), data_axis,
+        )
+        loss = lax.pmean(lax.pmean(loss, data_axis), model_axis)
+        return params, opt_state, loss
+
+    fn = _shard_map(
+        step, mesh, check=True,
+        in_specs=(P(model_axis), P(model_axis), P(data_axis)),
+        out_specs=(P(model_axis), P(model_axis), P()),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
