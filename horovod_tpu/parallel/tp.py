@@ -83,6 +83,74 @@ def tp_mlp(params: dict, x: jax.Array, *,
                         axis_name=axis_name)
 
 
+def tp_attention(params: dict, x: jax.Array, *, head_dim: int,
+                 axis_name: str = MODEL_AXIS,
+                 causal: bool = True) -> jax.Array:
+    """Megatron head-sharded self-attention: the QKV projection is
+    column-parallel over heads (each rank holds H/n heads), attention runs
+    on the local heads through the Pallas flash kernel, and the output
+    projection is row-parallel — again exactly ONE psum per block.
+
+    ``params = {"wqkv": [D, 3*(H/n)*Dh], "wo": [(H/n)*Dh, D],
+    "bo": [D/n]}``; ``head_dim`` is static (shapes derive from it).
+    """
+    from ..ops.pallas_attention import flash_attention_bthd
+
+    B, T, D = x.shape
+    qkv = column_parallel(x, params["wqkv"])          # [B, T, 3*Hl*Dh]
+    if qkv.shape[-1] % (3 * head_dim):
+        raise ValueError(
+            f"qkv width {qkv.shape[-1]} is not divisible by 3*head_dim "
+            f"({3 * head_dim}); head_dim does not match the sharded weights"
+        )
+    hl = qkv.shape[-1] // (3 * head_dim)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, hl, head_dim)
+    k = k.reshape(B, T, hl, head_dim)
+    v = v.reshape(B, T, hl, head_dim)
+    a = flash_attention_bthd(q, k, v, causal=causal)
+    a = a.reshape(B, T, hl * head_dim)
+    return row_parallel(a, params["wo"], params.get("bo"),
+                        axis_name=axis_name)
+
+
+def shard_attention_params(rng, d_model: int, n_heads: int, n_shards: int,
+                           dtype=jnp.float32) -> dict:
+    """Initialize full attention weights and return head-sharded stacks
+    [n, ...] for placement via P(model)."""
+    if n_heads % n_shards or d_model % n_heads or d_model % n_shards:
+        raise ValueError(
+            f"n_heads ({n_heads}) and d_model ({d_model}) must divide by "
+            f"n_shards ({n_shards}); d_model by n_heads"
+        )
+    head_dim = d_model // n_heads
+    hl = n_heads // n_shards
+    k1, k2 = jax.random.split(rng)
+    wqkv = jax.random.normal(k1, (d_model, 3 * d_model), dtype) * (
+        d_model ** -0.5
+    )
+    wo = jax.random.normal(k2, (d_model, d_model), dtype) * (
+        d_model ** -0.5
+    )
+    # Per-shard QKV columns: for each of q/k/v, take that shard's heads.
+    wq, wk, wv = jnp.split(wqkv, 3, axis=1)
+    f = hl * head_dim
+
+    def col(w, i):
+        return w[:, i * f:(i + 1) * f]
+
+    return {
+        "wqkv": jnp.stack([
+            jnp.concatenate([col(wq, i), col(wk, i), col(wv, i)], axis=1)
+            for i in range(n_shards)
+        ]),
+        "wo": jnp.stack([
+            wo[i * f:(i + 1) * f, :] for i in range(n_shards)
+        ]),
+        "bo": jnp.zeros((n_shards, d_model // n_shards), dtype),
+    }
+
+
 def shard_mlp_params(rng, d_model: int, d_hidden: int, n_shards: int,
                      dtype=jnp.float32) -> dict:
     """Initialize full MLP weights and return them with a leading shard
