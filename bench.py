@@ -655,6 +655,33 @@ def _fail_json(args, error: str, **detail) -> None:
     )
 
 
+def _cpu_fallback_smoke(args, timeout: float):
+    """Run one --smoke --platform cpu worker and return its parsed JSON
+    (or an error dict); called when the accelerator is unreachable."""
+    if timeout < 60:
+        return {"error": "no budget left for CPU fallback"}
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--_worker", "--smoke",
+        "--platform", "cpu", "--model",
+        args.model if args.model == "transformer" else "resnet18",
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            timeout=timeout, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"CPU fallback hung past {timeout:.0f}s"}
+    for line in proc.stdout.splitlines():
+        if line.strip().startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                pass
+    return {"error": f"CPU fallback rc={proc.returncode}",
+            "stderr_tail": (proc.stderr or "")[-300:]}
+
+
 def supervise(args) -> int:
     """Run the benchmark in child processes with timeout + backoff retries.
 
@@ -671,18 +698,29 @@ def supervise(args) -> int:
     cmd += [a for a in sys.argv[1:] if a != "--_worker"]
     probe_backoff = 15.0
     probe_attempts = 0
+    # Reserve tail budget for a CPU-smoke evidence run when the
+    # accelerator never comes up (platform=auto only: a forced platform
+    # either works or is a config error). Probing continues with backoff
+    # until only the reserve is left, so transient outages still recover.
+    reserve = 540 if args.platform == "auto" else 120
     while True:
         budget = deadline - time.time()
-        if budget <= 120:
+        if budget <= reserve:
             print("[bench] backend never became reachable within the "
                   "deadline; giving up", file=sys.stderr)
+            fallback = None
+            if args.platform == "auto":
+                fallback = _cpu_fallback_smoke(args, budget - 120)
+                print("[bench] attaching CPU-smoke fallback evidence",
+                      file=sys.stderr)
             _fail_json(
                 args, "backend unreachable: every probe hung or failed",
                 probe_attempts=probe_attempts, deadline_s=args.deadline,
+                **({"cpu_fallback": fallback} if fallback else {}),
             )
             return 1
         probe_attempts += 1
-        if _probe_backend(timeout=min(180, budget - 60),
+        if _probe_backend(timeout=min(180, budget - reserve + 60),
                           platform=args.platform,
                           cpu_devices=args.cpu_devices):
             break

@@ -345,7 +345,13 @@ class XlaPlanExecutor(PlanExecutor):
 
         garr = self._global_array(buf, hierarchical=hier)
         out = self._compiled(key, build)(garr)
-        return self._unpack(self._local_out(out), entries, shapes)
+        res = self._local_out(out)
+        # jax (x64 disabled) narrows 64-bit wires; restore the caller's
+        # dtype (compute happened in 32-bit — values beyond its range
+        # wrap, the same contract the framework bindings document).
+        if res.dtype != buf.dtype:
+            res = res.astype(buf.dtype)
+        return self._unpack(res, entries, shapes)
 
     def _allreduce_device(self, entries, *, op, adasum, hier, pre, post,
                           participants) -> Dict[str, Any]:
@@ -450,6 +456,8 @@ class XlaPlanExecutor(PlanExecutor):
             garr = self._global_array(send, hierarchical=hier)
             out = self._compiled(key, build)(garr)
             gathered = self._local_out(out)
+            if gathered.dtype != send.dtype:
+                gathered = gathered.astype(send.dtype)
             if uneven:
                 gathered = np.concatenate([
                     gathered[i * max_dim0: i * max_dim0 + rank_sizes[i]]
@@ -482,7 +490,10 @@ class XlaPlanExecutor(PlanExecutor):
 
             garr = self._global_array(local)
             out = self._compiled(key, build)(garr)
-            outputs[e.name] = self._local_out(out)
+            res = self._local_out(out)
+            outputs[e.name] = (
+                res if res.dtype == local.dtype else res.astype(local.dtype)
+            )
         return outputs
 
     def _alltoall(self, plan, entries) -> Dict[str, Any]:
@@ -516,5 +527,8 @@ class XlaPlanExecutor(PlanExecutor):
 
             garr = self._global_array(local)
             out = self._compiled(key, build)(garr)
-            outputs[e.name] = self._local_out(out)
+            res = self._local_out(out)
+            outputs[e.name] = (
+                res if res.dtype == local.dtype else res.astype(local.dtype)
+            )
         return outputs

@@ -644,3 +644,45 @@ def test_topology_metadata_drives_hierarchical_mesh_four_ranks():
     )
     for out in outs:
         assert "HIER [10.0, 10.0]" in out, outs
+
+
+def test_allreduce_dtype_sweep_two_ranks():
+    """Op-correctness across the dtype table (reference test strategy:
+    every collective x dtype, test_tensorflow.py:123-380). Exercises the
+    XLA executor's pack/collective/unpack for each wire dtype at a real
+    communicator size, including the device-resident jax path for bf16."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import jax.numpy as jnp
+        import horovod_tpu as hvd
+        hvd.init()
+        r = hvd.rank()
+        checks = []
+        for name in ("uint8", "int16", "int32", "int64", "float16",
+                     "float32", "float64"):
+            x = np.full((5,), r + 1, dtype=name)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, name=f"dt.{name}"))
+            # dtype must survive the wire (64-bit computes in 32-bit but
+            # the executor restores the caller's dtype).
+            checks.append((name, bool((out == 3).all())
+                           and out.dtype == np.dtype(name)))
+        xb = jnp.full((5,), float(r + 1), jnp.bfloat16)
+        ob = hvd.allreduce(xb, op=hvd.Sum, name="dt.bf16")
+        checks.append(("bfloat16", bool(
+            np.allclose(np.asarray(ob, np.float32), 3.0))))
+        bad = [n for n, ok in checks if not ok]
+        print("DTYPES_OK" if not bad else f"DTYPES_BAD {bad}")
+        # MIN/MAX on ints (reference covers non-sum ops too)
+        mn = np.asarray(hvd.allreduce(
+            np.full((3,), r + 1, np.int32), op=hvd.Min, name="dt.min"))
+        mx = np.asarray(hvd.allreduce(
+            np.full((3,), r + 1, np.int32), op=hvd.Max, name="dt.max"))
+        print("MINMAX", int(mn[0]), int(mx[0]))
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "DTYPES_OK" in out, outs
+        assert "MINMAX 1 2" in out, outs
