@@ -1,0 +1,27 @@
+"""MXNet MNIST — CLI-parity stub for the reference
+``examples/mxnet_mnist.py`` (MXNet is not part of this image; see
+``examples/mxnet_imagenet_resnet50.py`` for the gating rationale)."""
+
+import argparse
+import sys
+
+parser = argparse.ArgumentParser(
+    description="MXNet MNIST Example",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+)
+parser.add_argument("--batch-size", type=int, default=64)
+parser.add_argument("--dtype", type=str, default="float32")
+parser.add_argument("--epochs", type=int, default=5)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--momentum", type=float, default=0.9)
+args = parser.parse_args()
+
+try:
+    import mxnet  # noqa: F401
+except ImportError:
+    print(
+        "MXNet is not available in this build; use examples/jax_mnist.py, "
+        "examples/pytorch_mnist.py or examples/keras_mnist.py instead.",
+        file=sys.stderr,
+    )
+    raise SystemExit(3)

@@ -394,6 +394,11 @@ class XlaPlanExecutor(PlanExecutor):
         outs = self._compiled(key, build)(*garrs)
         if len(entries) == 1:
             outs = (outs,)
+        if self._knob("autotune"):
+            # Async dispatch is the TPU-native default (consumers block
+            # naturally), but the autotuner scores plans by wall time at
+            # plan_done — only block when those scores matter.
+            self._jax.block_until_ready(outs)
         return {
             e.name: self._local_view(o) for e, o in zip(entries, outs)
         }

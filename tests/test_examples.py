@@ -82,3 +82,14 @@ def test_mxnet_example_gates_cleanly():
     )
     assert proc.returncode == 3
     assert "MXNet is not available" in proc.stderr
+
+
+def test_keras_imagenet_resnet50_synthetic():
+    proc, outs, errs = _run_example(
+        "keras_imagenet_resnet50.py",
+        ["--epochs", "1", "--synthetic-batches", "2", "--batch-size", "4",
+         "--image-size", "64", "--warmup-epochs", "1"],
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, errs)
+    assert any("TRAINING DONE" in o for o in outs), (outs, errs)
