@@ -6,10 +6,12 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -135,6 +137,18 @@ class TcpTransport : public ControlTransport {
   ~TcpTransport() override { Close(); }
 
  private:
+  // HOROVOD_START_TIMEOUT (reference --start-timeout) bounds both sides
+  // of rendezvous: worker connect retries and rank 0's accept loop.
+  static long StartTimeoutSec() {
+    long timeout_s = 60;
+    if (const char* e = std::getenv("HOROVOD_START_TIMEOUT")) {
+      long v = std::atol(e);
+      if (v > 0) timeout_s = v;
+    }
+    if (timeout_s > 86400) timeout_s = 86400;  // clamp: avoid overflow
+    return timeout_s;
+  }
+
   Status InitServer(const CoreConfig& cfg) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) return Errno("socket");
@@ -150,7 +164,24 @@ class TcpTransport : public ControlTransport {
     }
     if (::listen(listen_fd_, size_) < 0) return Errno("listen");
     fds_.assign(size_, -1);
+    double deadline = NowSec() + static_cast<double>(StartTimeoutSec());
     for (int i = 1; i < size_; ++i) {
+      // Bounded accept: a worker that never launches must abort the job
+      // at the start timeout, not hang rank 0 forever.
+      for (;;) {
+        double left = deadline - NowSec();
+        if (left <= 0) {
+          return Status::Error(
+              StatusCode::kUnknownError,
+              "rendezvous timed out waiting for worker registrations "
+              "(HOROVOD_START_TIMEOUT)");
+        }
+        pollfd pfd{listen_fd_, POLLIN, 0};
+        int pr = ::poll(&pfd, 1, static_cast<int>(
+            left * 1000 > 1000 ? 1000 : left * 1000));
+        if (pr < 0) return Errno("poll");
+        if (pr > 0 && (pfd.revents & POLLIN)) break;
+      }
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) return Errno("accept");
       int one2 = 1;
@@ -179,8 +210,11 @@ class TcpTransport : public ControlTransport {
                                cfg.coord_addr);
     }
     Status last = Status::OK();
-    // Retry for up to ~60 s: rank 0 may still be starting.
-    for (int attempt = 0; attempt < 600; ++attempt) {
+    // Retry while rank 0 may still be starting; HOROVOD_START_TIMEOUT
+    // (reference --start-timeout, default 30s there, 60s here for slow
+    // container spin-up) bounds the wait.
+    long timeout_s = StartTimeoutSec();
+    for (long attempt = 0; attempt < timeout_s * 10; ++attempt) {
       fd0_ = ::socket(AF_INET, SOCK_STREAM, 0);
       if (fd0_ < 0) {
         last = Errno("socket");

@@ -28,6 +28,7 @@ ARG_TO_ENV = {
     "stall_check_time_seconds": "HOROVOD_STALL_CHECK_TIME_SECONDS",
     "stall_shutdown_time_seconds": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
     "log_level": "HOROVOD_LOG_LEVEL",
+    "start_timeout": "HOROVOD_START_TIMEOUT",
     "mesh_axes": "HOROVOD_TPU_MESH_AXES",
 }
 
@@ -72,7 +73,10 @@ def parse_config_file(path: str, args, overridden: set) -> None:
 def set_env_from_args(env: Dict[str, str], args) -> Dict[str, str]:
     for attr, env_name in ARG_TO_ENV.items():
         value = getattr(args, attr, None)
-        if value in (None, False, ""):
+        # Precise unset test: numeric 0 is a VALID setting (e.g.
+        # --cache-capacity 0 disables the cache); `in (None, False, "")`
+        # would silently drop it (0 == False).
+        if value is None or value is False or value == "":
             continue
         if attr == "fusion_threshold_mb":
             value = int(value) * 1024 * 1024

@@ -45,6 +45,15 @@ def parse_args(argv: Optional[List[str]] = None):
     parser.add_argument("--fusion-threshold-mb", type=int, default=None)
     parser.add_argument("--cycle-time-ms", type=float, default=None)
     parser.add_argument("--cache-capacity", type=int, default=None)
+    parser.add_argument("--disable-cache", action="store_true", default=None,
+                        help="turn the response cache off entirely "
+                             "(reference --disable-cache; same as "
+                             "--cache-capacity 0)")
+    parser.add_argument("--start-timeout", type=int, default=None,
+                        help="seconds to wait for all ranks to register "
+                             "with the rendezvous before aborting "
+                             "(reference --start-timeout / "
+                             "HOROVOD_START_TIMEOUT)")
     parser.add_argument("--hierarchical-allreduce", action="store_true",
                         default=None)
     parser.add_argument("--hierarchical-allgather", action="store_true",
@@ -159,6 +168,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             hosts = [("localhost", args.num_proc)]
         slots = launcher.allocate(hosts, args.num_proc)
 
+    if args.disable_cache:
+        args.cache_capacity = 0
     env = dict(os.environ)
     config_parser.set_env_from_args(env, args)
 

@@ -271,3 +271,22 @@ def test_eager_wakeup_beats_cycle_cadence():
     slow = time_to_plan({"HOROVOD_TPU_EAGER_WAKEUP": "0"})
     assert fast < 0.5, f"eager wakeup did not fire: {fast:.3f}s"
     assert slow > 0.5, f"cadence path returned too early: {slow:.3f}s"
+
+
+def test_start_timeout_bounds_rendezvous():
+    """A worker that never launches must abort rank 0 at
+    HOROVOD_START_TIMEOUT (reference --start-timeout), not hang accept()
+    forever."""
+    hvd.shutdown()
+    os.environ["HOROVOD_START_TIMEOUT"] = "3"
+    try:
+        topo = Topology(rank=0, size=2, local_rank=0, local_size=2,
+                        cross_rank=0, cross_size=1)
+        c = NativeCore()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="timed out"):
+            c.init(Config(), topo, coord_addr="127.0.0.1",
+                   coord_port=29437)
+        assert time.monotonic() - t0 < 30
+    finally:
+        os.environ.pop("HOROVOD_START_TIMEOUT", None)

@@ -142,3 +142,12 @@ def test_kv_store_roundtrip():
         assert client.wait("global", "k1") == b"hello"
     finally:
         server.stop()
+
+
+def test_disable_cache_and_start_timeout_flags():
+    from horovod_tpu.run.run import parse_args
+
+    args = parse_args(["-np", "2", "--disable-cache",
+                       "--start-timeout", "45", "python", "x.py"])
+    assert args.disable_cache is True
+    assert args.start_timeout == 45
