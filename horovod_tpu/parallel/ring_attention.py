@@ -70,6 +70,7 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     use_flash: bool = True,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Blockwise ring attention over a named mesh axis (call inside
     shard_map). q/k/v: [batch, seq_local, heads, head_dim], sequence-sharded
@@ -107,6 +108,7 @@ def ring_attention(
             delta = ((src - rank) * T).astype(jnp.float32)
             o_s, m_s, l_s = flash_attention_block(
                 qf, k_blk, v_blk, delta, sm_scale=scale, causal=causal,
+                interpret=interpret,
             )
             # Online-softmax merge of two partial blocks (finite -1e30
             # sentinel: fully-masked blocks contribute exp(-huge) = 0).
