@@ -686,3 +686,56 @@ def test_allreduce_dtype_sweep_two_ranks():
     for out in outs:
         assert "DTYPES_OK" in out, outs
         assert "MINMAX 1 2" in out, outs
+
+
+def test_worker_crash_terminates_job_cleanly():
+    """Failure detection at the launcher level (the reference horovodrun
+    contract): a rank that dies mid-job must bring the whole job down
+    promptly with a clear report — the surviving rank is terminated, the
+    launcher exits non-zero, and nothing hangs."""
+    import tempfile
+    import time as _time
+
+    script = """
+        import os, sys, time
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        r = hvd.rank()
+        out = hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum, name="ok")
+        assert np.allclose(out, 2.0)
+        if r == 1:
+            print("RANK1 EXITING", flush=True)
+            os._exit(7)  # simulate a crash: no shutdown handshake
+        # Rank 0 would block here forever without failure propagation.
+        for i in range(1000):
+            hvd.allreduce(np.ones(4, np.float32), op=hvd.Sum,
+                          name=f"after.{i}")
+            time.sleep(0.05)
+    """
+    import subprocess
+    import textwrap
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    with tempfile.TemporaryDirectory() as td:
+        worker = os.path.join(td, "worker.py")
+        with open(worker, "w") as f:
+            f.write(textwrap.dedent(script))
+        t0 = _time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             "--output-dir", td, sys.executable, worker],
+            env=env, cwd=REPO, capture_output=True, timeout=120,
+        )
+        dt = _time.monotonic() - t0
+    stderr = proc.stderr.decode()
+    assert proc.returncode != 0
+    assert "exit code 7" in stderr and "terminating" in stderr, stderr
+    assert dt < 90, f"job did not come down promptly: {dt:.0f}s"
