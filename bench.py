@@ -123,6 +123,28 @@ def _force_platform(platform: str, cpu_devices: int) -> None:
     """
     if platform == "auto":
         return
+    if platform == "tpu":
+        # "tpu" means "the accelerator this environment provides". Some
+        # deployments tunnel the chip through an alternate PJRT plugin and
+        # pin it via JAX_PLATFORMS (e.g. an experimental platform name);
+        # forcing the literal string "tpu" there would fail with "no
+        # device found" even though the chip is healthy. Respect an
+        # existing non-cpu pin and only force "tpu" when nothing is pinned.
+        import jax
+
+        config_pin = ""
+        try:
+            config_pin = jax.config.jax_platforms or ""
+        except Exception:
+            pass
+        pinned = [
+            p.strip()
+            for src in (os.environ.get("JAX_PLATFORMS", ""), config_pin)
+            for p in src.split(",")
+            if p.strip() and p.strip() != "cpu"
+        ]
+        if pinned:
+            platform = pinned[0]
     import re
 
     if platform == "cpu" and cpu_devices > 1:
@@ -187,32 +209,59 @@ def _peak_flops(device) -> float | None:
 
 
 def _aot_compile(fn, *inputs):
-    """AOT-compile a jitted fn once (reused for execution and FLOPs cost
-    analysis); falls back to the jit path when the backend lacks AOT."""
+    """AOT-compile a jitted fn once; falls back to the jit path when the
+    backend lacks AOT."""
     try:
-        compiled = fn.lower(*inputs).compile()
-        return compiled, _compiled_flops(compiled)
+        lowered = fn.lower(*inputs)
+    except Exception as e:
+        print(f"[bench] AOT lowering unavailable ({e!r}); using jit path",
+              file=sys.stderr)
+        return fn, None
+    flops = _flops_from_cost_analysis(lowered)
+    try:
+        return lowered.compile(), flops
     except Exception as e:
         print(f"[bench] AOT compile unavailable ({e!r}); using jit path",
               file=sys.stderr)
-        return fn, None
+        return fn, flops
 
 
-def _mfu(flops_per_call, calls_per_iter, best_dt, n_chips, device):
+def _step_flops(step_fn, *inputs) -> float | None:
+    """Model FLOPs of ONE training step, from the step fn's pre-backend
+    (lowered HLO) cost analysis. Two traps this dodges, both observed on
+    this machine: (a) some remote-compile TPU plugins return a compiled
+    cost analysis that drops convolution FLOPs (~25x CNN understatement);
+    (b) HloCostAnalysis counts a lax.scan body ONCE, not times trip
+    count, so the scanned train loop must never be the thing analyzed —
+    always analyze the single step and multiply by steps elsewhere."""
+    try:
+        flops = _flops_from_cost_analysis(step_fn.lower(*inputs))
+    except Exception as e:
+        print(f"[bench] step FLOPs analysis failed: {e!r}", file=sys.stderr)
+        return None
+    if flops is None:
+        print("[bench] step FLOPs analysis returned no flops; "
+              "mfu will be null", file=sys.stderr)
+    return flops
+
+
+def _mfu(flops_per_step, steps_per_iter, best_dt, n_chips, device):
     """Model-FLOPs utilization vs the chip's peak bf16 rate (None off-TPU
     or when cost analysis is unavailable)."""
-    if flops_per_call is None:
+    if flops_per_step is None:
         return None
-    achieved = flops_per_call * calls_per_iter / best_dt / n_chips
+    achieved = flops_per_step * steps_per_iter / best_dt / n_chips
     peak = _peak_flops(device)
     return round(achieved / peak, 4) if peak else None
 
 
-def _compiled_flops(compiled) -> float | None:
-    """Total FLOPs of a compiled XLA module, via cost analysis (best-effort:
-    not every backend/version exposes it)."""
+def _flops_from_cost_analysis(obj) -> float | None:
+    """Total FLOPs via ``obj.cost_analysis()`` (best-effort: not every
+    backend/version exposes it). ``obj`` is a jax Lowered (pre-backend HLO
+    analysis, counts convolutions correctly regardless of the target
+    plugin) or Compiled module."""
     try:
-        cost = compiled.cost_analysis()
+        cost = obj.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
@@ -331,15 +380,28 @@ def run_lm_benchmark(args) -> int:
         )
         return p, s, losses[-1]
 
-    fn = jax.jit(
-        _shard_map(
-            scan_steps if args.scan else step, mesh,
-            in_specs=(P(), P(), P("data"), P("data")),
-            out_specs=P(),
-        ),
-        donate_argnums=(0, 1),
-    )
-    fn, flops_per_call = _aot_compile(fn, params, opt_state, tokens, labels)
+    def _jit(f):
+        return jax.jit(
+            _shard_map(
+                f, mesh,
+                in_specs=(P(), P(), P("data"), P("data")),
+                out_specs=P(),
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    if args.scan:
+        flops_per_step = _step_flops(
+            _jit(step), params, opt_state, tokens, labels
+        )
+        fn, _ = _aot_compile(
+            _jit(scan_steps), params, opt_state, tokens, labels
+        )
+    else:
+        # One lowering serves both the FLOPs analysis and the compile.
+        fn, flops_per_step = _aot_compile(
+            _jit(step), params, opt_state, tokens, labels
+        )
 
     # Warmup (same methodology as the CNN path: one scan call, or
     # --num-warmup-batches plain steps).
@@ -361,7 +423,7 @@ def run_lm_benchmark(args) -> int:
 
     total = float(np.mean(tok_secs))
     per_chip = total / n_chips
-    mfu = _mfu(flops_per_call, calls_per_iter, min(iter_times), n_chips,
+    mfu = _mfu(flops_per_step, steps_per_iter, min(iter_times), n_chips,
                devices[0])
 
     print(json.dumps({
@@ -382,8 +444,7 @@ def run_lm_benchmark(args) -> int:
             "scan": bool(args.scan),
             "mfu": mfu,
             "flops_per_step": (
-                round(flops_per_call / steps_per_iter)
-                if (flops_per_call and args.scan) else flops_per_call
+                round(flops_per_step) if flops_per_step else None
             ),
             "backend_init_s": round(init_s, 1),
             "backend_init_attempts": init_attempts,
@@ -504,11 +565,13 @@ def run_benchmark(args) -> int:
             donate_argnums=(0, 1, 2),
         )
 
-    timed_fn = fn_scan if args.scan else fn
-    timed_fn, flops_per_call = _aot_compile(
-        timed_fn, params, batch_stats, opt_state, images, labels,
-        jnp.int32(0),
-    )
+    ex_args = (params, batch_stats, opt_state, images, labels, jnp.int32(0))
+    if args.scan:
+        flops_per_step = _step_flops(fn, *ex_args)
+        timed_fn, _ = _aot_compile(fn_scan, *ex_args)
+    else:
+        # One lowering serves both the FLOPs analysis and the compile.
+        timed_fn, flops_per_step = _aot_compile(fn, *ex_args)
 
     # Warmup (includes compile when the AOT path was unavailable).
     it = 0
@@ -553,7 +616,7 @@ def run_benchmark(args) -> int:
     total = float(np.mean(img_secs))
     per_chip = total / n_chips
 
-    mfu = _mfu(flops_per_call, 1 if args.scan else args.num_batches_per_iter,
+    mfu = _mfu(flops_per_step, args.num_batches_per_iter,
                min(iter_times), n_chips, devices[0])
 
     detail = {
@@ -568,8 +631,7 @@ def run_benchmark(args) -> int:
         "dtype": "bf16 compute / f32 params",
         "mfu": mfu,
         "flops_per_step": (
-            round(flops_per_call / (args.num_batches_per_iter if args.scan else 1))
-            if flops_per_call else None
+            round(flops_per_step) if flops_per_step else None
         ),
         "backend_init_s": round(init_s, 1),
         "backend_init_attempts": init_attempts,
