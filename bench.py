@@ -208,16 +208,17 @@ def _peak_flops(device) -> float | None:
     return None
 
 
-def _aot_compile(fn, *inputs):
+def _aot_compile(fn, *inputs, with_flops=True):
     """AOT-compile a jitted fn once; falls back to the jit path when the
-    backend lacks AOT."""
+    backend lacks AOT. ``with_flops=False`` skips the cost analysis (scan
+    callers analyze the single step separately — see _step_flops)."""
     try:
         lowered = fn.lower(*inputs)
     except Exception as e:
         print(f"[bench] AOT lowering unavailable ({e!r}); using jit path",
               file=sys.stderr)
         return fn, None
-    flops = _flops_from_cost_analysis(lowered)
+    flops = _flops_from_cost_analysis(lowered) if with_flops else None
     try:
         return lowered.compile(), flops
     except Exception as e:
@@ -395,7 +396,8 @@ def run_lm_benchmark(args) -> int:
             _jit(step), params, opt_state, tokens, labels
         )
         fn, _ = _aot_compile(
-            _jit(scan_steps), params, opt_state, tokens, labels
+            _jit(scan_steps), params, opt_state, tokens, labels,
+            with_flops=False,
         )
     else:
         # One lowering serves both the FLOPs analysis and the compile.
@@ -568,7 +570,7 @@ def run_benchmark(args) -> int:
     ex_args = (params, batch_stats, opt_state, images, labels, jnp.int32(0))
     if args.scan:
         flops_per_step = _step_flops(fn, *ex_args)
-        timed_fn, _ = _aot_compile(fn_scan, *ex_args)
+        timed_fn, _ = _aot_compile(fn_scan, *ex_args, with_flops=False)
     else:
         # One lowering serves both the FLOPs analysis and the compile.
         timed_fn, flops_per_step = _aot_compile(fn, *ex_args)

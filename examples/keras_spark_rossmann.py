@@ -1,0 +1,132 @@
+"""Spark + Keras end-to-end pipeline (Rossmann-style tabular regression).
+
+The analogue of the reference's ``examples/keras_spark_rossmann.py``: a
+Spark job prepares a tabular dataset (feature engineering in the
+executors), then ``horovod_tpu.spark.run`` trains a Keras regression
+model data-parallel across the same executors, and the best model scores
+a held-out split back in Spark. The reference's 500-line script is built
+around the real Kaggle CSVs; this version generates a synthetic
+store-sales frame with the same shape of pipeline so it runs hermetic.
+
+PySpark is not installed in the TPU image; the script exits with a clear
+message in that case (same gating as ``horovod_tpu.spark``). On a Spark
+cluster with pyspark available:
+
+    spark-submit examples/keras_spark_rossmann.py --num-proc 4
+"""
+
+import argparse
+import os as _os
+import sys as _sys
+
+try:  # allow running from a source checkout without installation
+    import horovod_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import numpy as np
+
+from horovod_tpu.spark import _SPARK_AVAILABLE
+
+N_STORES = 50
+N_DAYS = 200
+
+
+def make_frame(spark):
+    """Synthetic store-sales rows: (store, day-of-week, promo, holiday,
+    sales). Mirrors the reference's joined train frame post-feature-
+    engineering, at toy scale."""
+    rng = np.random.RandomState(0)
+    rows = []
+    for store in range(N_STORES):
+        base = rng.uniform(200.0, 2000.0)
+        for day in range(N_DAYS):
+            dow = day % 7
+            promo = int(rng.rand() < 0.3)
+            holiday = int(rng.rand() < 0.05)
+            sales = base * (1.0 + 0.3 * promo - 0.8 * holiday) \
+                * (0.7 if dow == 6 else 1.0) * rng.uniform(0.9, 1.1)
+            rows.append((store, dow, promo, holiday, float(sales)))
+    return spark.createDataFrame(
+        rows, ["store", "dow", "promo", "holiday", "sales"]
+    )
+
+
+def train_fn(train_rows, val_rows, epochs, lr):
+    """Runs inside each Spark task under horovod_tpu.spark.run."""
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+
+    train = np.asarray(train_rows, np.float32)
+    x, y = train[:, :4], np.log1p(train[:, 4:5])
+    val = np.asarray(val_rows, np.float32)
+    xv, yv = val[:, :4], np.log1p(val[:, 4:5])
+
+    # Rank-sharded data: each worker trains on its slice (the reference
+    # relies on Petastorm row-group sharding; here a plain stride).
+    x = x[hvd.rank()::hvd.size()]
+    y = y[hvd.rank()::hvd.size()]
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.Adam(lr * hvd.size())
+    )
+    model.compile(optimizer=opt, loss="mae")
+    model.fit(
+        x, y, batch_size=64, epochs=epochs, verbose=0,
+        callbacks=[hvd.callbacks.BroadcastGlobalVariablesCallback(0)],
+    )
+    val_mae = float(model.evaluate(xv, yv, verbose=0))
+    if hvd.rank() == 0:
+        return {"val_mae": val_mae,
+                "weights": [w.tolist() for w in model.get_weights()]}
+    return {"val_mae": val_mae}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-proc", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args()
+
+    if not _SPARK_AVAILABLE:
+        print("PySpark is not installed; this example needs a Spark "
+              "cluster. See horovod_tpu.spark docs.", file=_sys.stderr)
+        raise SystemExit(3)
+
+    from pyspark.sql import SparkSession
+
+    import horovod_tpu.spark as hvd_spark
+
+    spark = SparkSession.builder.master(
+        _os.environ.get("SPARK_MASTER", f"local[{args.num_proc}]")
+    ).appName("hvd-tpu-rossmann").getOrCreate()
+
+    df = make_frame(spark)
+    train_df, val_df = df.randomSplit([0.9, 0.1], seed=42)
+    train_rows = [tuple(r) for r in train_df.collect()]
+    val_rows = [tuple(r) for r in val_df.collect()]
+
+    results = hvd_spark.run(
+        train_fn, args=(train_rows, val_rows, args.epochs, args.lr),
+        num_proc=args.num_proc,
+    )
+    maes = [r["val_mae"] for r in results]
+    print(f"val MAE per rank: {[round(m, 4) for m in maes]}")
+    assert max(maes) - min(maes) < 1e-6, "ranks diverged"
+    print("SPARK TRAINING DONE")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

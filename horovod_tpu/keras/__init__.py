@@ -31,10 +31,12 @@ from . import callbacks  # noqa: E402,F401  (import after basics)
 
 def DistributedOptimizer(optimizer, name=None,  # noqa: N802
                          device_dense="", device_sparse="",
-                         compression=Compression.none, op=None):
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=None):
     return _TfDistributedOptimizer(
         optimizer, name=name, device_dense=device_dense,
-        device_sparse=device_sparse, compression=compression, op=op,
+        device_sparse=device_sparse, compression=compression,
+        sparse_as_dense=sparse_as_dense, op=op,
     )
 
 

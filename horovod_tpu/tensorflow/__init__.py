@@ -260,9 +260,11 @@ class DistributedGradientTape:
     (reference ``tensorflow/__init__.py:473-530``)."""
 
     def __init__(self, tape, device_dense="", device_sparse="",
-                 compression=Compression.none, op=None):
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=None):
         self._tape = tape
         self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
         self._op = op if op is not None else Average
 
     def __enter__(self):
@@ -277,6 +279,14 @@ class DistributedGradientTape:
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
+        if self._sparse_as_dense:
+            import tensorflow as tf
+
+            grads = [
+                tf.convert_to_tensor(g)
+                if isinstance(g, tf.IndexedSlices) else g
+                for g in grads
+            ]
         return [
             allreduce(g, compression=self._compression, op=self._op,
                       name=f"DistributedGradientTape.grad.{i}")
@@ -292,7 +302,8 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,  # noqa: N802
     """Wrap a Keras optimizer so gradients are allreduced before apply
     (API parity with ``tensorflow/__init__.py:409-470``)."""
     cls = _make_distributed_optimizer_class(
-        optimizer.__class__, compression=compression, op=op
+        optimizer.__class__, compression=compression,
+        sparse_as_dense=sparse_as_dense, op=op
     )
     # Fresh instance with the same config; Keras builds slots lazily on the
     # first apply_gradients, so no state transfer is needed for a new model.
@@ -300,7 +311,7 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,  # noqa: N802
 
 
 def _make_distributed_optimizer_class(base, compression=Compression.none,
-                                      op=None):
+                                      sparse_as_dense=False, op=None):
     """Subclass ``base`` so gradients are allreduced before apply.
 
     The subclass keeps the base class name (as the reference does when
@@ -318,15 +329,18 @@ def _make_distributed_optimizer_class(base, compression=Compression.none,
         _hvd_distributed = True
 
         def apply_gradients(self, grads_and_vars, **kwargs):
-            gv = [
-                (
+            import tensorflow as tf
+
+            gv = []
+            for i, (g, v) in enumerate(grads_and_vars):
+                if sparse_as_dense and isinstance(g, tf.IndexedSlices):
+                    g = tf.convert_to_tensor(g)
+                gv.append((
                     allreduce(g, compression=compression, op=reduce_op,
                               name=f"DistributedOptimizer.grad.{i}")
                     if g is not None else None,
                     v,
-                )
-                for i, (g, v) in enumerate(grads_and_vars)
-            ]
+                ))
             return super().apply_gradients(gv, **kwargs)
 
     _Distributed.__name__ = base.__name__

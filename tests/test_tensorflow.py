@@ -68,6 +68,32 @@ def test_distributed_gradient_tape():
     np.testing.assert_allclose(grads[0].numpy(), [[36.0]])
 
 
+def test_distributed_gradient_tape_sparse_as_dense():
+    """Reference parity: ``sparse_as_dense=True`` densifies IndexedSlices
+    gradients (embedding lookups) before the allreduce
+    (``tensorflow/__init__.py:467`` upstream)."""
+    table = tf.Variable(tf.ones((4, 2)))
+    ids = tf.constant([0, 2])
+    with hvd.DistributedGradientTape(
+        tf.GradientTape(), sparse_as_dense=True
+    ) as tape:
+        emb = tf.nn.embedding_lookup(table, ids)
+        loss = tf.reduce_sum(emb)
+    grads = tape.gradient(loss, [table])
+    assert not isinstance(grads[0], tf.IndexedSlices)
+    np.testing.assert_allclose(
+        tf.convert_to_tensor(grads[0]).numpy(),
+        [[1.0, 1.0], [0.0, 0.0], [1.0, 1.0], [0.0, 0.0]],
+    )
+
+    # Default keeps the sparse (allgather) path.
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        emb = tf.nn.embedding_lookup(table, ids)
+        loss = tf.reduce_sum(emb)
+    grads = tape.gradient(loss, [table])
+    assert isinstance(grads[0], tf.IndexedSlices)
+
+
 def test_broadcast_variables():
     v1 = tf.Variable([1.0, 2.0])
     v2 = tf.Variable([[3.0]])
