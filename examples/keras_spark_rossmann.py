@@ -52,6 +52,17 @@ def make_frame(spark):
     )
 
 
+def build_model():
+    import tensorflow as tf
+
+    return tf.keras.Sequential([
+        tf.keras.layers.Input((4,)),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+
+
 def train_fn(train_rows, val_rows, epochs, lr):
     """Runs inside each Spark task under horovod_tpu.spark.run."""
     import numpy as np
@@ -71,12 +82,7 @@ def train_fn(train_rows, val_rows, epochs, lr):
     x = x[hvd.rank()::hvd.size()]
     y = y[hvd.rank()::hvd.size()]
 
-    model = tf.keras.Sequential([
-        tf.keras.layers.Input((4,)),
-        tf.keras.layers.Dense(64, activation="relu"),
-        tf.keras.layers.Dense(32, activation="relu"),
-        tf.keras.layers.Dense(1),
-    ])
+    model = build_model()
     opt = hvd.DistributedOptimizer(
         tf.keras.optimizers.Adam(lr * hvd.size())
     )
@@ -124,6 +130,16 @@ def main():
     maes = [r["val_mae"] for r in results]
     print(f"val MAE per rank: {[round(m, 4) for m in maes]}")
     assert max(maes) - min(maes) < 1e-6, "ranks diverged"
+
+    # Score the trained model on the held-out split back in the driver
+    # (the reference scores its test frame in Spark the same way).
+    weights = next(r["weights"] for r in results if "weights" in r)
+    model = build_model()
+    model.set_weights([np.asarray(w, np.float32) for w in weights])
+    val = np.asarray(val_rows, np.float32)
+    pred = model.predict(val[:, :4], verbose=0)
+    holdout_mae = float(np.mean(np.abs(pred - np.log1p(val[:, 4:5]))))
+    print(f"driver-side holdout MAE: {holdout_mae:.4f}")
     print("SPARK TRAINING DONE")
     spark.stop()
 

@@ -255,6 +255,17 @@ def broadcast_global_variables(root_rank: int = 0) -> None:
         broadcast_variables(tf.compat.v1.global_variables(), root_rank)
 
 
+def _densify_if_sparse(g):
+    """sparse_as_dense support: convert an IndexedSlices gradient to a
+    dense tensor so it rides the dense-allreduce path (reference
+    ``tensorflow/__init__.py:235`` upstream)."""
+    import tensorflow as tf
+
+    if isinstance(g, tf.IndexedSlices):
+        return tf.convert_to_tensor(g)
+    return g
+
+
 class DistributedGradientTape:
     """Wraps tf.GradientTape; ``gradient()`` allreduces the results
     (reference ``tensorflow/__init__.py:473-530``)."""
@@ -278,21 +289,21 @@ class DistributedGradientTape:
         return getattr(self._tape, item)
 
     def gradient(self, target, sources, output_gradients=None):
-        grads = self._tape.gradient(target, sources, output_gradients)
-        if self._sparse_as_dense:
-            import tensorflow as tf
+        import tensorflow as tf
 
-            grads = [
-                tf.convert_to_tensor(g)
-                if isinstance(g, tf.IndexedSlices) else g
-                for g in grads
-            ]
-        return [
+        grads = self._tape.gradient(target, sources, output_gradients)
+        # Mirror the sources structure (a single tensor source yields a
+        # single gradient, not a list — reference uses nest the same way).
+        flat = tf.nest.flatten(grads)
+        if self._sparse_as_dense:
+            flat = [_densify_if_sparse(g) for g in flat]
+        reduced = [
             allreduce(g, compression=self._compression, op=self._op,
                       name=f"DistributedGradientTape.grad.{i}")
             if g is not None else None
-            for i, g in enumerate(grads)
+            for i, g in enumerate(flat)
         ]
+        return tf.nest.pack_sequence_as(grads, reduced)
 
 
 def DistributedOptimizer(optimizer, name=None, use_locking=False,  # noqa: N802
@@ -329,12 +340,10 @@ def _make_distributed_optimizer_class(base, compression=Compression.none,
         _hvd_distributed = True
 
         def apply_gradients(self, grads_and_vars, **kwargs):
-            import tensorflow as tf
-
             gv = []
             for i, (g, v) in enumerate(grads_and_vars):
-                if sparse_as_dense and isinstance(g, tf.IndexedSlices):
-                    g = tf.convert_to_tensor(g)
+                if sparse_as_dense:
+                    g = _densify_if_sparse(g)
                 gv.append((
                     allreduce(g, compression=compression, op=reduce_op,
                               name=f"DistributedOptimizer.grad.{i}")

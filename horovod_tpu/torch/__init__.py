@@ -159,11 +159,114 @@ class _DistributedOptimizer:
         return self._opt.load_state_dict(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer:
+    """Delta-space Adasum (reference ``horovod/torch/__init__.py:211-379``):
+    the inner optimizer steps on LOCAL gradients, and what is Adasum-reduced
+    is the parameter *delta* it produced — so adaptive state (Adam moments,
+    momentum) stays local and the adaptive combine acts on the actual
+    update direction, which is the Adasum paper's formulation."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none, backward_passes_per_step=1):
+        self._opt = optimizer
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        if named_parameters is not None:
+            named = list(named_parameters)
+            names = [n for n, _ in named]
+            if len(names) != len(set(names)):
+                raise ValueError(
+                    "named_parameters contains duplicate parameter names"
+                )
+            self._param_names = {p: n for n, p in named}
+        else:
+            self._param_names = {}
+            i = 0
+            for group in optimizer.param_groups:
+                for p in group["params"]:
+                    self._param_names[p] = f"param.{i}"
+                    i += 1
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    def step(self, closure=None):
+        import torch
+
+        if self.backward_passes_per_step > 1:
+            # N backward() calls accumulated into p.grad; average them
+            # before the local step (same normalization as the
+            # gradient-space wrapper).
+            with torch.no_grad():
+                for group in self._opt.param_groups:
+                    for p in group["params"]:
+                        if p.grad is not None:
+                            p.grad.div_(self.backward_passes_per_step)
+        # Only parameters the optimizer can update get cloned/reduced —
+        # frozen (grad-None) params never produce a delta, and the skip is
+        # structural, so it is consistent across ranks.
+        starts = {}
+        with torch.no_grad():
+            for group in self._opt.param_groups:
+                for p in group["params"]:
+                    if p.grad is not None:
+                        starts[p] = p.detach().clone()
+        loss = self._opt.step(closure)
+        # Adasum-allreduce each parameter's local delta asynchronously,
+        # then rebase: p = p_start + adasum(delta).
+        handles = []
+        with torch.no_grad():
+            for group in self._opt.param_groups:
+                for p in group["params"]:
+                    if p not in starts:
+                        continue
+                    delta = p - starts[p]
+                    name = self._param_names.get(p, f"param.{id(p)}")
+                    compressed, ctx = self._compression.compress(delta)
+                    handles.append((
+                        p,
+                        allreduce_async(
+                            compressed,
+                            name=f"AdasumOptimizer.delta.{name}",
+                            op=Adasum,
+                        ),
+                        ctx,
+                    ))
+            for p, handle, ctx in handles:
+                out = self._compression.decompress(synchronize(handle), ctx)
+                p.copy_(starts[p] + out.reshape(p.shape).to(p.dtype))
+        return loss
+
+    def synchronize(self) -> None:
+        """Adasum reduces inside step(); nothing is outstanding between
+        steps (kept for API parity with _DistributedOptimizer)."""
+
+    def zero_grad(self, *args, **kwargs):
+        return self._opt.zero_grad(*args, **kwargs)
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, *args, **kwargs):
+        return self._opt.load_state_dict(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,  # noqa: N802
                          compression=Compression.none,
                          backward_passes_per_step=1, op=Average):
     """API parity with ``hvd.DistributedOptimizer``
-    (``horovod/torch/__init__.py:381-435``)."""
+    (``horovod/torch/__init__.py:381-435``): ``op=Adasum`` dispatches to
+    the delta-space Adasum optimizer exactly as the reference does."""
+    if op == Adasum:
+        return _DistributedAdasumOptimizer(
+            optimizer, named_parameters=named_parameters,
+            compression=compression,
+            backward_passes_per_step=backward_passes_per_step,
+        )
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
@@ -194,7 +297,11 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     broadcast, and written back via callbacks."""
     import torch
 
-    if isinstance(optimizer, _DistributedOptimizer):
+    if isinstance(optimizer, (_DistributedOptimizer,
+                              _DistributedAdasumOptimizer)):
+        # Unwrap so the dummy state-materialization step below uses the
+        # inner optimizer directly — the wrapped step() would fire
+        # collectives that ranks skipping this branch never post.
         optimizer = optimizer._opt
 
     state_dict = optimizer.state_dict()

@@ -723,3 +723,54 @@ def test_worker_crash_terminates_job_cleanly():
     assert proc.returncode != 0
     assert "exit code 7" in stderr and "terminating" in stderr, stderr
     assert dt < 90, f"job did not come down promptly: {dt:.0f}s"
+
+
+def test_torch_adasum_optimizer_two_ranks():
+    """Delta-space Adasum optimizer across 2 real ranks (reference
+    ``horovod/torch/__init__.py:211-379``): each rank SGD-steps on its own
+    gradient, and the applied update must equal the NumPy VHDD reference
+    combine of the two local deltas."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import torch
+        import horovod_tpu.torch as hvd
+        from horovod_tpu.ops.adasum import adasum_allreduce_reference
+        hvd.init()
+        r = hvd.rank()
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 1, bias=False)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        w0 = model.weight.detach().clone()
+        lr = 0.1
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=lr),
+            named_parameters=model.named_parameters(), op=hvd.Adasum,
+        )
+        # Deterministic per-rank batch -> known local gradient/delta.
+        X = torch.eye(4)[: 4]
+        y = torch.full((4, 1), float(r + 1))
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(model(X), y).backward()
+        grad = model.weight.grad.detach().clone()
+        opt.step()
+        local_delta = (-lr * grad).numpy().ravel()
+        # Reconstruct both ranks' deltas: grad depends on y = r+1.
+        deltas = []
+        for rr in range(2):
+            yy = torch.full((4, 1), float(rr + 1))
+            ww = w0.clone().requires_grad_(True)
+            loss = torch.nn.functional.mse_loss(X @ ww.t(), yy)
+            g, = torch.autograd.grad(loss, ww)
+            deltas.append((-lr * g).numpy().ravel())
+        assert np.allclose(deltas[r], local_delta, atol=1e-6)
+        expected = w0.numpy().ravel() + adasum_allreduce_reference(deltas)
+        got = model.weight.detach().numpy().ravel()
+        ok = np.allclose(got, expected, rtol=1e-5, atol=1e-6)
+        print("TORCH_ADASUM_OK", bool(ok))
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "TORCH_ADASUM_OK True" in out, outs

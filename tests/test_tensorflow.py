@@ -68,6 +68,29 @@ def test_distributed_gradient_tape():
     np.testing.assert_allclose(grads[0].numpy(), [[36.0]])
 
 
+def test_distributed_gradient_tape_single_source():
+    """A single (non-list) source must yield a single gradient tensor, not
+    an element-wise-iterated list (tf.GradientTape semantics)."""
+    w = tf.Variable([[2.0], [3.0]])
+    x = tf.constant([[3.0, 1.0]])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(tf.matmul(x, w))
+    grad = tape.gradient(loss, w)
+    assert isinstance(grad, (tf.Tensor, tf.IndexedSlices)), type(grad)
+    np.testing.assert_allclose(
+        tf.convert_to_tensor(grad).numpy(), [[3.0], [1.0]]
+    )
+
+    # Single sparse source with sparse_as_dense: densified, still single.
+    table = tf.Variable(tf.ones((3, 2)))
+    with hvd.DistributedGradientTape(
+        tf.GradientTape(), sparse_as_dense=True
+    ) as tape:
+        loss = tf.reduce_sum(tf.nn.embedding_lookup(table, tf.constant([1])))
+    grad = tape.gradient(loss, table)
+    assert isinstance(grad, tf.Tensor), type(grad)
+
+
 def test_distributed_gradient_tape_sparse_as_dense():
     """Reference parity: ``sparse_as_dense=True`` densifies IndexedSlices
     gradients (embedding lookups) before the allreduce

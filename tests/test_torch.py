@@ -186,3 +186,33 @@ def test_dlpack_zero_copy_bridge():
     out = hvd.allreduce(b, op=hvd.Sum, name="bf16.dlpack")
     assert out.dtype == torch.bfloat16
     assert torch.allclose(out.float(), torch.ones(4))
+
+
+def test_adasum_optimizer_delta_space_single_rank():
+    """op=Adasum dispatches to the delta-space optimizer (reference
+    ``horovod/torch/__init__.py:427-435``). At size 1 Adasum is the
+    identity, so the wrapped step must equal the plain optimizer step —
+    including for Adam, whose moments must stay local."""
+    from horovod_tpu.torch import _DistributedAdasumOptimizer
+
+    torch.manual_seed(0)
+    model_a = _make_model()
+    model_b = _make_model()
+    model_b.load_state_dict(model_a.state_dict())
+
+    opt_plain = torch.optim.Adam(model_a.parameters(), lr=0.05)
+    opt_hvd = hvd.DistributedOptimizer(
+        torch.optim.Adam(model_b.parameters(), lr=0.05),
+        named_parameters=model_b.named_parameters(), op=hvd.Adasum,
+    )
+    assert isinstance(opt_hvd, _DistributedAdasumOptimizer)
+
+    X = torch.randn(16, 4)
+    y = torch.randn(16, 1)
+    for _ in range(5):
+        for opt, model in ((opt_plain, model_a), (opt_hvd, model_b)):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(X), y).backward()
+            opt.step()
+    for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+        assert torch.allclose(pa, pb, atol=1e-6), (pa, pb)
