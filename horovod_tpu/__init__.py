@@ -320,6 +320,31 @@ def alltoall(tensor: Any, name: Optional[str] = None) -> Any:
     return synchronize(alltoall_async(tensor, name))
 
 
+def reducescatter_async(
+    tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None
+) -> int:
+    """Sum/average across ranks, scatter dim0 shards: rank r receives rows
+    ``[r*d/size, (r+1)*d/size)`` of the reduction. TPU-native extension
+    (single ``lax.psum_scatter`` on the ICI ring); the reference op set
+    stops at broadcast (``message.h:48-50``)."""
+    op = op if op is not None else ReduceOp.SUM
+    # Validate here, not only in the multi-rank executor, so a size-1 dev
+    # run rejects exactly what a production job would.
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM/AVERAGE only")
+    if not getattr(tensor, "shape", ()):
+        raise ValueError("reducescatter needs a tensor with a dim0 to scatter")
+    return _rt().enqueue_reducescatter(
+        _auto_name("reducescatter", name), tensor, reduce_op=op
+    )
+
+
+def reducescatter(
+    tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None
+) -> Any:
+    return synchronize(reducescatter_async(tensor, name, op))
+
+
 def join() -> None:
     """Signal this rank is out of data; blocks until all ranks join
     (reference ``hvd.join``, ``operations.cc:910-934``)."""
@@ -364,6 +389,8 @@ __all__ = [
     "broadcast_async",
     "alltoall",
     "alltoall_async",
+    "reducescatter",
+    "reducescatter_async",
     "join",
     "poll",
     "synchronize",

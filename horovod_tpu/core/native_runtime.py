@@ -203,6 +203,9 @@ class NativeRuntime:
     def enqueue_alltoall(self, name, tensor, **kw) -> int:
         return self._enqueue(RequestType.ALLTOALL, name, tensor, **kw)
 
+    def enqueue_reducescatter(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.REDUCESCATTER, name, tensor, **kw)
+
     def enqueue_join(self) -> int:
         if not self.running:
             raise RuntimeError("Horovod runtime is shut down.")

@@ -50,6 +50,7 @@ from ..utils.timeline import (
     XLA_ALLTOALL,
     XLA_BROADCAST,
     XLA_ADASUM,
+    XLA_REDUCESCATTER,
 )
 
 logger = logging.getLogger("horovod_tpu")
@@ -59,6 +60,7 @@ _REQ_TO_TIMELINE = {
     RequestType.ALLGATHER: XLA_ALLGATHER,
     RequestType.BROADCAST: XLA_BROADCAST,
     RequestType.ALLTOALL: XLA_ALLTOALL,
+    RequestType.REDUCESCATTER: XLA_REDUCESCATTER,
     RequestType.ADASUM: XLA_ADASUM,
 }
 
@@ -498,6 +500,9 @@ class Runtime:
 
     def enqueue_alltoall(self, name, tensor, **kw) -> int:
         return self._enqueue(RequestType.ALLTOALL, name, tensor, **kw)
+
+    def enqueue_reducescatter(self, name, tensor, **kw) -> int:
+        return self._enqueue(RequestType.REDUCESCATTER, name, tensor, **kw)
 
     def enqueue_join(self) -> int:
         self.joined = True

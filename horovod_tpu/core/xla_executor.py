@@ -230,6 +230,8 @@ class XlaPlanExecutor(PlanExecutor):
             return self._broadcast(plan, entries)
         if ptype == 4:
             return self._alltoall(plan, entries)
+        if ptype == 5:
+            return self._reducescatter(plan, entries)
         raise RuntimeError(f"unsupported plan type {ptype}")
 
     def _pack(self, entries) -> Tuple[np.ndarray, List[Tuple[int, ...]], str]:
@@ -499,6 +501,65 @@ class XlaPlanExecutor(PlanExecutor):
             outputs[e.name] = (
                 res if res.dtype == local.dtype else res.astype(local.dtype)
             )
+        return outputs
+
+    def _reducescatter(self, plan, entries) -> Dict[str, Any]:
+        """Sum-reduce across ranks and scatter dim0 shards: rank r gets
+        rows [r*d0/n, (r+1)*d0/n) of the sum. TPU-native extension (the
+        reference's op set stops at broadcast, message.h:48-50); lowers
+        through the one canonical ``ops.collectives.reducescatter``
+        psum_scatter. AVERAGE divides by the participant count like
+        allreduce. Device-resident inputs stay on device."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from ..jax import _shard_map
+        from ..ops.collectives import reducescatter as rs_lowering
+
+        outputs: Dict[str, Any] = {}
+        n = self._topo.size
+        participants = int(plan.get("participants", n)) or n
+        reduce_op = int(plan.get("op", int(ReduceOp.SUM)))
+        if reduce_op not in (int(ReduceOp.SUM), int(ReduceOp.AVERAGE)):
+            raise RuntimeError("reducescatter supports SUM/AVERAGE only")
+        for e in entries:
+            shape = tuple(int(d) for d in e.tensor.shape)
+            if not shape or shape[0] % n != 0:
+                raise RuntimeError(
+                    f"reducescatter dim0 "
+                    f"({shape[0] if shape else 'scalar'}) must be "
+                    f"divisible by size ({n})"
+                )
+            on_device = self._device_resident(e.tensor)
+            key = ("rs", str(e.tensor.dtype), shape, reduce_op, participants)
+
+            def build():
+                def body(x):
+                    out = rs_lowering(x[0], axis_name=_RANK_AXIS)
+                    if reduce_op == int(ReduceOp.AVERAGE):
+                        out = (
+                            out / np.asarray(participants, dtype=np.float32)
+                        ).astype(x.dtype)  # int/int promotes; restore dtype
+                    return out
+
+                fn = _shard_map(
+                    body, self._mesh, in_specs=(P(_RANK_AXIS),),
+                    out_specs=P(_RANK_AXIS),
+                )
+                return jax.jit(fn)
+
+            if on_device:
+                garr = self._global_from_device(e.tensor)
+                out = self._compiled(key, build)(garr)
+                outputs[e.name] = self._local_view(out)
+            else:
+                local = np.asarray(e.tensor)
+                garr = self._global_array(local)
+                out = self._compiled(key, build)(garr)
+                res = self._local_out(out)
+                outputs[e.name] = (
+                    res if res.dtype == local.dtype
+                    else res.astype(local.dtype)
+                )
         return outputs
 
     def _alltoall(self, plan, entries) -> Dict[str, Any]:

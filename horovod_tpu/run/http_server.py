@@ -12,7 +12,6 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse
-from urllib.request import Request, urlopen
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -82,20 +81,39 @@ class KVStoreServer:
 
 
 class KVStoreClient:
+    """Plain-TCP HTTP KV client built on ``http.client.HTTPConnection``.
+
+    Deliberately NOT ``urllib.request.urlopen``: urlopen's default opener
+    constructs an HTTPS handler (``ssl.create_default_context`` →
+    ``load_default_certs``) even for http:// URLs, and that OpenSSL
+    initialization can deadlock in a process forked from a multi-threaded
+    parent — exactly the Spark-task fork pattern this client serves.
+    A raw HTTPConnection never touches ssl."""
+
     def __init__(self, addr: str, port: int):
-        self._base = f"http://{addr}:{port}"
+        self._addr = addr
+        self._port = port
+
+    def _request(self, method: str, path: str, body=None) -> bytes:
+        import http.client
+
+        conn = http.client.HTTPConnection(self._addr, self._port, timeout=30)
+        try:
+            conn.request(method, path, body=body)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise OSError(f"KV {method} {path}: HTTP {resp.status}")
+            return data
+        finally:
+            conn.close()
 
     def put(self, scope: str, key: str, value: bytes) -> None:
-        req = Request(
-            f"{self._base}/{scope}/{key}", data=value, method="PUT"
-        )
-        urlopen(req, timeout=30).read()
+        self._request("PUT", f"/{scope}/{key}", body=value)
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
         try:
-            return urlopen(
-                f"{self._base}/{scope}/{key}", timeout=30
-            ).read()
+            return self._request("GET", f"/{scope}/{key}")
         except Exception:
             return None
 

@@ -340,6 +340,8 @@ def _make_distributed_optimizer_class(base, compression=Compression.none,
         _hvd_distributed = True
 
         def apply_gradients(self, grads_and_vars, **kwargs):
+            if reduce_op == ReduceOp.ADASUM:
+                return self._apply_adasum(list(grads_and_vars), **kwargs)
             gv = []
             for i, (g, v) in enumerate(grads_and_vars):
                 if sparse_as_dense:
@@ -351,6 +353,27 @@ def _make_distributed_optimizer_class(base, compression=Compression.none,
                     v,
                 ))
             return super().apply_gradients(gv, **kwargs)
+
+        def _apply_adasum(self, gv, **kwargs):
+            """Delta-space Adasum (reference
+            ``tensorflow/__init__.py:313-407`` _DistributedAdasumOptimizer):
+            step locally on own gradients, Adasum-reduce the parameter
+            delta, rebase. Adaptive state (Adam moments) stays local."""
+            import tensorflow as tf
+
+            tracked = [v for g, v in gv if g is not None]
+            starts = [tf.identity(v) for v in tracked]
+            result = super().apply_gradients(gv, **kwargs)
+            for i, (v, start) in enumerate(zip(tracked, starts)):
+                delta = v - start
+                compressed, ctx = compression.compress(delta)
+                reduced = compression.decompress(
+                    allreduce(compressed, op=Adasum,
+                              name=f"AdasumOptimizer.delta.{i}"),
+                    ctx,
+                )
+                v.assign(start + tf.cast(reduced, v.dtype))
+            return result
 
     _Distributed.__name__ = base.__name__
     _Distributed.__qualname__ = base.__qualname__

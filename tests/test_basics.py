@@ -168,3 +168,17 @@ def test_topology_from_env(monkeypatch):
     assert topo.cross_size == 2
     assert topo.is_homogeneous
     assert topo.source == "env"
+
+
+def test_reducescatter_single_process(hvd_session):
+    # size=1: the sum is the tensor and the single shard is all of it.
+    x = jnp.arange(6, dtype=jnp.float32)
+    np.testing.assert_allclose(hvd.reducescatter(x), x)
+    np.testing.assert_allclose(hvd.reducescatter(x, op=hvd.Average), x)
+
+
+def test_reducescatter_rejects_bad_args(hvd_session):
+    with pytest.raises(ValueError, match="SUM/AVERAGE"):
+        hvd.reducescatter(jnp.ones((4,)), op=hvd.Min)
+    with pytest.raises(ValueError, match="dim0"):
+        hvd.reducescatter(jnp.float32(1.0))

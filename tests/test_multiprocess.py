@@ -304,6 +304,38 @@ def test_alltoall_two_ranks():
     assert "A2A [1.0, 3.0]" in outs[1], outs
 
 
+def test_reducescatter_two_ranks():
+    """Eager reducescatter (TPU-native extension): sum across ranks,
+    rank r keeps dim0 shard r; AVERAGE divides by participant count."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        r = hvd.rank()
+        x = jnp.asarray(np.arange(4, dtype=np.float32) + r)  # [r,1+r,2+r,3+r]
+        s = hvd.reducescatter(x, op=hvd.Sum)        # sum=[1,3,5,7]; shard 2
+        a = hvd.reducescatter(x, op=hvd.Average)
+        print("RS", np.asarray(s).tolist())
+        print("RSAVG", np.asarray(a).tolist())
+        try:
+            hvd.reducescatter(jnp.ones((3,), jnp.float32), name="bad")
+            print("NO_ERROR")
+        except RuntimeError:
+            print("DIV_ERROR")
+        hvd.shutdown()
+        """
+    )
+    assert "RS [1.0, 3.0]" in outs[0], outs
+    assert "RS [5.0, 7.0]" in outs[1], outs
+    assert "RSAVG [0.5, 1.5]" in outs[0], outs
+    assert "RSAVG [2.5, 3.5]" in outs[1], outs
+    for out in outs:
+        assert "DIV_ERROR" in out, outs
+
+
 _FAKE_GRID_PROLOGUE = """
         import os
         # Fake a 2-host x 2-rank grid on localhost so the (cross, local)

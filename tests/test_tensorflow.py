@@ -266,3 +266,26 @@ def test_int64_overflow_fails_loudly():
             tf.constant([2**40], dtype=tf.int64), root_rank=0,
             name="big.int",
         )
+
+
+def test_tf_adasum_optimizer_delta_space_single_rank():
+    """op=Adasum dispatches to the delta-space apply path (reference
+    ``tensorflow/__init__.py:313-407``). At size 1 Adasum is the identity,
+    so the wrapped Adam step must match the unwrapped one exactly."""
+    tf.keras.utils.set_random_seed(0)
+    w_plain = tf.Variable([[1.0], [2.0]])
+    w_hvd = tf.Variable([[1.0], [2.0]])
+    x = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    y = tf.constant([[1.0], [0.0]])
+
+    opt_plain = tf.keras.optimizers.Adam(0.1)
+    opt_hvd = hvd.DistributedOptimizer(
+        tf.keras.optimizers.Adam(0.1), op=hvd.Adasum
+    )
+    for _ in range(4):
+        for opt, w in ((opt_plain, w_plain), (opt_hvd, w_hvd)):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean((tf.matmul(x, w) - y) ** 2)
+            g = tape.gradient(loss, [w])
+            opt.apply_gradients(zip(g, [w]))
+    np.testing.assert_allclose(w_plain.numpy(), w_hvd.numpy(), atol=1e-6)
