@@ -1,0 +1,122 @@
+"""ZeRO-1 optimizer-state sharding over the data axis.
+
+TPU-native extension beyond the reference (whose optimizer state is fully
+replicated, ``horovod/torch/__init__.py:381-435`` role): the optimizer
+state lives sharded 1/N per device, the gradient allreduce becomes a
+reduce-scatter, each rank updates only its parameter shard, and the
+updated shards are all-gathered back — the ZeRO stage-1 schedule (Rajbhandari
+et al., 2019) expressed as three XLA collectives inside one jitted step:
+
+    flat(grads) --psum_scatter--> g_shard          (ICI ring, 1/N bytes out)
+    tx.update(g_shard, state_shard, p_shard)       (compute on 1/N params)
+    flat(params') <--all_gather-- p_shard'         (ICI ring)
+
+Memory per device: optimizer state + one params copy of updates shrink by
+the data-axis size (Adam: 8 bytes/param -> 8/N). Wire bytes match plain
+DP's reduce-scatter + all-gather decomposition of the ring allreduce, so
+there is no communication penalty.
+
+The parameter pytree is flattened to one vector (padded to a multiple of
+the axis size), so element-wise optax transforms (sgd, momentum, adam,
+adamw with scalar weight decay, ...) are exact — the update equals plain
+DP bit-for-bit (tested). Transforms that need per-parameter tree
+structure (per-layer masking, lars/lamb trust ratios) need the
+replicated path instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+__all__ = ["init_zero1_state", "make_zero1_train_step"]
+
+
+def _flat_meta(params, n_shards: int):
+    flat, unravel = ravel_pytree(params)
+    total = flat.shape[0]
+    padded = ((total + n_shards - 1) // n_shards) * n_shards
+    return flat, unravel, total, padded, padded // n_shards
+
+
+def init_zero1_state(optimizer, params, n_shards: int):
+    """Per-shard optimizer states, stacked on a leading [n_shards] axis
+    (the axis ``make_zero1_train_step`` shards over the mesh). Each
+    shard's state is ``optimizer.init`` of that rank's flat parameter
+    slice, so stateful transforms (momentum, Adam moments) start exactly
+    as they would on the full vector."""
+    flat, _, total, padded, k = _flat_meta(params, n_shards)
+    flat = jnp.pad(flat, (0, padded - total))
+    states = [
+        optimizer.init(lax.dynamic_slice(flat, (r * k,), (k,)))
+        for r in range(n_shards)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def make_zero1_train_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    optimizer,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build the jitted ZeRO-1 step: ``step(params, state, batch) ->
+    (params, state, loss)``. ``params`` replicated, ``state`` from
+    ``init_zero1_state`` (sharded over ``axis_name``), ``batch`` sharded
+    on dim0, gradient averaging over the axis."""
+    import optax
+
+    from ..jax import _shard_map
+
+    n = int(mesh.shape[axis_name])
+
+    def body(params, state_stacked, batch):
+        state = jax.tree.map(lambda s: s[0], state_stacked)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+
+        flat_g, _ = ravel_pytree(grads)
+        flat_p, unravel = ravel_pytree(params)
+        total = flat_p.shape[0]
+        padded = ((total + n - 1) // n) * n
+        k = padded // n
+        flat_g = jnp.pad(flat_g, (0, padded - total))
+        flat_p = jnp.pad(flat_p, (0, padded - total))
+
+        # Average-reduce-scatter: each rank owns the reduced shard r.
+        g_shard = lax.psum_scatter(flat_g, axis_name, tiled=True) / n
+        idx = lax.axis_index(axis_name)
+        p_shard = lax.dynamic_slice(flat_p, (idx * k,), (k,))
+
+        updates, new_state = optimizer.update(g_shard, state, p_shard)
+        new_p_shard = optax.apply_updates(p_shard, updates)
+
+        new_flat = lax.all_gather(new_p_shard, axis_name, tiled=True)
+        new_params = unravel(new_flat[:total])
+        loss = lax.pmean(loss, axis_name)
+        return (
+            new_params,
+            jax.tree.map(lambda s: s[None], new_state),
+            loss,
+        )
+
+    fn = jax.jit(
+        _shard_map(
+            body, mesh,
+            in_specs=(P(), P(axis_name), P(axis_name)),
+            out_specs=(P(), P(axis_name), P()),
+        ),
+        donate_argnums=(0, 1) if donate else (),
+    )
+    return fn
