@@ -108,8 +108,16 @@ def _parse_args(argv=None):
              "driver's capture window so failures surface as structured "
              "JSON, not an external kill)",
     )
+    parser.add_argument(
+        "--zero1", action="store_true",
+        help="transformer: shard optimizer state over the data axis "
+             "(ZeRO-1; parallel/zero.py) instead of replicating it",
+    )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
-    return parser.parse_args(argv)
+    args = parser.parse_args(argv)
+    if args.zero1 and args.model != "transformer":
+        parser.error("--zero1 is implemented for --model transformer only")
+    return args
 
 
 def _force_platform(platform: str, cpu_devices: int) -> None:
@@ -246,12 +254,15 @@ def _step_flops(step_fn, *inputs) -> float | None:
     return flops
 
 
-def _mfu(flops_per_step, steps_per_iter, best_dt, n_chips, device):
+def _mfu(flops_per_step, steps_per_iter, best_dt, device):
     """Model-FLOPs utilization vs the chip's peak bf16 rate (None off-TPU
-    or when cost analysis is unavailable)."""
+    or when cost analysis is unavailable). ``flops_per_step`` is PER
+    DEVICE: the lowered shard_map module is the per-device SPMD program,
+    so its cost analysis already excludes other chips' shards (verified:
+    equal per-chip batch gives equal flops at 1 and 8 devices)."""
     if flops_per_step is None:
         return None
-    achieved = flops_per_step * steps_per_iter / best_dt / n_chips
+    achieved = flops_per_step * steps_per_iter / best_dt
     peak = _peak_flops(device)
     return round(achieved / peak, 4) if peak else None
 
@@ -355,7 +366,6 @@ def run_lm_benchmark(args) -> int:
     params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     tx = optax.adamw(3e-4)
-    opt_state = tx.init(params)
 
     def loss_fn(p, tok, lab):
         logits = model.apply({"params": p}, tok)
@@ -363,12 +373,30 @@ def run_lm_benchmark(args) -> int:
             logits, lab
         ).mean()
 
-    def step(p, s, tok, lab):
-        loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
-        grads = hvdj.allreduce_gradients(grads)
-        updates, s = tx.update(grads, s, p)
-        p = optax.apply_updates(p, updates)
-        return p, s, jax.lax.pmean(loss, "data")
+    if args.zero1:
+        # Optimizer state sharded 1/n_chips over the data axis; the
+        # gradient allreduce becomes reduce-scatter + all-gather around
+        # the shard-local update (parallel/zero.py).
+        from horovod_tpu.parallel.zero import init_zero1_state, zero1_update
+
+        opt_state = init_zero1_state(tx, params, n_chips)
+
+        def step(p, s_stacked, tok, lab):
+            s = jax.tree.map(lambda x: x[0], s_stacked)
+            loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
+            p, s = zero1_update(
+                tx, p, s, grads, axis_name="data", n_shards=n_chips
+            )
+            return (p, jax.tree.map(lambda x: x[None], s),
+                    jax.lax.pmean(loss, "data"))
+    else:
+        opt_state = tx.init(params)
+
+        def step(p, s, tok, lab):
+            loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
+            updates, s = tx.update(hvdj.allreduce_gradients(grads), s, p)
+            p = optax.apply_updates(p, updates)
+            return p, s, jax.lax.pmean(loss, "data")
 
     def scan_steps(p, s, tok, lab):
         def body(carry, _):
@@ -381,12 +409,14 @@ def run_lm_benchmark(args) -> int:
         )
         return p, s, losses[-1]
 
+    state_spec = P("data") if args.zero1 else P()
+
     def _jit(f):
         return jax.jit(
             _shard_map(
                 f, mesh,
-                in_specs=(P(), P(), P("data"), P("data")),
-                out_specs=P(),
+                in_specs=(P(), state_spec, P("data"), P("data")),
+                out_specs=(P(), state_spec, P()),
             ),
             donate_argnums=(0, 1),
         )
@@ -425,8 +455,7 @@ def run_lm_benchmark(args) -> int:
 
     total = float(np.mean(tok_secs))
     per_chip = total / n_chips
-    mfu = _mfu(flops_per_step, steps_per_iter, min(iter_times), n_chips,
-               devices[0])
+    mfu = _mfu(flops_per_step, steps_per_iter, min(iter_times), devices[0])
 
     print(json.dumps({
         "metric": "transformer_synthetic_tokens_per_sec_per_chip",
@@ -443,9 +472,10 @@ def run_lm_benchmark(args) -> int:
             "platform": devices[0].platform,
             "device_kind": getattr(devices[0], "device_kind", "unknown"),
             "attention": "pallas-flash (interpret off-TPU)",
+            "optimizer_state": "zero1-sharded" if args.zero1 else "replicated",
             "scan": bool(args.scan),
             "mfu": mfu,
-            "flops_per_step": (
+            "flops_per_step_per_chip": (
                 round(flops_per_step) if flops_per_step else None
             ),
             "backend_init_s": round(init_s, 1),
@@ -619,7 +649,7 @@ def run_benchmark(args) -> int:
     per_chip = total / n_chips
 
     mfu = _mfu(flops_per_step, args.num_batches_per_iter,
-               min(iter_times), n_chips, devices[0])
+               min(iter_times), devices[0])
 
     detail = {
         "total_img_per_sec": round(total, 2),
@@ -632,7 +662,7 @@ def run_benchmark(args) -> int:
         "scan": bool(args.scan),
         "dtype": "bf16 compute / f32 params",
         "mfu": mfu,
-        "flops_per_step": (
+        "flops_per_step_per_chip": (
             round(flops_per_step) if flops_per_step else None
         ),
         "backend_init_s": round(init_s, 1),

@@ -18,10 +18,11 @@ there is no communication penalty.
 
 The parameter pytree is flattened to one vector (padded to a multiple of
 the axis size), so element-wise optax transforms (sgd, momentum, adam,
-adamw with scalar weight decay, ...) are exact — the update equals plain
-DP bit-for-bit (tested). Transforms that need per-parameter tree
-structure (per-layer masking, lars/lamb trust ratios) need the
-replicated path instead.
+adamw with scalar weight decay, ...) track plain DP to numerical
+tolerance (tested; psum_scatter vs psum reduction order leaves no
+bitwise guarantee). Transforms that need per-parameter tree structure
+(per-layer masking, lars/lamb trust ratios) need the replicated path
+instead.
 """
 
 from __future__ import annotations
@@ -39,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import DATA_AXIS
 
-__all__ = ["init_zero1_state", "make_zero1_train_step"]
+__all__ = ["init_zero1_state", "make_zero1_train_step", "zero1_update"]
 
 
 def _flat_meta(params, n_shards: int):
@@ -64,6 +65,32 @@ def init_zero1_state(optimizer, params, n_shards: int):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
 
+def zero1_update(optimizer, params, state, grads, *,
+                 axis_name: str = DATA_AXIS, n_shards: int):
+    """The ZeRO-1 update inside an existing shard_map/pmap context:
+    reduce-scatter ``grads`` (averaged over the axis), optax-update this
+    rank's flat parameter shard against its 1/N ``state`` (un-stacked, as
+    produced by ``init_zero1_state`` rows), all-gather the new params.
+    Returns ``(new_params, new_state)``. Use ``make_zero1_train_step`` for
+    the packaged whole-step version."""
+    import optax
+
+    flat_p, unravel, total, padded, k = _flat_meta(params, n_shards)
+    flat_g, _ = ravel_pytree(grads)
+    flat_g = jnp.pad(flat_g, (0, padded - total))
+    flat_p = jnp.pad(flat_p, (0, padded - total))
+
+    g_shard = lax.psum_scatter(flat_g, axis_name, tiled=True) / n_shards
+    idx = lax.axis_index(axis_name)
+    p_shard = lax.dynamic_slice(flat_p, (idx * k,), (k,))
+
+    updates, new_state = optimizer.update(g_shard, state, p_shard)
+    new_p_shard = optax.apply_updates(p_shard, updates)
+
+    new_flat = lax.all_gather(new_p_shard, axis_name, tiled=True)
+    return unravel(new_flat[:total]), new_state
+
+
 def make_zero1_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     optimizer,
@@ -76,8 +103,6 @@ def make_zero1_train_step(
     (params, state, loss)``. ``params`` replicated, ``state`` from
     ``init_zero1_state`` (sharded over ``axis_name``), ``batch`` sharded
     on dim0, gradient averaging over the axis."""
-    import optax
-
     from ..jax import _shard_map
 
     n = int(mesh.shape[axis_name])
@@ -85,25 +110,10 @@ def make_zero1_train_step(
     def body(params, state_stacked, batch):
         state = jax.tree.map(lambda s: s[0], state_stacked)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-
-        flat_g, _ = ravel_pytree(grads)
-        flat_p, unravel = ravel_pytree(params)
-        total = flat_p.shape[0]
-        padded = ((total + n - 1) // n) * n
-        k = padded // n
-        flat_g = jnp.pad(flat_g, (0, padded - total))
-        flat_p = jnp.pad(flat_p, (0, padded - total))
-
-        # Average-reduce-scatter: each rank owns the reduced shard r.
-        g_shard = lax.psum_scatter(flat_g, axis_name, tiled=True) / n
-        idx = lax.axis_index(axis_name)
-        p_shard = lax.dynamic_slice(flat_p, (idx * k,), (k,))
-
-        updates, new_state = optimizer.update(g_shard, state, p_shard)
-        new_p_shard = optax.apply_updates(p_shard, updates)
-
-        new_flat = lax.all_gather(new_p_shard, axis_name, tiled=True)
-        new_params = unravel(new_flat[:total])
+        new_params, new_state = zero1_update(
+            optimizer, params, state, grads,
+            axis_name=axis_name, n_shards=n,
+        )
         loss = lax.pmean(loss, axis_name)
         return (
             new_params,

@@ -51,7 +51,7 @@ def test_smoke_cpu_end_to_end():
     assert out["detail"]["platform"] == "cpu"
     assert out["detail"]["n_chips"] == 2
     # FLOPs cost analysis populated => MFU is computable on TPU.
-    assert out["detail"]["flops_per_step"], out["detail"]
+    assert out["detail"]["flops_per_step_per_chip"], out["detail"]
 
 
 def test_failure_emits_structured_json():
