@@ -806,3 +806,46 @@ def test_torch_adasum_optimizer_two_ranks():
     )
     for out in outs:
         assert "TORCH_ADASUM_OK True" in out, outs
+
+
+def test_tf_adasum_optimizer_two_ranks():
+    """TF delta-space Adasum across 2 real ranks: the applied update must
+    equal the NumPy VHDD reference combine of the two ranks' local SGD
+    deltas (reference ``tensorflow/__init__.py:313-407``)."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        from horovod_tpu.ops.adasum import adasum_allreduce_reference
+        hvd.init()
+        r = hvd.rank()
+        w = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+        hvd.broadcast_variables([w], root_rank=0)
+        w0 = w.numpy().copy()
+        lr = 0.1
+        opt = hvd.DistributedOptimizer(
+            tf.keras.optimizers.SGD(lr), op=hvd.Adasum
+        )
+        x = tf.eye(2)
+        y = tf.fill((2, 2), float(r + 1))
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((tf.matmul(x, w) - y) ** 2)
+        g = tape.gradient(loss, [w])
+        opt.apply_gradients(zip(g, [w]))
+        # Reconstruct both ranks' deltas from the shared start point.
+        deltas = []
+        for rr in range(2):
+            yy = np.full((2, 2), float(rr + 1), np.float32)
+            grad = (2.0 / 4.0) * (w0 - yy)  # d/dw mean((w-y)^2), eye(2) x
+            deltas.append((-lr * grad).ravel())
+        expected = w0.ravel() + adasum_allreduce_reference(deltas)
+        got = w.numpy().ravel()
+        ok = np.allclose(got, expected, rtol=1e-5, atol=1e-6)
+        print("TF_ADASUM_OK", bool(ok), got.tolist(), expected.tolist())
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "TF_ADASUM_OK True" in out, outs
