@@ -345,6 +345,63 @@ def reducescatter(
     return synchronize(reducescatter_async(tensor, name, op))
 
 
+def grouped_allreduce_async(
+    tensors, average: Optional[bool] = None, name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+):
+    """Enqueue a list of tensors back-to-back and return their handles.
+    The coordinator fuses whatever lands in the same cycle into one
+    collective (best-effort grouping, like the core's fusion generally —
+    results are correct and ordered regardless of how the cycle boundary
+    falls). Forward-parity with the later reference's grouped API.
+
+    If an enqueue fails partway, the already-submitted members are
+    synchronized before re-raising so peer ranks are not left waiting on
+    a half-submitted group."""
+    base = name if name is not None else _auto_name("grouped_allreduce", None)
+    handles = []
+    try:
+        for i, t in enumerate(tensors):
+            handles.append(allreduce_async(
+                t, average=average, name=f"{base}.{i}", op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            ))
+    except Exception:
+        for h in handles:
+            try:
+                synchronize(h)
+            except Exception:  # noqa: BLE001 - surfacing the original error
+                pass
+        raise
+    return handles
+
+
+def grouped_allreduce(
+    tensors, average: Optional[bool] = None, name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+):
+    """Synchronous :func:`grouped_allreduce_async`; returns outputs in
+    input order. Every handle is waited on even when one fails, so no
+    results are orphaned in the handle table; the first error wins."""
+    handles = grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    outputs, first_error = [], None
+    for h in handles:
+        try:
+            outputs.append(synchronize(h))
+        except Exception as exc:  # noqa: BLE001 - re-raised below
+            if first_error is None:
+                first_error = exc
+    if first_error is not None:
+        raise first_error
+    return outputs
+
+
 def join() -> None:
     """Signal this rank is out of data; blocks until all ranks join
     (reference ``hvd.join``, ``operations.cc:910-934``)."""
@@ -391,6 +448,8 @@ __all__ = [
     "alltoall_async",
     "reducescatter",
     "reducescatter_async",
+    "grouped_allreduce",
+    "grouped_allreduce_async",
     "join",
     "poll",
     "synchronize",

@@ -182,3 +182,19 @@ def test_reducescatter_rejects_bad_args(hvd_session):
         hvd.reducescatter(jnp.ones((4,)), op=hvd.Min)
     with pytest.raises(ValueError, match="dim0"):
         hvd.reducescatter(jnp.float32(1.0))
+
+
+def test_grouped_allreduce(hvd_session):
+    xs = [jnp.full((3,), float(i), jnp.float32) for i in range(4)]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    assert len(outs) == 4
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, np.full((3,), float(i)))
+
+
+def test_grouped_allreduce_async_and_average(hvd_session):
+    xs = [jnp.ones((2,), jnp.float32) * i for i in range(3)]
+    handles = hvd.grouped_allreduce_async(xs, average=True)
+    outs = [hvd.synchronize(h) for h in handles]
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, np.ones((2,)) * i)
