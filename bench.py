@@ -1,7 +1,9 @@
 #!/usr/bin/env python
-"""Synthetic CNN benchmark — the TPU-native counterpart of the reference's
+"""Synthetic benchmark — the TPU-native counterpart of the reference's
 ``examples/tensorflow2_synthetic_benchmark.py`` (img/sec on synthetic data,
-averaged over timed iterations; ``:119-132``).
+averaged over timed iterations; ``:119-132``). CNN img/s by default;
+``--model transformer`` benchmarks the flash-attention LM in tokens/s
+(optionally ``--zero1`` for sharded optimizer state).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "img/s/chip", "vs_baseline": N}

@@ -1,0 +1,130 @@
+"""Int8-quantized ring allreduce (in-jit, over a named mesh axis).
+
+TPU-native extension inspired by EQuARX (arXiv 2506.17615, listed in
+PAPERS.md): the ring allreduce's two phases move quantized blocks instead
+of full-precision values, cutting wire bytes ~4x (fp32) / ~2x (bf16) at a
+bounded accuracy cost. Each hop of the reduce-scatter phase dequantizes
+the incoming partial into float32, accumulates the local chunk, and
+requantizes before forwarding (per-hop requantization — the accumulation
+itself is never done in int8, so there is no overflow at any world size).
+The all-gather phase forwards completed chunks the same way.
+
+Quantization is symmetric per-chunk int8: ``q = round(v / s)`` with
+``s = max|v| / 127`` (zero-safe). Each of the n-1 reduce-scatter hops
+adds at most half a quantization step of the running partial's scale, so
+the error grows ~sqrt(n) relative to the summed magnitude: measured ~1%
+relative L2 at 8 ranks on iid gradient-like data (the unit tests assert
+<3%). Use where gradient noise of that order is acceptable — the same
+regime the quantized-collective literature targets.
+
+This is the compiled-mode counterpart of the eager wire-compression
+knob (``Compression.fp16``): use it where gradient traffic, not compute,
+bounds step time — e.g. DCN-crossing data-parallel axes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.mesh import DATA_AXIS
+
+__all__ = ["quantized_ring_allreduce"]
+
+
+def _quantize(v: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-block int8: returns (q int8, scale f32)."""
+    amax = jnp.max(jnp.abs(v))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale
+
+
+def _pack(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """One wire payload per hop: int8 values ++ the scale's 4 raw bytes
+    (EQuARX packs scales with the data the same way — a second permute
+    for a 4-byte scalar would double the launch count)."""
+    sb = lax.bitcast_convert_type(scale.reshape(1), jnp.int8).reshape(-1)
+    return jnp.concatenate([q, sb])
+
+
+def _unpack(buf: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    q = buf[:k]
+    scale = lax.bitcast_convert_type(buf[k:k + 4].reshape(1, 4),
+                                     jnp.float32).reshape(())
+    return q, scale
+
+
+def quantized_ring_allreduce(
+    x: jax.Array,
+    *,
+    axis_name: str = DATA_AXIS,
+    average: bool = False,
+) -> jax.Array:
+    """Sum (or average) ``x`` across ``axis_name`` moving int8 on the wire.
+
+    Must run inside shard_map/pmap with the axis bound. The result has
+    ``x``'s shape and dtype; internal accumulation is float32.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    r = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]  # ring: send to next rank
+
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    total = flat.shape[0]
+    k = -(-total // n)  # ceil
+    flat = jnp.pad(flat, (0, n * k - total))
+    chunks = flat.reshape(n, k)
+
+    def chunk_at(idx):
+        return lax.dynamic_slice(chunks, (idx % n, 0), (1, k))[0]
+
+    # --- reduce-scatter phase: after n-1 hops, rank r holds the complete
+    # sum of chunk (r + 1) mod n.
+    def rs_body(step, partial):
+        wire = lax.ppermute(_pack(*_quantize(partial)), axis_name, fwd)
+        q, s = _unpack(wire, k)
+        # Incoming partial covers chunk (r - step - 1); add our local copy.
+        return _dequantize(q, s) + chunk_at(r - step - 1)
+
+    partial = lax.fori_loop(0, n - 1, rs_body, chunk_at(r))
+
+    # --- all-gather phase: circulate completed chunks; rank r receives
+    # chunk (r - step) mod n at step (owned chunk ids decrease by one per
+    # hop around the ring). Each chunk is quantized ONCE by its owner and
+    # the packed payload is forwarded verbatim, so hops add no error. The
+    # owner writes the DEQUANTIZED value for its own chunk too — every
+    # rank must produce the identical result (the allreduce contract;
+    # keeping the exact partial only locally would let DP replicas drift).
+    q0, s0 = _quantize(partial)
+    out = jnp.zeros((n, k), jnp.float32)
+    out = lax.dynamic_update_slice(
+        out, _dequantize(q0, s0)[None], ((r + 1) % n, 0)
+    )
+    wire0 = _pack(q0, s0)
+
+    def ag_body(step, carry):
+        out, wire = carry
+        wire = lax.ppermute(wire, axis_name, fwd)
+        q, s = _unpack(wire, k)
+        out = lax.dynamic_update_slice(
+            out, _dequantize(q, s)[None], ((r - step) % n, 0)
+        )
+        return out, wire
+
+    out, _ = lax.fori_loop(0, n - 1, ag_body, (out, wire0))
+
+    result = out.reshape(-1)[:total].reshape(orig_shape)
+    if average:
+        result = result / n
+    return result.astype(orig_dtype)

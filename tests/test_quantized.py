@@ -1,0 +1,92 @@
+"""Int8-quantized ring allreduce: numerics vs exact psum, and the wire
+really carries int8 (HLO collective-permute on s8)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.jax import _shard_map
+from horovod_tpu.ops.quantized import quantized_ring_allreduce
+from horovod_tpu.parallel.mesh import build_mesh
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh({"data": N_DEV})
+
+
+def _run(mesh, x_global, **kw):
+    def body(x):
+        return quantized_ring_allreduce(x[0], axis_name="data", **kw)
+
+    fn = jax.jit(
+        _shard_map(body, mesh, in_specs=(P("data"),), out_specs=P("data"))
+    )
+    return np.asarray(fn(x_global))
+
+
+def test_matches_exact_psum_within_quantization_error(mesh):
+    rng = np.random.RandomState(0)
+    # Gradient-like data: zero-mean, smooth magnitudes, odd length (padding).
+    x = rng.randn(N_DEV, 1003).astype(np.float32) * 0.01
+    got = _run(mesh, jnp.asarray(x)).reshape(N_DEV, -1)
+    exact = x.sum(axis=0)
+    for r in range(N_DEV):
+        err = np.abs(got[r] - exact)
+        rel = np.linalg.norm(err) / np.linalg.norm(exact)
+        assert rel < 3e-2, (r, rel)
+
+
+def test_all_ranks_identical(mesh):
+    """The allreduce contract: every rank must produce the SAME result —
+    including each chunk's owner, which must use the dequantized value it
+    broadcast, not its exact local partial (else DP replicas drift)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(N_DEV, 257).astype(np.float32)
+    got = _run(mesh, jnp.asarray(x)).reshape(N_DEV, -1)
+    for r in range(1, N_DEV):
+        np.testing.assert_array_equal(got[0], got[r])
+
+
+def test_average_and_dtype_preserved(mesh):
+    x = np.linspace(-1, 1, N_DEV * 64, dtype=np.float32).reshape(N_DEV, 64)
+    got = _run(mesh, jnp.asarray(x), average=True).reshape(N_DEV, -1)
+    exact = x.mean(axis=0)
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got[0], exact, atol=8e-3)
+
+    xb = jnp.asarray(x, jnp.bfloat16)
+    got_b = _run(mesh, xb)
+    assert got_b.dtype == jnp.bfloat16
+
+
+def test_wire_is_int8(mesh):
+    def body(x):
+        return quantized_ring_allreduce(x[0], axis_name="data")
+
+    fn = jax.jit(
+        _shard_map(body, mesh, in_specs=(P("data"),), out_specs=P("data"))
+    )
+    text = fn.lower(jnp.ones((N_DEV, 256), jnp.float32)).as_text()
+    assert "collective-permute" in text or "collective_permute" in text, text[:500]
+    # The bulk payload permutes as int8 (MLIR `xi8` / HLO `s8`); scales
+    # ride as f32 scalars.
+    assert "xi8>" in text or "s8[" in text, "no int8 payload in lowered HLO"
+
+
+def test_single_device_axis_identity():
+    mesh1 = build_mesh({"data": 1}, devices=jax.devices()[:1])
+
+    def body(x):
+        return quantized_ring_allreduce(x[0], axis_name="data")
+
+    fn = jax.jit(
+        _shard_map(body, mesh1, in_specs=(P("data"),), out_specs=P("data"))
+    )
+    x = jnp.arange(16.0).reshape(1, 16)
+    np.testing.assert_allclose(np.asarray(fn(x)), np.asarray(x).reshape(-1))
