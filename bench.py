@@ -115,10 +115,18 @@ def _parse_args(argv=None):
         help="transformer: shard optimizer state over the data axis "
              "(ZeRO-1; parallel/zero.py) instead of replicating it",
     )
+    parser.add_argument(
+        "--quantized", action="store_true",
+        help="transformer: int8-wire ring allreduce for the gradient "
+             "buckets (ops/quantized.py; ~1%% gradient noise at 8 ranks)",
+    )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.zero1 and args.model != "transformer":
         parser.error("--zero1 is implemented for --model transformer only")
+    if args.quantized and (args.model != "transformer" or args.zero1):
+        parser.error("--quantized applies to --model transformer "
+                     "(replicated-optimizer path) only")
     return args
 
 
@@ -396,7 +404,10 @@ def run_lm_benchmark(args) -> int:
 
         def step(p, s, tok, lab):
             loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
-            updates, s = tx.update(hvdj.allreduce_gradients(grads), s, p)
+            grads = hvdj.allreduce_gradients(
+                grads, quantized=args.quantized
+            )
+            updates, s = tx.update(grads, s, p)
             p = optax.apply_updates(p, updates)
             return p, s, jax.lax.pmean(loss, "data")
 
@@ -475,6 +486,7 @@ def run_lm_benchmark(args) -> int:
             "device_kind": getattr(devices[0], "device_kind", "unknown"),
             "attention": "pallas-flash (interpret off-TPU)",
             "optimizer_state": "zero1-sharded" if args.zero1 else "replicated",
+            "gradient_wire": "int8-quantized" if args.quantized else "full-precision",
             "scan": bool(args.scan),
             "mfu": mfu,
             "flops_per_step_per_chip": (

@@ -117,15 +117,46 @@ def allreduce_gradients(
     fusion_threshold_bytes: int = 64 * 1024 * 1024,
     compression=Compression.none,
     hierarchical: bool = False,
+    quantized: bool = False,
 ) -> Any:
     """Fusion-bucketed allreduce of a gradient pytree (in-jit).
 
     The compiled-mode equivalent of the reference's per-gradient
     ``hvd.allreduce`` + background fusion: same-dtype leaves are concatenated
     into buckets up to the fusion threshold and each bucket becomes one XLA
-    collective (see ops/fusion.py).
+    collective (see ops/fusion.py). ``quantized=True`` moves each bucket
+    through the int8-wire ring allreduce (``ops/quantized.py``, ~1%
+    gradient noise at 8 ranks) instead of a full-precision ``psum``.
     """
     axis_name = _normalize_axis(axis_name, hierarchical)
+    if quantized:
+        if hierarchical or op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise ValueError(
+                "quantized=True supports flat SUM/AVERAGE reduction only"
+            )
+        if compression is not Compression.none:
+            raise ValueError(
+                "quantized=True already compresses the wire to int8; "
+                "stacking cast compression would add loss for no "
+                "bandwidth win"
+            )
+
+        def _quantized_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
+                                 postscale_factor=1.0):
+            from ..ops.quantized import quantized_ring_allreduce
+
+            if prescale_factor != 1.0:
+                x = x * prescale_factor
+            out = quantized_ring_allreduce(
+                x, axis_name=axis_name, average=(op == ReduceOp.AVERAGE)
+            )
+            if postscale_factor != 1.0:
+                out = out * postscale_factor
+            return out
+
+        reduce_fn = _quantized_reduce_fn
+    else:
+        reduce_fn = _select_reduce_fn(op, hierarchical)
     if compression is not Compression.none:
         leaves, treedef = jax.tree.flatten(grads)
         compressed = [compression.compress(l) for l in leaves]
@@ -136,7 +167,7 @@ def allreduce_gradients(
         op=op,
         axis_name=axis_name,
         threshold_bytes=fusion_threshold_bytes,
-        reduce_fn=_select_reduce_fn(op, hierarchical),
+        reduce_fn=reduce_fn,
     )
     if compression is not Compression.none:
         leaves, treedef = jax.tree.flatten(reduced)
@@ -153,6 +184,7 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     fusion_threshold_bytes: int = 64 * 1024 * 1024,
     compression=Compression.none,
     hierarchical: bool = False,
+    quantized: bool = False,
     backward_passes_per_step: int = 1,
 ):
     """Wrap an optax ``GradientTransformation`` so its update first
@@ -180,6 +212,7 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             fusion_threshold_bytes=fusion_threshold_bytes,
             compression=compression,
             hierarchical=hierarchical,
+            quantized=quantized,
         )
         if prescale != 1.0:
             reduced = jax.tree.map(lambda g: g * prescale, reduced)
@@ -215,6 +248,7 @@ def make_train_step(
     fusion_threshold_bytes: int = 64 * 1024 * 1024,
     compression=Compression.none,
     hierarchical: bool = False,
+    quantized: bool = False,
     donate: bool = True,
     has_aux: bool = False,
 ):
@@ -247,6 +281,7 @@ def make_train_step(
             fusion_threshold_bytes=fusion_threshold_bytes,
             compression=compression,
             hierarchical=hierarchical,
+            quantized=quantized,
         )
         loss = lax.pmean(loss, axis_name)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)

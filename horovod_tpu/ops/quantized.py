@@ -9,8 +9,11 @@ requantizes before forwarding (per-hop requantization — the accumulation
 itself is never done in int8, so there is no overflow at any world size).
 The all-gather phase forwards completed chunks the same way.
 
-Quantization is symmetric per-chunk int8: ``q = round(v / s)`` with
-``s = max|v| / 127`` (zero-safe). Each of the n-1 reduce-scatter hops
+Quantization is symmetric BLOCKWISE int8 (one f32 scale per
+``BLOCK=256`` elements): ``q = round(v / s)`` with ``s = max|block| /
+127`` (zero-safe), so a small-magnitude gradient leaf packed into a
+fusion bucket next to a large one keeps its own scales instead of
+rounding to zero against a global amax. Each of the n-1 reduce-scatter hops
 adds at most half a quantization step of the running partial's scale, so
 the error grows ~sqrt(n) relative to the summed magnitude: measured ~1%
 relative L2 at 8 ranks on iid gradient-like data (the unit tests assert
@@ -35,31 +38,44 @@ from ..parallel.mesh import DATA_AXIS
 __all__ = ["quantized_ring_allreduce"]
 
 
+# Elements sharing one scale. Small enough that a low-magnitude gradient
+# leaf (layernorm/bias) packed into a fusion bucket next to a large-
+# magnitude one keeps its own scales instead of rounding to zero against
+# the bucket's global amax; 4 scale bytes per 256 payload bytes = 1.6%
+# wire overhead.
+BLOCK = 256
+
+
 def _quantize(v: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Symmetric per-block int8: returns (q int8, scale f32)."""
-    amax = jnp.max(jnp.abs(v))
+    """Symmetric blockwise int8: (q int8 [m], scales f32 [m/BLOCK]).
+    ``m`` must be a multiple of BLOCK (callers pad)."""
+    vb = v.reshape(-1, BLOCK)
+    amax = jnp.max(jnp.abs(vb), axis=1, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
-    q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8)
-    return q, scale
+    q = jnp.clip(jnp.round(vb / scale), -127, 127).astype(jnp.int8)
+    return q.reshape(-1), scale.reshape(-1)
 
 
-def _dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
-    return q.astype(jnp.float32) * scale
+def _dequantize(q: jax.Array, scales: jax.Array) -> jax.Array:
+    vb = q.astype(jnp.float32).reshape(-1, BLOCK) * scales[:, None]
+    return vb.reshape(-1)
 
 
-def _pack(q: jax.Array, scale: jax.Array) -> jax.Array:
-    """One wire payload per hop: int8 values ++ the scale's 4 raw bytes
+def _pack(q: jax.Array, scales: jax.Array) -> jax.Array:
+    """One wire payload per hop: int8 values ++ the scales' raw bytes
     (EQuARX packs scales with the data the same way — a second permute
-    for a 4-byte scalar would double the launch count)."""
-    sb = lax.bitcast_convert_type(scale.reshape(1), jnp.int8).reshape(-1)
+    for the scale vector would double the launch count)."""
+    sb = lax.bitcast_convert_type(scales, jnp.int8).reshape(-1)
     return jnp.concatenate([q, sb])
 
 
 def _unpack(buf: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     q = buf[:k]
-    scale = lax.bitcast_convert_type(buf[k:k + 4].reshape(1, 4),
-                                     jnp.float32).reshape(())
-    return q, scale
+    nb = k // BLOCK
+    scales = lax.bitcast_convert_type(
+        buf[k:k + 4 * nb].reshape(nb, 4), jnp.float32
+    ).reshape(-1)
+    return q, scales
 
 
 def quantized_ring_allreduce(
@@ -83,6 +99,7 @@ def quantized_ring_allreduce(
     flat = x.astype(jnp.float32).reshape(-1)
     total = flat.shape[0]
     k = -(-total // n)  # ceil
+    k = -(-k // BLOCK) * BLOCK  # chunk length a multiple of the scale block
     flat = jnp.pad(flat, (0, n * k - total))
     chunks = flat.reshape(n, k)
 
