@@ -350,6 +350,31 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     optimizer.load_state_dict(state_dict)
 
 
+def allgather_object(obj, name: Optional[str] = None) -> list:
+    """Gather one picklable object per rank; every rank gets the full
+    rank-ordered list (later-reference API, included for completeness).
+    Rides the uneven (Allgatherv-parity) dim0 allgather, so payload sizes
+    may differ per rank."""
+    import pickle
+
+    import numpy as np
+    import torch
+
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    sizes = allgather(
+        torch.tensor([len(data)], dtype=torch.int64),
+        name=f"{name or 'gather_obj'}.size",
+    )
+    payload = allgather(
+        torch.from_numpy(data), name=f"{name or 'gather_obj'}.data"
+    ).numpy()
+    out, off = [], 0
+    for n in sizes.tolist():
+        out.append(pickle.loads(payload[off:off + n].tobytes()))
+        off += n
+    return out
+
+
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
     """Broadcast an arbitrary picklable object (later-reference API,
     included for completeness)."""

@@ -849,3 +849,25 @@ def test_tf_adasum_optimizer_two_ranks():
     )
     for out in outs:
         assert "TF_ADASUM_OK True" in out, outs
+
+
+def test_allgather_object_two_ranks():
+    """Per-rank picklables of DIFFERENT sizes gather into the same
+    rank-ordered list everywhere (rides the Allgatherv-parity path)."""
+    outs = _run_workers(
+        """
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        r = hvd.rank()
+        objs = hvd.allgather_object({"rank": r, "pad": "z" * (10 + 100 * r)})
+        ok = (len(objs) == 2
+              and objs[0]["rank"] == 0 and len(objs[0]["pad"]) == 10
+              and objs[1]["rank"] == 1 and len(objs[1]["pad"]) == 110)
+        print("GATHER_OBJ_OK", bool(ok))
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "GATHER_OBJ_OK True" in out, outs

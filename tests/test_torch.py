@@ -216,3 +216,8 @@ def test_adasum_optimizer_delta_space_single_rank():
             opt.step()
     for pa, pb in zip(model_a.parameters(), model_b.parameters()):
         assert torch.allclose(pa, pb, atol=1e-6), (pa, pb)
+
+
+def test_allgather_object_single_rank():
+    out = hvd.allgather_object({"rank": hvd.rank(), "blob": "x" * 10})
+    assert out == [{"rank": 0, "blob": "x" * 10}]
