@@ -748,6 +748,20 @@ def _fail_json(args, error: str, **detail) -> None:
     Metric/unit must match what a SUCCESSFUL run of the same model would
     print, or the failure files under a metric that never exists."""
     lm = args.model == "transformer"
+    # Point at the most recent committed capture of this metric (if any):
+    # a dead backend should not erase the evidence a healthier day left.
+    committed = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_TPU_{args.model.upper()}.json",
+    )
+    try:
+        with open(committed) as f:
+            detail["last_committed_tpu_capture"] = json.load(f)
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError) as e:
+        print(f"[bench] committed capture {committed} unreadable: {e!r}",
+              file=sys.stderr)
     print(
         json.dumps({
             "metric": (f"{args.model}_synthetic_tokens_per_sec_per_chip"
