@@ -284,7 +284,11 @@ class Core {
   bool wake_ = false;
   bool eager_wakeup_ = true;
   double linger_s_ = 0.0;
-  double last_enqueue_ = 0.0;  // guarded by table_mu_
+  double last_enqueue_ = 0.0;      // guarded by table_mu_
+  // Burst history for the adaptive linger; starts at 2 ("assume burst")
+  // so the cold-start cycle keeps the full fusion window — only observed
+  // solo traffic enables the fast seal. Guarded by table_mu_.
+  size_t last_cycle_nreq_ = 2;
   bool joined_ = false;
   uint64_t join_ticket_ = 0;
 

@@ -341,8 +341,9 @@ Status Core::Init(const CoreConfig& cfg) {
                          cfg.hierarchical_allgather != 0,
                          cfg.cache_capacity > 0, grid);
   // Event-driven cycle wakeup (HOROVOD_TPU_EAGER_WAKEUP=0 restores the
-  // reference's pure fixed-cadence behavior); linger defaults to a
-  // quarter cycle, capped at 500us.
+  // reference's pure fixed-cadence behavior); the full fusion linger
+  // defaults to half a cycle, capped at 2ms (isolated requests seal
+  // after a 100us grace instead — see BackgroundLoop).
   if (const char* e = std::getenv("HOROVOD_TPU_EAGER_WAKEUP")) {
     eager_wakeup_ = std::string(e) != "0";
   }
@@ -550,20 +551,60 @@ void Core::BackgroundLoop() {
     if (shutdown_.load()) break;
     if (woke_early && linger_s_ > 0) {
       // Quiescence-based fusion window: wait until no new submission has
-      // arrived for linger_s_ (each arrival restarts the window), bounded
-      // by one cycle_time — a burst with gaps under the linger always
-      // fuses fully, which the fixed-cadence design only guaranteed when
-      // the burst happened to fit the remaining cycle phase.
+      // arrived for the window (each arrival restarts it), bounded by one
+      // cycle_time — a burst with gaps under the linger always fuses
+      // fully, which the fixed-cadence design only guaranteed when the
+      // burst happened to fit the remaining cycle phase.
+      //
+      // Adaptive width: a lone request with no fusion in the previous
+      // cycle is the isolated-collective pattern (eager framework call,
+      // latency-sensitive) — seal immediately; even a 100us grace costs
+      // 3-5x that in sleep-quantum overshoot on a busy host. Bursts
+      // (DistributedOptimizer gradient hooks enqueue many tensors per
+      // step) get the full window: detected either by >1 request already
+      // queued at wake, or by the previous cycle having fused >1 (so a
+      // steady training loop keeps its fusion window from the second
+      // step on; at worst the very first burst splits across cycles
+      // once, which negotiation handles as stragglers).
+      double window;
+      {
+        std::lock_guard<std::mutex> l(table_mu_);
+        window = (queued_.size() <= 1 && last_cycle_nreq_ <= 1)
+                     ? -1.0
+                     : linger_s_;
+      }
+      if (window < 0) {
+        // Solo grace: yield-spin up to 100us (never longer than the full
+        // window — HOROVOD_TPU_LINGER_US below 100 must keep solo the
+        // faster path) watching for burst companions: a producer
+        // mid-burst gets the core on yield and enqueues the rest; a
+        // truly lone caller is already blocked in synchronize. sleep_for
+        // here would overshoot 3-5x on a busy host — the spin keeps the
+        // seal tight.
+        const double grace = std::min(1e-4, linger_s_);
+        double start = NowSec();
+        while (!shutdown_.load() && NowSec() - start < grace) {
+          {
+            std::lock_guard<std::mutex> l(table_mu_);
+            if (queued_.size() > 1) {
+              window = linger_s_;
+              break;
+            }
+          }
+          std::this_thread::yield();
+        }
+      }
       double start = NowSec();
-      while (!shutdown_.load() && NowSec() - start < cycle_s) {
+      while (window > 0 && !shutdown_.load() &&
+             NowSec() - start < cycle_s) {
         double since;
         {
           std::lock_guard<std::mutex> l(table_mu_);
           since = NowSec() - last_enqueue_;
         }
-        if (since >= linger_s_) break;
+        if (since >= window) break;
         std::this_thread::sleep_for(
-            std::chrono::duration<double>(linger_s_ - since));
+            std::chrono::duration<double>(window - since));
       }
     }
     RunCycleOnce();
@@ -608,6 +649,11 @@ void Core::RunCycleOnce() {
     std::lock_guard<std::mutex> l(table_mu_);
     mine.requests = std::move(queued_);
     queued_.clear();
+    // Burst history for the adaptive linger: only non-empty cycles count
+    // (idle cadence ticks between training steps must not erase the
+    // "this workload fuses" signal, or every step's burst would re-enter
+    // the solo fast-seal path and serialize per-tensor).
+    if (!mine.requests.empty()) last_cycle_nreq_ = mine.requests.size();
   }
   if (cache_.capacity() > 0 && params_.cache_enabled()) {
     // Response-cache fast path (reference controller.cc:157-186): an

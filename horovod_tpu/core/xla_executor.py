@@ -114,6 +114,14 @@ class XlaPlanExecutor(PlanExecutor):
             NamedSharding(self._mesh2, P(_CROSS_AXIS, _LOCAL_AXIS))
             if self._mesh2 is not None else None
         )
+        # dim0-sharded grid variant for the zero-copy device path: the
+        # local array is its own shard of a (size*d0, *rest) global
+        # (cross-major, local-minor). The flat-mesh case reuses
+        # self._sharding (P(_RANK_AXIS) shards dim0 either way).
+        self._sharding2_dim0 = (
+            NamedSharding(self._mesh2, P((_CROSS_AXIS, _LOCAL_AXIS)))
+            if self._mesh2 is not None else None
+        )
         self._fn_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
 
@@ -132,16 +140,24 @@ class XlaPlanExecutor(PlanExecutor):
         return self._knob(name)
 
     def _wrap(self, body, hier: bool, n_in: int = 1, n_out: int = 1,
-              donate: bool = False):
+              donate: bool = False, dim0: bool = False):
         """shard_map+jit a plan body over the flat rank mesh or the
         (cross, local) grid. ``donate`` aliases the carrier buffer into the
         output (persistent-fusion-buffer behavior); only set it when the
-        executor owns the input arrays."""
+        executor owns the input arrays. ``dim0`` selects the zero-copy
+        layout where dim0 itself is sharded (the body receives the local
+        block with no leading rank axes)."""
         import jax
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
 
-        in_spec = P(_CROSS_AXIS, _LOCAL_AXIS) if hier else P(_RANK_AXIS)
+        if hier:
+            # dim0 layout shards dim0 by BOTH grid axes (cross-major);
+            # the host layout carries explicit (cross, local) lead axes.
+            in_spec = (P((_CROSS_AXIS, _LOCAL_AXIS)) if dim0
+                       else P(_CROSS_AXIS, _LOCAL_AXIS))
+        else:
+            in_spec = P(_RANK_AXIS)
         fn = _shard_map(
             body, self._mesh2 if hier else self._mesh,
             in_specs=(in_spec,) * n_in,
@@ -189,21 +205,21 @@ class XlaPlanExecutor(PlanExecutor):
 
     def _global_from_device(self, x, hierarchical: bool = False):
         """Wrap this rank's device-resident array as its shard of the global
-        array — no host round-trip; the reshape stays on device."""
+        array with ZERO device ops: the global shape is (size*d0, *rest)
+        sharded on dim0 (cross-major, local-minor on the 2-D grid, matching
+        rank = cross*local_size + local), so the local array IS its shard —
+        no reshape dispatch, no host round-trip, pure aliasing metadata.
+        Scalars take the one-element-reshape slow path."""
         import jax
 
-        lead = (1, 1) if hierarchical else (1,)
-        local = x.reshape(lead + x.shape)
-        if hierarchical:
-            gshape = (
-                self._topo.cross_size, self._topo.local_size
-            ) + tuple(x.shape)
-            sharding = self._sharding2
-        else:
-            gshape = (self._topo.size,) + tuple(x.shape)
-            sharding = self._sharding
+        if x.ndim == 0:
+            x = x.reshape(1)
+        gshape = (self._topo.size * x.shape[0],) + tuple(x.shape[1:])
+        sharding = (
+            self._sharding2_dim0 if hierarchical else self._sharding
+        )
         return jax.make_array_from_single_device_arrays(
-            gshape, sharding, [local]
+            gshape, sharding, [x]
         )
 
     def _compiled(self, key: Tuple, builder):
@@ -370,7 +386,8 @@ class XlaPlanExecutor(PlanExecutor):
 
         def build():
             def body(*xs):
-                vs = [(x[0, 0] if hier else x[0]).reshape(-1) for x in xs]
+                # dim0 layout: each block is this rank's tensor verbatim.
+                vs = [x.reshape(-1) for x in xs]
                 v = vs[0] if len(vs) == 1 else jnp.concatenate(vs)
                 r = self._reduce_flat(
                     v, op=op, adasum=adasum, hier=hier, pre=pre, post=post,
@@ -386,7 +403,7 @@ class XlaPlanExecutor(PlanExecutor):
                 return tuple(outs)
 
             return self._wrap(
-                body, hier, n_in=len(entries), n_out=len(entries)
+                body, hier, n_in=len(entries), n_out=len(entries), dim0=True
             )
 
         garrs = [
@@ -530,11 +547,16 @@ class XlaPlanExecutor(PlanExecutor):
                     f"divisible by size ({n})"
                 )
             on_device = self._device_resident(e.tensor)
-            key = ("rs", str(e.tensor.dtype), shape, reduce_op, participants)
+            key = ("rs", str(e.tensor.dtype), shape, reduce_op, participants,
+                   on_device)
 
             def build():
                 def body(x):
-                    out = rs_lowering(x[0], axis_name=_RANK_AXIS)
+                    # Host layout carries a leading rank axis; the device
+                    # (dim0-sharded) layout is the local block verbatim.
+                    out = rs_lowering(
+                        x if on_device else x[0], axis_name=_RANK_AXIS
+                    )
                     if reduce_op == int(ReduceOp.AVERAGE):
                         out = (
                             out / np.asarray(participants, dtype=np.float32)
