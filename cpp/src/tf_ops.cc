@@ -1,0 +1,251 @@
+// TensorFlow graph-native collective ops for horovod_tpu.
+//
+// Role parity with the reference's custom-op extension
+// (`horovod/tensorflow/mpi_ops.cc:287-339`): real AsyncOpKernels so a
+// `tf.function` graph executes collectives as first-class graph nodes —
+// no PyFunc/EagerPyFunc hop — with the TF executor never blocked (the
+// kernel enqueues and returns; completion fires the done callback from
+// the runtime's executor thread).
+//
+// TPU-native division of labor: this kernel is control-plane only. It
+// hands the host buffer to the Python-side runtime (negotiation in the
+// C++ core, data plane = compiled XLA collectives) through a trampoline
+// registered at import, and the runtime finishes the op through
+// hvd_tf_finish() below, which allocates the output (dynamically shaped
+// ops like allgather only know their shape post-negotiation, like the
+// reference's post-coordination AllocateOutput) and copies the result.
+//
+// Built separately from libhvd_core.so because it needs the TensorFlow
+// and Python headers: `make tf_ops`, or automatically on first use by
+// horovod_tpu/tensorflow/graph_ops.py:_build (same recipe).
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+using tensorflow::AsyncOpKernel;
+using tensorflow::OpKernelConstruction;
+using tensorflow::OpKernelContext;
+using tensorflow::Tensor;
+using tensorflow::TensorShape;
+
+namespace {
+
+// Python trampoline: called (with the GIL) as
+//   trampoline(handle, kind, ptr, shape_tuple, tf_dtype, name,
+//              root_rank, reduce_op, prescale, postscale)
+// and must arrange for hvd_tf_finish(handle, ...) to be called exactly
+// once from any thread.
+PyObject* g_trampoline = nullptr;
+
+struct PendingOp {
+  OpKernelContext* ctx;
+  AsyncOpKernel::DoneCallback done;
+};
+
+std::mutex g_mu;
+std::unordered_map<long long, PendingOp> g_pending;
+long long g_next_handle = 0;
+
+class HvdCollectiveOp : public AsyncOpKernel {
+ public:
+  explicit HvdCollectiveOp(OpKernelConstruction* c, std::string kind)
+      : AsyncOpKernel(c), kind_(std::move(kind)) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &tensor_name_));
+    if (c->HasAttr("reduce_op")) c->GetAttr("reduce_op", &reduce_op_);
+    if (c->HasAttr("root_rank")) c->GetAttr("root_rank", &root_rank_);
+    if (c->HasAttr("prescale_factor")) c->GetAttr("prescale_factor", &pre_);
+    if (c->HasAttr("postscale_factor")) c->GetAttr("postscale_factor", &post_);
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    long long handle;
+    {
+      std::lock_guard<std::mutex> l(g_mu);
+      handle = ++g_next_handle;
+      g_pending[handle] = {ctx, std::move(done)};
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    bool ok = false;
+    if (g_trampoline != nullptr) {
+      PyObject* shape = PyTuple_New(input.dims());
+      for (int i = 0; i < input.dims(); ++i) {
+        PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(input.dim_size(i)));
+      }
+      PyObject* r = PyObject_CallFunction(
+          g_trampoline, "LsKOisiidd", handle, kind_.c_str(),
+          (unsigned long long)(uintptr_t)input.tensor_data().data(), shape,
+          static_cast<int>(input.dtype()), tensor_name_.c_str(), root_rank_,
+          reduce_op_, pre_, post_);
+      Py_DECREF(shape);
+      if (r != nullptr) {
+        ok = true;
+        Py_DECREF(r);
+      } else {
+        PyErr_Print();
+      }
+    }
+    PyGILState_Release(st);
+    if (!ok) {
+      PendingOp p;
+      {
+        std::lock_guard<std::mutex> l(g_mu);
+        p = std::move(g_pending[handle]);
+        g_pending.erase(handle);
+      }
+      p.ctx->CtxFailure(tensorflow::errors::Internal(
+          "horovod_tpu graph-op trampoline missing or raised"));
+      p.done();
+    }
+  }
+
+ private:
+  std::string kind_;
+  std::string tensor_name_;
+  int reduce_op_ = 0;
+  int root_rank_ = -1;
+  float pre_ = 1.0f;
+  float post_ = 1.0f;
+};
+
+#define DEFINE_KIND_KERNEL(cls, kind)                       \
+  class cls : public HvdCollectiveOp {                      \
+   public:                                                  \
+    explicit cls(OpKernelConstruction* c)                   \
+        : HvdCollectiveOp(c, kind) {}                       \
+  };
+
+DEFINE_KIND_KERNEL(HvdAllreduceOp, "allreduce")
+DEFINE_KIND_KERNEL(HvdAllgatherOp, "allgather")
+DEFINE_KIND_KERNEL(HvdBroadcastOp, "broadcast")
+DEFINE_KIND_KERNEL(HvdAlltoallOp, "alltoall")
+
+using tensorflow::shape_inference::InferenceContext;
+
+REGISTER_OP("HorovodTpuAllreduce")
+    .Attr(
+        "T: {float16, bfloat16, float32, float64, int32, int64, uint8, int8}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int = 1")
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Input("tensor: T")
+    .Output("sum: T")
+    .SetShapeFn([](InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HorovodTpuAllgather")
+    .Attr(
+        "T: {float16, bfloat16, float32, float64, int32, int64, uint8, int8}")
+    .Attr("tensor_name: string")
+    .Input("tensor: T")
+    .Output("gathered: T")
+    .SetShapeFn([](InferenceContext* c) {
+      // dim 0 becomes the cross-rank concatenation; only its rank is known
+      // statically (reference mpi_ops.cc shape fn does the same).
+      tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(
+          c->input(0), 0, c->UnknownDim(), &out));
+      c->set_output(0, out);
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HorovodTpuBroadcast")
+    .Attr(
+        "T: {float16, bfloat16, float32, float64, int32, int64, uint8, int8}")
+    .Attr("tensor_name: string")
+    .Attr("root_rank: int")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HorovodTpuAlltoall")
+    .Attr(
+        "T: {float16, bfloat16, float32, float64, int32, int64, uint8, int8}")
+    .Attr("tensor_name: string")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuAllreduce").Device(tensorflow::DEVICE_CPU),
+    HvdAllreduceOp);
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuAllgather").Device(tensorflow::DEVICE_CPU),
+    HvdAllgatherOp);
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuBroadcast").Device(tensorflow::DEVICE_CPU),
+    HvdBroadcastOp);
+REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuAlltoall").Device(tensorflow::DEVICE_CPU),
+    HvdAlltoallOp);
+
+}  // namespace
+
+extern "C" {
+
+// Registered once at import: `fn` is a Python callable (borrowed ref is
+// upgraded to a strong one).
+void hvd_tf_set_trampoline(PyObject* fn) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  Py_XDECREF(g_trampoline);
+  g_trampoline = fn;
+  Py_XINCREF(g_trampoline);
+  PyGILState_Release(st);
+}
+
+// Completion path, called from the runtime's executor thread (ctypes
+// releases the GIL around this call, so done() may run TF work inline
+// without deadlocking). Allocates the output with the post-negotiation
+// shape and copies `data` (nbytes) into it. status != 0 fails the op
+// with `error`.
+void hvd_tf_finish(long long handle, int status, const char* error,
+                   const void* data, const long long* dims, int ndims,
+                   long long nbytes) {
+  PendingOp p;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    auto it = g_pending.find(handle);
+    if (it == g_pending.end()) return;
+    p = std::move(it->second);
+    g_pending.erase(it);
+  }
+  if (status != 0) {
+    p.ctx->CtxFailure(tensorflow::errors::Internal(
+        error != nullptr ? error : "horovod_tpu collective failed"));
+    p.done();
+    return;
+  }
+  TensorShape shape;
+  for (int i = 0; i < ndims; ++i) shape.AddDim(dims[i]);
+  Tensor* out = nullptr;
+  tensorflow::Status s = p.ctx->allocate_output(0, shape, &out);
+  if (!s.ok()) {
+    p.ctx->CtxFailure(s);
+    p.done();
+    return;
+  }
+  if (nbytes > 0) {
+    std::memcpy(const_cast<char*>(out->tensor_data().data()), data,
+                static_cast<size_t>(nbytes));
+  }
+  p.done();
+}
+
+}  // extern "C"

@@ -10,9 +10,11 @@ Data path (the role of the reference's graph-native HorovodAllreduceOp,
 ``tensorflow/mpi_ops.cc:287-339``): EagerTensors hand their buffer to the
 XLA data plane **zero-copy via DLPack** — no ``.numpy()`` host copy — and
 ride the eager executor's device-resident fast path; results come back the
-same way. Inside ``tf.function`` graphs the op body runs under
-``tf.py_function`` (whose EagerTensors take the identical DLPack path), and
-every collective carries a registered gradient via ``tf.custom_gradient``
+same way. Inside ``tf.function`` graphs collectives execute as
+**graph-native custom AsyncOpKernels** (``HorovodTpu*`` nodes,
+``cpp/src/tf_ops.cc`` — compiled on first use against the installed TF;
+``tf.py_function`` remains only as the no-toolchain fallback), and every
+collective carries a registered gradient via ``tf.custom_gradient``
 (parity with the reference's RegisterGradient set,
 ``tensorflow/mpi_ops.py:107-198``), so allreduce/allgather/broadcast are
 differentiable in both eager and graph mode.
@@ -105,12 +107,59 @@ def _np_op(fn, tensor, *args, keep_shape=True, **kwargs):
         tensor = tf.convert_to_tensor(tensor)
     if tf.executing_eagerly() and hasattr(tensor, "numpy"):
         return run(tensor)
+    # Graph mode: emit a first-class HorovodTpu* node (AsyncOpKernel,
+    # cpp/src/tf_ops.cc) — no PyFunc/EagerPyFunc in the concrete graph,
+    # parity with the reference's compiled op (mpi_ops.cc:287-339).
+    out = _graph_dispatch(fn, tensor, *args, **kwargs)
+    if out is not None:
+        return out
     out = tf.py_function(run, [tensor], Tout=tensor.dtype)
     if keep_shape:
         out.set_shape(tensor.shape)
     elif tensor.shape.rank is not None:
         out.set_shape([None] + list(tensor.shape)[1:])
     return out
+
+
+def _graph_dispatch(fn, tensor, *args, **kwargs):
+    """Map an eager-runtime collective call onto its graph-native custom
+    op. Returns None when the op library is unavailable (py_function
+    fallback) or ``fn`` has no graph twin.
+
+    Contract with the ``_np_op`` call sites: ``name`` always travels as a
+    keyword; ``broadcast``'s root rank is the sole positional extra (it
+    is positional-required in the eager fn too). Keeping the protocol
+    keyword-based means a call-site refactor cannot silently desync the
+    tensor names negotiated across ranks."""
+    from . import graph_ops
+
+    ops = graph_ops.load()
+    if ops is None:
+        return None
+    name = kwargs.get("name")
+    if fn is _allreduce_np:
+        return ops.horovod_tpu_allreduce(
+            tensor,
+            tensor_name=name or graph_ops.auto_name("allreduce"),
+            reduce_op=int(kwargs.get("op", ReduceOp.SUM)),
+            prescale_factor=float(kwargs.get("prescale_factor", 1.0)),
+            postscale_factor=float(kwargs.get("postscale_factor", 1.0)),
+        )
+    if fn is _allgather_np:
+        return ops.horovod_tpu_allgather(
+            tensor, tensor_name=name or graph_ops.auto_name("allgather")
+        )
+    if fn is _broadcast_np:
+        return ops.horovod_tpu_broadcast(
+            tensor,
+            tensor_name=name or graph_ops.auto_name("broadcast"),
+            root_rank=int(args[0]),
+        )
+    if fn is _alltoall_np:
+        return ops.horovod_tpu_alltoall(
+            tensor, tensor_name=name or graph_ops.auto_name("alltoall")
+        )
+    return None
 
 
 def allreduce(tensor, average=None, device_dense="", device_sparse="",
@@ -175,7 +224,7 @@ def allgather(tensor, name=None):
 
     @tf.custom_gradient
     def _ag(x):
-        y = _np_op(_allgather_np, x, name, keep_shape=False)
+        y = _np_op(_allgather_np, x, name=name, keep_shape=False)
 
         def grad(dy):
             # Reference gradient (mpi_ops.py:140-163): sum the upstream
@@ -186,7 +235,7 @@ def allgather(tensor, name=None):
             d0 = tf.reshape(tf.cast(tf.shape(x)[0], tf.int32), [1])
             sizes = tf.reshape(
                 _np_op(_allgather_np, d0,
-                       f"{name}.grad.sizes" if name else None,
+                       name=f"{name}.grad.sizes" if name else None,
                        keep_shape=False),
                 [size()],
             )
@@ -202,7 +251,7 @@ def broadcast(tensor, root_rank, name=None):
 
     @tf.custom_gradient
     def _bc(x):
-        y = _np_op(_broadcast_np, x, root_rank, name)
+        y = _np_op(_broadcast_np, x, root_rank, name=name)
 
         def grad(dy):
             # Reference gradient (mpi_ops.py:185-198): allreduce the
@@ -222,14 +271,14 @@ def alltoall(tensor, name=None):
 
     @tf.custom_gradient
     def _a2a(x):
-        y = _np_op(_alltoall_np, x, name)
+        y = _np_op(_alltoall_np, x, name=name)
 
         def grad(dy):
             # alltoall with equal splits is an involution: routing the
             # upstream gradient back through it returns each shard home
             # (TPU-native extension; the reference has no alltoall).
             return _np_op(_alltoall_np, dy,
-                          f"{name}.grad" if name else None)
+                          name=f"{name}.grad" if name else None)
 
         return y, grad
 

@@ -1,0 +1,238 @@
+"""Graph-native TF collectives: real custom AsyncOpKernels.
+
+Role parity with the reference's compiled TF extension
+(``horovod/tensorflow/mpi_ops.cc:287-339``): inside a ``tf.function``
+graph, collectives execute as first-class ``HorovodTpu*`` graph nodes —
+no ``PyFunc``/``EagerPyFunc`` hop, shape inference declared at
+registration, and the TF executor never blocked (the kernel enqueues
+into the runtime and returns; the runtime's executor thread finishes the
+op through the library's ``hvd_tf_finish``, which allocates the output
+with the post-negotiation shape — how dynamically-shaped allgather
+works, like the reference's post-coordination ``AllocateOutput``).
+
+The kernel source is ``cpp/src/tf_ops.cc``; it is compiled on first use
+against the installed TensorFlow's headers (``tf.sysconfig``) and cached
+next to ``libhvd_core.so``. When TF or a toolchain is unavailable the
+binding falls back to the ``tf.py_function`` path transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu")
+
+_lock = threading.Lock()
+_state: dict = {"tried": False, "ops": None, "cdll": None}
+
+# TF DataType enum -> numpy dtype (DT_* values are stable public ABI).
+_TF_DTYPE_TO_NP = {
+    1: np.float32,    # DT_FLOAT
+    2: np.float64,    # DT_DOUBLE
+    3: np.int32,      # DT_INT32
+    4: np.uint8,      # DT_UINT8
+    6: np.int8,       # DT_INT8
+    9: np.int64,      # DT_INT64
+    19: np.float16,   # DT_HALF
+}
+
+
+def _np_dtype(tf_enum: int):
+    if tf_enum == 14:  # DT_BFLOAT16
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(_TF_DTYPE_TO_NP[tf_enum])
+
+
+def _lib_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "cpp", "libhvd_tf_ops.so",
+    )
+
+
+def _src_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "cpp", "src", "tf_ops.cc",
+    )
+
+
+def _build(src: str, out: str) -> None:
+    """Compile the op library with the installed TF's flags (the same
+    recipe the reference's setup.py uses for its TF extension, reduced
+    to one translation unit)."""
+    import tensorflow as tf
+
+    # Compile to a per-process temp file and rename into place: rename is
+    # atomic, so concurrent ranks on a fresh checkout never load a
+    # half-linked library, and a killed build leaves no corrupt cache.
+    tmp = f"{out}.build.{os.getpid()}"
+    cmd = (
+        ["g++", "-O2", "-std=c++17", "-fPIC", "-shared", src, "-o", tmp]
+        + tf.sysconfig.get_compile_flags()
+        + tf.sysconfig.get_link_flags()
+        + [f"-I{sysconfig.get_paths()['include']}"]
+    )
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=600
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"tf_ops build failed: {proc.stderr[-2000:]}"
+            )
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _trampoline(handle, kind, ptr, shape, tf_dtype, name, root_rank,
+                reduce_op, prescale, postscale):
+    """Called (with the GIL) from the kernel's ComputeAsync on a TF
+    executor thread. Enqueues into the eager runtime and returns
+    immediately; completion calls back into the library."""
+    from .. import _rt
+    from ..common.types import ReduceOp
+
+    cdll = _state["cdll"]
+    np_dtype = _np_dtype(tf_dtype)
+    n = 1
+    for d in shape:
+        n *= d
+    buf = (ctypes.c_char * (n * np_dtype.itemsize)).from_address(ptr)
+    view = np.frombuffer(buf, dtype=np_dtype).reshape(shape)
+
+    def finish_error(msg: str) -> None:
+        cdll.hvd_tf_finish(
+            ctypes.c_longlong(handle), 1, msg.encode(), None, None, 0,
+            ctypes.c_longlong(0),
+        )
+
+    # The data plane computes in 32-bit (jax x64 disabled); a 64-bit int
+    # payload that cannot round-trip must fail loudly, matching the eager
+    # binding's guard.
+    if np_dtype in (np.dtype(np.int64),) and view.size:
+        if not np.array_equal(view.astype(np.int32).astype(np.int64), view):
+            finish_error(
+                "int64 payload exceeds int32 range: the XLA data plane "
+                "runs with x64 disabled"
+            )
+            return
+
+    def callback(status, output) -> None:
+        try:
+            if not status.ok():
+                finish_error(status.reason or "collective failed")
+                return
+            out = np.asarray(output)
+            if out.dtype != np_dtype:
+                out = out.astype(np_dtype)
+            out = np.ascontiguousarray(out)
+            dims = (ctypes.c_longlong * max(out.ndim, 1))(*(
+                out.shape if out.ndim else (1,)
+            ))
+            cdll.hvd_tf_finish(
+                ctypes.c_longlong(handle), 0, b"",
+                out.ctypes.data_as(ctypes.c_void_p), dims, out.ndim,
+                ctypes.c_longlong(out.nbytes),
+            )
+        except Exception as exc:  # noqa: BLE001 - must never lose done()
+            logger.exception("tf graph-op completion failed")
+            try:
+                finish_error(str(exc))
+            except Exception:  # noqa: BLE001
+                pass
+
+    try:
+        rt = _rt()
+        if kind == "allreduce":
+            rt.enqueue_allreduce(
+                name, view, reduce_op=ReduceOp(reduce_op),
+                prescale_factor=prescale, postscale_factor=postscale,
+                callback=callback,
+            )
+        elif kind == "allgather":
+            rt.enqueue_allgather(name, view, callback=callback)
+        elif kind == "broadcast":
+            rt.enqueue_broadcast(name, view, root_rank, callback=callback)
+        elif kind == "alltoall":
+            rt.enqueue_alltoall(name, view, callback=callback)
+        else:
+            finish_error(f"unknown collective kind {kind!r}")
+    except Exception as exc:  # noqa: BLE001
+        finish_error(str(exc))
+
+
+def load():
+    """Build (if stale) + load the op library and register the
+    trampoline. Returns the TF op module, or None when unavailable."""
+    with _lock:
+        if _state["tried"]:
+            return _state["ops"]
+        _state["tried"] = True
+        try:
+            import tensorflow as tf
+
+            src, out = _src_path(), _lib_path()
+            if not os.path.exists(out) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(out)
+            ):
+                _build(src, out)
+            try:
+                ops = tf.load_op_library(out)
+            except Exception:
+                # A cached library from another TF build (or a corrupt
+                # file) fails to load; rebuild once before giving up.
+                _build(src, out)
+                ops = tf.load_op_library(out)
+            cdll = ctypes.CDLL(out)
+            cdll.hvd_tf_set_trampoline.argtypes = [ctypes.py_object]
+            cdll.hvd_tf_set_trampoline.restype = None
+            cdll.hvd_tf_finish.argtypes = [
+                ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_int, ctypes.c_longlong,
+            ]
+            cdll.hvd_tf_finish.restype = None
+            cdll.hvd_tf_set_trampoline(_trampoline)
+            _state["ops"] = ops
+            _state["cdll"] = cdll
+        except Exception as exc:  # noqa: BLE001
+            logger.warning(
+                "graph-native TF ops unavailable (%s); tf.function "
+                "collectives fall back to py_function", exc,
+            )
+            _state["ops"] = None
+        return _state["ops"]
+
+
+def available() -> bool:
+    return load() is not None
+
+
+_name_counter = [0]
+_name_lock = threading.Lock()
+
+
+def auto_name(prefix: str) -> str:
+    """Deterministic per-trace names: all ranks trace the same program in
+    the same order, so the counter sequence matches across ranks (the
+    reference gets the same property from TF node-name uniquification)."""
+    with _name_lock:
+        _name_counter[0] += 1
+        return f"{prefix}.graph.{_name_counter[0]}"
