@@ -76,6 +76,12 @@ struct Request {
   double postscale = 1.0;
   std::string name;
   std::vector<int64_t> shape;
+  // First-class grouped collectives (grouped_allreduce): nonzero id ties
+  // members together; the coordinator holds the group until all
+  // group_size members are ready on every rank and fuses them into one
+  // response regardless of cycle boundaries or the fusion threshold.
+  int64_t group_id = 0;
+  int32_t group_size = 0;
 
   int64_t NumElements() const {
     int64_t n = 1;
@@ -112,6 +118,9 @@ struct Response {
   // Number of ranks contributing real (non-zero-substituted) tensors —
   // the correct Average divisor under Join.
   int32_t participants = 0;
+  // Nonzero for grouped responses (kept out of the response cache: the
+  // cache-bit path cannot carry group membership).
+  int64_t group_id = 0;
 };
 
 struct ResponseList {

@@ -251,6 +251,7 @@ class Core {
 
   double cycle_time_ms() const { return params_.cycle_time_ms(); }
   bool eager_wakeup() const { return eager_wakeup_; }
+  long long grouped_splits() const { return grouped_splits_.load(); }
   int64_t fusion_threshold() const { return params_.fusion_threshold(); }
   int tuned_flags() const { return params_.Flags(); }
 
@@ -282,6 +283,9 @@ class Core {
   std::vector<Request> queued_;
   std::condition_variable wake_cv_;
   bool wake_ = false;
+  // Groups that could not fuse into a single response (heterogeneous
+  // member signatures): observability for grouped_allreduce.
+  std::atomic<long long> grouped_splits_{0};
   bool eager_wakeup_ = true;
   double linger_s_ = 0.0;
   double last_enqueue_ = 0.0;      // guarded by table_mu_
@@ -302,6 +306,14 @@ class Core {
     // reference MPI_Allgatherv mpi_operations.cc:83-162).
     std::map<int32_t, int64_t> dim0;
   };
+  // First-class grouped collectives (coordinator state, rank 0 only):
+  // members of a group are held here once all-ranks-ready until every
+  // group_size member arrives, then emitted in one cycle (and fused into
+  // one response per signature, exempt from the fusion threshold). A
+  // member that fails validation poisons the whole group.
+  std::map<int64_t, std::set<std::string>> group_ready_;
+  // gid -> (error message, members still expected to arrive and fail)
+  std::map<int64_t, std::pair<std::string, int>> group_poisoned_;
   std::map<std::string, Negotiation> negotiating_;
   std::set<int32_t> joined_ranks_;
 

@@ -141,6 +141,7 @@ int hvd_core_cross_size() { return Core::Get().config().cross_size; }
 long long hvd_core_enqueue(int request_type, const char* name, int dtype,
                            const long long* shape, int ndim, int root_rank,
                            int reduce_op, double prescale, double postscale,
+                           long long group_id, int group_size,
                            char* err, int errlen) {
   Request req;
   req.rank = Core::Get().config().rank;
@@ -150,6 +151,8 @@ long long hvd_core_enqueue(int request_type, const char* name, int dtype,
   req.reduce_op = reduce_op;
   req.prescale = prescale;
   req.postscale = postscale;
+  req.group_id = group_id;
+  req.group_size = group_size;
   req.name = name ? name : "";
   for (int i = 0; i < ndim; ++i) req.shape.push_back(shape[i]);
   uint64_t ticket = 0;
@@ -159,6 +162,10 @@ long long hvd_core_enqueue(int request_type, const char* name, int dtype,
     return -static_cast<long long>(s.code);
   }
   return static_cast<long long>(ticket);
+}
+
+long long hvd_core_grouped_splits() {
+  return Core::Get().grouped_splits();
 }
 
 long long hvd_core_enqueue_join(char* err, int errlen) {

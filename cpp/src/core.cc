@@ -662,7 +662,12 @@ void Core::RunCycleOnce() {
     // (deterministically identical) cache.
     std::vector<Request> full;
     for (auto& req : mine.requests) {
-      int32_t bit = req.type == RequestType::kJoin ? -1 : cache_.Lookup(req);
+      // Grouped requests never ride the cache-bit path: a bit cannot
+      // carry group membership, and the group barrier needs the full
+      // request at the coordinator.
+      int32_t bit = (req.type == RequestType::kJoin || req.group_id != 0)
+                        ? -1
+                        : cache_.Lookup(req);
       if (bit >= 0) {
         SetBit(mine.cache_bits, bit);
       } else {
@@ -823,10 +828,72 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
   // A tensor is ready when announced by all non-joined ranks (reference:
   // count == size - joined_size).
   int needed = cfg_.size - static_cast<int>(joined_ranks_.size());
-  std::vector<std::string> done;
+  std::vector<std::string> ready_names;
   for (auto& [name, neg] : negotiating_) {
     if (static_cast<int>(neg.ranks.size()) >= needed) {
-      done.push_back(name);
+      ready_names.push_back(name);
+    }
+  }
+  // First-class groups: a grouped member is held (stays in negotiating_)
+  // until every group_size member is all-ranks-ready, then the whole
+  // group emits in one cycle — fusion can then pack it into one response
+  // regardless of where cycle boundaries fell between member enqueues.
+  // A member that failed validation poisons the group: every ready and
+  // future member fails with the same message rather than deadlocking
+  // the incomplete group.
+  std::vector<std::string> done;
+  std::set<std::string> done_set;  // guards double-emission: a member a
+                                   // failing peer already pushed must not
+                                   // re-enter the poison machinery when
+                                   // its own ready_names turn comes.
+  auto push_done = [&](const std::string& n) {
+    if (done_set.insert(n).second) done.push_back(n);
+  };
+  for (auto& name : ready_names) {
+    if (done_set.count(name)) continue;
+    auto& neg = negotiating_[name];
+    int64_t gid = neg.request.group_id;
+    if (gid == 0) {
+      push_done(name);
+      continue;
+    }
+    auto pit = group_poisoned_.find(gid);
+    if (neg.error || pit != group_poisoned_.end()) {
+      if (!neg.error) {
+        neg.error = true;
+        neg.error_msg = pit->second.first;
+      } else if (pit == group_poisoned_.end()) {
+        // First failing member: poison the group and fail the members
+        // already held ready.
+        auto msg = "grouped collective failed: " + neg.error_msg;
+        int remaining = neg.request.group_size - 1;
+        auto git = group_ready_.find(gid);
+        if (git != group_ready_.end()) {
+          for (auto& m : git->second) {
+            auto& mneg = negotiating_[m];
+            mneg.error = true;
+            mneg.error_msg = msg;
+            push_done(m);
+            --remaining;
+          }
+          group_ready_.erase(git);
+        }
+        if (remaining > 0) {
+          group_poisoned_[gid] = {msg, remaining};
+        }
+        neg.error_msg = msg;
+      }
+      if (pit != group_poisoned_.end() && --pit->second.second <= 0) {
+        group_poisoned_.erase(pit);
+      }
+      push_done(name);
+      continue;
+    }
+    auto& members = group_ready_[gid];
+    members.insert(name);
+    if (static_cast<int32_t>(members.size()) >= neg.request.group_size) {
+      for (auto& m : members) push_done(m);
+      group_ready_.erase(gid);
     }
   }
   // Keep deterministic dispatch order across ranks: sort by name (the map
@@ -882,14 +949,21 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
 
 void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
   // Greedy same-signature fusion with lookahead (reference FuseResponses):
-  // allreduce/adasum responses pack up to the fusion threshold.
+  // allreduce/adasum responses pack up to the fusion threshold. Grouped
+  // members fuse with their own group only, EXEMPT from the threshold
+  // (the group explicitly requested one collective); a group whose
+  // members have heterogeneous signatures emits one response per
+  // signature and counts as a split (observability: grouped_splits()).
   int64_t threshold = params_.fusion_threshold();
   std::vector<bool> used(ready.size(), false);
   int participants = cfg_.size - static_cast<int>(joined_ranks_.size());
+  std::map<int64_t, int> group_responses;
   for (size_t i = 0; i < ready.size(); ++i) {
     if (used[i]) continue;
     const Request& base = ready[i];
+    if (base.group_id != 0) ++group_responses[base.group_id];
     Response r;
+    r.group_id = base.group_id;
     r.type = static_cast<ResponseType>(static_cast<uint8_t>(base.type));
     r.dtype = base.dtype;
     r.root_rank = base.root_rank;
@@ -920,13 +994,17 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
       for (size_t j = i + 1; j < ready.size(); ++j) {
         if (used[j]) continue;
         const Request& cand = ready[j];
+        if (cand.group_id != base.group_id) continue;
         if (cand.type != base.type || cand.dtype != base.dtype ||
             cand.reduce_op != base.reduce_op ||
             cand.prescale != base.prescale ||
             cand.postscale != base.postscale) {
           continue;
         }
-        if (r.total_bytes + cand.ByteSize() > threshold) continue;
+        if (base.group_id == 0 &&
+            r.total_bytes + cand.ByteSize() > threshold) {
+          continue;
+        }
         r.names.push_back(cand.name);
         r.entry_shapes.push_back(cand.shape);
         r.total_bytes += cand.ByteSize();
@@ -935,6 +1013,14 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
     }
     out->responses.push_back(std::move(r));
   }
+  for (auto& [gid, n] : group_responses) {
+    if (n > 1) {
+      grouped_splits_ += n - 1;
+      HVD_LOG(kWarn, "grouped collective " + std::to_string(gid) +
+                         " split into " + std::to_string(n) +
+                         " responses (heterogeneous member signatures)");
+    }
+  }
 }
 
 void Core::DispatchResponses(const ResponseList& rl) {
@@ -942,7 +1028,7 @@ void Core::DispatchResponses(const ResponseList& rl) {
     if (cache_.capacity() > 0) {
       if (resp.type == ResponseType::kError) {
         for (const auto& name : resp.names) cache_.Invalidate(name);
-      } else if (resp.type != ResponseType::kJoin &&
+      } else if (resp.type != ResponseType::kJoin && resp.group_id == 0 &&
                  (rl.tuned_flags >= 0 ? (rl.tuned_flags & 4) != 0
                                       : params_.cache_enabled())) {
         // Gate on the DELIVERING VERDICT's flags, not live tuner state:

@@ -252,6 +252,7 @@ def allreduce_async(
     op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    _group: tuple = (0, 0),
 ) -> int:
     rop = _resolve_op(average, op)
     rt = _rt()
@@ -262,6 +263,7 @@ def allreduce_async(
             tensor,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
+            group_id=_group[0], group_size=_group[1],
         )
     return rt.enqueue_allreduce(
         tensor_name,
@@ -269,6 +271,7 @@ def allreduce_async(
         reduce_op=rop,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
+        group_id=_group[0], group_size=_group[1],
     )
 
 
@@ -350,16 +353,28 @@ def grouped_allreduce_async(
     op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0, postscale_factor: float = 1.0,
 ):
-    """Enqueue a list of tensors back-to-back and return their handles.
-    The coordinator fuses whatever lands in the same cycle into one
-    collective (best-effort grouping, like the core's fusion generally —
-    results are correct and ordered regardless of how the cycle boundary
-    falls). Forward-parity with the later reference's grouped API.
+    """Enqueue a list of tensors as ONE first-class group and return
+    their handles. The group travels with the requests (a stable id +
+    member count), and the coordinator holds members until every one is
+    ready on every rank, then fuses them into a single collective
+    regardless of cycle boundaries or the fusion threshold — the
+    semantics of the later reference's grouped API, not best-effort
+    cycle fusion. Members with heterogeneous dtypes/signatures execute
+    as one plan per signature (observable via the core's
+    grouped_splits counter).
 
-    If an enqueue fails partway, the already-submitted members are
-    synchronized before re-raising so peer ranks are not left waiting on
-    a half-submitted group."""
+    If an enqueue fails partway on THIS rank, the already-submitted
+    members are synchronized before re-raising; peer ranks that
+    submitted the full group see the incomplete group as stalled (the
+    stall inspector warns and can shut the job down) — validate inputs
+    before submission when cross-rank failure atomicity matters."""
     base = name if name is not None else _auto_name("grouped_allreduce", None)
+    tensors = list(tensors)
+    import hashlib
+
+    gid = int.from_bytes(
+        hashlib.md5(base.encode()).digest()[:8], "little"
+    ) or 1
     handles = []
     try:
         for i, t in enumerate(tensors):
@@ -367,6 +382,7 @@ def grouped_allreduce_async(
                 t, average=average, name=f"{base}.{i}", op=op,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
+                _group=(gid, len(tensors)),
             ))
     except Exception:
         for h in handles:

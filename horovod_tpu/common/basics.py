@@ -67,8 +67,11 @@ def load() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ctypes.c_longlong, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_int,
     ]
+    lib.hvd_core_grouped_splits.restype = ctypes.c_longlong
+    lib.hvd_core_grouped_splits.argtypes = []
     lib.hvd_core_enqueue_join.restype = ctypes.c_longlong
     lib.hvd_core_enqueue_join.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_core_next_plan.restype = ctypes.c_int
@@ -139,17 +142,24 @@ class NativeCore:
 
     def enqueue(self, request_type: int, name: str, dtype: int,
                 shape, root_rank: int, reduce_op: int,
-                prescale: float, postscale: float) -> int:
+                prescale: float, postscale: float,
+                group_id: int = 0, group_size: int = 0) -> int:
         err = ctypes.create_string_buffer(self.ERRBUF)
         arr = (ctypes.c_longlong * len(shape))(*shape)
         ticket = self.lib.hvd_core_enqueue(
             request_type, name.encode(), dtype, arr, len(shape), root_rank,
             reduce_op, ctypes.c_double(prescale), ctypes.c_double(postscale),
+            ctypes.c_longlong(group_id), group_size,
             err, self.ERRBUF,
         )
         if ticket < 0:
             raise _CoreError(-ticket, err.value.decode())
         return int(ticket)
+
+    def grouped_splits(self) -> int:
+        """Groups that could not fuse into a single plan (heterogeneous
+        member signatures) since init."""
+        return int(self.lib.hvd_core_grouped_splits())
 
     def enqueue_join(self) -> int:
         err = ctypes.create_string_buffer(self.ERRBUF)

@@ -141,6 +141,8 @@ class NativeRuntime:
         prescale_factor: float = 1.0,
         postscale_factor: float = 1.0,
         callback: Optional[Callable] = None,
+        group_id: int = 0,
+        group_size: int = 0,
     ) -> int:
         if not self.running:
             raise RuntimeError(
@@ -164,6 +166,7 @@ class NativeRuntime:
             ticket = self.core.enqueue(
                 int(request_type), name, dtype, shape, root_rank,
                 int(reduce_op), prescale_factor, postscale_factor,
+                group_id, group_size,
             )
         except _CoreError as e:
             with self._entries_lock:

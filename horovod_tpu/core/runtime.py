@@ -78,6 +78,12 @@ class Request:
     reduce_op: int = int(ReduceOp.SUM)
     prescale_factor: float = 1.0
     postscale_factor: float = 1.0
+    # First-class grouped collectives: nonzero id ties members; the
+    # coordinator holds the group until all group_size members arrive and
+    # fuses them into one response, threshold-exempt (same semantics as
+    # the native core).
+    group_id: int = 0
+    group_size: int = 0
 
 
 @dataclass
@@ -254,18 +260,33 @@ class Coordinator:
 class SingleProcessCoordinator(Coordinator):
     def __init__(self):
         self._pending: List[Request] = []
+        # gid -> buffered members (first-class groups: held until the
+        # group is complete, emitted as one threshold-exempt response —
+        # the same semantics the native core implements multi-rank).
+        self._groups: Dict[int, List[Request]] = {}
 
     def compute_response_list(
         self, requests: List[Request], queue: TensorQueue, config: Config
     ) -> List[Response]:
         # Everything announced is ready; fuse same-type/dtype/op requests up
         # to the fusion threshold, preserving submission order (reference
-        # FuseResponses, controller.cc:626-750).
+        # FuseResponses, controller.cc:626-750). Grouped members are held
+        # until the whole group arrives, then fuse together regardless of
+        # the threshold.
+        emit: List[Request] = []
+        for req in requests:
+            if req.request_type != RequestType.JOIN and req.group_id:
+                members = self._groups.setdefault(req.group_id, [])
+                members.append(req)
+                if len(members) >= req.group_size:
+                    emit.extend(self._groups.pop(req.group_id))
+            else:
+                emit.append(req)
         responses: List[Response] = []
         current: Optional[Response] = None
         current_key = None
         current_bytes = 0
-        for req in requests:
+        for req in emit:
             if req.request_type == RequestType.JOIN:
                 responses.append(Response(ResponseType.JOIN, [req.tensor_name]))
                 current, current_key = None, None
@@ -273,13 +294,14 @@ class SingleProcessCoordinator(Coordinator):
             rtype = ResponseType(int(req.request_type))
             nbytes = int(np.prod(req.shape or (1,))) * dtype_size_or(req.dtype)
             key = (rtype, req.dtype, req.reduce_op, req.root_rank,
-                   req.prescale_factor, req.postscale_factor)
+                   req.prescale_factor, req.postscale_factor, req.group_id)
             fusable = rtype in (ResponseType.ALLREDUCE, ResponseType.ADASUM)
             if (
                 fusable
                 and current is not None
                 and key == current_key
-                and current_bytes + nbytes <= config.fusion_threshold_bytes
+                and (req.group_id
+                     or current_bytes + nbytes <= config.fusion_threshold_bytes)
             ):
                 current.tensor_names.append(req.tensor_name)
                 current_bytes += nbytes
@@ -439,6 +461,8 @@ class Runtime:
         prescale_factor: float = 1.0,
         postscale_factor: float = 1.0,
         callback: Optional[Callable[[Status, Any], None]] = None,
+        group_id: int = 0,
+        group_size: int = 0,
     ) -> int:
         if self._shutdown.is_set() or self._thread is None:
             raise RuntimeError(
@@ -466,6 +490,8 @@ class Runtime:
             reduce_op=int(reduce_op),
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
+            group_id=group_id,
+            group_size=group_size,
         )
         entry = TensorTableEntry(
             name=name,

@@ -131,8 +131,18 @@ def _graph_dispatch(fn, tensor, *args, **kwargs):
     is positional-required in the eager fn too). Keeping the protocol
     keyword-based means a call-site refactor cannot silently desync the
     tensor names negotiated across ranks."""
+    import tensorflow as tf
+
     from . import graph_ops
 
+    # Dtypes outside the custom op's registered T set (bool, int16,
+    # complex, ...) must keep the py_function path instead of raising a
+    # trace-time TypeError.
+    if tensor.dtype not in (
+        tf.float16, tf.bfloat16, tf.float32, tf.float64,
+        tf.int32, tf.int64, tf.uint8, tf.int8,
+    ):
+        return None
     ops = graph_ops.load()
     if ops is None:
         return None

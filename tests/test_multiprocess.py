@@ -947,3 +947,69 @@ def test_tf_graph_native_collectives_two_ranks():
         # rows: 1 row of 1s*1 (2 cols) + 2 rows of 2s -> sum = 2 + 8 = 10
         assert "GATHER 10.0 [3, 2]" in out, outs
         assert "BCAST [3.0]" in out, outs
+
+
+def test_grouped_allreduce_one_plan_two_ranks():
+    """A 10-member grouped_allreduce under a 1 ms cycle, with enqueues
+    deliberately staggered across many cycle boundaries, executes as ONE
+    fused plan on every rank (first-class groups: the coordinator holds
+    the group until complete — fusion semantics of the later reference's
+    grouped API, controller.cc:626-750 lineage)."""
+    outs = _run_workers(
+        """
+        import time
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        from horovod_tpu.core import xla_executor
+
+        plans = []
+        orig = xla_executor.XlaPlanExecutor.execute
+        def spy(self, plan, entries, topo):
+            plans.append(list(plan.get("names", [])))
+            return orig(self, plan, entries, topo)
+        xla_executor.XlaPlanExecutor.execute = spy
+
+        hvd.init()
+        r = hvd.rank()
+        tensors = [np.full(8, i + 1, np.float32) for i in range(10)]
+        # Stagger the member enqueues well past the 1 ms cycle time so a
+        # cycle-boundary-based grouping would provably split them.
+        base = "grp"
+        handles = []
+        import horovod_tpu
+        gid_handles = hvd.grouped_allreduce_async(
+            tensors, op=hvd.Sum, name=base)
+        outs = [hvd.synchronize(h) for h in gid_handles]
+        for i, o in enumerate(outs):
+            assert np.allclose(np.asarray(o), 2.0 * (i + 1)), (i, o)
+        grp_plans = [p for p in plans if any("grp." in n for n in p)]
+        assert len(grp_plans) == 1, grp_plans
+        assert sorted(grp_plans[0]) == sorted(
+            f"grp.{i}" for i in range(10)), grp_plans
+        print("ONEPLAN", len(grp_plans[0]))
+
+        # Staggered: re-run with sleeps between member announcements via
+        # two explicit enqueue waves — rank skew plus 3 ms gaps spans
+        # multiple cycles; still one plan.
+        plans.clear()
+        import hashlib
+        gid = int.from_bytes(hashlib.md5(b"wave").digest()[:8], "little")
+        hs = []
+        for i in range(10):
+            hs.append(hvd.allreduce_async(
+                tensors[i], op=hvd.Sum, name=f"wave.{i}",
+                _group=(gid, 10)))
+            time.sleep(0.003 * (1 + (r == 0)))
+        outs = [hvd.synchronize(h) for h in hs]
+        wave_plans = [p for p in plans if any("wave." in n for n in p)]
+        assert len(wave_plans) == 1, wave_plans
+        assert len(wave_plans[0]) == 10, wave_plans
+        print("STAGGERED_ONEPLAN", len(wave_plans[0]))
+        hvd.shutdown()
+        """,
+        timeout=300,
+    )
+    for out in outs:
+        assert "ONEPLAN 10" in out, outs
+        assert "STAGGERED_ONEPLAN 10" in out, outs

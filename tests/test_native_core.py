@@ -290,3 +290,56 @@ def test_start_timeout_bounds_rendezvous():
         assert time.monotonic() - t0 < 30
     finally:
         os.environ.pop("HOROVOD_START_TIMEOUT", None)
+
+
+def test_grouped_requests_hold_until_complete(core):
+    # First-class group: members enqueued across different cycles still
+    # emit as ONE plan once the last member lands (the coordinator holds
+    # the group; cycle boundaries are irrelevant).
+    gid = 77
+    core.enqueue(0, "g.0", 7, [4], -1, 2, 1.0, 1.0, gid, 3)
+    # Let several 1 ms cycles pass: the lone member must NOT emit.
+    assert _drain_plans(core, max_plans=1, timeout_ms=120) == []
+    core.enqueue(0, "g.1", 7, [4], -1, 2, 1.0, 1.0, gid, 3)
+    assert _drain_plans(core, max_plans=1, timeout_ms=120) == []
+    core.enqueue(0, "g.2", 7, [4], -1, 2, 1.0, 1.0, gid, 3)
+    plans = _drain_plans(core, max_plans=2, timeout_ms=500)
+    assert len(plans) == 1, plans
+    assert sorted(plans[0]["names"]) == ["g.0", "g.1", "g.2"], plans
+
+
+def test_grouped_fusion_exempt_from_threshold(core):
+    # A group larger than the fusion threshold still fuses into one plan
+    # (the group explicitly requested one collective).
+    import horovod_tpu.common.basics as basics
+
+    gid = 88
+    # 3 x 1 MB f32 with a tiny threshold would normally split; grouped
+    # must not. (Threshold is a Config field read at init; default is
+    # 64 MB, so make the members bigger than a forced-small threshold by
+    # re-initing the core with fusion_threshold=16 bytes.)
+    core.shutdown()
+    c = basics.NativeCore()
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    cfg.fusion_threshold = 16
+    c.init(cfg, SINGLE)
+    try:
+        for i in range(3):
+            c.enqueue(0, f"big.{i}", 7, [64], -1, 2, 1.0, 1.0, gid, 3)
+        plans = _drain_plans(c, max_plans=3, timeout_ms=500)
+        assert len(plans) == 1, plans
+        assert len(plans[0]["names"]) == 3, plans
+    finally:
+        c.shutdown()
+
+
+def test_grouped_heterogeneous_dtypes_split_counted(core):
+    # Mixed-dtype group: one plan per signature, and the split is counted.
+    gid = 99
+    before = core.grouped_splits()
+    core.enqueue(0, "mix.0", 7, [4], -1, 2, 1.0, 1.0, gid, 2)  # f32
+    core.enqueue(0, "mix.1", 4, [4], -1, 2, 1.0, 1.0, gid, 2)  # i32
+    plans = _drain_plans(core, max_plans=3, timeout_ms=500)
+    assert len(plans) == 2, plans
+    assert core.grouped_splits() == before + 1
