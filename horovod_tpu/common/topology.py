@@ -7,10 +7,14 @@ same global/LOCAL/CROSS triple is derived, in priority order, from:
 
 1. ``HOROVOD_RANK``/``HOROVOD_SIZE``/... env vars set by the launcher
    (parity with ``horovod/common/gloo/gloo_context.cc:113-157``),
-2. an already-initialized ``jax.distributed`` runtime: LOCAL = processes in
+2. the megascale multislice env (``MEGASCALE_SLICE_ID`` /
+   ``MEGASCALE_NUM_SLICES`` + ``TPU_WORKER_*``): real multi-slice
+   deployments get the (cross, local) = (DCN, ICI) grid with no
+   hand-set topology vars (``_from_megascale_env``),
+3. an already-initialized ``jax.distributed`` runtime: LOCAL = processes in
    this process's TPU *slice* (one ICI domain, possibly spanning hosts),
    CROSS = across slices over DCN (``topology_from_slice_metadata``),
-3. single-process fallback: rank 0 of 1.
+4. single-process fallback: rank 0 of 1.
 
 The LOCAL axis maps onto ICI and the CROSS axis onto DCN — the analogue of
 the reference's NCCL-local / MPI-cross communicator pair
@@ -133,6 +137,46 @@ def topology_from_slice_metadata(process_index: int,
     )
 
 
+def _from_megascale_env() -> Optional[Topology]:
+    """Multi-slice (DCN) deployment detection from the megascale env —
+    ``MEGASCALE_SLICE_ID`` / ``MEGASCALE_NUM_SLICES``, set per process by
+    the Cloud TPU multislice runtime — combined with the per-slice worker
+    env (``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``). CROSS maps onto
+    the DCN slice axis and LOCAL onto the ICI within-slice workers, with
+    the block layout ``rank = slice_id * workers_per_slice + worker_id``
+    the hierarchical executor assumes — no hand-set ``HOROVOD_*``
+    topology vars needed. The analogue of the reference deriving its
+    LOCAL/CROSS communicators at ``mpi_context.cc:149-158``; here the
+    deployment env IS the authority, which is exactly where the
+    hierarchical (ICI-then-DCN) lowerings earn their keep."""
+    raw = os.environ.get("MEGASCALE_NUM_SLICES")
+    if raw is None:
+        return None
+    try:
+        num_slices = int(raw)
+        slice_id = int(os.environ.get("MEGASCALE_SLICE_ID", "0"))
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        local_size = len([h for h in hostnames.split(",") if h.strip()]) or 1
+        local_rank = int(os.environ.get("TPU_WORKER_ID", "0"))
+    except ValueError:
+        return None
+    # Degenerate env (bad ranges, worker id without the hostname list)
+    # falls through to the next detection source instead of crashing
+    # hvd.init().
+    if not (0 <= slice_id < num_slices and 0 <= local_rank < local_size):
+        return None
+    return Topology(
+        rank=slice_id * local_size + local_rank,
+        size=num_slices * local_size,
+        local_rank=local_rank,
+        local_size=local_size,
+        cross_rank=slice_id,
+        cross_size=num_slices,
+        is_homogeneous=True,
+        source="megascale-env",
+    )
+
+
 def _from_jax_distributed() -> Optional[Topology]:
     try:
         import jax
@@ -165,7 +209,14 @@ def detect() -> Topology:
     topo = _from_env()
     if topo is not None:
         return topo
+    # An already-initialized jax.distributed runtime is authoritative
+    # (its process indices are ground truth and interleaved layouts are
+    # detected); the megascale env is the pre-init inference for real
+    # multislice deployments.
     topo = _from_jax_distributed()
+    if topo is not None:
+        return topo
+    topo = _from_megascale_env()
     if topo is not None:
         return topo
     return Topology(

@@ -127,6 +127,69 @@ def tpu_pod_allocation() -> Optional[List[SlotInfo]]:
     ]
 
 
+def ssh_base_cmd(host, ssh_port=None, batch=False, connect_timeout=None):
+    """The one ssh invocation prefix (options + host) shared by the
+    pre-flight probe and the rank fan-out, so a connectivity option added
+    for one cannot silently diverge from the other."""
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if batch:
+        cmd += ["-o", "BatchMode=yes"]
+    if connect_timeout:
+        cmd += ["-o", f"ConnectTimeout={int(connect_timeout)}"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    return cmd + [host]
+
+
+def check_hosts_reachable(hostnames, ssh_port=None, timeout=8.0,
+                          cache=None):
+    """Fail-fast SSH pre-flight (reference ``run/run.py:62-115`` +
+    ``run/util/cache.py``): every remote host must answer a BatchMode
+    ``ssh <host> true`` before any rank is launched, so a dead host
+    produces one clear per-host message instead of a start-timeout
+    minutes later. Successful probes are cached on disk with a TTL;
+    failures are always re-probed (a fixed host must not stay "down"
+    for the cache lifetime).
+    """
+    import concurrent.futures
+    import subprocess
+
+    remote = [h for h in dict.fromkeys(hostnames) if not _is_local(h)]
+    if not remote:
+        return
+
+    def probe(host):
+        key = f"ssh:{host}:{ssh_port or 22}"
+        if cache is not None and cache.get(key):
+            return host, True
+        cmd = ssh_base_cmd(
+            host, ssh_port, batch=True, connect_timeout=timeout
+        ) + ["true"]
+        try:
+            ok = subprocess.run(
+                cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=timeout + 4,
+            ).returncode == 0
+        except Exception:  # noqa: BLE001 - unreachable is unreachable
+            ok = False
+        if ok and cache is not None:
+            cache.put(key, True)
+        return host, ok
+
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(len(remote), 32)
+    ) as pool:
+        results = list(pool.map(probe, remote))
+    unreachable = sorted(h for h, ok in results if not ok)
+    if unreachable:
+        raise RuntimeError(
+            "hvdrun: unable to connect over ssh to: "
+            + ", ".join(unreachable)
+            + ". Verify the host names in -H/--hostfile are reachable and "
+            "passwordless ssh (BatchMode) is configured."
+        )
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -218,10 +281,7 @@ def launch_job(
                 if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH",
                                  "PYTHONPATH", "LD_LIBRARY"))
             )
-            cmd = [
-                "ssh", "-o", "StrictHostKeyChecking=no",
-                *( ["-p", str(ssh_port)] if ssh_port else [] ),
-                slot.hostname,
+            cmd = ssh_base_cmd(slot.hostname, ssh_port) + [
                 f"cd {_shquote(os.getcwd())} > /dev/null 2>&1 ; "
                 f"{env_str} {' '.join(_shquote(c) for c in command)}",
             ]

@@ -671,10 +671,10 @@ def discover_common_interfaces(
                     f"IFS= read -r _HVDKEY; {env_str} {SECRET_ENV}=\"$_HVDKEY\" "
                     f"{' '.join(shlex.quote(a) for a in argv)}"
                 )
-                port_args = ["-p", str(ssh_port)] if ssh_port else []
+                from .launcher import ssh_base_cmd
+
                 p = subprocess.Popen(
-                    ["ssh", "-o", "StrictHostKeyChecking=no", *port_args,
-                     host, remote],
+                    ssh_base_cmd(host, ssh_port) + [remote],
                     stdin=subprocess.PIPE,
                 )
                 p.stdin.write((encode_key(key) + "\n").encode())

@@ -170,6 +170,26 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     if args.disable_cache:
         args.cache_capacity = 0
+
+    # SSH pre-flight (reference run/run.py:62-115): fail fast with a
+    # per-host message when a remote host is unreachable, instead of a
+    # start-timeout minutes into the launch. Successes are disk-cached
+    # with a TTL so repeated launches skip the probe.
+    if not args.tpu_pod:
+        from .disk_cache import default_cache
+
+        try:
+            launcher.check_hosts_reachable(
+                sorted({s.hostname for s in slots}),
+                ssh_port=args.ssh_port,
+                # --disable-cache governs the launcher check cache too
+                # (reference parity: run/util/cache.py fn_cache).
+                cache=None if args.disable_cache else default_cache(),
+            )
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 4
+
     env = dict(os.environ)
     config_parser.set_env_from_args(env, args)
 

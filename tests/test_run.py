@@ -151,3 +151,90 @@ def test_disable_cache_and_start_timeout_flags():
                        "--start-timeout", "45", "python", "x.py"])
     assert args.disable_cache is True
     assert args.start_timeout == 45
+
+
+def test_ssh_preflight_unreachable_fails_fast(monkeypatch, tmp_path):
+    """Reference run/run.py:62-115 parity: a dead host yields one clear
+    per-host error before any rank launches; ssh is mocked."""
+    import subprocess
+
+    from horovod_tpu.run import launcher
+    from horovod_tpu.run.disk_cache import DiskCache
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        host = cmd[-2]
+
+        class R:
+            returncode = 0 if host == "good-host" else 255
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    cache = DiskCache(str(tmp_path / "c.json"), ttl_seconds=300)
+    with pytest.raises(RuntimeError) as e:
+        launcher.check_hosts_reachable(
+            ["good-host", "bad-host", "localhost"], cache=cache
+        )
+    assert "bad-host" in str(e.value)
+    assert "good-host" not in str(e.value)
+    # localhost is never probed.
+    assert all("localhost" not in c for c in calls)
+
+
+def test_ssh_preflight_caches_successes(monkeypatch, tmp_path):
+    import subprocess
+
+    from horovod_tpu.run import launcher
+    from horovod_tpu.run.disk_cache import DiskCache
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    cache = DiskCache(str(tmp_path / "c.json"), ttl_seconds=300)
+    launcher.check_hosts_reachable(["h1", "h2"], cache=cache)
+    assert len(calls) == 2
+    # Second launch: cache hits, no ssh spawned.
+    launcher.check_hosts_reachable(["h1", "h2"], cache=cache)
+    assert len(calls) == 2
+    # Expired TTL re-probes.
+    expired = DiskCache(str(tmp_path / "c.json"), ttl_seconds=0)
+    launcher.check_hosts_reachable(["h1"], cache=expired)
+    assert len(calls) == 3
+
+
+def test_ssh_preflight_failure_not_cached(monkeypatch, tmp_path):
+    import subprocess
+
+    from horovod_tpu.run import launcher
+    from horovod_tpu.run.disk_cache import DiskCache
+
+    rc = {"v": 255}
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = rc["v"]
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    cache = DiskCache(str(tmp_path / "c.json"), ttl_seconds=300)
+    with pytest.raises(RuntimeError):
+        launcher.check_hosts_reachable(["flaky"], cache=cache)
+    # Host fixed: must re-probe (failures are never cached) and pass.
+    rc["v"] = 0
+    launcher.check_hosts_reachable(["flaky"], cache=cache)
+    assert len(calls) == 2

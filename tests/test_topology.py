@@ -63,3 +63,72 @@ def test_interleaved_process_indices_disable_grid():
     assert not t.is_homogeneous
     # Sizes still describe the slice correctly.
     assert t.local_size == 2 and t.cross_size == 2
+
+
+def test_megascale_env_detection(monkeypatch):
+    """Multi-slice deployments (megascale env) map CROSS onto the DCN
+    slice axis and LOCAL onto ICI workers with the block rank layout the
+    hierarchical executor assumes — no HOROVOD_* topology vars set."""
+    from horovod_tpu.common import topology
+
+    for v in ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+              "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+              "HOROVOD_CROSS_SIZE"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "2")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b,host-c")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    topo = topology.detect()
+    assert topo.source == "megascale-env"
+    assert topo.size == 12 and topo.rank == 2 * 3 + 1
+    assert (topo.local_rank, topo.local_size) == (1, 3)
+    assert (topo.cross_rank, topo.cross_size) == (2, 4)
+    assert topo.is_homogeneous
+
+
+def test_megascale_env_single_worker_slices(monkeypatch):
+    from horovod_tpu.common import topology
+
+    for v in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    topo = topology.detect()
+    assert (topo.rank, topo.size) == (1, 2)
+    assert (topo.cross_rank, topo.cross_size) == (1, 2)
+    assert (topo.local_rank, topo.local_size) == (0, 1)
+
+
+def test_horovod_env_wins_over_megascale(monkeypatch):
+    from horovod_tpu.common import topology
+
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    monkeypatch.setenv("HOROVOD_SIZE", "1")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "3")
+    topo = topology.detect()
+    assert topo.source == "env"
+    assert topo.size == 1
+
+
+def test_megascale_env_degenerate_falls_through(monkeypatch):
+    """Bad megascale env (worker id without the hostname list, or
+    non-numeric values) is ignored rather than crashing hvd.init()."""
+    from horovod_tpu.common import topology
+
+    for v in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.setenv("TPU_WORKER_ID", "1")  # no hostname list: degenerate
+    assert topology._from_megascale_env() is None
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "not-a-number")
+    assert topology._from_megascale_env() is None
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "5")  # out of range
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    assert topology._from_megascale_env() is None
