@@ -52,6 +52,12 @@ class Timeline {
   void NegotiateRankReady(const std::string& tensor, int rank);
   void NegotiateEnd(const std::string& tensor, const std::string& op);
   void Begin(const std::string& tensor, const std::string& activity);
+  // Begin with a plan correlation id: the same "hvd_plan_<id>" string is
+  // emitted by the Python executor as a jax.profiler TraceAnnotation, so
+  // a slow cycle in this trace can be matched to its on-chip XLA
+  // profile (SURVEY §5 timeline<->XLA interop).
+  void BeginPlan(const std::string& tensor, const std::string& activity,
+                 uint64_t plan_id);
   void End(const std::string& tensor, const std::string& activity);
   void MarkCycle();
 

@@ -114,10 +114,13 @@ void Timeline::WriterLoop() {
 
 namespace {
 std::string DurEvent(const char* ph, int pid, int tid, double ts,
-                     const std::string& name) {
+                     const std::string& name,
+                     const std::string& args_json = "") {
   std::ostringstream os;
   os << "{\"name\":\"" << name << "\",\"ph\":\"" << ph << "\",\"pid\":" << pid
-     << ",\"tid\":" << tid << ",\"ts\":" << ts << "}";
+     << ",\"tid\":" << tid << ",\"ts\":" << ts;
+  if (!args_json.empty()) os << ",\"args\":" << args_json;
+  os << "}";
   return os.str();
 }
 }  // namespace
@@ -155,6 +158,17 @@ void Timeline::Begin(const std::string& tensor, const std::string& activity) {
   std::lock_guard<std::mutex> l(mu_);
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("B", rank_, tid, NowUs(), activity));
+  cv_.notify_one();
+}
+
+void Timeline::BeginPlan(const std::string& tensor,
+                         const std::string& activity, uint64_t plan_id) {
+  if (!initialized_.load()) return;
+  std::lock_guard<std::mutex> l(mu_);
+  int tid = Tid(tensor);
+  queue_.push_back(DurEvent(
+      "B", rank_, tid, NowUs(), activity,
+      "{\"plan\":\"hvd_plan_" + std::to_string(plan_id) + "\"}"));
   cv_.notify_one();
 }
 
@@ -1078,9 +1092,6 @@ void Core::DispatchResponses(const ResponseList& rl) {
       }
       if (resp.type == ResponseType::kJoin) joined_ = false;
     }
-    for (const auto& name : resp.names) {
-      timeline_.Begin(name, ActivityName(resp.type));
-    }
     Plan p;
     {
       std::lock_guard<std::mutex> l(plan_mu_);
@@ -1088,7 +1099,10 @@ void Core::DispatchResponses(const ResponseList& rl) {
       p.response = resp;
       p.tuned_flags = rl.tuned_flags;
       inflight_[p.id] = Inflight{resp, std::move(plan_tickets)};
-      plans_.push_back(std::move(p));
+      plans_.push_back(p);
+    }
+    for (const auto& name : resp.names) {
+      timeline_.BeginPlan(name, ActivityName(resp.type), p.id);
     }
     plan_cv_.notify_one();
   }

@@ -95,6 +95,7 @@ def init(config: Optional[Config] = None) -> None:
                     cfg, topo, executor=executor,
                     coord_addr=coord_addr, coord_port=coord_port,
                 )
+                _start_profiler(cfg)
                 return
             except NotImplementedError:
                 raise
@@ -108,15 +109,49 @@ def init(config: Optional[Config] = None) -> None:
                 )
         _runtime = Runtime(cfg, topo)
         _runtime.start()
+        _start_profiler(cfg)
+
+
+def _start_profiler(cfg: Config) -> None:
+    """Optional jax.profiler session (HOROVOD_PROFILER_DIR): plan
+    executions carry the same hvd_plan_<id> TraceAnnotation the C++
+    timeline stamps on the plan's catapult events, linking a slow cycle
+    to its on-chip XLA profile (SURVEY §5 timeline parity)."""
+    global _profiler_active
+    if not getattr(cfg, "profiler_dir", ""):
+        return
+    try:
+        import jax.profiler as _prof
+
+        _prof.start_trace(cfg.profiler_dir)
+        _profiler_active = True
+    except Exception as exc:  # noqa: BLE001 - profiling is best-effort
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "could not start jax.profiler trace in %s: %s",
+            cfg.profiler_dir, exc,
+        )
+
+
+_profiler_active = False
 
 
 def shutdown() -> None:
-    global _runtime, _mesh
+    global _runtime, _mesh, _profiler_active
     with _lock:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
         _mesh = None
+        if _profiler_active:
+            _profiler_active = False
+            try:
+                import jax.profiler as _prof
+
+                _prof.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def is_initialized() -> bool:

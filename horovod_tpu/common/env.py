@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
 HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
 HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_PROFILER_DIR = "HOROVOD_PROFILER_DIR"
 HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
 HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
 HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
@@ -101,6 +102,11 @@ class Config:
     autotune_bayes_opt_max_samples: int = 20
     autotune_gaussian_process_noise: float = 0.8
     timeline_filename: str = ""
+    # Optional jax.profiler trace session directory: started at init,
+    # stopped at shutdown; plan executions inside carry the same
+    # hvd_plan_<id> annotation the timeline stamps (SURVEY §5).
+    profiler_dir: str = ""
+
     timeline_mark_cycles: bool = False
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
@@ -139,6 +145,7 @@ class Config:
             cfg.autotune_gaussian_process_noise,
         )
         cfg.timeline_filename = os.environ.get(HOROVOD_TIMELINE, "")
+        cfg.profiler_dir = os.environ.get(HOROVOD_PROFILER_DIR, "")
         cfg.timeline_mark_cycles = _get_bool(HOROVOD_TIMELINE_MARK_CYCLES)
         cfg.stall_check_disable = _get_bool(HOROVOD_STALL_CHECK_DISABLE)
         cfg.stall_warning_time_seconds = _get_float(

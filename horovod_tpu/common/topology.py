@@ -7,13 +7,15 @@ same global/LOCAL/CROSS triple is derived, in priority order, from:
 
 1. ``HOROVOD_RANK``/``HOROVOD_SIZE``/... env vars set by the launcher
    (parity with ``horovod/common/gloo/gloo_context.cc:113-157``),
-2. the megascale multislice env (``MEGASCALE_SLICE_ID`` /
+2. an already-initialized ``jax.distributed`` runtime (authoritative —
+   its process indices are ground truth): LOCAL = processes in this
+   process's TPU *slice* (one ICI domain, possibly spanning hosts),
+   CROSS = across slices over DCN (``topology_from_slice_metadata``),
+3. the megascale multislice env (``MEGASCALE_SLICE_ID`` /
    ``MEGASCALE_NUM_SLICES`` + ``TPU_WORKER_*``): real multi-slice
    deployments get the (cross, local) = (DCN, ICI) grid with no
-   hand-set topology vars (``_from_megascale_env``),
-3. an already-initialized ``jax.distributed`` runtime: LOCAL = processes in
-   this process's TPU *slice* (one ICI domain, possibly spanning hosts),
-   CROSS = across slices over DCN (``topology_from_slice_metadata``),
+   hand-set topology vars, before jax is initialized
+   (``_from_megascale_env``),
 4. single-process fallback: rank 0 of 1.
 
 The LOCAL axis maps onto ICI and the CROSS axis onto DCN — the analogue of
@@ -154,15 +156,23 @@ def _from_megascale_env() -> Optional[Topology]:
         return None
     try:
         num_slices = int(raw)
-        slice_id = int(os.environ.get("MEGASCALE_SLICE_ID", "0"))
+        slice_raw = os.environ.get("MEGASCALE_SLICE_ID")
+        slice_id = int(slice_raw) if slice_raw is not None else 0
         hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
         local_size = len([h for h in hostnames.split(",") if h.strip()]) or 1
-        local_rank = int(os.environ.get("TPU_WORKER_ID", "0"))
+        worker_raw = os.environ.get("TPU_WORKER_ID")
+        local_rank = int(worker_raw) if worker_raw is not None else 0
     except ValueError:
         return None
-    # Degenerate env (bad ranges, worker id without the hostname list)
-    # falls through to the next detection source instead of crashing
-    # hvd.init().
+    # Degenerate env falls through to the next detection source instead
+    # of crashing hvd.init() — including *absent* per-process ids when
+    # the sizes say there must be more than one process: defaulting them
+    # to 0 would give every process the same global rank (colliding
+    # ranks hang or silently corrupt collectives).
+    if num_slices > 1 and slice_raw is None:
+        return None
+    if local_size > 1 and worker_raw is None:
+        return None
     if not (0 <= slice_id < num_slices and 0 <= local_rank < local_size):
         return None
     return Topology(

@@ -261,7 +261,18 @@ class NativeRuntime:
             pass
         else:
             try:
-                outputs = self.executor.execute(plan, entries, self.topology)
+                # Correlation with on-chip profiles: the same
+                # "hvd_plan_<id>" string the C++ timeline stamps on this
+                # plan's activity events (Timeline::BeginPlan) annotates
+                # the XLA execution in any active jax.profiler trace, so
+                # a slow cycle in the catapult timeline can be matched to
+                # its device-side profile (SURVEY §5 timeline parity).
+                import jax.profiler as _prof
+
+                with _prof.TraceAnnotation(f"hvd_plan_{plan['id']}"):
+                    outputs = self.executor.execute(
+                        plan, entries, self.topology
+                    )
             except Exception as exc:  # noqa: BLE001
                 logger.exception("plan execution failed")
                 status_code = int(StatusType.UNKNOWN_ERROR)

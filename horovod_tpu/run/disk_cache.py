@@ -39,8 +39,16 @@ class DiskCache:
         return entry.get("v")
 
     def put(self, key: str, value: Any) -> None:
+        self.put_many({key: value})
+
+    def put_many(self, items: dict) -> None:
+        """One read-modify-replace for a batch of keys: concurrent
+        per-key puts would lose each other's entries (last writer wins on
+        the whole file), so batch writers must use this."""
         data = self._load()
-        data[key] = {"v": value, "t": time.time()}
+        now = time.time()
+        for key, value in items.items():
+            data[key] = {"v": value, "t": now}
         try:
             os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
             fd, tmp = tempfile.mkstemp(

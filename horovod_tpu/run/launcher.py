@@ -172,14 +172,20 @@ def check_hosts_reachable(hostnames, ssh_port=None, timeout=8.0,
             ).returncode == 0
         except Exception:  # noqa: BLE001 - unreachable is unreachable
             ok = False
-        if ok and cache is not None:
-            cache.put(key, True)
         return host, ok
 
     with concurrent.futures.ThreadPoolExecutor(
         max_workers=min(len(remote), 32)
     ) as pool:
         results = list(pool.map(probe, remote))
+    if cache is not None:
+        # One batched write after the pool joins: concurrent per-host
+        # puts would overwrite each other's entries.
+        fresh = {
+            f"ssh:{h}:{ssh_port or 22}": True for h, ok in results if ok
+        }
+        if fresh:
+            cache.put_many(fresh)
     unreachable = sorted(h for h, ok in results if not ok)
     if unreachable:
         raise RuntimeError(

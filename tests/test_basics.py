@@ -198,3 +198,28 @@ def test_grouped_allreduce_async_and_average(hvd_session):
     outs = [hvd.synchronize(h) for h in handles]
     for i, o in enumerate(outs):
         np.testing.assert_allclose(o, np.ones((2,)) * i)
+
+
+def test_profiler_session_env(tmp_path, monkeypatch):
+    """HOROVOD_PROFILER_DIR starts a jax.profiler trace session at init
+    and stops it at shutdown; plan executions inside carry the
+    hvd_plan_<id> annotation matching the timeline's correlation ids."""
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_PROFILER_DIR", str(tmp_path))
+    hvd.init()
+    hvd.allreduce(np.ones(4, np.float32), name="prof_t")
+    hvd.shutdown()
+    monkeypatch.delenv("HOROVOD_PROFILER_DIR")
+    # A trace session writes under <dir>/plugins/profile/<ts>/.
+    written = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(tmp_path)
+        for f in fs
+    ]
+    assert written, "profiler session produced no trace files"

@@ -473,6 +473,18 @@ def test_timeline_two_ranks(tmp_path):
             events = json.load(f)
         names = {e.get("name") for e in events}
         assert "XLA_ALLREDUCE" in names, (r, sorted(names))
+        # Plan correlation id (SURVEY §5 timeline<->XLA interop): every
+        # executed plan's Begin event carries args.plan = hvd_plan_<id>,
+        # the same string the executor annotates into any active
+        # jax.profiler trace.
+        plan_ids = {
+            e["args"]["plan"]
+            for e in events
+            if e.get("ph") == "B" and "plan" in e.get("args", {})
+        }
+        assert any(p.startswith("hvd_plan_") for p in plan_ids), (
+            r, events[:10],
+        )
 
 
 def test_spark_gated():
