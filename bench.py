@@ -195,16 +195,17 @@ def _parse_args(argv=None):
     )
     parser.add_argument(
         "--quantized", action="store_true",
-        help="transformer: int8-wire ring allreduce for the gradient "
-             "buckets (ops/quantized.py; ~1%% gradient noise at 8 ranks)",
+        help="transformer: int8 gradient wire (ops/quantized.py; ~1%% "
+             "gradient noise at 8 ranks) — ring allreduce on the "
+             "replicated path, ring reduce-scatter when composed with "
+             "--zero1",
     )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.zero1 and args.model != "transformer":
         parser.error("--zero1 is implemented for --model transformer only")
-    if args.quantized and (args.model != "transformer" or args.zero1):
-        parser.error("--quantized applies to --model transformer "
-                     "(replicated-optimizer path) only")
+    if args.quantized and args.model != "transformer":
+        parser.error("--quantized applies to --model transformer only")
     return args
 
 
@@ -477,13 +478,16 @@ def run_lm_benchmark(args) -> int:
         # the shard-local update (parallel/zero.py).
         from horovod_tpu.parallel.zero import init_zero1_state, zero1_update
 
-        opt_state = init_zero1_state(tx, params, n_chips)
+        opt_state = init_zero1_state(
+            tx, params, n_chips, quantized=args.quantized
+        )
 
         def step(p, s_stacked, tok, lab):
             s = jax.tree.map(lambda x: x[0], s_stacked)
             loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
             p, s = zero1_update(
-                tx, p, s, grads, axis_name="data", n_shards=n_chips
+                tx, p, s, grads, axis_name="data", n_shards=n_chips,
+                quantized=args.quantized,
             )
             return (p, jax.tree.map(lambda x: x[None], s),
                     jax.lax.pmean(loss, "data"))
@@ -580,7 +584,9 @@ def run_lm_benchmark(args) -> int:
             "device_kind": getattr(devices[0], "device_kind", "unknown"),
             "attention": "pallas-flash (interpret off-TPU)",
             "optimizer_state": "zero1-sharded" if args.zero1 else "replicated",
-            "gradient_wire": "int8-quantized" if args.quantized else "full-precision",
+            "gradient_wire": (
+                "int8-quantized" if args.quantized else "full-precision"
+            ),
             "scan": bool(args.scan),
             "mfu": mfu,
             "flops_per_step_per_chip": (

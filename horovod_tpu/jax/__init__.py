@@ -145,6 +145,16 @@ def allreduce_gradients(
                                  postscale_factor=1.0):
             from ..ops.quantized import quantized_ring_allreduce
 
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                # Integer buckets reduce exactly: a float32/int8 round
+                # trip would silently corrupt exact sums. Buckets are
+                # same-dtype (fusion groups by dtype), so per-bucket
+                # dispatch loses nothing.
+                return _select_reduce_fn(op, False)(
+                    x, op=op, axis_name=axis_name,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                )
             if prescale_factor != 1.0:
                 x = x * prescale_factor
             out = quantized_ring_allreduce(

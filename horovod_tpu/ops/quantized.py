@@ -78,6 +78,63 @@ def _unpack(buf: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
     return q, scales
 
 
+def _ring_rs_phase(chunks, k, n, r, axis_name, shift):
+    """Shared int8-wire ring reduce-scatter pass: after n-1 hops rank r
+    holds the complete float32 sum of chunk (r + 1 + shift) mod n. The
+    allreduce uses shift=0 (then all-gathers); ZeRO-1's reduce-scatter
+    uses shift=-1 so rank r finishes holding its own chunk r — one copy
+    of the ring-index math serves both."""
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+
+    def chunk_at(idx):
+        return lax.dynamic_slice(chunks, (idx % n, 0), (1, k))[0]
+
+    def rs_body(step, partial):
+        wire = lax.ppermute(_pack(*_quantize(partial)), axis_name, fwd)
+        q, s = _unpack(wire, k)
+        return _dequantize(q, s) + chunk_at(r - step - 1 + shift)
+
+    return lax.fori_loop(0, n - 1, rs_body, chunk_at(r + shift))
+
+
+def quantized_ring_reduce_scatter(
+    x: jax.Array,
+    *,
+    axis_name: str = DATA_AXIS,
+    average: bool = False,
+) -> jax.Array:
+    """Reduce-scatter with int8 on the wire: rank r returns the complete
+    sum (or average) of chunk r in ``psum_scatter``'s tiled layout.
+
+    ``x`` is the flat input, length n*k with k a multiple of BLOCK
+    (callers pad — ``parallel/zero.py`` aligns its shard length). This is
+    the reduce-scatter phase of :func:`quantized_ring_allreduce` with the
+    chunk labeling shifted by one so rank r finishes holding chunk r
+    (the plain ring finishes at chunk (r+1) mod n), which is exactly the
+    gradient shard ZeRO-1 needs — composing the int8 wire with sharded
+    optimizer state costs no extra hop."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        res = x.astype(jnp.float32)
+        return (res / n if average else res).astype(x.dtype)
+    r = lax.axis_index(axis_name)
+
+    orig_dtype = x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    total = flat.shape[0]
+    if total % n != 0 or (total // n) % BLOCK != 0:
+        raise ValueError(
+            f"quantized reduce-scatter needs len(x) divisible by n*BLOCK "
+            f"(= {n * BLOCK}); got {total}"
+        )
+    k = total // n
+    chunks = flat.reshape(n, k)
+    partial = _ring_rs_phase(chunks, k, n, r, axis_name, shift=-1)
+    if average:
+        partial = partial / n
+    return partial.astype(orig_dtype)
+
+
 def quantized_ring_allreduce(
     x: jax.Array,
     *,
@@ -103,18 +160,9 @@ def quantized_ring_allreduce(
     flat = jnp.pad(flat, (0, n * k - total))
     chunks = flat.reshape(n, k)
 
-    def chunk_at(idx):
-        return lax.dynamic_slice(chunks, (idx % n, 0), (1, k))[0]
-
-    # --- reduce-scatter phase: after n-1 hops, rank r holds the complete
-    # sum of chunk (r + 1) mod n.
-    def rs_body(step, partial):
-        wire = lax.ppermute(_pack(*_quantize(partial)), axis_name, fwd)
-        q, s = _unpack(wire, k)
-        # Incoming partial covers chunk (r - step - 1); add our local copy.
-        return _dequantize(q, s) + chunk_at(r - step - 1)
-
-    partial = lax.fori_loop(0, n - 1, rs_body, chunk_at(r))
+    # --- reduce-scatter phase (shared ring pass): after n-1 hops, rank r
+    # holds the complete sum of chunk (r + 1) mod n.
+    partial = _ring_rs_phase(chunks, k, n, r, axis_name, shift=0)
 
     # --- all-gather phase: circulate completed chunks; rank r receives
     # chunk (r - step) mod n at step (owned chunk ids decrease by one per

@@ -43,20 +43,32 @@ from .mesh import DATA_AXIS
 __all__ = ["init_zero1_state", "make_zero1_train_step", "zero1_update"]
 
 
-def _flat_meta(params, n_shards: int):
+def _flat_meta(params, n_shards: int, block: int = 1):
     flat, unravel = ravel_pytree(params)
     total = flat.shape[0]
-    padded = ((total + n_shards - 1) // n_shards) * n_shards
-    return flat, unravel, total, padded, padded // n_shards
+    per = -(-total // n_shards)
+    per = -(-per // block) * block  # quantized wire: BLOCK-aligned shards
+    return flat, unravel, total, per * n_shards, per
 
 
-def init_zero1_state(optimizer, params, n_shards: int):
+def _block(quantized: bool) -> int:
+    if not quantized:
+        return 1
+    from ..ops.quantized import BLOCK
+
+    return BLOCK
+
+
+def init_zero1_state(optimizer, params, n_shards: int,
+                     quantized: bool = False):
     """Per-shard optimizer states, stacked on a leading [n_shards] axis
     (the axis ``make_zero1_train_step`` shards over the mesh). Each
     shard's state is ``optimizer.init`` of that rank's flat parameter
     slice, so stateful transforms (momentum, Adam moments) start exactly
     as they would on the full vector."""
-    flat, _, total, padded, k = _flat_meta(params, n_shards)
+    flat, _, total, padded, k = _flat_meta(
+        params, n_shards, _block(quantized)
+    )
     flat = jnp.pad(flat, (0, padded - total))
     states = [
         optimizer.init(lax.dynamic_slice(flat, (r * k,), (k,)))
@@ -66,7 +78,8 @@ def init_zero1_state(optimizer, params, n_shards: int):
 
 
 def zero1_update(optimizer, params, state, grads, *,
-                 axis_name: str = DATA_AXIS, n_shards: int):
+                 axis_name: str = DATA_AXIS, n_shards: int,
+                 quantized: bool = False):
     """The ZeRO-1 update inside an existing shard_map/pmap context:
     reduce-scatter ``grads`` (averaged over the axis), optax-update this
     rank's flat parameter shard against its 1/N ``state`` (un-stacked, as
@@ -75,12 +88,25 @@ def zero1_update(optimizer, params, state, grads, *,
     the packaged whole-step version."""
     import optax
 
-    flat_p, unravel, total, padded, k = _flat_meta(params, n_shards)
+    flat_p, unravel, total, padded, k = _flat_meta(
+        params, n_shards, _block(quantized)
+    )
     flat_g, _ = ravel_pytree(grads)
     flat_g = jnp.pad(flat_g, (0, padded - total))
     flat_p = jnp.pad(flat_p, (0, padded - total))
 
-    g_shard = lax.psum_scatter(flat_g, axis_name, tiled=True) / n_shards
+    if quantized:
+        # int8-wire ring reduce-scatter (ops/quantized.py): the shard
+        # length is BLOCK-aligned by _flat_meta, and rank r receives
+        # exactly its chunk r, so the composition with the sharded
+        # update/all-gather below is layout-free.
+        from ..ops.quantized import quantized_ring_reduce_scatter
+
+        g_shard = quantized_ring_reduce_scatter(
+            flat_g, axis_name=axis_name, average=True
+        )
+    else:
+        g_shard = lax.psum_scatter(flat_g, axis_name, tiled=True) / n_shards
     idx = lax.axis_index(axis_name)
     p_shard = lax.dynamic_slice(flat_p, (idx * k,), (k,))
 
@@ -98,6 +124,7 @@ def make_zero1_train_step(
     *,
     axis_name: str = DATA_AXIS,
     donate: bool = True,
+    quantized: bool = False,
 ):
     """Build the jitted ZeRO-1 step: ``step(params, state, batch) ->
     (params, state, loss)``. ``params`` replicated, ``state`` from
@@ -112,7 +139,7 @@ def make_zero1_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         new_params, new_state = zero1_update(
             optimizer, params, state, grads,
-            axis_name=axis_name, n_shards=n,
+            axis_name=axis_name, n_shards=n, quantized=quantized,
         )
         loss = lax.pmean(loss, axis_name)
         return (

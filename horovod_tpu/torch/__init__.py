@@ -198,6 +198,19 @@ class _DistributedAdasumOptimizer:
         import torch
 
         if self.backward_passes_per_step > 1:
+            if closure is not None:
+                # A gradient-recomputing closure (LBFGS-style) would
+                # overwrite p.grad after the division below, silently
+                # dropping the accumulation normalization — refuse
+                # rather than train on wrong gradients (the reference's
+                # gradient-space wrapper has the same structural
+                # limitation).
+                raise ValueError(
+                    "DistributedAdasumOptimizer does not support a step "
+                    "closure together with backward_passes_per_step > 1: "
+                    "the closure recomputes gradients after the "
+                    "accumulation divisor is applied."
+                )
             # N backward() calls accumulated into p.grad; average them
             # before the local step (same normalization as the
             # gradient-space wrapper).
@@ -209,6 +222,21 @@ class _DistributedAdasumOptimizer:
         # Only parameters the optimizer can update get cloned/reduced —
         # frozen (grad-None) params never produce a delta, and the skip is
         # structural, so it is consistent across ranks.
+        if closure is not None and all(
+            p.grad is None
+            for group in self._opt.param_groups
+            for p in group["params"]
+        ):
+            # No gradients exist yet, so the closure is the gradient
+            # producer (LBFGS pattern): the delta snapshot below would be
+            # empty and NOTHING would be Adasum-reduced — ranks diverge
+            # silently. Delta-space Adasum needs loss.backward() before
+            # step().
+            raise ValueError(
+                "DistributedAdasumOptimizer cannot reduce "
+                "closure-computed gradients: call loss.backward() before "
+                "step() so parameter deltas are observable."
+            )
         starts = {}
         with torch.no_grad():
             for group in self._opt.param_groups:

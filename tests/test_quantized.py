@@ -147,3 +147,89 @@ def test_blockwise_scales_preserve_small_leaves(mesh):
     assert np.linalg.norm(tiny) > 0.5 * np.linalg.norm(exact)
     rel = np.linalg.norm(tiny - exact) / np.linalg.norm(exact)
     assert rel < 5e-2, rel
+
+
+def test_reduce_scatter_matches_psum_scatter_within_error(mesh):
+    """quantized_ring_reduce_scatter: rank r gets chunk r (psum_scatter
+    tiled layout) within int8 quantization error — the composition point
+    for ZeRO-1's sharded update."""
+    from jax import lax
+
+    from horovod_tpu.ops.quantized import BLOCK, quantized_ring_reduce_scatter
+
+    rng = np.random.RandomState(3)
+    k = BLOCK  # per-rank chunk
+    x = rng.randn(N_DEV, N_DEV * k).astype(np.float32) * 0.01
+
+    def body(xs):
+        return quantized_ring_reduce_scatter(xs[0], axis_name="data")
+
+    got = np.asarray(jax.jit(_shard_map(
+        body, mesh, in_specs=(P("data"),), out_specs=P("data"),
+    ))(jnp.asarray(x.reshape(N_DEV, 1, -1))))
+
+    exact = x.sum(axis=0).reshape(N_DEV, k)  # chunk r = rows [r*k,(r+1)*k)
+    got = got.reshape(N_DEV, k)
+    denom = np.maximum(np.abs(exact), 1e-3)
+    rel = np.abs(got - exact) / denom
+    assert rel.mean() < 0.05, rel.mean()
+    # Layout check: rank r must hold chunk r, not the plain ring's
+    # natural endpoint chunk (r+1) mod n.
+    wrong = np.roll(exact, -1, axis=0)
+    rel_wrong = np.abs(got - wrong) / np.maximum(np.abs(wrong), 1e-3)
+    assert rel_wrong.mean() > 10 * rel.mean(), (rel.mean(), rel_wrong.mean())
+
+
+def test_reduce_scatter_average_and_bad_length(mesh):
+    from horovod_tpu.ops.quantized import BLOCK, quantized_ring_reduce_scatter
+
+    rng = np.random.RandomState(4)
+    k = BLOCK
+    x = rng.randn(N_DEV, N_DEV * k).astype(np.float32) * 0.01
+
+    def body(xs):
+        return quantized_ring_reduce_scatter(
+            xs[0], axis_name="data", average=True
+        )
+
+    got = np.asarray(jax.jit(_shard_map(
+        body, mesh, in_specs=(P("data"),), out_specs=P("data"),
+    ))(jnp.asarray(x.reshape(N_DEV, 1, -1)))).reshape(N_DEV, k)
+    exact = x.mean(axis=0).reshape(N_DEV, k)
+    assert np.abs(got - exact).mean() < np.abs(exact).mean() * 0.05
+
+    with pytest.raises(ValueError, match="divisible"):
+        def bad(xs):
+            return quantized_ring_reduce_scatter(xs[0], axis_name="data")
+        jax.jit(_shard_map(
+            bad, mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ))(jnp.ones((N_DEV, 1, 24), jnp.float32))
+
+
+def test_integer_bucket_reduces_exactly(mesh):
+    """allreduce_gradients(quantized=True) must NOT round-trip integer
+    leaves through float32/int8 (exact sums would become lossy): the
+    int bucket takes the exact psum path, float buckets stay quantized."""
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.common.types import ReduceOp
+    from horovod_tpu.ops.quantized import BLOCK
+
+    def body(r):
+        grads = {
+            "w": jnp.full((BLOCK,), 0.001, jnp.float32) * (r[0, 0] + 1),
+            "counter": jnp.full((4,), 100_000, jnp.int32) * (r[0, 0] + 1),
+        }
+        return hvdj.allreduce_gradients(
+            grads, op=ReduceOp.SUM, quantized=True
+        )
+
+    ranks = jnp.arange(N_DEV, dtype=jnp.int32).reshape(N_DEV, 1)
+    out = jax.jit(_shard_map(
+        body, mesh, in_specs=(P("data"),), out_specs=P(),
+    ))(ranks)
+    # sum over r of 100000*(r+1) = 100000 * 36 — must be EXACT.
+    assert np.array_equal(
+        np.asarray(out["counter"]), np.full(4, 3_600_000, np.int32)
+    )
+    expected_w = 0.001 * sum(range(1, N_DEV + 1))
+    assert np.allclose(np.asarray(out["w"]), expected_w, rtol=0.05)
