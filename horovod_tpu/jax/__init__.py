@@ -149,12 +149,15 @@ def allreduce_gradients(
                 # Integer buckets reduce exactly: a float32/int8 round
                 # trip would silently corrupt exact sums. Buckets are
                 # same-dtype (fusion groups by dtype), so per-bucket
-                # dispatch loses nothing.
-                return _select_reduce_fn(op, False)(
+                # dispatch loses nothing. Preserve the leaf dtype like
+                # the quantized path does (AVERAGE's true-division
+                # promotes to float; truncate back).
+                out = _select_reduce_fn(op, False)(
                     x, op=op, axis_name=axis_name,
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
                 )
+                return out.astype(x.dtype)
             if prescale_factor != 1.0:
                 x = x * prescale_factor
             out = quantized_ring_allreduce(

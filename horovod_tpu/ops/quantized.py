@@ -35,7 +35,7 @@ from jax import lax
 
 from ..parallel.mesh import DATA_AXIS
 
-__all__ = ["quantized_ring_allreduce"]
+__all__ = ["quantized_ring_allreduce", "quantized_ring_reduce_scatter"]
 
 
 # Elements sharing one scale. Small enough that a low-magnitude gradient
@@ -114,19 +114,19 @@ def quantized_ring_reduce_scatter(
     gradient shard ZeRO-1 needs — composing the int8 wire with sharded
     optimizer state costs no extra hop."""
     n = lax.axis_size(axis_name)
-    if n == 1:
-        res = x.astype(jnp.float32)
-        return (res / n if average else res).astype(x.dtype)
-    r = lax.axis_index(axis_name)
-
     orig_dtype = x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     total = flat.shape[0]
+    # Validate BEFORE the n==1 shortcut so misuse fails on debug runs
+    # too, not only at scale.
     if total % n != 0 or (total // n) % BLOCK != 0:
         raise ValueError(
             f"quantized reduce-scatter needs len(x) divisible by n*BLOCK "
             f"(= {n * BLOCK}); got {total}"
         )
+    if n == 1:
+        return flat.astype(orig_dtype)
+    r = lax.axis_index(axis_name)
     k = total // n
     chunks = flat.reshape(n, k)
     partial = _ring_rs_phase(chunks, k, n, r, axis_name, shift=-1)

@@ -11,10 +11,17 @@ transpose in the reverse direction) — no hand-written 1F1B schedule is
 needed for correctness, and XLA overlaps each tick's compute with the
 next's ICI transfer.
 
-Layout: stage parameters enter with a leading [n_stages, ...] dim placed
-``P(stage)``; every stage must map activations of one shape to the same
-shape (the classic homogeneous-pipeline constraint; embed/head layers
-belong on stages 0 / n-1 inside ``stage_fn``).
+Two APIs:
+
+- ``make_pp_train_step`` — homogeneous stages: parameters enter with a
+  leading [n_stages, ...] dim placed ``P(stage)``; every stage maps one
+  activation shape to itself.
+- ``make_pp_lm_train_step`` — heterogeneous ends as first-class stages:
+  ``embed_fn`` ingests raw tokens on stage 0, ``head_loss_fn`` folds the
+  projection + loss on the last stage, and only the hidden activation
+  crosses ICI. ``remat=True`` bounds backward memory to the carried
+  activations plus one rematerialized tick (``jax.checkpoint`` per tick
+  — the memory role of 1F1B, scheduled by the compiler).
 """
 
 from __future__ import annotations
@@ -29,6 +36,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .mesh import DATA_AXIS
 
 STAGE_AXIS = "stage"
+
+
+def _zeros_with_vma_of(shape, dtype, ref):
+    """Zeros of (shape, dtype) carrying ``ref``'s varying-axis type: a
+    scan carry must match its body output's vma over every bound axis,
+    including axes whose names the callee does not know. The dead
+    multiply is DCE'd by XLA."""
+    return jnp.zeros(shape, dtype) + jnp.zeros((), dtype) * ref.ravel()[
+        0
+    ].astype(dtype)
 
 
 def _pvary(x, axis_name):
@@ -145,4 +162,178 @@ def make_pp_train_step(
     return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
-from ._stacked import init_stacked_state as init_pp_state  # noqa: E402
+from ._stacked import init_stacked_state  # noqa: E402
+
+init_pp_state = init_stacked_state
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous pipelines: embed / body / head as first-class stages
+# ---------------------------------------------------------------------------
+
+def pipeline_lm_loss(
+    embed_fn: Callable,
+    stage_fn: Callable,
+    head_loss_fn: Callable,
+    embed_params: Any,
+    stage_params_local: Any,
+    head_params: Any,
+    tokens_micro: jax.Array,
+    labels_micro: jax.Array,
+    *,
+    axis_name: str = STAGE_AXIS,
+    remat: bool = True,
+) -> jax.Array:
+    """Pipelined forward + loss with heterogeneous ends; call inside
+    shard_map with ``axis_name`` bound.
+
+    The wire between stages carries ONLY the hidden activation
+    [mb, ...]: stage 0 ingests raw tokens through ``embed_fn`` and the
+    last stage folds ``head_loss_fn`` (projection + loss) locally, so
+    logits-sized tensors never cross ICI and callers no longer have to
+    disguise embed/head as shape-preserving stages (the round-3
+    homogeneous-pipeline constraint).
+
+    - ``embed_fn(embed_params, tokens_mb) -> h``      [mb,...] any shape
+    - ``stage_fn(stage_params, h, stage_idx) -> h``   shape-preserving
+    - ``head_loss_fn(head_params, h, labels_mb) -> scalar``
+
+    ``embed_params``/``head_params`` are replicated across the mesh; under
+    a vma-checked shard_map their cotangents are psummed over the stage
+    axis automatically, and only the owning stage's branch contributes
+    (the ``where`` masks zero the rest), so the replicated update is
+    exact. SPMD uniformity means every stage *computes* embed/head each
+    tick and masks the result — for projection-dominated models put the
+    head inside the last ``stage_fn`` or shard it with TP instead.
+
+    ``remat=True`` wraps each tick's stage compute in ``jax.checkpoint``:
+    the backward pass holds the carried activations plus ONE
+    rematerialized tick instead of every tick's internals — the memory
+    role of a 1F1B schedule, expressed through the compiler (the
+    schedule itself stays GPipe fill/steady/drain; autodiff derives the
+    reverse pipeline through the ppermute transpose).
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    tokens_micro = _pvary(tokens_micro, axis_name)
+    labels_micro = _pvary(labels_micro, axis_name)
+    n_micro = tokens_micro.shape[0]
+    ticks = n_micro + n_stages - 1
+    # Derive the carries from traced inputs so they inherit the inputs'
+    # varying-axis (vma) type for the scan (a carry must match the body
+    # output's vma over EVERY bound axis — stage and the caller's data
+    # axis, whose name this function cannot know, so _pvary alone is not
+    # enough). The zeros are value-independent; XLA dead-code-eliminates
+    # the embed evaluation and the multiply.
+    state0 = jnp.zeros_like(embed_fn(embed_params, tokens_micro[0]))
+    losses0 = _zeros_with_vma_of((n_micro,), jnp.float32, state0)
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def tick(carry, t):
+        state, losses = carry
+        feed = embed_fn(embed_params, tokens_micro[jnp.minimum(t, n_micro - 1)])
+        h_in = jnp.where(s == 0, feed, state)
+        y = body(stage_params_local, h_in, s)
+        out_idx = t - (n_stages - 1)
+        idx = jnp.clip(out_idx, 0, n_micro - 1)
+        mb_loss = head_loss_fn(
+            head_params, y, labels_micro[idx]
+        ).astype(jnp.float32)
+        is_emit = jnp.logical_and(s == n_stages - 1, out_idx >= 0)
+        prev = lax.dynamic_index_in_dim(losses, idx, 0, keepdims=False)
+        losses = lax.dynamic_update_index_in_dim(
+            losses, jnp.where(is_emit, mb_loss, prev), idx, 0
+        )
+        state_next = lax.ppermute(y, axis_name, perm)
+        return (state_next, losses), None
+
+    (_, losses), _ = lax.scan(tick, (state0, losses0), jnp.arange(ticks))
+    # Losses live on the last stage; share so the value (and the gradient
+    # wiring) is SPMD-identical everywhere.
+    mask = (s == n_stages - 1).astype(losses.dtype)
+    losses = lax.psum(losses * mask, axis_name)
+    return losses.mean()
+
+
+def init_pp_lm_state(optimizer, params):
+    """Optimizer state for the heterogeneous layout: ``params`` is a dict
+    {"embed", "stages" ([n_stages, ...]-stacked), "head"}; embed/head
+    states are replicated like their params, stage states stacked."""
+    return {
+        "embed": optimizer.init(params["embed"]),
+        "stages": init_stacked_state(optimizer, params["stages"]),
+        "head": optimizer.init(params["head"]),
+    }
+
+
+def make_pp_lm_train_step(
+    embed_fn: Callable,
+    stage_fn: Callable,
+    head_loss_fn: Callable,
+    optimizer,
+    mesh: Mesh,
+    *,
+    stage_axis: str = STAGE_AXIS,
+    data_axis: str = DATA_AXIS,
+    remat: bool = True,
+    donate: bool = True,
+):
+    """Jitted DP x PP train step over a heterogeneous pipeline.
+
+    ``step(params, opt_state, tokens_micro, labels_micro) ->
+    (params, opt_state, loss)`` with ``params`` =
+    {"embed", "stages", "head"} (see :func:`pipeline_lm_loss` /
+    :func:`init_pp_lm_state`). Batches are [n_micro, mb, ...] with dim 1
+    sharded over ``data``.
+    """
+    import optax
+
+    from ..jax import _shard_map
+
+    def step(params, opt_state, tokens_micro, labels_micro):
+        nd = lax.axis_size(data_axis)
+
+        def loss_of(embed_p, stages_local, head_p):
+            return pipeline_lm_loss(
+                embed_fn, stage_fn, head_loss_fn,
+                embed_p, stages_local, head_p,
+                tokens_micro, labels_micro,
+                axis_name=stage_axis, remat=remat,
+            )
+
+        stages_local = jax.tree.map(lambda t: t[0], params["stages"])
+        loss, grads = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
+            params["embed"], stages_local, params["head"]
+        )
+        # vma-checked shard_map already psummed each gradient over every
+        # axis its parameter is invariant on (stage+data for embed/head,
+        # data for stage params); divide by the data size to average.
+        g_embed, g_stages, g_head = jax.tree.map(
+            lambda g: g / nd, grads
+        )
+
+        new_params, new_state = {}, {}
+        up, new_state["embed"] = optimizer.update(
+            g_embed, opt_state["embed"], params["embed"]
+        )
+        new_params["embed"] = optax.apply_updates(params["embed"], up)
+        s_local = jax.tree.map(lambda t: t[0], opt_state["stages"])
+        up, s_local = optimizer.update(g_stages, s_local, stages_local)
+        new_params["stages"] = jax.tree.map(
+            lambda t: t[None], optax.apply_updates(stages_local, up)
+        )
+        new_state["stages"] = jax.tree.map(lambda t: t[None], s_local)
+        up, new_state["head"] = optimizer.update(
+            g_head, opt_state["head"], params["head"]
+        )
+        new_params["head"] = optax.apply_updates(params["head"], up)
+        return new_params, new_state, lax.pmean(loss, data_axis)
+
+    pspec = {"embed": P(), "stages": P(stage_axis), "head": P()}
+    fn = _shard_map(
+        step, mesh, check=True,
+        in_specs=(pspec, pspec, P(None, data_axis), P(None, data_axis)),
+        out_specs=(pspec, pspec, P()),
+    )
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())

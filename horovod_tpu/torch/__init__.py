@@ -222,20 +222,23 @@ class _DistributedAdasumOptimizer:
         # Only parameters the optimizer can update get cloned/reduced —
         # frozen (grad-None) params never produce a delta, and the skip is
         # structural, so it is consistent across ranks.
-        if closure is not None and all(
+        if closure is not None and any(
             p.grad is None
             for group in self._opt.param_groups
             for p in group["params"]
+            if p.requires_grad
         ):
-            # No gradients exist yet, so the closure is the gradient
-            # producer (LBFGS pattern): the delta snapshot below would be
-            # empty and NOTHING would be Adasum-reduced — ranks diverge
+            # A trainable param without a gradient + a closure means the
+            # closure may be the gradient producer (LBFGS pattern): such
+            # params would be missing from the delta snapshot below and
+            # their updates would never be Adasum-reduced — ranks diverge
             # silently. Delta-space Adasum needs loss.backward() before
-            # step().
+            # step() for every trainable parameter.
             raise ValueError(
                 "DistributedAdasumOptimizer cannot reduce "
                 "closure-computed gradients: call loss.backward() before "
-                "step() so parameter deltas are observable."
+                "step() so every trainable parameter's delta is "
+                "observable."
             )
         starts = {}
         with torch.no_grad():
