@@ -90,6 +90,37 @@ def main():
         pp_params, pp_state, loss = pp_step(pp_params, pp_state, xm, ym)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"  step {i:3d}  loss {float(loss):.4f}")
+    # --- Heterogeneous pipeline: embed on stage 0, head+loss on the
+    # last stage, hidden-only wire (see docs/parallelism.md).
+    from horovod_tpu.parallel.pp import (
+        init_pp_lm_state,
+        make_pp_lm_train_step,
+    )
+
+    vocab = 32
+    ek, hk = jax.random.split(jax.random.PRNGKey(7))
+    het = {
+        "embed": {"table": jax.random.normal(ek, (vocab, d)) * 0.5},
+        "stages": pp_params,
+        "head": {"proj": jax.random.normal(hk, (d, vocab)) * 0.5},
+    }
+    het_state = init_pp_lm_state(tx, het)
+    het_step = make_pp_lm_train_step(
+        lambda p, t: p["table"][t],
+        stage_fn,
+        lambda p, h, lab: optax.softmax_cross_entropy_with_integer_labels(
+            h @ p["proj"], lab
+        ).mean(),
+        tx, pp_mesh, donate=False,
+    )
+    rng = np.random.RandomState(0)
+    tok = jnp.asarray(rng.randint(0, vocab, xm.shape[:2] + (6,)), jnp.int32)
+    lab = jnp.asarray(rng.randint(0, vocab, xm.shape[:2] + (6,)), jnp.int32)
+    print(f"DP x PP (heterogeneous LM) on {n_dev} devices:")
+    for i in range(args.steps):
+        het, het_state, loss = het_step(het, het_state, tok, lab)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"  step {i:3d}  loss {float(loss):.4f}")
     print("DEMO DONE")
 
 

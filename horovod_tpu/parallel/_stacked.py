@@ -16,6 +16,22 @@ def init_stacked_state(optimizer, params_stacked):
     return jax.vmap(optimizer.init)(params_stacked)
 
 
+def apply_stacked_update(optimizer, params, opt_state, grads_local):
+    """Unstack -> optimizer.update on this shard's row -> restack.
+    ``grads_local`` is already normalized (local layout, no leading shard
+    dim). Returns ([1, ...]-restacked params, state)."""
+    import optax
+
+    p_local = jax.tree.map(lambda t: t[0], params)
+    s_local = jax.tree.map(lambda t: t[0], opt_state)
+    updates, s_local = optimizer.update(grads_local, s_local, p_local)
+    p_local = optax.apply_updates(p_local, updates)
+    return (
+        jax.tree.map(lambda t: t[None], p_local),
+        jax.tree.map(lambda t: t[None], s_local),
+    )
+
+
 def stacked_train_update(optimizer, params, opt_state, value_and_grad_fn,
                          data_axis: str):
     """One update on stacked shards, inside a vma-checked shard_map:
@@ -27,17 +43,11 @@ def stacked_train_update(optimizer, params, opt_state, value_and_grad_fn,
     an explicit pmean would double-count; dividing by the axis size turns
     that sum into the data-average.
     """
-    import optax
-
     p_local = jax.tree.map(lambda t: t[0], params)
-    s_local = jax.tree.map(lambda t: t[0], opt_state)
     loss, grads = value_and_grad_fn(p_local)
     nd = lax.axis_size(data_axis)
     grads = jax.tree.map(lambda g: g / nd, grads)
-    updates, s_local = optimizer.update(grads, s_local, p_local)
-    p_local = optax.apply_updates(p_local, updates)
-    return (
-        jax.tree.map(lambda t: t[None], p_local),
-        jax.tree.map(lambda t: t[None], s_local),
-        loss,
+    new_params, new_state = apply_stacked_update(
+        optimizer, params, opt_state, grads
     )
+    return new_params, new_state, loss

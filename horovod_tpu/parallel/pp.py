@@ -58,6 +58,43 @@ def _pvary(x, axis_name):
         return lax.pvary(x, (axis_name,))
 
 
+def _gpipe_scan(axis_name, n_micro, feed, stage_apply, emit, emit0):
+    """The one GPipe fill/steady/drain scan both pipeline APIs share.
+
+    - ``feed(i) -> h``: stage 0's input for microbatch i (raw slice or
+      embedded tokens);
+    - ``stage_apply(h, s) -> h``: this stage's compute;
+    - ``emit(outs, idx, y, is_emit) -> outs``: fold the last stage's
+      result for microbatch ``idx`` into the accumulator (tensor slot or
+      per-microbatch loss).
+
+    Ticks run n_micro + n_stages - 1 times; stage 0 ingests microbatch t
+    (clamped past the end: the garbage never reaches an emit slot), the
+    last stage emits microbatch t - (n_stages - 1), and each tick's
+    output moves one hop down the line over ppermute (stage n-1's hop is
+    dropped by the permutation — it exits via ``emit``).
+    """
+    s = lax.axis_index(axis_name)
+    n_stages = lax.axis_size(axis_name)
+    ticks = n_micro + n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    state0 = jnp.zeros_like(feed(jnp.int32(0)))
+
+    def tick(carry, t):
+        state, outs = carry
+        x_in = jnp.where(s == 0, feed(jnp.minimum(t, n_micro - 1)), state)
+        y = stage_apply(x_in, s)
+        out_idx = t - (n_stages - 1)
+        idx = jnp.clip(out_idx, 0, n_micro - 1)
+        is_emit = jnp.logical_and(s == n_stages - 1, out_idx >= 0)
+        outs = emit(outs, idx, y, is_emit)
+        state_next = lax.ppermute(y, axis_name, perm)
+        return (state_next, outs), None
+
+    (_, outs), _ = lax.scan(tick, (state0, emit0), jnp.arange(ticks))
+    return outs
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params: Any,
@@ -73,38 +110,20 @@ def pipeline_apply(
     stage's outputs [n_micro, mb, ...] (zeros elsewhere; the caller
     typically psums or masks by stage).
     """
-    s = lax.axis_index(axis_name)
-    n_stages = lax.axis_size(axis_name)
     x_micro = _pvary(x_micro, axis_name)
-    n_micro = x_micro.shape[0]
-    ticks = n_micro + n_stages - 1
-    state0 = jnp.zeros_like(x_micro[0])
-    outs0 = jnp.zeros_like(x_micro)
-    # Send each stage's output one hop down the line; stage n-1's output
-    # is dropped by the permutation (it exits via `outs`).
-    perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-    def tick(carry, t):
-        state, outs = carry
-        # Stage 0 ingests microbatch t (clamped: beyond n_micro it runs
-        # garbage that never reaches an output slot).
-        feed = x_micro[jnp.minimum(t, n_micro - 1)]
-        x_in = jnp.where(s == 0, feed, state)
-        y = stage_fn(stage_params, x_in, s)
-        # Last stage emits microbatch t-(n_stages-1) at ticks >= n-1.
-        out_idx = t - (n_stages - 1)
-        is_emit = jnp.logical_and(s == n_stages - 1, out_idx >= 0)
-        outs = lax.dynamic_update_index_in_dim(
-            outs,
-            jnp.where(is_emit, y, lax.dynamic_index_in_dim(
-                outs, jnp.maximum(out_idx, 0), 0, keepdims=False)),
-            jnp.maximum(out_idx, 0), 0,
+    def emit(outs, idx, y, is_emit):
+        prev = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            outs, jnp.where(is_emit, y, prev), idx, 0
         )
-        state_next = lax.ppermute(y, axis_name, perm)
-        return (state_next, outs), None
 
-    (state, outs), _ = lax.scan(tick, (state0, outs0), jnp.arange(ticks))
-    return outs
+    return _gpipe_scan(
+        axis_name, x_micro.shape[0],
+        lambda i: x_micro[i],
+        lambda h, s: stage_fn(stage_params, h, s),
+        emit, jnp.zeros_like(x_micro),
+    )
 
 
 def make_pp_train_step(
@@ -213,45 +232,36 @@ def pipeline_lm_loss(
     schedule itself stays GPipe fill/steady/drain; autodiff derives the
     reverse pipeline through the ppermute transpose).
     """
-    s = lax.axis_index(axis_name)
-    n_stages = lax.axis_size(axis_name)
     tokens_micro = _pvary(tokens_micro, axis_name)
     labels_micro = _pvary(labels_micro, axis_name)
     n_micro = tokens_micro.shape[0]
-    ticks = n_micro + n_stages - 1
-    # Derive the carries from traced inputs so they inherit the inputs'
-    # varying-axis (vma) type for the scan (a carry must match the body
-    # output's vma over EVERY bound axis — stage and the caller's data
-    # axis, whose name this function cannot know, so _pvary alone is not
-    # enough). The zeros are value-independent; XLA dead-code-eliminates
-    # the embed evaluation and the multiply.
-    state0 = jnp.zeros_like(embed_fn(embed_params, tokens_micro[0]))
-    losses0 = _zeros_with_vma_of((n_micro,), jnp.float32, state0)
-    perm = [(i, i + 1) for i in range(n_stages - 1)]
     body = jax.checkpoint(stage_fn) if remat else stage_fn
+    # The loss carry must inherit the inputs' varying-axis type over
+    # EVERY bound axis — stage and the caller's data axis, whose name
+    # this function cannot know, so _pvary alone is not enough; derive
+    # it from a (DCE'd) embed evaluation instead.
+    h_ref = embed_fn(embed_params, tokens_micro[0])
+    losses0 = _zeros_with_vma_of((n_micro,), jnp.float32, h_ref)
 
-    def tick(carry, t):
-        state, losses = carry
-        feed = embed_fn(embed_params, tokens_micro[jnp.minimum(t, n_micro - 1)])
-        h_in = jnp.where(s == 0, feed, state)
-        y = body(stage_params_local, h_in, s)
-        out_idx = t - (n_stages - 1)
-        idx = jnp.clip(out_idx, 0, n_micro - 1)
+    def emit(losses, idx, y, is_emit):
         mb_loss = head_loss_fn(
             head_params, y, labels_micro[idx]
         ).astype(jnp.float32)
-        is_emit = jnp.logical_and(s == n_stages - 1, out_idx >= 0)
         prev = lax.dynamic_index_in_dim(losses, idx, 0, keepdims=False)
-        losses = lax.dynamic_update_index_in_dim(
+        return lax.dynamic_update_index_in_dim(
             losses, jnp.where(is_emit, mb_loss, prev), idx, 0
         )
-        state_next = lax.ppermute(y, axis_name, perm)
-        return (state_next, losses), None
 
-    (_, losses), _ = lax.scan(tick, (state0, losses0), jnp.arange(ticks))
+    losses = _gpipe_scan(
+        axis_name, n_micro,
+        lambda i: embed_fn(embed_params, tokens_micro[i]),
+        lambda h, s: body(stage_params_local, h, s),
+        emit, losses0,
+    )
     # Losses live on the last stage; share so the value (and the gradient
     # wiring) is SPMD-identical everywhere.
-    mask = (s == n_stages - 1).astype(losses.dtype)
+    n_stages = lax.axis_size(axis_name)
+    mask = (lax.axis_index(axis_name) == n_stages - 1).astype(losses.dtype)
     losses = lax.psum(losses * mask, axis_name)
     return losses.mean()
 
@@ -290,6 +300,7 @@ def make_pp_lm_train_step(
     import optax
 
     from ..jax import _shard_map
+    from ._stacked import apply_stacked_update
 
     def step(params, opt_state, tokens_micro, labels_micro):
         nd = lax.axis_size(data_axis)
@@ -318,12 +329,9 @@ def make_pp_lm_train_step(
             g_embed, opt_state["embed"], params["embed"]
         )
         new_params["embed"] = optax.apply_updates(params["embed"], up)
-        s_local = jax.tree.map(lambda t: t[0], opt_state["stages"])
-        up, s_local = optimizer.update(g_stages, s_local, stages_local)
-        new_params["stages"] = jax.tree.map(
-            lambda t: t[None], optax.apply_updates(stages_local, up)
+        new_params["stages"], new_state["stages"] = apply_stacked_update(
+            optimizer, params["stages"], opt_state["stages"], g_stages
         )
-        new_state["stages"] = jax.tree.map(lambda t: t[None], s_local)
         up, new_state["head"] = optimizer.update(
             g_head, opt_state["head"], params["head"]
         )

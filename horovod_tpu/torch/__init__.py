@@ -222,23 +222,22 @@ class _DistributedAdasumOptimizer:
         # Only parameters the optimizer can update get cloned/reduced —
         # frozen (grad-None) params never produce a delta, and the skip is
         # structural, so it is consistent across ranks.
-        if closure is not None and any(
+        if closure is not None and all(
             p.grad is None
             for group in self._opt.param_groups
             for p in group["params"]
             if p.requires_grad
         ):
-            # A trainable param without a gradient + a closure means the
-            # closure may be the gradient producer (LBFGS pattern): such
-            # params would be missing from the delta snapshot below and
-            # their updates would never be Adasum-reduced — ranks diverge
-            # silently. Delta-space Adasum needs loss.backward() before
-            # step() for every trainable parameter.
+            # No gradients exist at all, so the closure is the gradient
+            # producer (LBFGS pattern): the delta snapshot below would be
+            # empty and nothing would be Adasum-reduced. Fail before
+            # stepping. (Partially-missing grads are legal — structurally
+            # unused params stay grad-None forever — so the precise
+            # check for closure-produced gradients runs AFTER the step.)
             raise ValueError(
                 "DistributedAdasumOptimizer cannot reduce "
                 "closure-computed gradients: call loss.backward() before "
-                "step() so every trainable parameter's delta is "
-                "observable."
+                "step() so parameter deltas are observable."
             )
         starts = {}
         with torch.no_grad():
@@ -247,6 +246,21 @@ class _DistributedAdasumOptimizer:
                     if p.grad is not None:
                         starts[p] = p.detach().clone()
         loss = self._opt.step(closure)
+        if closure is not None:
+            # Precise post-step detection: a param that was grad-None at
+            # snapshot time but has a gradient now got it FROM the
+            # closure — its locally-applied update was never
+            # Adasum-reduced, so ranks would diverge silently. Fail loud.
+            for group in self._opt.param_groups:
+                for p in group["params"]:
+                    if p not in starts and p.grad is not None:
+                        raise RuntimeError(
+                            "DistributedAdasumOptimizer: the step closure "
+                            "produced gradients for parameters that had "
+                            "none before step(); their updates cannot be "
+                            "Adasum-reduced. Call loss.backward() before "
+                            "step() instead."
+                        )
         # Adasum-allreduce each parameter's local delta asynchronously,
         # then rebase: p = p_start + adasum(delta).
         handles = []
