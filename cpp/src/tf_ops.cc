@@ -21,6 +21,7 @@
 
 #include <Python.h>
 
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -231,6 +232,13 @@ void hvd_tf_finish(long long handle, int status, const char* error,
         error != nullptr ? error : "horovod_tpu collective failed"));
     p.done();
     return;
+  }
+  static const bool debug = std::getenv("HVD_TF_DEBUG") != nullptr;
+  if (debug) {
+    std::fprintf(stderr,
+                 "[hvd_tf_finish] handle=%lld ndims=%d dims0=%lld "
+                 "nbytes=%lld\n",
+                 handle, ndims, ndims > 0 ? dims[0] : -1, nbytes);
   }
   TensorShape shape;
   for (int i = 0; i < ndims; ++i) shape.AddDim(dims[i]);

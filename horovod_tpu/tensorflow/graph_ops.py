@@ -141,7 +141,10 @@ def _trampoline(handle, kind, ptr, shape, tf_dtype, name, root_rank,
             out = np.asarray(output)
             if out.dtype != np_dtype:
                 out = out.astype(np_dtype)
-            out = np.ascontiguousarray(out)
+            # ascontiguousarray PROMOTES 0-d arrays to shape (1,) (numpy
+            # ndmin=1 wart) — restore the true shape or every scalar
+            # collective output would come back as [1].
+            out = np.ascontiguousarray(out).reshape(out.shape)
             dims = (ctypes.c_longlong * max(out.ndim, 1))(*(
                 out.shape if out.ndim else (1,)
             ))

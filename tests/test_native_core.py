@@ -269,8 +269,16 @@ def test_eager_wakeup_beats_cycle_cadence():
 
     fast = time_to_plan({})  # wakeup defaults on
     slow = time_to_plan({"HOROVOD_TPU_EAGER_WAKEUP": "0"})
-    assert fast < 0.5, f"eager wakeup did not fire: {fast:.3f}s"
-    assert slow > 0.5, f"cadence path returned too early: {slow:.3f}s"
+    # Absolute bounds relaxed for the shared-core CI host (a full-suite
+    # run can preempt this process for hundreds of ms); the relative
+    # separation is the real claim.
+    assert fast < 0.8, f"eager wakeup did not fire: {fast:.3f}s"
+    # The cadence path fires at the ~1.0s cycle boundary, so the relative
+    # bound must stay below that: demand clear separation, not a multiple
+    # of a possibly-preempted `fast`.
+    assert slow > 0.8 and slow > fast + 0.2, (
+        f"cadence path returned too early: {slow:.3f}s (fast {fast:.3f}s)"
+    )
 
 
 def test_start_timeout_bounds_rendezvous():

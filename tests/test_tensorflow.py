@@ -289,3 +289,35 @@ def test_tf_adasum_optimizer_delta_space_single_rank():
             g = tape.gradient(loss, [w])
             opt.apply_gradients(zip(g, [w]))
     np.testing.assert_allclose(w_plain.numpy(), w_hvd.numpy(), atol=1e-6)
+
+
+def test_graph_scalar_collectives_preserve_shape():
+    """Regression: scalar (0-d) tensors through the graph-native ops must
+    come back 0-d — np.ascontiguousarray promotes 0-d to (1,) (the numpy
+    ndmin wart), which broke optimizer iteration-counter broadcasts
+    (AssignVariableOp "Expected [] got [1]")."""
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd_tf
+    from horovod_tpu.tensorflow import graph_ops
+
+    if graph_ops.load() is None:
+        import pytest
+
+        pytest.skip("graph-native op library unavailable")
+    s = tf.constant(3.5)
+    out = tf.function(
+        lambda t: hvd_tf.broadcast(t, 0, name="scalar.bc.graph")
+    )(s)
+    assert out.shape == (), out.shape
+    assert float(out) == 3.5
+    out2 = tf.function(
+        lambda t: hvd_tf.allreduce(t, op=hvd_tf.Sum, name="scalar.ar.graph")
+    )(s)
+    assert out2.shape == (), out2.shape
+    # int64 scalar (the optimizer iteration counter pattern).
+    it = tf.constant(7, tf.int64)
+    out3 = tf.function(
+        lambda t: hvd_tf.broadcast(t, 0, name="scalar.it.graph")
+    )(it)
+    assert out3.shape == () and int(out3) == 7
