@@ -405,11 +405,13 @@ def grouped_allreduce_async(
     before submission when cross-rank failure atomicity matters."""
     base = name if name is not None else _auto_name("grouped_allreduce", None)
     tensors = list(tensors)
-    import hashlib
+    # Validate every member before enqueuing any: a mid-group failure
+    # leaves peers holding an incompletable group (see _drain_group).
+    from .common.types import dtype_from_array
 
-    gid = int.from_bytes(
-        hashlib.md5(base.encode()).digest()[:8], "little"
-    ) or 1
+    for t in tensors:
+        dtype_from_array(t)
+    gid = _group_id(base)
     handles = []
     try:
         for i, t in enumerate(tensors):
@@ -420,13 +422,50 @@ def grouped_allreduce_async(
                 _group=(gid, len(tensors)),
             ))
     except Exception:
-        for h in handles:
-            try:
-                synchronize(h)
-            except Exception:  # noqa: BLE001 - surfacing the original error
-                pass
+        _drain_group(handles)
         raise
     return handles
+
+
+def _group_id(base: str) -> int:
+    """Cross-rank-stable nonzero group id derived from the base name
+    (every rank traces the same name sequence; md5 makes collisions
+    between distinct concurrent groups negligible)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.md5(base.encode()).digest()[:8], "little"
+    ) or 1
+
+
+def _drain_group(handles) -> None:
+    """Best-effort bounded wait on already-submitted group members after
+    a mid-group enqueue failure. The group can never complete (the
+    coordinator holds it until every member arrives), so an unbounded
+    synchronize would deadlock here — wait briefly, then abandon; the
+    stall inspector reports the orphaned members and peers recover via
+    its warning/shutdown path."""
+    for h in handles:
+        try:
+            _rt().synchronize(h, timeout=1.0)
+        except Exception:  # noqa: BLE001 - surfacing the original error
+            pass
+
+
+def grouped_sync_first_error(handles, synchronize_fn):
+    """Wait on every handle even when one fails (no orphaned results in
+    the handle table); re-raise the first error. Shared by the top-level
+    and framework grouped APIs."""
+    outputs, first_error = [], None
+    for h in handles:
+        try:
+            outputs.append(synchronize_fn(h))
+        except Exception as exc:  # noqa: BLE001 - re-raised below
+            if first_error is None:
+                first_error = exc
+    if first_error is not None:
+        raise first_error
+    return outputs
 
 
 def grouped_allreduce(
@@ -441,16 +480,7 @@ def grouped_allreduce(
         tensors, average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     )
-    outputs, first_error = [], None
-    for h in handles:
-        try:
-            outputs.append(synchronize(h))
-        except Exception as exc:  # noqa: BLE001 - re-raised below
-            if first_error is None:
-                first_error = exc
-    if first_error is not None:
-        raise first_error
-    return outputs
+    return grouped_sync_first_error(handles, synchronize)
 
 
 def join() -> None:

@@ -73,6 +73,26 @@ def _from_jax(out):
     return tf.convert_to_tensor(np.asarray(out))
 
 
+def _restore_dtype(out, t):
+    """Restore the caller's dtype on a data-plane result: jax (x64
+    disabled) narrows 64-bit ints/floats — TF optimizer counters are
+    int64 scalars. Int payloads that do not survive the 32-bit round
+    trip must fail loudly, not wrap silently; float64 loses precision by
+    design (the data plane computes in float32)."""
+    import tensorflow as tf
+
+    if out.dtype != t.dtype:
+        if t.dtype.is_integer and not bool(
+            tf.reduce_all(tf.cast(tf.cast(t, out.dtype), t.dtype) == t)
+        ):
+            raise ValueError(
+                f"{t.dtype.name} payload exceeds {out.dtype.name} "
+                "range: the XLA data plane runs with x64 disabled"
+            )
+        out = tf.cast(out, t.dtype)
+    return out
+
+
 def _np_op(fn, tensor, *args, keep_shape=True, **kwargs):
     """Run an eager-runtime collective on a TF tensor, eagerly or inside a
     graph via py_function. Either way the payload crosses frameworks via
@@ -86,22 +106,7 @@ def _np_op(fn, tensor, *args, keep_shape=True, **kwargs):
     import tensorflow as tf
 
     def run(t):
-        out = _from_jax(fn(_to_jax(t), *args, **kwargs))
-        if out.dtype != t.dtype:
-            # jax (x64 disabled) narrows 64-bit ints/floats; restore the
-            # caller's dtype — TF optimizer counters are int64 scalars.
-            # Int payloads that do not survive the 32-bit round trip must
-            # fail loudly, not wrap silently; float64 loses precision by
-            # design (the data plane computes in float32).
-            if t.dtype.is_integer and not bool(
-                tf.reduce_all(tf.cast(tf.cast(t, out.dtype), t.dtype) == t)
-            ):
-                raise ValueError(
-                    f"{t.dtype.name} payload exceeds {out.dtype.name} "
-                    "range: the XLA data plane runs with x64 disabled"
-                )
-            out = tf.cast(out, t.dtype)
-        return out
+        return _restore_dtype(_from_jax(fn(_to_jax(t), *args, **kwargs)), t)
 
     if tf.executing_eagerly() and not isinstance(tensor, tf.Tensor):
         tensor = tf.convert_to_tensor(tensor)
@@ -293,6 +298,74 @@ def alltoall(tensor, name=None):
         return y, grad
 
     return _a2a(tensor)
+
+
+def grouped_allreduce(tensors, average=None, compression=Compression.none,
+                      op=None, prescale_factor=1.0, postscale_factor=1.0,
+                      name=None):
+    """Allreduce a list of tensors as one first-class group
+    (later-reference ``hvd.grouped_allreduce`` parity). Eager tensors
+    ride the runtime's group barrier and fuse into a single plan, with
+    a registered gradient (the group's adjoint is a grouped reduce of
+    the upstream gradients, same op mapping as ``allreduce``); inside
+    ``tf.function`` each tensor is its own graph node and fuses
+    per-cycle (the group id does not cross the graph boundary yet)."""
+    import tensorflow as tf
+
+    from .. import grouped_allreduce as _grouped_np
+
+    if op is None and average is None:
+        rop = ReduceOp.AVERAGE
+    elif op is not None:
+        rop = op
+    else:
+        rop = ReduceOp.AVERAGE if average else ReduceOp.SUM
+
+    if not tf.executing_eagerly():
+        return [
+            allreduce(t, compression=compression, op=rop,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      name=f"{name}.{i}" if name else None)
+            for i, t in enumerate(tensors)
+        ]
+
+    compressed, ctxs = [], []
+    for t in tensors:
+        c, ctx = compression.compress(tf.convert_to_tensor(t))
+        compressed.append(c)
+        ctxs.append(ctx)
+
+    def _run_group(xs, group_op, group_name):
+        outs = _grouped_np(
+            [_to_jax(x) for x in xs], op=group_op, name=group_name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+        return [
+            _restore_dtype(_from_jax(o), x) for o, x in zip(outs, xs)
+        ]
+
+    @tf.custom_gradient
+    def _gar(*xs):
+        ys = _run_group(xs, rop, name)
+
+        def grad(*dys):
+            # Same adjoint mapping as allreduce: the averaged op's
+            # adjoint is the averaged op; everything else reduces the
+            # upstream gradients with SUM.
+            grad_op = (ReduceOp.AVERAGE if rop == ReduceOp.AVERAGE
+                       else ReduceOp.SUM)
+            return tuple(_run_group(
+                dys, grad_op, f"{name}.grad" if name else None
+            ))
+
+        return tuple(ys), grad
+
+    outs = _gar(*compressed)
+    return [
+        compression.decompress(o, ctx) for o, ctx in zip(outs, ctxs)
+    ]
 
 
 def broadcast_variables(variables, root_rank: int = 0) -> None:

@@ -43,6 +43,8 @@ from .mpi_ops import (  # noqa: F401
     broadcast_,
     broadcast_async,
     broadcast_async_,
+    grouped_allreduce,
+    grouped_allreduce_async,
     join,
     poll,
     synchronize,

@@ -123,6 +123,57 @@ def allreduce_(tensor, average=None, name=None, op=None,
     )
 
 
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0):
+    """Enqueue ``tensors`` as ONE first-class group and return their
+    handles (later-reference ``hvd.grouped_allreduce_async`` parity for
+    torch): the coordinator holds the group until every member is ready
+    on every rank and fuses it into a single plan regardless of cycle
+    boundaries or the fusion threshold."""
+    from .. import _drain_group, _group_id
+
+    rop = _resolve_op(average, op)
+    if rop == ReduceOp.ADASUM:
+        raise ValueError(
+            "grouped_allreduce does not support op=Adasum; use the "
+            "DistributedAdasumOptimizer (delta-space) path instead"
+        )
+    tensors = list(tensors)
+    # Convert every member BEFORE enqueuing any: a mid-group failure
+    # leaves peers holding an incompletable group.
+    arrs = [_to_numpy(t) for t in tensors]
+    base = _auto_name("grouped_allreduce.torch", name)
+    gid = _group_id(base)
+    rt = _rt()
+    handles = []
+    try:
+        for i, (t, arr) in enumerate(zip(tensors, arrs)):
+            h = rt.enqueue_allreduce(
+                f"{base}.{i}", arr, reduce_op=rop,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                group_id=gid, group_size=len(tensors),
+            )
+            _handle_meta[h] = (None, t)
+            handles.append(h)
+    except Exception:
+        _drain_group(handles)
+        raise
+    return handles
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0):
+    """Synchronous grouped allreduce; returns outputs in input order."""
+    from .. import grouped_sync_first_error
+
+    handles = grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    return grouped_sync_first_error(handles, synchronize)
+
+
 def allgather_async(tensor, name=None) -> int:
     arr = _to_numpy(tensor)
     handle = _rt().enqueue_allgather(_auto_name("allgather.torch", name), arr)

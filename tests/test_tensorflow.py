@@ -321,3 +321,42 @@ def test_graph_scalar_collectives_preserve_shape():
         lambda t: hvd_tf.broadcast(t, 0, name="scalar.it.graph")
     )(it)
     assert out3.shape == () and int(out3) == 7
+
+
+def test_grouped_allreduce_tf_eager():
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd_tf
+
+    outs = hvd_tf.grouped_allreduce(
+        [tf.constant([1.0, 2.0]), tf.constant([3.0])],
+        op=hvd_tf.Sum, name="tfg",
+    )
+    assert len(outs) == 2
+    assert outs[0].numpy().tolist() == [1.0, 2.0]
+    assert outs[1].numpy().tolist() == [3.0]
+
+
+def test_grouped_allreduce_tf_dtype_and_gradient():
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd_tf
+
+    # int64 comes back int64 (dtype restoration like the single op).
+    outs = hvd_tf.grouped_allreduce(
+        [tf.constant([7], tf.int64)], op=hvd_tf.Sum, name="tfg64",
+    )
+    assert outs[0].dtype == tf.int64 and int(outs[0][0]) == 7
+
+    # The group differentiates: d(sum of reduced)/dx = 1 at size=1.
+    v = tf.Variable([1.0, 2.0])
+    w = tf.Variable([3.0])
+    with tf.GradientTape() as tape:
+        a, b = hvd_tf.grouped_allreduce(
+            [v * 2.0, w * 3.0], op=hvd_tf.Sum, name="tfg.grad",
+        )
+        loss = tf.reduce_sum(a) + tf.reduce_sum(b)
+    gv, gw = tape.gradient(loss, [v, w])
+    assert gv is not None and gw is not None
+    assert gv.numpy().tolist() == [2.0, 2.0]
+    assert gw.numpy().tolist() == [3.0]

@@ -221,3 +221,19 @@ def test_adasum_optimizer_delta_space_single_rank():
 def test_allgather_object_single_rank():
     out = hvd.allgather_object({"rank": hvd.rank(), "blob": "x" * 10})
     assert out == [{"rank": 0, "blob": "x" * 10}]
+
+
+def test_grouped_allreduce_torch():
+    """later-reference grouped API parity for torch: one first-class
+    group, outputs in input order, values correct at size=1."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    tensors = [torch.full((4,), float(i + 1)) for i in range(5)]
+    outs = hvd.grouped_allreduce(tensors, op=hvd.Sum, name="tg")
+    for i, o in enumerate(outs):
+        assert torch.allclose(o, torch.full((4,), float(i + 1))), (i, o)
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd.grouped_allreduce_async(tensors, op=hvd.Adasum)
