@@ -41,7 +41,8 @@ namespace {
 
 // Python trampoline: called (with the GIL) as
 //   trampoline(handle, kind, ptr, shape_tuple, tf_dtype, name,
-//              root_rank, reduce_op, prescale, postscale)
+//              root_rank, reduce_op, prescale, postscale,
+//              group_id, group_size)
 // and must arrange for hvd_tf_finish(handle, ...) to be called exactly
 // once from any thread.
 PyObject* g_trampoline = nullptr;
@@ -49,6 +50,8 @@ PyObject* g_trampoline = nullptr;
 struct PendingOp {
   OpKernelContext* ctx;
   AsyncOpKernel::DoneCallback done;
+  int remaining = 1;   // outputs not yet finished (grouped op: N)
+  bool failed = false;
 };
 
 std::mutex g_mu;
@@ -64,6 +67,12 @@ class HvdCollectiveOp : public AsyncOpKernel {
     if (c->HasAttr("root_rank")) c->GetAttr("root_rank", &root_rank_);
     if (c->HasAttr("prescale_factor")) c->GetAttr("prescale_factor", &pre_);
     if (c->HasAttr("postscale_factor")) c->GetAttr("postscale_factor", &post_);
+    if (c->HasAttr("group_id")) {
+      tensorflow::int64 gid = 0;
+      c->GetAttr("group_id", &gid);
+      group_id_ = static_cast<long long>(gid);
+    }
+    if (c->HasAttr("group_size")) c->GetAttr("group_size", &group_size_);
   }
 
   void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
@@ -72,40 +81,52 @@ class HvdCollectiveOp : public AsyncOpKernel {
     {
       std::lock_guard<std::mutex> l(g_mu);
       handle = ++g_next_handle;
-      g_pending[handle] = {ctx, std::move(done)};
+      g_pending[handle] = {ctx, std::move(done), 1, false};
     }
     PyGILState_STATE st = PyGILState_Ensure();
-    bool ok = false;
-    if (g_trampoline != nullptr) {
-      PyObject* shape = PyTuple_New(input.dims());
-      for (int i = 0; i < input.dims(); ++i) {
-        PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(input.dim_size(i)));
-      }
-      PyObject* r = PyObject_CallFunction(
-          g_trampoline, "LsKOisiidd", handle, kind_.c_str(),
-          (unsigned long long)(uintptr_t)input.tensor_data().data(), shape,
-          static_cast<int>(input.dtype()), tensor_name_.c_str(), root_rank_,
-          reduce_op_, pre_, post_);
-      Py_DECREF(shape);
-      if (r != nullptr) {
-        ok = true;
-        Py_DECREF(r);
-      } else {
-        PyErr_Print();
-      }
-    }
+    bool ok = CallTrampoline(handle, 0, kind_.c_str(), input, tensor_name_,
+                             root_rank_, reduce_op_, pre_, post_,
+                             group_id_, group_size_);
     PyGILState_Release(st);
-    if (!ok) {
-      PendingOp p;
-      {
-        std::lock_guard<std::mutex> l(g_mu);
-        p = std::move(g_pending[handle]);
-        g_pending.erase(handle);
-      }
-      p.ctx->CtxFailure(tensorflow::errors::Internal(
-          "horovod_tpu graph-op trampoline missing or raised"));
-      p.done();
+    if (!ok) FailPending(handle);
+  }
+
+  static bool CallTrampoline(long long handle, int out_index,
+                             const char* kind, const Tensor& input,
+                             const std::string& tensor_name, int root_rank,
+                             int reduce_op, float pre, float post,
+                             long long group_id, int group_size) {
+    if (g_trampoline == nullptr) return false;
+    PyObject* shape = PyTuple_New(input.dims());
+    for (int i = 0; i < input.dims(); ++i) {
+      PyTuple_SET_ITEM(shape, i, PyLong_FromLongLong(input.dim_size(i)));
     }
+    PyObject* r = PyObject_CallFunction(
+        g_trampoline, "LisKOisiiddLi", handle, out_index, kind,
+        (unsigned long long)(uintptr_t)input.tensor_data().data(), shape,
+        static_cast<int>(input.dtype()), tensor_name.c_str(), root_rank,
+        reduce_op, pre, post, group_id, group_size);
+    Py_DECREF(shape);
+    if (r == nullptr) {
+      PyErr_Print();
+      return false;
+    }
+    Py_DECREF(r);
+    return true;
+  }
+
+  static void FailPending(long long handle) {
+    PendingOp p;
+    {
+      std::lock_guard<std::mutex> l(g_mu);
+      auto it = g_pending.find(handle);
+      if (it == g_pending.end()) return;
+      p = std::move(it->second);
+      g_pending.erase(it);
+    }
+    p.ctx->CtxFailure(tensorflow::errors::Internal(
+        "horovod_tpu graph-op trampoline missing or raised"));
+    p.done();
   }
 
  private:
@@ -115,6 +136,8 @@ class HvdCollectiveOp : public AsyncOpKernel {
   int root_rank_ = -1;
   float pre_ = 1.0f;
   float post_ = 1.0f;
+  long long group_id_ = 0;
+  int group_size_ = 0;
 };
 
 #define DEFINE_KIND_KERNEL(cls, kind)                       \
@@ -123,6 +146,79 @@ class HvdCollectiveOp : public AsyncOpKernel {
     explicit cls(OpKernelConstruction* c)                   \
         : HvdCollectiveOp(c, kind) {}                       \
   };
+
+class HvdGroupedAllreduceOp : public AsyncOpKernel {
+ public:
+  explicit HvdGroupedAllreduceOp(OpKernelConstruction* c) : AsyncOpKernel(c) {
+    OP_REQUIRES_OK(c, c->GetAttr("tensor_name", &tensor_name_));
+    c->GetAttr("reduce_op", &reduce_op_);
+    c->GetAttr("prescale_factor", &pre_);
+    c->GetAttr("postscale_factor", &post_);
+    tensorflow::int64 gid = 0;
+    c->GetAttr("group_id", &gid);
+    group_id_ = static_cast<long long>(gid);
+  }
+
+  // ONE graph node for the whole group: members cannot be pruned apart
+  // (a partially-pruned group would deadlock the coordinator's group
+  // barrier waiting for members that never execute — observed with
+  // per-member nodes under gradient-only tf.functions).
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const int n = ctx->num_inputs();
+    long long handle;
+    {
+      std::lock_guard<std::mutex> l(g_mu);
+      handle = ++g_next_handle;
+      g_pending[handle] = {ctx, std::move(done), n, false};
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    int launched = 0;
+    for (; launched < n; ++launched) {
+      if (!HvdCollectiveOp::CallTrampoline(
+              handle, launched, "allreduce", ctx->input(launched),
+              tensor_name_ + "." + std::to_string(launched), -1,
+              reduce_op_, pre_, post_, group_id_, n)) {
+        break;
+      }
+    }
+    PyGILState_Release(st);
+    if (launched < n) {
+      // Mark the op failed and subtract the members that never
+      // launched (the failed member itself included) from `remaining`;
+      // the launched members' hvd_tf_finish calls drain the rest, so
+      // done() only fires once no callback can still touch the input
+      // buffers (their views alias ctx's tensors).
+      PendingOp done_op;
+      bool fire = false;
+      {
+        std::lock_guard<std::mutex> l(g_mu);
+        auto it = g_pending.find(handle);
+        if (it != g_pending.end()) {
+          PendingOp& p = it->second;
+          if (!p.failed) {
+            p.failed = true;
+            p.ctx->CtxFailure(tensorflow::errors::Internal(
+                "horovod_tpu grouped trampoline missing or raised"));
+          }
+          p.remaining -= n - launched;
+          if (p.remaining <= 0) {
+            done_op = std::move(p);
+            g_pending.erase(it);
+            fire = true;
+          }
+        }
+      }
+      if (fire) done_op.done();
+    }
+  }
+
+ private:
+  std::string tensor_name_;
+  int reduce_op_ = 1;
+  float pre_ = 1.0f;
+  float post_ = 1.0f;
+  long long group_id_ = 0;
+};
 
 DEFINE_KIND_KERNEL(HvdAllreduceOp, "allreduce")
 DEFINE_KIND_KERNEL(HvdAllgatherOp, "allgather")
@@ -138,10 +234,30 @@ REGISTER_OP("HorovodTpuAllreduce")
     .Attr("reduce_op: int = 1")
     .Attr("prescale_factor: float = 1.0")
     .Attr("postscale_factor: float = 1.0")
+    .Attr("group_id: int = 0")
+    .Attr("group_size: int = 0")
     .Input("tensor: T")
     .Output("sum: T")
     .SetShapeFn([](InferenceContext* c) {
       c->set_output(0, c->input(0));
+      return tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HorovodTpuGroupedAllreduce")
+    .Attr("N: int >= 1")
+    .Attr(
+        "T: {float16, bfloat16, float32, float64, int32, int64, uint8, int8}")
+    .Attr("tensor_name: string")
+    .Attr("reduce_op: int = 1")
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Attr("group_id: int = 0")
+    .Input("tensors: N * T")
+    .Output("sums: N * T")
+    .SetShapeFn([](InferenceContext* c) {
+      for (int i = 0; i < c->num_inputs(); ++i) {
+        c->set_output(i, c->input(i));
+      }
       return tensorflow::OkStatus();
     });
 
@@ -188,6 +304,9 @@ REGISTER_KERNEL_BUILDER(
     Name("HorovodTpuAllreduce").Device(tensorflow::DEVICE_CPU),
     HvdAllreduceOp);
 REGISTER_KERNEL_BUILDER(
+    Name("HorovodTpuGroupedAllreduce").Device(tensorflow::DEVICE_CPU),
+    HvdGroupedAllreduceOp);
+REGISTER_KERNEL_BUILDER(
     Name("HorovodTpuAllgather").Device(tensorflow::DEVICE_CPU),
     HvdAllgatherOp);
 REGISTER_KERNEL_BUILDER(
@@ -211,49 +330,69 @@ void hvd_tf_set_trampoline(PyObject* fn) {
   PyGILState_Release(st);
 }
 
-// Completion path, called from the runtime's executor thread (ctypes
-// releases the GIL around this call, so done() may run TF work inline
-// without deadlocking). Allocates the output with the post-negotiation
-// shape and copies `data` (nbytes) into it. status != 0 fails the op
-// with `error`.
-void hvd_tf_finish(long long handle, int status, const char* error,
-                   const void* data, const long long* dims, int ndims,
-                   long long nbytes) {
-  PendingOp p;
+// Completion path for ONE output of a pending op, called from the
+// runtime's executor thread (ctypes releases the GIL around this call,
+// so done() may run TF work inline without deadlocking). Allocates
+// output `out_index` with the post-negotiation shape and copies `data`
+// (nbytes) into it; done() fires when every output of the op has
+// finished (single-output ops: immediately). status != 0 fails the op
+// with `error` once; remaining members still drain.
+void hvd_tf_finish(long long handle, int out_index, int status,
+                   const char* error, const void* data,
+                   const long long* dims, int ndims, long long nbytes) {
+  static const bool debug = std::getenv("HVD_TF_DEBUG") != nullptr;
+  if (debug) {
+    std::fprintf(stderr,
+                 "[hvd_tf_finish] handle=%lld idx=%d status=%d ndims=%d "
+                 "nbytes=%lld\n",
+                 handle, out_index, status, ndims, nbytes);
+  }
+  // Phase 1 (locked): record failure or allocate this member's output.
+  // The bulk memcpy runs OUTSIDE the lock — completions of different
+  // outputs write disjoint buffers, and holding g_mu through a large
+  // copy would stall every other dispatch/completion.
+  Tensor* out = nullptr;
   {
     std::lock_guard<std::mutex> l(g_mu);
     auto it = g_pending.find(handle);
     if (it == g_pending.end()) return;
-    p = std::move(it->second);
-    g_pending.erase(it);
+    PendingOp& p = it->second;
+    if (status != 0) {
+      if (!p.failed) {
+        p.failed = true;
+        p.ctx->CtxFailure(tensorflow::errors::Internal(
+            error != nullptr ? error : "horovod_tpu collective failed"));
+      }
+    } else if (!p.failed) {
+      TensorShape shape;
+      for (int i = 0; i < ndims; ++i) shape.AddDim(dims[i]);
+      tensorflow::Status s = p.ctx->allocate_output(out_index, shape, &out);
+      if (!s.ok()) {
+        p.failed = true;
+        p.ctx->CtxFailure(s);
+        out = nullptr;
+      }
+    }
   }
-  if (status != 0) {
-    p.ctx->CtxFailure(tensorflow::errors::Internal(
-        error != nullptr ? error : "horovod_tpu collective failed"));
-    p.done();
-    return;
-  }
-  static const bool debug = std::getenv("HVD_TF_DEBUG") != nullptr;
-  if (debug) {
-    std::fprintf(stderr,
-                 "[hvd_tf_finish] handle=%lld ndims=%d dims0=%lld "
-                 "nbytes=%lld\n",
-                 handle, ndims, ndims > 0 ? dims[0] : -1, nbytes);
-  }
-  TensorShape shape;
-  for (int i = 0; i < ndims; ++i) shape.AddDim(dims[i]);
-  Tensor* out = nullptr;
-  tensorflow::Status s = p.ctx->allocate_output(0, shape, &out);
-  if (!s.ok()) {
-    p.ctx->CtxFailure(s);
-    p.done();
-    return;
-  }
-  if (nbytes > 0) {
+  if (out != nullptr && nbytes > 0) {
     std::memcpy(const_cast<char*>(out->tensor_data().data()), data,
                 static_cast<size_t>(nbytes));
   }
-  p.done();
+  // Phase 2 (locked): decrement; the entry cannot have been erased in
+  // between because only the final decrement erases and ours is pending.
+  PendingOp done_op;
+  bool fire = false;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    auto it = g_pending.find(handle);
+    if (it == g_pending.end()) return;
+    if (--it->second.remaining <= 0) {
+      done_op = std::move(it->second);
+      g_pending.erase(it);
+      fire = true;
+    }
+  }
+  if (fire) done_op.done();
 }
 
 }  // extern "C"

@@ -430,12 +430,13 @@ def grouped_allreduce_async(
 def _group_id(base: str) -> int:
     """Cross-rank-stable nonzero group id derived from the base name
     (every rank traces the same name sequence; md5 makes collisions
-    between distinct concurrent groups negligible)."""
+    between distinct concurrent groups negligible). Masked to 63 bits:
+    the id travels through signed-int64 channels (the TF custom op's
+    int attr, the wire codec), where the top bit would overflow."""
     import hashlib
 
-    return int.from_bytes(
-        hashlib.md5(base.encode()).digest()[:8], "little"
-    ) or 1
+    raw = int.from_bytes(hashlib.md5(base.encode()).digest()[:8], "little")
+    return (raw & ((1 << 63) - 1)) or 1
 
 
 def _drain_group(handles) -> None:

@@ -143,10 +143,7 @@ def _graph_dispatch(fn, tensor, *args, **kwargs):
     # Dtypes outside the custom op's registered T set (bool, int16,
     # complex, ...) must keep the py_function path instead of raising a
     # trace-time TypeError.
-    if tensor.dtype not in (
-        tf.float16, tf.bfloat16, tf.float32, tf.float64,
-        tf.int32, tf.int64, tf.uint8, tf.int8,
-    ):
+    if tensor.dtype not in graph_ops.supported_tf_dtypes():
         return None
     ops = graph_ops.load()
     if ops is None:
@@ -308,8 +305,9 @@ def grouped_allreduce(tensors, average=None, compression=Compression.none,
     ride the runtime's group barrier and fuse into a single plan, with
     a registered gradient (the group's adjoint is a grouped reduce of
     the upstream gradients, same op mapping as ``allreduce``); inside
-    ``tf.function`` each tensor is its own graph node and fuses
-    per-cycle (the group id does not cross the graph boundary yet)."""
+    ``tf.function`` each member is its own HorovodTpu* node carrying the
+    shared group id + member count, so the coordinator still fuses the
+    whole group into ONE plan."""
     import tensorflow as tf
 
     from .. import grouped_allreduce as _grouped_np
@@ -322,13 +320,10 @@ def grouped_allreduce(tensors, average=None, compression=Compression.none,
         rop = ReduceOp.AVERAGE if average else ReduceOp.SUM
 
     if not tf.executing_eagerly():
-        return [
-            allreduce(t, compression=compression, op=rop,
-                      prescale_factor=prescale_factor,
-                      postscale_factor=postscale_factor,
-                      name=f"{name}.{i}" if name else None)
-            for i, t in enumerate(tensors)
-        ]
+        return _graph_grouped_allreduce(
+            list(tensors), rop, compression,
+            prescale_factor, postscale_factor, name,
+        )
 
     compressed, ctxs = [], []
     for t in tensors:
@@ -359,6 +354,87 @@ def grouped_allreduce(tensors, average=None, compression=Compression.none,
             return tuple(_run_group(
                 dys, grad_op, f"{name}.grad" if name else None
             ))
+
+        return tuple(ys), grad
+
+    outs = _gar(*compressed)
+    return [
+        compression.decompress(o, ctx) for o, ctx in zip(outs, ctxs)
+    ]
+
+
+def _graph_grouped_allreduce(tensors, rop, compression,
+                             prescale_factor, postscale_factor, name):
+    """Graph-mode grouped allreduce: one HorovodTpu* node per member,
+    all carrying the same group id + member count, so the coordinator
+    fuses the whole group into ONE plan inside tf.function exactly like
+    the eager path. Falls back to independent per-tensor allreduces
+    (cycle fusion) when the op library is unavailable."""
+    import tensorflow as tf
+
+    from .. import _group_id
+    from . import graph_ops
+
+    if rop == ReduceOp.ADASUM:
+        # Consistent with the torch binding: Adasum has no grouped form
+        # (its adjoint/delta semantics are per-optimizer, not per-list).
+        raise ValueError(
+            "grouped_allreduce does not support op=Adasum; use the "
+            "delta-space Adasum optimizer path instead"
+        )
+    ops = graph_ops.load()
+    dtypes = {tf.convert_to_tensor(t).dtype for t in tensors}
+    supported = (
+        ops is not None
+        and len(dtypes) == 1  # the grouped op is homogeneous (N * T)
+        and next(iter(dtypes)) in graph_ops.supported_tf_dtypes()
+        # int64 members can fail the data-dependent range guard WITHOUT
+        # enqueuing, which would strand the rest of a first-class group
+        # at the coordinator — int64 lists take the per-tensor fallback,
+        # where each op fails loudly on its own.
+        and next(iter(dtypes)) != tf.int64
+    )
+    if not supported:
+        return [
+            allreduce(t, compression=compression, op=rop,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      name=f"{name}.{i}" if name else None)
+            for i, t in enumerate(tensors)
+        ]
+
+    base = name or graph_ops.auto_name("grouped_allreduce")
+    compressed, ctxs = [], []
+    for t in tensors:
+        c, ctx = compression.compress(tf.convert_to_tensor(t))
+        compressed.append(c)
+        ctxs.append(ctx)
+
+    def _emit(xs, group_base, group_op):
+        # ONE multi-input/multi-output node for the whole group: graph
+        # pruning is all-or-nothing by construction. Per-member nodes
+        # deadlocked — a gradient-only tf.function pruned some members
+        # (even through control deps, which grappler strips), leaving
+        # the coordinator's group barrier waiting forever.
+        outs = ops.horovod_tpu_grouped_allreduce(
+            tensors=list(xs), tensor_name=group_base,
+            reduce_op=int(group_op),
+            prescale_factor=float(prescale_factor),
+            postscale_factor=float(postscale_factor),
+            group_id=_group_id(group_base),
+        )
+        return list(outs)
+
+    @tf.custom_gradient
+    def _gar(*xs):
+        ys = _emit(list(xs), base, rop)
+
+        def grad(*dys):
+            # Group adjoint: grouped reduce of the upstream gradients
+            # (AVERAGE's adjoint is AVERAGE; everything else SUM).
+            grad_op = (ReduceOp.AVERAGE if rop == ReduceOp.AVERAGE
+                       else ReduceOp.SUM)
+            return tuple(_emit(list(dys), f"{base}.grad", grad_op))
 
         return tuple(ys), grad
 

@@ -100,8 +100,9 @@ def _build(src: str, out: str) -> None:
             os.unlink(tmp)
 
 
-def _trampoline(handle, kind, ptr, shape, tf_dtype, name, root_rank,
-                reduce_op, prescale, postscale):
+def _trampoline(handle, out_index, kind, ptr, shape, tf_dtype, name,
+                root_rank, reduce_op, prescale, postscale,
+                group_id=0, group_size=0):
     """Called (with the GIL) from the kernel's ComputeAsync on a TF
     executor thread. Enqueues into the eager runtime and returns
     immediately; completion calls back into the library."""
@@ -118,8 +119,8 @@ def _trampoline(handle, kind, ptr, shape, tf_dtype, name, root_rank,
 
     def finish_error(msg: str) -> None:
         cdll.hvd_tf_finish(
-            ctypes.c_longlong(handle), 1, msg.encode(), None, None, 0,
-            ctypes.c_longlong(0),
+            ctypes.c_longlong(handle), out_index, 1, msg.encode(),
+            None, None, 0, ctypes.c_longlong(0),
         )
 
     # The data plane computes in 32-bit (jax x64 disabled); a 64-bit int
@@ -149,7 +150,7 @@ def _trampoline(handle, kind, ptr, shape, tf_dtype, name, root_rank,
                 out.shape if out.ndim else (1,)
             ))
             cdll.hvd_tf_finish(
-                ctypes.c_longlong(handle), 0, b"",
+                ctypes.c_longlong(handle), out_index, 0, b"",
                 out.ctypes.data_as(ctypes.c_void_p), dims, out.ndim,
                 ctypes.c_longlong(out.nbytes),
             )
@@ -167,6 +168,7 @@ def _trampoline(handle, kind, ptr, shape, tf_dtype, name, root_rank,
                 name, view, reduce_op=ReduceOp(reduce_op),
                 prescale_factor=prescale, postscale_factor=postscale,
                 callback=callback,
+                group_id=group_id, group_size=group_size,
             )
         elif kind == "allgather":
             rt.enqueue_allgather(name, view, callback=callback)
@@ -207,8 +209,9 @@ def load():
             cdll.hvd_tf_set_trampoline.argtypes = [ctypes.py_object]
             cdll.hvd_tf_set_trampoline.restype = None
             cdll.hvd_tf_finish.argtypes = [
-                ctypes.c_longlong, ctypes.c_int, ctypes.c_char_p,
-                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_longlong),
                 ctypes.c_int, ctypes.c_longlong,
             ]
             cdll.hvd_tf_finish.restype = None
@@ -226,6 +229,18 @@ def load():
 
 def available() -> bool:
     return load() is not None
+
+
+def supported_tf_dtypes():
+    """The dtypes the custom ops register for attr T (must mirror the
+    constraint list in cpp/src/tf_ops.cc); shared by every graph-dispatch
+    guard so the set cannot silently diverge between call sites."""
+    import tensorflow as tf
+
+    return (
+        tf.float16, tf.bfloat16, tf.float32, tf.float64,
+        tf.int32, tf.int64, tf.uint8, tf.int8,
+    )
 
 
 _name_counter = [0]

@@ -120,3 +120,30 @@ def test_spark_example_gates_cleanly():
     )
     assert proc.returncode == 3
     assert "PySpark is not installed" in proc.stderr
+
+
+def test_jax_tp_pp_demo():
+    """The TP/PP demo (incl. the heterogeneous LM pipeline section) runs
+    end to end on the 8-device virtual mesh; single-process SPMD, so no
+    launcher needed."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "jax_tp_pp_demo.py"),
+         "--steps", "4"],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "DEMO DONE" in proc.stdout
+    assert "heterogeneous LM" in proc.stdout

@@ -1077,3 +1077,66 @@ def test_megascale_env_drives_hierarchical_mesh_four_ranks():
     )
     for out in outs:
         assert "MEGA_HIER [10.0, 10.0]" in out, outs
+
+
+def test_tf_graph_grouped_allreduce_one_plan_two_ranks():
+    """tf.function grouped_allreduce: the group id crosses the graph
+    boundary via the custom op attrs, so all members fuse into ONE plan
+    even though each is its own graph node."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvd
+        from horovod_tpu.core import xla_executor
+        hvd.init()
+        r = hvd.rank()
+
+        plans = []
+        orig = xla_executor.XlaPlanExecutor.execute
+        def spy(self, plan, entries, topo):
+            plans.append(list(plan.get("names", [])))
+            return orig(self, plan, entries, topo)
+        xla_executor.XlaPlanExecutor.execute = spy
+
+        @tf.function
+        def f(a, b, c):
+            return hvd.grouped_allreduce(
+                [a, b, c], op=hvd.Sum, name="gg")
+
+        outs = f(tf.constant([1.0]) * (r + 1),
+                 tf.constant([2.0]) * (r + 1),
+                 tf.constant([3.0]) * (r + 1))
+        vals = [float(o[0]) for o in outs]
+        assert vals == [3.0, 6.0, 9.0], vals
+        gg_plans = [p for p in plans if any("gg." in n for n in p)]
+        assert len(gg_plans) == 1 and len(gg_plans[0]) == 3, gg_plans
+
+        # Gradient through the graph group (default auto-name exercises
+        # the 63-bit group-id mask; the adjoint is a grouped SUM).
+        v = tf.Variable([1.0, 2.0])
+        @tf.function
+        def g():
+            with tf.GradientTape() as tape:
+                a, b = hvd.grouped_allreduce(
+                    [v * 2.0, v * 3.0], op=hvd.Sum)
+                loss = tf.reduce_sum(a) + tf.reduce_sum(b)
+            return tape.gradient(loss, v)
+        gv = g()
+        # d/dv sum(psum(2v)) + sum(psum(3v)) = 2*size + 3*size = 10
+        assert gv.numpy().tolist() == [10.0, 10.0], gv.numpy()
+        gdef = f.get_concrete_function(
+            tf.TensorSpec([1]), tf.TensorSpec([1]), tf.TensorSpec([1])
+        ).graph.as_graph_def()
+        types = {n.op for n in gdef.node}
+        for fn in gdef.library.function:
+            types |= {n.op for n in fn.node_def}
+        assert not any("PyFunc" in t for t in types), sorted(types)
+        print("GRAPH_GROUP_ONEPLAN", len(gg_plans[0]))
+        hvd.shutdown()
+        """,
+        timeout=300,
+    )
+    for out in outs:
+        assert "GRAPH_GROUP_ONEPLAN 3" in out, outs
