@@ -136,9 +136,11 @@ def _parse_args(argv=None):
     parser.add_argument(
         "--model", default="resnet50",
         choices=["resnet18", "resnet34", "resnet50", "resnet101",
-                 "resnet152", "vgg16", "inception3", "transformer"],
-        help="CNN img/sec benchmarks, or 'transformer': a GPT-style LM "
-             "(Pallas flash attention) measured in tokens/sec",
+                 "resnet152", "vgg16", "inception3", "transformer", "moe"],
+        help="CNN img/sec benchmarks; 'transformer': a GPT-style LM "
+             "(Pallas flash attention) in tokens/sec; 'moe': a "
+             "Switch-style mixture-of-experts layer stack trained with "
+             "expert parallelism (DP x EP alltoall) in tokens/sec",
     )
     parser.add_argument("--batch-size", type=int, default=32, help="per-chip batch")
     parser.add_argument("--image-size", type=int, default=224)
@@ -600,9 +602,163 @@ def run_lm_benchmark(args) -> int:
     return 0
 
 
+def _analytic_flops_moe(d_model, d_hidden, vocab, n_layers,
+                        tokens_per_chip):
+    """Per-chip step FLOPs for the top-1 switch stack: each token runs
+    ONE expert's two matmuls per layer plus embed/head projections
+    (2 FLOPs/MAC, x3 for train)."""
+    per_token_fwd = (
+        n_layers * 2 * (2 * d_model * d_hidden)  # expert in+out matmuls
+        + 2 * d_model * vocab                    # head projection
+    )
+    return 3.0 * per_token_fwd * tokens_per_chip
+
+
+def run_moe_benchmark(args) -> int:
+    """DP x EP mixture-of-experts benchmark in tokens/sec: Switch-style
+    top-1 routing, experts sharded over the expert axis, token shards
+    exchanged with lax.all_to_all over ICI (parallel/ep.py — a TPU-native
+    extension; the reference has no alltoall at all, message.h:48-50)."""
+    if args.smoke:
+        args.batch_size, args.seq_len = 2, 64
+        args.num_batches_per_iter, args.num_iters = 2, 2
+        dims = dict(d_model=64, d_hidden=128, n_layers=2, experts=8,
+                    vocab=512)
+    else:
+        dims = dict(d_model=512, d_hidden=2048, n_layers=4, experts=16,
+                    vocab=32768)
+
+    _force_platform(args.platform, args.cpu_devices)
+    devices, init_s, init_attempts = _init_backend_with_retry()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.parallel.ep import (
+        init_moe_params,
+        make_ep_train_step,
+        moe_ffn,
+    )
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    if args.devices > 0:
+        devices = devices[:args.devices]
+    n_chips = len(devices)
+    ep = 4 if n_chips % 4 == 0 else (2 if n_chips % 2 == 0 else 1)
+    dp = n_chips // ep
+    mesh = build_mesh({"data": dp, "expert": ep}, devices=devices)
+    tokens_per_chip = args.batch_size * args.seq_len
+    total_tokens = tokens_per_chip * n_chips
+
+    rngs = jax.random.split(jax.random.PRNGKey(0), dims["n_layers"] + 2)
+    params = {
+        "embed": jax.random.normal(
+            rngs[0], (dims["vocab"], dims["d_model"])) * 0.02,
+        "layers": [
+            init_moe_params(
+                rngs[1 + i], d_model=dims["d_model"],
+                d_hidden=dims["d_hidden"], num_experts=dims["experts"],
+                num_expert_shards=ep,
+            )
+            for i in range(dims["n_layers"])
+        ],
+        "head": jax.random.normal(
+            rngs[-1], (dims["d_model"], dims["vocab"])) * 0.02,
+    }
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, dims["vocab"], (total_tokens,)), jnp.int32)
+    labels = jnp.asarray(
+        rng.randint(0, dims["vocab"], (total_tokens,)), jnp.int32)
+
+    def loss_fn(p, batch):
+        tok, lab = batch
+        h = p["embed"][tok].astype(jnp.bfloat16)
+        aux_total = 0.0
+        for layer in p["layers"]:
+            out, aux = moe_ffn(
+                jax.tree.map(lambda x: x.astype(jnp.bfloat16), layer),
+                h, expert_axis="expert",
+            )
+            h = h + out
+            aux_total = aux_total + aux
+        logits = (h @ p["head"].astype(jnp.bfloat16)).astype(jnp.float32)
+        task = optax.softmax_cross_entropy_with_integer_labels(
+            logits, lab
+        ).mean()
+        return task, aux_total
+
+    step = make_ep_train_step(
+        loss_fn, tx, mesh, params, opt_state, donate=False,
+    )
+
+    flops_per_step = _step_flops(step, params, opt_state, (tokens, labels))
+    params, opt_state, loss = step(params, opt_state, (tokens, labels))
+    float(loss)  # warmup barrier (includes compile)
+
+    tok_secs, iter_times = [], []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, loss = step(params, opt_state,
+                                           (tokens, labels))
+        np.asarray(jax.device_get(
+            jax.tree.leaves(params)[0].ravel()[:1]))
+        dt = time.perf_counter() - t0
+        iter_times.append(dt)
+        tok_secs.append(total_tokens * args.num_batches_per_iter / dt)
+
+    total = float(np.mean(tok_secs))
+    per_chip = total / n_chips
+    flops_per_step, flops_source = _reconcile_flops(
+        flops_per_step,
+        _analytic_flops_moe(dims["d_model"], dims["d_hidden"],
+                            dims["vocab"], dims["n_layers"],
+                            tokens_per_chip),
+        devices[0].platform,
+    )
+    mfu = _mfu(flops_per_step, args.num_batches_per_iter,
+               min(iter_times), devices[0])
+
+    print(json.dumps({
+        "metric": "moe_synthetic_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "detail": {
+            "total_tokens_per_sec": round(total, 1),
+            "n_chips": n_chips,
+            "mesh": {"data": dp, "expert": ep},
+            "tokens_per_chip_per_step": tokens_per_chip,
+            "n_params": n_params,
+            "n_experts": dims["experts"],
+            "loss": float(loss),
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "routing": "switch-top1 (static capacity, all_to_all)",
+            "scan": False,
+            "mfu": mfu,
+            "flops_per_step_per_chip": (
+                round(flops_per_step) if flops_per_step else None
+            ),
+            "flops_source": flops_source,
+            "backend_init_s": round(init_s, 1),
+            "backend_init_attempts": init_attempts,
+        },
+    }), flush=True)
+    return 0
+
+
 def run_benchmark(args) -> int:
     if args.model == "transformer":
         return run_lm_benchmark(args)
+    if args.model == "moe":
+        return run_moe_benchmark(args)
     if args.smoke:
         args.batch_size, args.image_size = 4, 64
         if args.model == "inception3":

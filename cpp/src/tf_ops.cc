@@ -183,32 +183,32 @@ class HvdGroupedAllreduceOp : public AsyncOpKernel {
     }
     PyGILState_Release(st);
     if (launched < n) {
-      // Mark the op failed and subtract the members that never
-      // launched (the failed member itself included) from `remaining`;
-      // the launched members' hvd_tf_finish calls drain the rest, so
-      // done() only fires once no callback can still touch the input
-      // buffers (their views alias ctx's tensors).
+      // Fail the op NOW. The launched members are stranded: they carry
+      // group_size=n and the coordinator holds the group until every
+      // member arrives, which can never happen — so no completion
+      // callback for them will ever fire (waiting on them would hang
+      // forever, and none can be mid-completion either, which is what
+      // makes the immediate done() safe: a grouped member only
+      // completes when the whole group executes). A later runtime
+      // drain delivers error callbacks whose hvd_tf_finish no-ops on
+      // the erased handle.
       PendingOp done_op;
       bool fire = false;
       {
         std::lock_guard<std::mutex> l(g_mu);
         auto it = g_pending.find(handle);
         if (it != g_pending.end()) {
-          PendingOp& p = it->second;
-          if (!p.failed) {
-            p.failed = true;
-            p.ctx->CtxFailure(tensorflow::errors::Internal(
-                "horovod_tpu grouped trampoline missing or raised"));
-          }
-          p.remaining -= n - launched;
-          if (p.remaining <= 0) {
-            done_op = std::move(p);
-            g_pending.erase(it);
-            fire = true;
-          }
+          done_op = std::move(it->second);
+          g_pending.erase(it);
+          fire = true;
         }
       }
-      if (fire) done_op.done();
+      if (fire) {
+        done_op.ctx->CtxFailure(tensorflow::errors::Internal(
+            "horovod_tpu grouped trampoline failed at member " +
+            std::to_string(launched) + " of " + std::to_string(n)));
+        done_op.done();
+      }
     }
   }
 

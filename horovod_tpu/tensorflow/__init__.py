@@ -305,9 +305,11 @@ def grouped_allreduce(tensors, average=None, compression=Compression.none,
     ride the runtime's group barrier and fuse into a single plan, with
     a registered gradient (the group's adjoint is a grouped reduce of
     the upstream gradients, same op mapping as ``allreduce``); inside
-    ``tf.function`` each member is its own HorovodTpu* node carrying the
-    shared group id + member count, so the coordinator still fuses the
-    whole group into ONE plan."""
+    ``tf.function`` the whole group lowers to ONE multi-input/
+    multi-output HorovodTpuGroupedAllreduce node — graph pruning cannot
+    split a first-class group (per-member nodes deadlocked when a
+    gradient-only function pruned some members) — and still executes as
+    one coordinator plan."""
     import tensorflow as tf
 
     from .. import grouped_allreduce as _grouped_np

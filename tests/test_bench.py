@@ -69,3 +69,18 @@ def test_failure_emits_structured_json():
     out = _json_line(proc.stdout)
     assert out["value"] is None
     assert "error" in out and out["error"]
+
+
+def test_moe_smoke_cpu_end_to_end():
+    """DP x EP MoE benchmark path: switch routing + all_to_all over a
+    (data, expert) mesh, tokens/s metric, FLOPs reconciliation wired."""
+    proc = _run([
+        "--smoke", "--platform", "cpu", "--cpu-devices", "4",
+        "--model", "moe",
+    ])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = _json_line(proc.stdout)
+    assert out["metric"] == "moe_synthetic_tokens_per_sec_per_chip"
+    assert out["value"] and out["value"] > 0
+    assert out["detail"]["mesh"] == {"data": 1, "expert": 4}
+    assert out["detail"]["flops_per_step_per_chip"], out["detail"]
