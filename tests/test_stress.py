@@ -1,0 +1,120 @@
+"""Randomized multi-op coordination stress (2 real ranks).
+
+The reference has no in-tree race detection; thread safety is by design
+(one background comm thread, mutexed queues — SURVEY.md §5). This test
+exercises that design adversarially: a seeded random mix of every
+collective type, submitted async in bursts with the completion order
+deliberately shuffled, values checked against locally-computed
+expectations. Any coordination bug (plan mis-order, fusion mixing
+signatures, group splitting, handle cross-wiring) surfaces as a value
+mismatch or a hang (the launcher timeout)."""
+
+import pytest
+
+from test_multiprocess import _run_workers
+
+pytestmark = pytest.mark.multiproc
+
+WORKER = """
+import numpy as np, jax
+jax.config.update('jax_platforms', 'cpu')
+import horovod_tpu as hvd
+
+hvd.init()
+r, n = hvd.rank(), hvd.size()
+rng = np.random.RandomState(1234)  # SAME seed on every rank: shared plan
+
+OPS = ("allreduce_sum", "allreduce_avg", "allreduce_min", "broadcast",
+       "allgather", "alltoall", "reducescatter", "grouped")
+DTYPES = (np.float32, np.float64, np.int32)
+
+pending = []  # (handle/list, kind, expected)
+for i in range(60):
+    kind = OPS[rng.randint(len(OPS))]
+    dt = DTYPES[rng.randint(len(DTYPES))]
+    L = int(rng.randint(1, 9)) * n  # divisible dim0 for alltoall/rs
+    base = rng.randint(1, 50, size=L).astype(dt)
+
+    def mine(rank):
+        return (base * (rank + 1)).astype(dt)
+
+    x = mine(r)
+    name = f"stress.{i}"
+    if kind == "allreduce_sum":
+        h = hvd.allreduce_async(x, op=hvd.Sum, name=name)
+        exp = sum(mine(k).astype(np.float64) for k in range(n))
+        pending.append((h, "one", exp.astype(dt)))
+    elif kind == "allreduce_avg":
+        h = hvd.allreduce_async(x.astype(np.float32), average=True,
+                                name=name)
+        exp = sum(mine(k).astype(np.float64) for k in range(n)) / n
+        pending.append((h, "one", exp.astype(np.float32)))
+    elif kind == "allreduce_min":
+        h = hvd.allreduce_async(x, op=hvd.Min, name=name)
+        exp = np.minimum.reduce([mine(k) for k in range(n)])
+        pending.append((h, "one", exp))
+    elif kind == "broadcast":
+        root = int(rng.randint(n))
+        h = hvd.broadcast_async(x, root, name=name)
+        pending.append((h, "one", mine(root)))
+    elif kind == "allgather":
+        # Uneven dim0: rank k contributes (k+1) leading rows.
+        rows = x[: (r + 1) * (L // n)]
+        h = hvd.allgather_async(rows, name=name)
+        exp = np.concatenate([
+            mine(k)[: (k + 1) * (L // n)] for k in range(n)
+        ])
+        pending.append((h, "one", exp))
+    elif kind == "alltoall":
+        h = hvd.alltoall_async(x, name=name)
+        k = L // n
+        exp = np.concatenate([
+            mine(src)[r * k:(r + 1) * k] for src in range(n)
+        ])
+        pending.append((h, "one", exp))
+    elif kind == "reducescatter":
+        h = hvd.reducescatter_async(x, name=name)
+        k = L // n
+        total = sum(mine(j).astype(np.float64) for j in range(n))
+        pending.append((h, "one", total[r * k:(r + 1) * k].astype(dt)))
+    else:  # grouped
+        members = [
+            (base[:4] * (r + 1) * (m + 1)).astype(np.float32)
+            for m in range(3)
+        ]
+        hs = hvd.grouped_allreduce_async(members, op=hvd.Sum, name=name)
+        exps = [
+            sum((base[:4].astype(np.float64) * (k + 1) * (m + 1))
+                for k in range(n)).astype(np.float32)
+            for m in range(3)
+        ]
+        pending.append((hs, "group", exps))
+
+    # Drain in bursts with shuffled completion order: handles must
+    # resolve correctly regardless of synchronize() order.
+    if len(pending) >= 7 or i == 59:
+        order = rng.permutation(len(pending))
+        for j in order:
+            h, tag, exp = pending[j]
+            if tag == "group":
+                outs = [hvd.synchronize(hh) for hh in h]
+                for o, e in zip(outs, exp):
+                    assert np.allclose(np.asarray(o), e, rtol=1e-5), (
+                        j, np.asarray(o), e)
+            else:
+                o = np.asarray(hvd.synchronize(h))
+                assert o.shape == exp.shape, (j, o.shape, exp.shape)
+                assert np.allclose(o.astype(np.float64),
+                                   exp.astype(np.float64), rtol=1e-5), (
+                    j, o, exp)
+        pending.clear()
+
+print("STRESS_OK")
+hvd.shutdown()
+"""
+
+
+def test_random_collective_mix_two_ranks():
+    outs = _run_workers(WORKER, timeout=420)
+    for out in outs:
+        assert "STRESS_OK" in out, outs
