@@ -82,6 +82,12 @@ struct Request {
   // response regardless of cycle boundaries or the fusion threshold.
   int64_t group_id = 0;
   int32_t group_size = 0;
+  // Process set this collective runs over (later-reference API parity:
+  // horovod.ProcessSet). 0 = the global set; other ids must be registered
+  // identically on every rank via Core::RegisterProcessSet before use.
+  // Readiness is counted against the set's membership and the emitted
+  // plan executes on a sub-mesh of the member ranks' devices only.
+  int32_t process_set_id = 0;
 
   int64_t NumElements() const {
     int64_t n = 1;
@@ -121,6 +127,9 @@ struct Response {
   // Nonzero for grouped responses (kept out of the response cache: the
   // cache-bit path cannot carry group membership).
   int64_t group_id = 0;
+  // Process set this plan executes over (0 = global). Non-member ranks
+  // never see the plan (DispatchResponses skips it for them).
+  int32_t process_set_id = 0;
 };
 
 struct ResponseList {

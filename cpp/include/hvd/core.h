@@ -246,6 +246,14 @@ class Core {
   Status Enqueue(const Request& req, uint64_t* ticket);
   Status EnqueueJoin(uint64_t* ticket);
 
+  // Process sets (later-reference horovod.ProcessSet parity): register a
+  // rank subset under a nonzero id. MUST be called identically on every
+  // rank before any collective uses the id (the Python layer enforces
+  // this with a registration barrier); the coordinator counts readiness
+  // against the membership and non-member ranks never see the plans.
+  Status RegisterProcessSet(int32_t id, const std::vector<int32_t>& ranks);
+  Status RemoveProcessSet(int32_t id);
+
   // Executor API: block up to timeout for the next plan. Returns 1 when a
   // plan was produced, 0 on timeout, -1 on shutdown.
   int NextPlan(Plan* out, int timeout_ms);
@@ -270,7 +278,8 @@ class Core {
   void RunCycleOnce();
   // Coordinator-side: decide ready tensors, validate, fuse.
   ResponseList Coordinate(std::vector<RequestList>& lists);
-  void FuseAndEmit(std::vector<Request>& ready, ResponseList* out);
+  void FuseAndEmit(std::vector<Request>& ready, ResponseList* out,
+                   const std::map<int32_t, std::vector<int32_t>>& ps_snap);
   void DispatchResponses(const ResponseList& rl);
   void FailAll(const Status& s);
 
@@ -322,6 +331,20 @@ class Core {
   std::map<int64_t, std::pair<std::string, int>> group_poisoned_;
   std::map<std::string, Negotiation> negotiating_;
   std::set<int32_t> joined_ranks_;
+
+  // Registered process sets: id -> sorted member ranks. Guarded by
+  // ps_mu_ (written from the API thread at registration, read by the
+  // background thread during negotiation/dispatch). Set 0 is implicit
+  // (all ranks) and never stored.
+  std::mutex ps_mu_;
+  std::map<int32_t, std::vector<int32_t>> process_sets_;
+  // Lock-order-free snapshot helper (copy under ps_mu_). The coordinator
+  // hot path instead snapshots the WHOLE registry once per cycle
+  // (Coordinate) and never touches ps_mu_ per tensor.
+  bool LookupProcessSet(int32_t id, std::vector<int32_t>* ranks);
+  // Copy-free membership probe for the per-op Enqueue/Dispatch paths.
+  // known=false when the id is not registered.
+  bool IsProcessSetMember(int32_t id, int32_t rank, bool* known);
 
   // Plan queue to the executor. Tickets are captured at dispatch time so
   // completion never resolves through names (a same-name tensor can be

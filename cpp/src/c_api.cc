@@ -51,6 +51,7 @@ std::string PlanToJson(const Plan& p) {
      << ",\"root\":" << r.root_rank << ",\"op\":" << r.reduce_op
      << ",\"prescale\":" << r.prescale << ",\"postscale\":" << r.postscale
      << ",\"participants\":" << r.participants
+     << ",\"process_set\":" << r.process_set_id
      << ",\"tuned_flags\":" << p.tuned_flags
      << ",\"total_bytes\":" << r.total_bytes << ",\"error\":\""
      << JsonEscape(r.error) << "\",\"names\":[";
@@ -142,6 +143,7 @@ long long hvd_core_enqueue(int request_type, const char* name, int dtype,
                            const long long* shape, int ndim, int root_rank,
                            int reduce_op, double prescale, double postscale,
                            long long group_id, int group_size,
+                           int process_set_id,
                            char* err, int errlen) {
   Request req;
   req.rank = Core::Get().config().rank;
@@ -153,6 +155,7 @@ long long hvd_core_enqueue(int request_type, const char* name, int dtype,
   req.postscale = postscale;
   req.group_id = group_id;
   req.group_size = group_size;
+  req.process_set_id = process_set_id;
   req.name = name ? name : "";
   for (int i = 0; i < ndim; ++i) req.shape.push_back(shape[i]);
   uint64_t ticket = 0;
@@ -166,6 +169,26 @@ long long hvd_core_enqueue(int request_type, const char* name, int dtype,
 
 long long hvd_core_grouped_splits() {
   return Core::Get().grouped_splits();
+}
+
+int hvd_core_register_process_set(int id, const int* ranks, int nranks,
+                                  char* err, int errlen) {
+  std::vector<int32_t> rs(ranks, ranks + (nranks > 0 ? nranks : 0));
+  Status s = Core::Get().RegisterProcessSet(id, rs);
+  if (!s.ok()) {
+    FillErr(err, errlen, s.reason);
+    return -static_cast<int>(s.code);
+  }
+  return 0;
+}
+
+int hvd_core_remove_process_set(int id, char* err, int errlen) {
+  Status s = Core::Get().RemoveProcessSet(id);
+  if (!s.ok()) {
+    FillErr(err, errlen, s.reason);
+    return -static_cast<int>(s.code);
+  }
+  return 0;
 }
 
 long long hvd_core_enqueue_join(char* err, int errlen) {

@@ -195,7 +195,8 @@ std::string ResponseCache::Key(const Request& r) {
   std::ostringstream os;
   os << r.name << '|' << static_cast<int>(r.type) << '|'
      << static_cast<int>(r.dtype) << '|' << r.root_rank << '|' << r.reduce_op
-     << '|' << r.prescale << '|' << r.postscale << '|';
+     << '|' << r.prescale << '|' << r.postscale << '|' << r.process_set_id
+     << '|';
   for (auto d : r.shape) os << d << ',';
   return os.str();
 }
@@ -307,7 +308,14 @@ bool StallInspector::Check(int size) {
           "broadcasted by subset of ranks and are waiting for remainder of "
           "ranks for more than "
        << warn_sec_ << " seconds. Stalled ops:";
-    for (auto& s : stalled) os << ' ' << s;
+    for (auto& s : stalled) {
+      // Process-set keys embed a \x1f separator (NegKey); print a
+      // readable form instead of a raw control character.
+      std::string shown = s;
+      auto pos = shown.find('\x1f');
+      if (pos != std::string::npos) shown.replace(pos, 1, " @");
+      os << ' ' << shown;
+    }
     HVD_LOG(kWarn, os.str());
   }
   return shutdown;
@@ -412,12 +420,34 @@ void Core::Shutdown() {
   }
   negotiating_.clear();
   joined_ranks_.clear();
+  {
+    std::lock_guard<std::mutex> l(ps_mu_);
+    process_sets_.clear();
+  }
   initialized_ = false;
 }
 
 Status Core::Enqueue(const Request& req, uint64_t* ticket) {
   if (!initialized_.load() || shutdown_.load()) {
     return Status::Error(StatusCode::kAborted, "core is not running");
+  }
+  if (req.process_set_id != 0) {
+    // Fail fast locally: an unregistered set or a non-member submission
+    // would otherwise hang negotiation on every member rank.
+    bool known = false;
+    bool member = IsProcessSetMember(req.process_set_id, cfg_.rank, &known);
+    if (!known) {
+      return Status::Error(
+          StatusCode::kInvalidArgument,
+          "process set " + std::to_string(req.process_set_id) +
+              " is not registered on this rank");
+    }
+    if (!member) {
+      return Status::Error(
+          StatusCode::kInvalidArgument,
+          "rank " + std::to_string(cfg_.rank) + " is not a member of "
+              "process set " + std::to_string(req.process_set_id));
+    }
   }
   std::lock_guard<std::mutex> l(table_mu_);
   if (table_.count(req.name)) {
@@ -445,6 +475,57 @@ Status Core::Enqueue(const Request& req, uint64_t* ticket) {
   if (eager_wakeup_) wake_cv_.notify_one();
   *ticket = t;
   return Status::OK();
+}
+
+Status Core::RegisterProcessSet(int32_t id,
+                                const std::vector<int32_t>& ranks) {
+  if (id == 0) {
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "process set id 0 is the implicit global set");
+  }
+  if (ranks.empty()) {
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "process set needs at least one member rank");
+  }
+  std::vector<int32_t> sorted = ranks;
+  std::sort(sorted.begin(), sorted.end());
+  if (std::unique(sorted.begin(), sorted.end()) != sorted.end() ||
+      sorted.front() < 0 || sorted.back() >= cfg_.size) {
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "process set ranks must be unique and in [0, size)");
+  }
+  std::lock_guard<std::mutex> l(ps_mu_);
+  process_sets_[id] = std::move(sorted);
+  return Status::OK();
+}
+
+Status Core::RemoveProcessSet(int32_t id) {
+  std::lock_guard<std::mutex> l(ps_mu_);
+  if (!process_sets_.erase(id)) {
+    return Status::Error(StatusCode::kInvalidArgument,
+                         "process set " + std::to_string(id) +
+                             " is not registered");
+  }
+  return Status::OK();
+}
+
+bool Core::LookupProcessSet(int32_t id, std::vector<int32_t>* ranks) {
+  std::lock_guard<std::mutex> l(ps_mu_);
+  auto it = process_sets_.find(id);
+  if (it == process_sets_.end()) return false;
+  if (ranks) *ranks = it->second;
+  return true;
+}
+
+bool Core::IsProcessSetMember(int32_t id, int32_t rank, bool* known) {
+  std::lock_guard<std::mutex> l(ps_mu_);
+  auto it = process_sets_.find(id);
+  if (it == process_sets_.end()) {
+    if (known) *known = false;
+    return false;
+  }
+  if (known) *known = true;
+  return std::binary_search(it->second.begin(), it->second.end(), rank);
 }
 
 Status Core::EnqueueJoin(uint64_t* ticket) {
@@ -746,6 +827,15 @@ void Core::RunCycleOnce() {
 }
 
 namespace {
+// Negotiation-map key: tensors in different process sets are different
+// tensors even under the same name. Set 0 keeps the plain name so
+// global-set behavior (messages, timelines, tests) is unchanged.
+std::string NegKey(const Request& r) {
+  return r.process_set_id == 0
+             ? r.name
+             : r.name + "\x1f" + "ps" + std::to_string(r.process_set_id);
+}
+
 const char* TypeName(RequestType t) {
   switch (t) {
     case RequestType::kAllreduce: return "ALLREDUCE";
@@ -784,22 +874,25 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
         joined_ranks_.insert(req.rank);
         continue;
       }
-      auto it = negotiating_.find(req.name);
+      const std::string key = NegKey(req);
+      auto it = negotiating_.find(key);
       if (it == negotiating_.end()) {
         timeline_.NegotiateStart(req.name, TypeName(req.type));
-        auto& neg = negotiating_[req.name];
+        auto& neg = negotiating_[key];
         neg.request = req;
         neg.ranks.insert(req.rank);
         if (req.type == RequestType::kAllgather) {
           neg.dim0[req.rank] = req.shape.empty() ? 0 : req.shape[0];
         }
-        stall_.Record(req.name, req.rank);
+        stall_.Record(key, req.rank);
       } else {
         auto& neg = it->second;
         // Validation — reference ConstructResponse semantics: dtype, op
         // type, shape (exact for allreduce/broadcast, non-0 dims for
         // allgather), root consistency.
         const Request& first = neg.request;
+        // (Cross-set same-name requests can never meet here: NegKey embeds
+        // the process_set_id, so they negotiate as distinct tensors.)
         if (req.type != first.type) {
           neg.error = true;
           neg.error_msg = "Mismatched collective operations for tensor " +
@@ -833,18 +926,53 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
         if (req.type == RequestType::kAllgather) {
           neg.dim0[req.rank] = req.shape.empty() ? 0 : req.shape[0];
         }
-        stall_.Record(req.name, req.rank);
+        stall_.Record(key, req.rank);
       }
       timeline_.NegotiateRankReady(req.name, req.rank);
     }
   }
 
-  // A tensor is ready when announced by all non-joined ranks (reference:
-  // count == size - joined_size).
-  int needed = cfg_.size - static_cast<int>(joined_ranks_.size());
+  // One registry snapshot per cycle: the readiness loop and FuseAndEmit
+  // below run per-tensor on the latency-critical coordinator thread and
+  // must not take ps_mu_ (or copy member vectors) per entry.
+  std::map<int32_t, std::vector<int32_t>> ps_snap;
+  {
+    std::lock_guard<std::mutex> psl(ps_mu_);
+    ps_snap = process_sets_;
+  }
+  // Join is a global-set barrier (reference semantics): a joined rank is
+  // absent from every set's counting, so a set's readiness target is its
+  // non-joined membership.
+  auto set_needed = [&](int32_t id, bool* known) -> int {
+    if (known) *known = true;
+    if (id == 0) return cfg_.size - static_cast<int>(joined_ranks_.size());
+    auto it = ps_snap.find(id);
+    if (it == ps_snap.end()) {
+      if (known) *known = false;
+      return 0;
+    }
+    int n = 0;
+    for (int32_t r : it->second) {
+      if (!joined_ranks_.count(r)) ++n;
+    }
+    return n;
+  };
+  // A tensor is ready when announced by all non-joined members of its
+  // process set (reference: count == size - joined_size; per-set here).
   std::vector<std::string> ready_names;
   for (auto& [name, neg] : negotiating_) {
-    if (static_cast<int>(neg.ranks.size()) >= needed) {
+    bool known = true;
+    int needed = set_needed(neg.request.process_set_id, &known);
+    if (!known) {
+      // Defensive: the enqueue-side check makes this unreachable in
+      // correct use, but a race with RemoveProcessSet must surface as an
+      // error, not a silent hang.
+      neg.error = true;
+      neg.error_msg = "process set " +
+                      std::to_string(neg.request.process_set_id) +
+                      " is not registered on the coordinator";
+      ready_names.push_back(name);
+    } else if (static_cast<int>(neg.ranks.size()) >= needed) {
       ready_names.push_back(name);
     }
   }
@@ -915,11 +1043,14 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
   std::sort(done.begin(), done.end());
   for (auto& name : done) {
     auto& neg = negotiating_[name];
-    timeline_.NegotiateEnd(name, TypeName(neg.request.type));
+    timeline_.NegotiateEnd(neg.request.name, TypeName(neg.request.type));
     if (neg.error) {
       Response r;
       r.type = ResponseType::kError;
-      r.names = {name};
+      // Plain tensor name (the table on member ranks is name-keyed);
+      // the set id makes non-members skip the error plan.
+      r.names = {neg.request.name};
+      r.process_set_id = neg.request.process_set_id;
       r.error = neg.error_msg;
       out.responses.push_back(std::move(r));
     } else {
@@ -933,7 +1064,7 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
     stall_.Clear(name);
   }
 
-  FuseAndEmit(ready, &out);
+  FuseAndEmit(ready, &out, ps_snap);
   for (auto& name : done) negotiating_.erase(name);
 
   // All ranks joined => emit the JOIN barrier completion and reset.
@@ -961,7 +1092,9 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
   return out;
 }
 
-void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
+void Core::FuseAndEmit(
+    std::vector<Request>& ready, ResponseList* out,
+    const std::map<int32_t, std::vector<int32_t>>& ps_snap) {
   // Greedy same-signature fusion with lookahead (reference FuseResponses):
   // allreduce/adasum responses pack up to the fusion threshold. Grouped
   // members fuse with their own group only, EXEMPT from the threshold
@@ -970,7 +1103,6 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
   // signature and counts as a split (observability: grouped_splits()).
   int64_t threshold = params_.fusion_threshold();
   std::vector<bool> used(ready.size(), false);
-  int participants = cfg_.size - static_cast<int>(joined_ranks_.size());
   std::map<int64_t, int> group_responses;
   for (size_t i = 0; i < ready.size(); ++i) {
     if (used[i]) continue;
@@ -978,26 +1110,57 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
     if (base.group_id != 0) ++group_responses[base.group_id];
     Response r;
     r.group_id = base.group_id;
+    r.process_set_id = base.process_set_id;
     r.type = static_cast<ResponseType>(static_cast<uint8_t>(base.type));
     r.dtype = base.dtype;
     r.root_rank = base.root_rank;
     r.reduce_op = base.reduce_op;
     r.prescale = base.prescale;
     r.postscale = base.postscale;
-    r.participants = participants;
+    // Non-joined member count of this request's set: the Average divisor
+    // and (for sets) the sub-mesh extent check.
+    if (base.process_set_id == 0) {
+      r.participants = cfg_.size - static_cast<int>(joined_ranks_.size());
+    } else {
+      r.participants = 0;
+      auto psit = ps_snap.find(base.process_set_id);
+      if (psit != ps_snap.end()) {
+        for (int32_t rk : psit->second) {
+          if (!joined_ranks_.count(rk)) ++r.participants;
+        }
+      }
+    }
     r.names.push_back(base.name);
     r.entry_shapes.push_back(base.shape);
     r.total_bytes = base.ByteSize();
     if (base.type == RequestType::kAllgather) {
-      // Per-rank dim0 (ordered by rank) for the executor's displacement
-      // math; ranks that never submitted (Join zero-substitution) gather
-      // the canonical zero tensor, so they contribute base dim0 rows.
-      auto nit = negotiating_.find(base.name);
+      // Per-rank dim0 for the executor's displacement math, ordered by
+      // GLOBAL rank for the global set and by member position for a
+      // process set; ranks that never submitted (Join zero-substitution)
+      // gather the canonical zero tensor, so they contribute base dim0
+      // rows.
+      auto nit = negotiating_.find(NegKey(base));
       int64_t canonical = base.shape.empty() ? 0 : base.shape[0];
-      r.rank_sizes.assign(cfg_.size, canonical);
-      if (nit != negotiating_.end()) {
-        for (auto& [rk, d0] : nit->second.dim0) {
-          if (rk >= 0 && rk < cfg_.size) r.rank_sizes[rk] = d0;
+      auto psit = base.process_set_id != 0
+                      ? ps_snap.find(base.process_set_id)
+                      : ps_snap.end();
+      if (psit != ps_snap.end()) {
+        const std::vector<int32_t>& members = psit->second;
+        r.rank_sizes.assign(members.size(), canonical);
+        if (nit != negotiating_.end()) {
+          for (auto& [rk, d0] : nit->second.dim0) {
+            auto pos = std::lower_bound(members.begin(), members.end(), rk);
+            if (pos != members.end() && *pos == rk) {
+              r.rank_sizes[pos - members.begin()] = d0;
+            }
+          }
+        }
+      } else {
+        r.rank_sizes.assign(cfg_.size, canonical);
+        if (nit != negotiating_.end()) {
+          for (auto& [rk, d0] : nit->second.dim0) {
+            if (rk >= 0 && rk < cfg_.size) r.rank_sizes[rk] = d0;
+          }
         }
       }
     }
@@ -1009,6 +1172,7 @@ void Core::FuseAndEmit(std::vector<Request>& ready, ResponseList* out) {
         if (used[j]) continue;
         const Request& cand = ready[j];
         if (cand.group_id != base.group_id) continue;
+        if (cand.process_set_id != base.process_set_id) continue;
         if (cand.type != base.type || cand.dtype != base.dtype ||
             cand.reduce_op != base.reduce_op ||
             cand.prescale != base.prescale ||
@@ -1061,6 +1225,7 @@ void Core::DispatchResponses(const ResponseList& rl) {
           req.reduce_op = resp.reduce_op;
           req.prescale = resp.prescale;
           req.postscale = resp.postscale;
+          req.process_set_id = resp.process_set_id;
           req.name = resp.names[i];
           if (i < resp.entry_shapes.size()) req.shape = resp.entry_shapes[i];
           Response single;
@@ -1070,27 +1235,51 @@ void Core::DispatchResponses(const ResponseList& rl) {
           single.reduce_op = resp.reduce_op;
           single.prescale = resp.prescale;
           single.postscale = resp.postscale;
+          single.process_set_id = resp.process_set_id;
           single.names = {resp.names[i]};
           single.entry_shapes = {req.shape};
           cache_.Put(req, single);
         }
       }
     }
+    // Process-set plans exist only on member ranks: the sub-mesh
+    // collective is executed by member processes alone (a non-member
+    // joining the compiled computation would deadlock it). The cache Put
+    // above MUST still run on every rank — bit numbering is kept
+    // coherent by identical dispatch-order Puts on all ranks.
+    if (resp.process_set_id != 0 && resp.type != ResponseType::kError) {
+      // Error plans are exempt: they must reach the submitting rank even
+      // when the set registry is in a bad state (e.g. the unknown-set
+      // error itself), or its ticket would hang forever.
+      if (!IsProcessSetMember(resp.process_set_id, cfg_.rank, nullptr)) {
+        continue;
+      }
+    }
     // Remove entries from the local table; names this rank never submitted
     // (Join zero-substitution) stay absent — the executor fabricates zeros
-    // from entry_shapes.
+    // from entry_shapes. A plan only consumes entries of ITS OWN process
+    // set: names are per-set namespaces, so a set-A error response must
+    // not clobber an unrelated same-named global (or set-B) tensor this
+    // rank has in flight.
     std::vector<uint64_t> plan_tickets;
     {
       std::lock_guard<std::mutex> l(table_mu_);
       for (const auto& name : resp.names) {
         auto it = table_.find(name);
-        if (it != table_.end()) {
+        if (it != table_.end() &&
+            it->second.request.process_set_id == resp.process_set_id) {
           plan_tickets.push_back(it->second.ticket);
           table_.erase(it);
         }
         // Absent => Join zero-substitution (this rank never submitted).
       }
       if (resp.type == ResponseType::kJoin) joined_ = false;
+    }
+    if (resp.type == ResponseType::kError && plan_tickets.empty()) {
+      // Error verdict for tensors this rank never submitted (it reached
+      // us only because error plans bypass the membership skip so the
+      // SUBMITTER always gets its failure): nothing to fail here.
+      continue;
     }
     Plan p;
     {

@@ -14,6 +14,7 @@ void EncodeRequest(Writer& w, const Request& r) {
   w.Str(r.name);
   w.I64(r.group_id);
   w.I32(r.group_size);
+  w.I32(r.process_set_id);
   w.U32(static_cast<uint32_t>(r.shape.size()));
   for (auto d : r.shape) w.I64(d);
 }
@@ -29,6 +30,7 @@ bool DecodeRequest(Reader& rd, Request* out) {
   out->name = rd.Str();
   out->group_id = rd.I64();
   out->group_size = rd.I32();
+  out->process_set_id = rd.I32();
   uint32_t ndim = rd.U32();
   if (ndim > 256) return false;
   out->shape.clear();
@@ -71,6 +73,7 @@ void EncodeResponse(Writer& w, const Response& r) {
   w.I64(r.total_bytes);
   w.I32(r.participants);
   w.I64(r.group_id);
+  w.I32(r.process_set_id);
   w.Str(r.error);
   w.U32(static_cast<uint32_t>(r.names.size()));
   for (const auto& s : r.names) w.Str(s);
@@ -93,6 +96,7 @@ bool DecodeResponse(Reader& rd, Response* out) {
   out->total_bytes = rd.I64();
   out->participants = rd.I32();
   out->group_id = rd.I64();
+  out->process_set_id = rd.I32();
   out->error = rd.Str();
   uint32_t n = rd.U32();
   if (n > 1u << 20) return false;

@@ -138,12 +138,18 @@ _profiler_active = False
 
 
 def shutdown() -> None:
-    global _runtime, _mesh, _profiler_active
+    global _runtime, _mesh, _profiler_active, _ps_barrier_seq
     with _lock:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
         _mesh = None
+        # Process sets die with the runtime (a re-init starts clean, and
+        # id assignment restarts so all ranks stay aligned).
+        for ps in _process_sets.values():
+            ps.process_set_id = None
+        _process_sets.clear()
+        _ps_barrier_seq = 0
         if _profiler_active:
             _profiler_active = False
             try:
@@ -279,6 +285,184 @@ def _resolve_op(average: Optional[bool], op: Optional[ReduceOp]) -> ReduceOp:
     return ReduceOp.AVERAGE
 
 
+# --- process sets (later-reference horovod.ProcessSet parity) ---
+class ProcessSet:
+    """A subset of ranks that collectives can run over (the later
+    reference's ``horovod.ProcessSet``). TPU-native design: a registered
+    set becomes a sub-``Mesh`` over the member ranks' devices — only
+    member processes execute the compiled collective (multi-controller
+    JAX semantics), which is exactly the reference's per-set communicator
+    without a NCCL/MPI comm split.
+
+    Construct with a list of global ranks and register with
+    :func:`add_process_set` (which must be called identically on every
+    rank); ``hvd.global_process_set`` is the implicit all-ranks set."""
+
+    def __init__(self, ranks=None):
+        # None = the global set (all ranks, resolved at use time).
+        self.ranks = (
+            sorted({int(r) for r in ranks}) if ranks is not None else None
+        )
+        self.process_set_id: Optional[int] = None
+
+    def _resolved_ranks(self) -> list:
+        return self.ranks if self.ranks is not None else list(range(size()))
+
+    def size(self) -> int:
+        return len(self._resolved_ranks())
+
+    def included(self) -> bool:
+        return rank() in self._resolved_ranks()
+
+    def rank(self) -> int:
+        """This process's position within the set (set-local rank)."""
+        rs = self._resolved_ranks()
+        me = rank()
+        if me not in rs:
+            raise RuntimeError(
+                f"rank {me} is not a member of process set "
+                f"{self.process_set_id}"
+            )
+        return rs.index(me)
+
+    def __repr__(self):
+        return (f"ProcessSet(id={self.process_set_id}, "
+                f"ranks={'GLOBAL' if self.ranks is None else self.ranks})")
+
+
+global_process_set = ProcessSet(None)
+global_process_set.process_set_id = 0
+
+_process_sets: dict = {}
+# Per-call barrier sequence, shared by add_process_set AND
+# remove_process_set: the k-th registration call uses barrier name k and
+# (for adds) set id k on EVERY rank — even ranks whose local validation
+# failed, and even when one rank is adding while another removes — so any
+# divergent call completes the allgather and fails loudly on all ranks
+# instead of stranding the healthy ones inside it, and a failed call can
+# never desynchronize id assignment (all ranks consumed the same value).
+_ps_barrier_seq = 0
+
+
+def _ps_barrier(payload, seq: int, n: int) -> list:
+    """Cross-rank agreement exchange for process-set registration calls.
+    ONE name per sequence number regardless of call type — an add on one
+    rank racing a remove on another meets in the same allgather and the
+    payload mismatch raises everywhere."""
+    if n <= 1:
+        return [payload]
+    return allgather_object(payload, name=f"hvd.ps.bar.{seq}")
+
+
+def _psid(process_set: Optional[ProcessSet]) -> int:
+    if process_set is None or process_set.process_set_id == 0:
+        return 0
+    if process_set.process_set_id is None:
+        raise ValueError(
+            "process set must be registered with hvd.add_process_set() "
+            "before use"
+        )
+    return int(process_set.process_set_id)
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a process set (a ``ProcessSet`` or a list of ranks).
+    MUST be called identically, in the same order, on every rank — the
+    registration performs a cross-rank agreement barrier so a divergent
+    call (wrong ranks on one rank, different membership across ranks)
+    fails loudly on EVERY rank instead of deadlocking the first
+    collective: local validation failures enter the barrier too and
+    poison it."""
+    global _ps_barrier_seq
+    ps = (process_set if isinstance(process_set, ProcessSet)
+          else ProcessSet(process_set))
+    rt = _rt()
+    n = rt.topology.size
+    with _lock:
+        _ps_barrier_seq += 1
+        seq = _ps_barrier_seq
+    # Validate into an error payload rather than raising before the
+    # barrier — a pre-barrier raise would strand every healthy peer
+    # inside the agreement allgather.
+    err = None
+    if ps.ranks is None:
+        err = "the global process set is registered implicitly"
+    elif ps.process_set_id is not None:
+        err = f"process set is already registered (id {ps.process_set_id})"
+    elif not ps.ranks or ps.ranks[0] < 0 or ps.ranks[-1] >= n:
+        err = f"process set ranks must lie in [0, {n})"
+    psid = None
+    if err is None:
+        reg = getattr(rt, "register_process_set", None)
+        if reg is None:
+            err = "the active runtime does not support process sets"
+        else:
+            # The set id IS the barrier sequence number: consumed
+            # identically on every rank by every registration call,
+            # successful or not, so a failed call can never skew later
+            # id assignment across ranks.
+            psid = seq
+            try:
+                # Register BEFORE the barrier: a member may use the set
+                # the moment its own barrier returns, which implies every
+                # rank (the coordinator included) contributed — and hence
+                # registered — already.
+                reg(psid, ps.ranks)
+            except Exception as exc:  # noqa: BLE001 - poisons the barrier
+                err = str(exc)
+                psid = None
+    payload = (("add", psid, tuple(ps.ranks or ()))
+               if err is None else ("err", err))
+    agreement = _ps_barrier(payload, seq, n)
+    unanimous = len(set(agreement)) == 1 and agreement[0][0] == "add"
+    if err is not None or not unanimous:
+        if psid is not None:
+            try:
+                rt.remove_process_set(psid)
+            except Exception:  # noqa: BLE001 - best-effort rollback
+                pass
+        if err is not None:
+            raise ValueError(err)
+        raise ValueError(
+            "add_process_set must be called identically on every rank; "
+            f"cross-rank registrations: {agreement}"
+        )
+    with _lock:
+        ps.process_set_id = psid
+        _process_sets[psid] = ps
+    return ps
+
+
+def remove_process_set(process_set: ProcessSet) -> None:
+    """Deregister a dynamic process set. Collective: call identically on
+    every rank (barrier first, so no member removes the set while a peer
+    still has ops in flight; a divergent call fails on all ranks)."""
+    global _ps_barrier_seq
+    rt = _rt()
+    n = rt.topology.size
+    with _lock:
+        _ps_barrier_seq += 1
+        seq = _ps_barrier_seq
+    psid = process_set.process_set_id
+    err = (
+        "only registered non-global process sets can be removed"
+        if psid in (None, 0) else None
+    )
+    payload = ("rm", psid) if err is None else ("err", err)
+    agreement = _ps_barrier(payload, seq, n)
+    if err is not None:
+        raise ValueError(err)
+    if any(a != ("rm", psid) for a in agreement):
+        raise ValueError(
+            "remove_process_set must be called identically on every "
+            f"rank; cross-rank calls: {agreement}"
+        )
+    rt.remove_process_set(psid)
+    with _lock:
+        _process_sets.pop(psid, None)
+        process_set.process_set_id = None
+
+
 # --- eager collective API ---
 def allreduce_async(
     tensor: Any,
@@ -287,11 +471,13 @@ def allreduce_async(
     op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
     _group: tuple = (0, 0),
 ) -> int:
     rop = _resolve_op(average, op)
     rt = _rt()
     tensor_name = _auto_name("allreduce", name)
+    psid = _psid(process_set)
     if rop == ReduceOp.ADASUM:
         return rt.enqueue_adasum(
             tensor_name,
@@ -299,6 +485,7 @@ def allreduce_async(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             group_id=_group[0], group_size=_group[1],
+            process_set_id=psid,
         )
     return rt.enqueue_allreduce(
         tensor_name,
@@ -307,6 +494,7 @@ def allreduce_async(
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
         group_id=_group[0], group_size=_group[1],
+        process_set_id=psid,
     )
 
 
@@ -318,6 +506,7 @@ def allreduce(
     op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
 ) -> Any:
     tensor_compressed, ctx = compression.compress(tensor)
     handle = allreduce_async(
@@ -327,39 +516,84 @@ def allreduce(
         op=op,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
+        process_set=process_set,
     )
     out = synchronize(handle)
     return compression.decompress(out, ctx)
 
 
-def allgather_async(tensor: Any, name: Optional[str] = None) -> int:
-    return _rt().enqueue_allgather(_auto_name("allgather", name), tensor)
+def allgather_async(tensor: Any, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    return _rt().enqueue_allgather(
+        _auto_name("allgather", name), tensor,
+        process_set_id=_psid(process_set),
+    )
 
 
-def allgather(tensor: Any, name: Optional[str] = None) -> Any:
-    return synchronize(allgather_async(tensor, name))
+def allgather(tensor: Any, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> Any:
+    return synchronize(allgather_async(tensor, name, process_set))
+
+
+def allgather_object(obj, name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> list:
+    """Gather one picklable object per (member) rank; every member gets
+    the member-ordered list (later-reference API). Rides the uneven
+    (Allgatherv-parity) dim0 allgather, so payload sizes may differ."""
+    import pickle
+
+    import numpy as np
+
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    base = name or _auto_name("gather_obj", None)
+    sizes = np.asarray(allgather(
+        np.array([len(data)], dtype=np.int64), name=f"{base}.size",
+        process_set=process_set,
+    ))
+    payload = np.asarray(allgather(
+        data, name=f"{base}.data", process_set=process_set,
+    ))
+    out, off = [], 0
+    for count in sizes.tolist():
+        out.append(pickle.loads(payload[off:off + count].tobytes()))
+        off += count
+    return out
 
 
 def broadcast_async(
-    tensor: Any, root_rank: int, name: Optional[str] = None
+    tensor: Any, root_rank: int, name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
 ) -> int:
-    return _rt().enqueue_broadcast(_auto_name("broadcast", name), tensor, root_rank)
+    # root_rank is a GLOBAL rank even within a process set (reference
+    # process-set API semantics; the executor maps it to the member
+    # position on the sub-mesh).
+    return _rt().enqueue_broadcast(
+        _auto_name("broadcast", name), tensor, root_rank,
+        process_set_id=_psid(process_set),
+    )
 
 
-def broadcast(tensor: Any, root_rank: int, name: Optional[str] = None) -> Any:
-    return synchronize(broadcast_async(tensor, root_rank, name))
+def broadcast(tensor: Any, root_rank: int, name: Optional[str] = None,
+              process_set: Optional[ProcessSet] = None) -> Any:
+    return synchronize(broadcast_async(tensor, root_rank, name, process_set))
 
 
-def alltoall_async(tensor: Any, name: Optional[str] = None) -> int:
-    return _rt().enqueue_alltoall(_auto_name("alltoall", name), tensor)
+def alltoall_async(tensor: Any, name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    return _rt().enqueue_alltoall(
+        _auto_name("alltoall", name), tensor,
+        process_set_id=_psid(process_set),
+    )
 
 
-def alltoall(tensor: Any, name: Optional[str] = None) -> Any:
-    return synchronize(alltoall_async(tensor, name))
+def alltoall(tensor: Any, name: Optional[str] = None,
+             process_set: Optional[ProcessSet] = None) -> Any:
+    return synchronize(alltoall_async(tensor, name, process_set))
 
 
 def reducescatter_async(
-    tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None
+    tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None,
+    process_set: Optional[ProcessSet] = None,
 ) -> int:
     """Sum/average across ranks, scatter dim0 shards: rank r receives rows
     ``[r*d/size, (r+1)*d/size)`` of the reduction. TPU-native extension
@@ -373,20 +607,23 @@ def reducescatter_async(
     if not getattr(tensor, "shape", ()):
         raise ValueError("reducescatter needs a tensor with a dim0 to scatter")
     return _rt().enqueue_reducescatter(
-        _auto_name("reducescatter", name), tensor, reduce_op=op
+        _auto_name("reducescatter", name), tensor, reduce_op=op,
+        process_set_id=_psid(process_set),
     )
 
 
 def reducescatter(
-    tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None
+    tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None,
+    process_set: Optional[ProcessSet] = None,
 ) -> Any:
-    return synchronize(reducescatter_async(tensor, name, op))
+    return synchronize(reducescatter_async(tensor, name, op, process_set))
 
 
 def grouped_allreduce_async(
     tensors, average: Optional[bool] = None, name: Optional[str] = None,
     op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
 ):
     """Enqueue a list of tensors as ONE first-class group and return
     their handles. The group travels with the requests (a stable id +
@@ -419,6 +656,7 @@ def grouped_allreduce_async(
                 t, average=average, name=f"{base}.{i}", op=op,
                 prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor,
+                process_set=process_set,
                 _group=(gid, len(tensors)),
             ))
     except Exception:
@@ -473,6 +711,7 @@ def grouped_allreduce(
     tensors, average: Optional[bool] = None, name: Optional[str] = None,
     op: Optional[ReduceOp] = None,
     prescale_factor: float = 1.0, postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
 ):
     """Synchronous :func:`grouped_allreduce_async`; returns outputs in
     input order. Every handle is waited on even when one fails, so no
@@ -480,6 +719,7 @@ def grouped_allreduce(
     handles = grouped_allreduce_async(
         tensors, average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
     )
     return grouped_sync_first_error(handles, synchronize)
 
@@ -532,6 +772,11 @@ __all__ = [
     "reducescatter_async",
     "grouped_allreduce",
     "grouped_allreduce_async",
+    "allgather_object",
+    "ProcessSet",
+    "global_process_set",
+    "add_process_set",
+    "remove_process_set",
     "join",
     "poll",
     "synchronize",

@@ -67,11 +67,20 @@ def load() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_double, ctypes.c_double,
-        ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
         ctypes.c_char_p, ctypes.c_int,
     ]
     lib.hvd_core_grouped_splits.restype = ctypes.c_longlong
     lib.hvd_core_grouped_splits.argtypes = []
+    lib.hvd_core_register_process_set.restype = ctypes.c_int
+    lib.hvd_core_register_process_set.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.hvd_core_remove_process_set.restype = ctypes.c_int
+    lib.hvd_core_remove_process_set.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+    ]
     lib.hvd_core_enqueue_join.restype = ctypes.c_longlong
     lib.hvd_core_enqueue_join.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.hvd_core_next_plan.restype = ctypes.c_int
@@ -143,18 +152,34 @@ class NativeCore:
     def enqueue(self, request_type: int, name: str, dtype: int,
                 shape, root_rank: int, reduce_op: int,
                 prescale: float, postscale: float,
-                group_id: int = 0, group_size: int = 0) -> int:
+                group_id: int = 0, group_size: int = 0,
+                process_set_id: int = 0) -> int:
         err = ctypes.create_string_buffer(self.ERRBUF)
         arr = (ctypes.c_longlong * len(shape))(*shape)
         ticket = self.lib.hvd_core_enqueue(
             request_type, name.encode(), dtype, arr, len(shape), root_rank,
             reduce_op, ctypes.c_double(prescale), ctypes.c_double(postscale),
-            ctypes.c_longlong(group_id), group_size,
+            ctypes.c_longlong(group_id), group_size, process_set_id,
             err, self.ERRBUF,
         )
         if ticket < 0:
             raise _CoreError(-ticket, err.value.decode())
         return int(ticket)
+
+    def register_process_set(self, psid: int, ranks) -> None:
+        err = ctypes.create_string_buffer(self.ERRBUF)
+        arr = (ctypes.c_int * len(ranks))(*ranks)
+        rc = self.lib.hvd_core_register_process_set(
+            psid, arr, len(ranks), err, self.ERRBUF
+        )
+        if rc != 0:
+            raise _CoreError(-rc, err.value.decode())
+
+    def remove_process_set(self, psid: int) -> None:
+        err = ctypes.create_string_buffer(self.ERRBUF)
+        rc = self.lib.hvd_core_remove_process_set(psid, err, self.ERRBUF)
+        if rc != 0:
+            raise _CoreError(-rc, err.value.decode())
 
     def grouped_splits(self) -> int:
         """Groups that could not fuse into a single plan (heterogeneous

@@ -143,6 +143,7 @@ class NativeRuntime:
         callback: Optional[Callable] = None,
         group_id: int = 0,
         group_size: int = 0,
+        process_set_id: int = 0,
     ) -> int:
         if not self.running:
             raise RuntimeError(
@@ -166,7 +167,7 @@ class NativeRuntime:
             ticket = self.core.enqueue(
                 int(request_type), name, dtype, shape, root_rank,
                 int(reduce_op), prescale_factor, postscale_factor,
-                group_id, group_size,
+                group_id, group_size, process_set_id,
             )
         except _CoreError as e:
             with self._entries_lock:
@@ -213,6 +214,31 @@ class NativeRuntime:
         if not self.running:
             raise RuntimeError("Horovod runtime is shut down.")
         return self.core.enqueue_join()
+
+    # --- process sets (later-reference horovod.ProcessSet parity) ---
+    def register_process_set(self, psid: int, ranks) -> None:
+        """Register a rank subset in the native core AND the data-plane
+        executor (which builds the member sub-mesh). Atomic: an executor
+        failure rolls the core registration back, so control plane and
+        data plane can never disagree about a set. The caller is
+        responsible for the cross-rank registration barrier."""
+        self.core.register_process_set(psid, list(ranks))
+        reg = getattr(self.executor, "register_process_set", None)
+        if reg is not None:
+            try:
+                reg(psid, ranks)
+            except Exception:
+                try:
+                    self.core.remove_process_set(psid)
+                except Exception:  # noqa: BLE001 - keep the original error
+                    pass
+                raise
+
+    def remove_process_set(self, psid: int) -> None:
+        self.core.remove_process_set(psid)
+        rem = getattr(self.executor, "remove_process_set", None)
+        if rem is not None:
+            rem(psid)
 
     # --- executor loop ---
     def _executor_loop(self) -> None:

@@ -84,6 +84,10 @@ class Request:
     # the native core).
     group_id: int = 0
     group_size: int = 0
+    # Process set (later-reference parity). In the single-process runtime
+    # any registered set degenerates to {0}; the field still travels so
+    # fusion never mixes sets and tests can assert the plumbing.
+    process_set_id: int = 0
 
 
 @dataclass
@@ -294,7 +298,8 @@ class SingleProcessCoordinator(Coordinator):
             rtype = ResponseType(int(req.request_type))
             nbytes = int(np.prod(req.shape or (1,))) * dtype_size_or(req.dtype)
             key = (rtype, req.dtype, req.reduce_op, req.root_rank,
-                   req.prescale_factor, req.postscale_factor, req.group_id)
+                   req.prescale_factor, req.postscale_factor, req.group_id,
+                   req.process_set_id)
             fusable = rtype in (ResponseType.ALLREDUCE, ResponseType.ADASUM)
             if (
                 fusable
@@ -420,6 +425,21 @@ class Runtime:
         self._wake = threading.Event()
         self._initialized = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Registered process sets (id -> sorted ranks). The single-process
+        # data plane executes any set containing rank 0 as an identity,
+        # matching the reference's size=1 behavior.
+        self._process_sets: Dict[int, List[int]] = {}
+
+    # --- process sets ---
+    def register_process_set(self, psid: int, ranks) -> None:
+        rs = sorted(int(r) for r in ranks)
+        if not rs or rs[0] < 0 or rs[-1] >= self.topology.size:
+            raise ValueError("process set ranks must lie in [0, size)")
+        self._process_sets[int(psid)] = rs
+
+    def remove_process_set(self, psid: int) -> None:
+        if self._process_sets.pop(int(psid), None) is None:
+            raise ValueError(f"process set {psid} is not registered")
 
     # --- lifecycle ---
     def start(self) -> None:
@@ -463,12 +483,25 @@ class Runtime:
         callback: Optional[Callable[[Status, Any], None]] = None,
         group_id: int = 0,
         group_size: int = 0,
+        process_set_id: int = 0,
     ) -> int:
         if self._shutdown.is_set() or self._thread is None:
             raise RuntimeError(
                 "Horovod runtime is shut down or was never initialized; "
                 "call hvd.init() first."
             )
+        if process_set_id != 0:
+            members = self._process_sets.get(process_set_id)
+            if members is None:
+                raise RuntimeError(
+                    f"process set {process_set_id} is not registered on "
+                    "this rank"
+                )
+            if self.topology.rank not in members:
+                raise RuntimeError(
+                    f"rank {self.topology.rank} is not a member of process "
+                    f"set {process_set_id}"
+                )
         handle = self.handle_manager.allocate()
 
         def _done(status: Status, output: Any) -> None:
@@ -492,6 +525,7 @@ class Runtime:
             postscale_factor=postscale_factor,
             group_id=group_id,
             group_size=group_size,
+            process_set_id=process_set_id,
         )
         entry = TensorTableEntry(
             name=name,

@@ -67,6 +67,32 @@ def rank_mesh_devices(devices=None) -> list:
     ]
 
 
+class _SetContext:
+    """Per-process-set mesh bundle (later-reference horovod.ProcessSet).
+
+    The TPU-native expression of a process set is a sub-``Mesh`` over the
+    member ranks' devices: only member processes execute the compiled
+    collective (multi-controller JAX runs a computation on exactly the
+    processes whose devices are in the mesh), which is precisely the
+    reference's per-set communicator semantics — no per-set NCCL comm
+    split, just a smaller mesh."""
+
+    def __init__(self, psid: int, ranks, mesh_devices, my_rank: int):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        self.id = int(psid)
+        self.ranks = sorted(int(r) for r in ranks)
+        self.size = len(self.ranks)
+        # This rank's member position (-1 on non-members, which never
+        # receive plans for the set).
+        self.index = (
+            self.ranks.index(my_rank) if my_rank in self.ranks else -1
+        )
+        devs = [mesh_devices[r] for r in self.ranks]
+        self.mesh = Mesh(np.array(devs), (_RANK_AXIS,))
+        self.sharding = NamedSharding(self.mesh, P(_RANK_AXIS))
+
+
 class XlaPlanExecutor(PlanExecutor):
     def __init__(self, topology: Topology, device=None, config=None):
         import jax
@@ -85,10 +111,14 @@ class XlaPlanExecutor(PlanExecutor):
                 f"process count {len(mesh_devices)} != horovod size "
                 f"{topology.size}"
             )
+        self._mesh_devices = mesh_devices
         self._mesh = Mesh(np.array(mesh_devices), (_RANK_AXIS,))
         self._local_device = device or mesh_devices[topology.rank]
         self._topo = topology
         self._config = config
+        # Registered process-set sub-meshes (id -> _SetContext); id 0 (the
+        # global set) uses the executor's own mesh fields.
+        self._sets: Dict[int, _SetContext] = {}
         # Two-level (cross, local) mesh for the hierarchical lowerings —
         # the ICI/DCN analogue of the reference's LOCAL/CROSS communicator
         # pair (nccl_operations.cc:151-346, mpi_operations.cc:168-321).
@@ -125,6 +155,33 @@ class XlaPlanExecutor(PlanExecutor):
         self._fn_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
 
+    # --- process sets ---
+    def register_process_set(self, psid: int, ranks) -> None:
+        with self._lock:
+            self._sets[int(psid)] = _SetContext(
+                psid, ranks, self._mesh_devices, self._topo.rank
+            )
+
+    def remove_process_set(self, psid: int) -> None:
+        with self._lock:
+            self._sets.pop(int(psid), None)
+            # Compiled plans over the dropped sub-mesh must not outlive it
+            # (a re-registered id could carry different membership).
+            for key in [k for k in self._fn_cache if k[-1] == ("ps", psid)]:
+                self._fn_cache.pop(key, None)
+
+    def _set_ctx(self, plan: dict) -> Optional[_SetContext]:
+        psid = int(plan.get("process_set", 0))
+        if psid == 0:
+            return None
+        with self._lock:
+            ctx = self._sets.get(psid)
+        if ctx is None:
+            raise RuntimeError(
+                f"process set {psid} is not registered on this rank"
+            )
+        return ctx
+
     def _knob(self, name: str) -> bool:
         return bool(getattr(self._config, name, False)) if self._config else False
 
@@ -140,13 +197,15 @@ class XlaPlanExecutor(PlanExecutor):
         return self._knob(name)
 
     def _wrap(self, body, hier: bool, n_in: int = 1, n_out: int = 1,
-              donate: bool = False, dim0: bool = False):
-        """shard_map+jit a plan body over the flat rank mesh or the
-        (cross, local) grid. ``donate`` aliases the carrier buffer into the
-        output (persistent-fusion-buffer behavior); only set it when the
-        executor owns the input arrays. ``dim0`` selects the zero-copy
-        layout where dim0 itself is sharded (the body receives the local
-        block with no leading rank axes)."""
+              donate: bool = False, dim0: bool = False,
+              ctx: Optional[_SetContext] = None):
+        """shard_map+jit a plan body over the flat rank mesh, the
+        (cross, local) grid, or a process set's sub-mesh. ``donate``
+        aliases the carrier buffer into the output (persistent-fusion-
+        buffer behavior); only set it when the executor owns the input
+        arrays. ``dim0`` selects the zero-copy layout where dim0 itself is
+        sharded (the body receives the local block with no leading rank
+        axes)."""
         import jax
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
@@ -154,12 +213,16 @@ class XlaPlanExecutor(PlanExecutor):
         if hier:
             # dim0 layout shards dim0 by BOTH grid axes (cross-major);
             # the host layout carries explicit (cross, local) lead axes.
+            # (Hierarchical lowerings are global-set-only.)
+            assert ctx is None, "hierarchical ops run on the global set"
             in_spec = (P((_CROSS_AXIS, _LOCAL_AXIS)) if dim0
                        else P(_CROSS_AXIS, _LOCAL_AXIS))
+            mesh = self._mesh2
         else:
             in_spec = P(_RANK_AXIS)
+            mesh = ctx.mesh if ctx is not None else self._mesh
         fn = _shard_map(
-            body, self._mesh2 if hier else self._mesh,
+            body, mesh,
             in_specs=(in_spec,) * n_in,
             out_specs=P() if n_out == 1 else (P(),) * n_out,
         )
@@ -168,10 +231,12 @@ class XlaPlanExecutor(PlanExecutor):
         )
 
     # --- helpers ---
-    def _global_array(self, local_np: np.ndarray, hierarchical: bool = False):
+    def _global_array(self, local_np: np.ndarray, hierarchical: bool = False,
+                      ctx: Optional[_SetContext] = None):
         """Build a global array of shape (size, *local) — or
         (cross, local, *local) on the 2-D mesh — with one shard per rank
-        from this process's local data."""
+        from this process's local data. ``ctx`` narrows "global" to a
+        process set's members."""
         import jax
 
         if hierarchical:
@@ -183,8 +248,9 @@ class XlaPlanExecutor(PlanExecutor):
                 local_np[None, None, ...], self._local_device
             )
         else:
-            sharding = self._sharding
-            gshape = (self._topo.size,) + local_np.shape
+            sharding = ctx.sharding if ctx is not None else self._sharding
+            n = ctx.size if ctx is not None else self._topo.size
+            gshape = (n,) + local_np.shape
             local = jax.device_put(local_np[None, ...], self._local_device)
         return jax.make_array_from_single_device_arrays(
             gshape, sharding, [local]
@@ -203,7 +269,8 @@ class XlaPlanExecutor(PlanExecutor):
         except Exception:
             return False
 
-    def _global_from_device(self, x, hierarchical: bool = False):
+    def _global_from_device(self, x, hierarchical: bool = False,
+                            ctx: Optional[_SetContext] = None):
         """Wrap this rank's device-resident array as its shard of the global
         array with ZERO device ops: the global shape is (size*d0, *rest)
         sharded on dim0 (cross-major, local-minor on the 2-D grid, matching
@@ -214,10 +281,14 @@ class XlaPlanExecutor(PlanExecutor):
 
         if x.ndim == 0:
             x = x.reshape(1)
-        gshape = (self._topo.size * x.shape[0],) + tuple(x.shape[1:])
-        sharding = (
-            self._sharding2_dim0 if hierarchical else self._sharding
-        )
+        n = ctx.size if ctx is not None else self._topo.size
+        gshape = (n * x.shape[0],) + tuple(x.shape[1:])
+        if ctx is not None:
+            sharding = ctx.sharding
+        else:
+            sharding = (
+                self._sharding2_dim0 if hierarchical else self._sharding
+            )
         return jax.make_array_from_single_device_arrays(
             gshape, sharding, [x]
         )
@@ -238,16 +309,20 @@ class XlaPlanExecutor(PlanExecutor):
     # --- execution ---
     def execute(self, plan: dict, entries, topo: Topology) -> Dict[str, Any]:
         ptype = plan["type"]
+        # Non-members never receive set plans (the core skips them at
+        # dispatch), so ctx.index >= 0 here by construction.
+        ctx = self._set_ctx(plan)
         if ptype in (0, 6):  # allreduce / adasum
-            return self._allreduce(plan, entries, adasum=(ptype == 6))
+            return self._allreduce(plan, entries, adasum=(ptype == 6),
+                                   ctx=ctx)
         if ptype == 1:
-            return self._allgather(plan, entries)
+            return self._allgather(plan, entries, ctx=ctx)
         if ptype == 2:
-            return self._broadcast(plan, entries)
+            return self._broadcast(plan, entries, ctx=ctx)
         if ptype == 4:
-            return self._alltoall(plan, entries)
+            return self._alltoall(plan, entries, ctx=ctx)
         if ptype == 5:
-            return self._reducescatter(plan, entries)
+            return self._reducescatter(plan, entries, ctx=ctx)
         raise RuntimeError(f"unsupported plan type {ptype}")
 
     def _pack(self, entries) -> Tuple[np.ndarray, List[Tuple[int, ...]], str]:
@@ -311,19 +386,24 @@ class XlaPlanExecutor(PlanExecutor):
             r = r * np.asarray(post, dtype=r.dtype)
         return r
 
-    def _allreduce(self, plan, entries, adasum: bool) -> Dict[str, Any]:
+    def _allreduce(self, plan, entries, adasum: bool,
+                   ctx: Optional[_SetContext] = None) -> Dict[str, Any]:
         op = ReduceOp(plan.get("op", int(ReduceOp.SUM)))
         pre = float(plan.get("prescale", 1.0))
         post = float(plan.get("postscale", 1.0))
-        participants = max(int(plan.get("participants", self._topo.size)), 1)
+        default_n = ctx.size if ctx is not None else self._topo.size
+        participants = max(int(plan.get("participants", default_n)), 1)
         adasum = adasum or op == ReduceOp.ADASUM
         # Hierarchical op selection, the analogue of the reference picking
         # NCCLHierarchicalAllreduce / AdasumCudaAllreduce at op-manager build
         # (operations.cc:142-223, nccl_operations.cc:348-355): honored in
         # eager mode whenever the knob is set and a (cross, local) grid
         # exists. MIN/MAX stay flat (reference hierarchy covers sums only).
+        # Process-set collectives always run flat on the sub-mesh (a set
+        # has no (cross, local) factorization of its own).
         hier = (
-            self._mesh2 is not None
+            ctx is None
+            and self._mesh2 is not None
             and (
                 (not adasum
                  and self._plan_knob(plan, "hierarchical_allreduce", 1)
@@ -334,7 +414,7 @@ class XlaPlanExecutor(PlanExecutor):
             )
         )
         kw = dict(op=op, adasum=adasum, hier=hier, pre=pre, post=post,
-                  participants=participants)
+                  participants=participants, ctx=ctx)
         if (
             all(self._device_resident(e.tensor) for e in entries)
             and len({str(e.tensor.dtype) for e in entries}) == 1
@@ -343,10 +423,10 @@ class XlaPlanExecutor(PlanExecutor):
         return self._allreduce_host(entries, **kw)
 
     def _allreduce_host(self, entries, *, op, adasum, hier, pre, post,
-                        participants) -> Dict[str, Any]:
+                        participants, ctx=None) -> Dict[str, Any]:
         buf, shapes, dtype = self._pack(entries)
         key = ("ar", dtype, buf.size, int(op), adasum, pre, post,
-               participants, hier)
+               participants, hier, ("ps", ctx.id if ctx else 0))
 
         def build():
             def body(x):
@@ -359,9 +439,9 @@ class XlaPlanExecutor(PlanExecutor):
 
             # The carrier is executor-owned: donate it so XLA aliases the
             # buffer across calls (persistent fusion buffer).
-            return self._wrap(body, hier, donate=True)
+            return self._wrap(body, hier, donate=True, ctx=ctx)
 
-        garr = self._global_array(buf, hierarchical=hier)
+        garr = self._global_array(buf, hierarchical=hier, ctx=ctx)
         out = self._compiled(key, build)(garr)
         res = self._local_out(out)
         # jax (x64 disabled) narrows 64-bit wires; restore the caller's
@@ -372,7 +452,7 @@ class XlaPlanExecutor(PlanExecutor):
         return self._unpack(res, entries, shapes)
 
     def _allreduce_device(self, entries, *, op, adasum, hier, pre, post,
-                          participants) -> Dict[str, Any]:
+                          participants, ctx=None) -> Dict[str, Any]:
         """Zero-host-copy path: entries are device-resident jax arrays, so
         pack + collective + unpack trace into one executable and outputs
         stay on device. The flat fusion buffer is an XLA temporary — the
@@ -382,7 +462,7 @@ class XlaPlanExecutor(PlanExecutor):
         shapes = tuple(tuple(int(d) for d in e.tensor.shape) for e in entries)
         dtype = str(entries[0].tensor.dtype)
         key = ("ar_dev", dtype, shapes, int(op), adasum, pre, post,
-               participants, hier)
+               participants, hier, ("ps", ctx.id if ctx else 0))
 
         def build():
             def body(*xs):
@@ -403,11 +483,12 @@ class XlaPlanExecutor(PlanExecutor):
                 return tuple(outs)
 
             return self._wrap(
-                body, hier, n_in=len(entries), n_out=len(entries), dim0=True
+                body, hier, n_in=len(entries), n_out=len(entries), dim0=True,
+                ctx=ctx,
             )
 
         garrs = [
-            self._global_from_device(e.tensor, hierarchical=hier)
+            self._global_from_device(e.tensor, hierarchical=hier, ctx=ctx)
             for e in entries
         ]
         outs = self._compiled(key, build)(*garrs)
@@ -430,22 +511,26 @@ class XlaPlanExecutor(PlanExecutor):
                 return s.data
         return garr.addressable_shards[0].data
 
-    def _allgather(self, plan, entries) -> Dict[str, Any]:
+    def _allgather(self, plan, entries,
+                   ctx: Optional[_SetContext] = None) -> Dict[str, Any]:
         import jax
         from jax import lax
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
 
         # Per-rank dim0 sizes from the coordinator (the reference's
-        # Allgatherv sizes/displacements, mpi_operations.cc:83-162). Equal
-        # sizes take the direct tiled all_gather; uneven sizes pad to the
-        # max, gather, and compact on the host (XLA needs static shapes).
+        # Allgatherv sizes/displacements, mpi_operations.cc:83-162) — in
+        # member-position order for a process set. Equal sizes take the
+        # direct tiled all_gather; uneven sizes pad to the max, gather,
+        # and compact on the host (XLA needs static shapes).
         rank_sizes = [int(s) for s in plan.get("rank_sizes", [])]
         uneven = bool(rank_sizes) and len(set(rank_sizes)) > 1
         hier = (
-            self._mesh2 is not None
+            ctx is None
+            and self._mesh2 is not None
             and self._plan_knob(plan, "hierarchical_allgather", 2)
         )
+        n_ranks = ctx.size if ctx is not None else self._topo.size
 
         outputs: Dict[str, Any] = {}
         for e in entries:
@@ -458,7 +543,8 @@ class XlaPlanExecutor(PlanExecutor):
                 send = np.pad(local, pad)
             else:
                 send = local
-            key = ("ag", str(send.dtype), send.shape, hier)
+            key = ("ag", str(send.dtype), send.shape, hier,
+                   ("ps", ctx.id if ctx else 0))
 
             def build():
                 def body(x):
@@ -475,9 +561,9 @@ class XlaPlanExecutor(PlanExecutor):
                         return lax.all_gather(g, _CROSS_AXIS, tiled=True)
                     return lax.all_gather(x[0], _RANK_AXIS, tiled=True)
 
-                return self._wrap(body, hier)
+                return self._wrap(body, hier, ctx=ctx)
 
-            garr = self._global_array(send, hierarchical=hier)
+            garr = self._global_array(send, hierarchical=hier, ctx=ctx)
             out = self._compiled(key, build)(garr)
             gathered = self._local_out(out)
             if gathered.dtype != send.dtype:
@@ -485,34 +571,46 @@ class XlaPlanExecutor(PlanExecutor):
             if uneven:
                 gathered = np.concatenate([
                     gathered[i * max_dim0: i * max_dim0 + rank_sizes[i]]
-                    for i in range(self._topo.size)
+                    for i in range(n_ranks)
                 ])
             outputs[e.name] = gathered
         return outputs
 
-    def _broadcast(self, plan, entries) -> Dict[str, Any]:
+    def _broadcast(self, plan, entries,
+                   ctx: Optional[_SetContext] = None) -> Dict[str, Any]:
         import jax
         from jax import lax
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
         from ..ops.collectives import broadcast as bcast_op
 
+        # root_rank travels as a GLOBAL rank (reference process-set API
+        # semantics); on a sub-mesh the lowering wants the member position.
         root = int(plan.get("root", 0))
+        if ctx is not None:
+            if root not in ctx.ranks:
+                raise RuntimeError(
+                    f"broadcast root {root} is not a member of process "
+                    f"set {ctx.id}"
+                )
+            root = ctx.ranks.index(root)
         outputs: Dict[str, Any] = {}
         for e in entries:
             local = np.asarray(e.tensor)
-            key = ("bc", str(local.dtype), local.shape, root)
+            key = ("bc", str(local.dtype), local.shape, root,
+                   ("ps", ctx.id if ctx else 0))
 
             def build():
                 def body(x):
                     return bcast_op(x[0], root_rank=root, axis_name=_RANK_AXIS)
 
                 fn = _shard_map(
-                    body, self._mesh, in_specs=(P(_RANK_AXIS),), out_specs=P()
+                    body, ctx.mesh if ctx is not None else self._mesh,
+                    in_specs=(P(_RANK_AXIS),), out_specs=P()
                 )
                 return jax.jit(fn)
 
-            garr = self._global_array(local)
+            garr = self._global_array(local, ctx=ctx)
             out = self._compiled(key, build)(garr)
             res = self._local_out(out)
             outputs[e.name] = (
@@ -520,7 +618,8 @@ class XlaPlanExecutor(PlanExecutor):
             )
         return outputs
 
-    def _reducescatter(self, plan, entries) -> Dict[str, Any]:
+    def _reducescatter(self, plan, entries,
+                       ctx: Optional[_SetContext] = None) -> Dict[str, Any]:
         """Sum-reduce across ranks and scatter dim0 shards: rank r gets
         rows [r*d0/n, (r+1)*d0/n) of the sum. TPU-native extension (the
         reference's op set stops at broadcast, message.h:48-50); lowers
@@ -533,7 +632,7 @@ class XlaPlanExecutor(PlanExecutor):
         from ..ops.collectives import reducescatter as rs_lowering
 
         outputs: Dict[str, Any] = {}
-        n = self._topo.size
+        n = ctx.size if ctx is not None else self._topo.size
         participants = int(plan.get("participants", n)) or n
         reduce_op = int(plan.get("op", int(ReduceOp.SUM)))
         if reduce_op not in (int(ReduceOp.SUM), int(ReduceOp.AVERAGE)):
@@ -548,7 +647,7 @@ class XlaPlanExecutor(PlanExecutor):
                 )
             on_device = self._device_resident(e.tensor)
             key = ("rs", str(e.tensor.dtype), shape, reduce_op, participants,
-                   on_device)
+                   on_device, ("ps", ctx.id if ctx else 0))
 
             def build():
                 def body(x):
@@ -564,18 +663,19 @@ class XlaPlanExecutor(PlanExecutor):
                     return out
 
                 fn = _shard_map(
-                    body, self._mesh, in_specs=(P(_RANK_AXIS),),
+                    body, ctx.mesh if ctx is not None else self._mesh,
+                    in_specs=(P(_RANK_AXIS),),
                     out_specs=P(_RANK_AXIS),
                 )
                 return jax.jit(fn)
 
             if on_device:
-                garr = self._global_from_device(e.tensor)
+                garr = self._global_from_device(e.tensor, ctx=ctx)
                 out = self._compiled(key, build)(garr)
                 outputs[e.name] = self._local_view(out)
             else:
                 local = np.asarray(e.tensor)
-                garr = self._global_array(local)
+                garr = self._global_array(local, ctx=ctx)
                 out = self._compiled(key, build)(garr)
                 res = self._local_out(out)
                 outputs[e.name] = (
@@ -584,14 +684,15 @@ class XlaPlanExecutor(PlanExecutor):
                 )
         return outputs
 
-    def _alltoall(self, plan, entries) -> Dict[str, Any]:
+    def _alltoall(self, plan, entries,
+                  ctx: Optional[_SetContext] = None) -> Dict[str, Any]:
         import jax
         from jax import lax
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
 
         outputs: Dict[str, Any] = {}
-        n = self._topo.size
+        n = ctx.size if ctx is not None else self._topo.size
         for e in entries:
             local = np.asarray(e.tensor)
             if local.shape[0] % n != 0:
@@ -599,7 +700,8 @@ class XlaPlanExecutor(PlanExecutor):
                     f"alltoall dim0 ({local.shape[0]}) must be divisible by "
                     f"size ({n})"
                 )
-            key = ("a2a", str(local.dtype), local.shape)
+            key = ("a2a", str(local.dtype), local.shape,
+                   ("ps", ctx.id if ctx else 0))
 
             def build():
                 def body(x):
@@ -609,11 +711,12 @@ class XlaPlanExecutor(PlanExecutor):
                     )
 
                 fn = _shard_map(
-                    body, self._mesh, in_specs=(P(_RANK_AXIS),), out_specs=P()
+                    body, ctx.mesh if ctx is not None else self._mesh,
+                    in_specs=(P(_RANK_AXIS),), out_specs=P()
                 )
                 return jax.jit(fn)
 
-            garr = self._global_array(local)
+            garr = self._global_array(local, ctx=ctx)
             out = self._compiled(key, build)(garr)
             res = self._local_out(out)
             outputs[e.name] = (

@@ -1140,3 +1140,166 @@ def test_tf_graph_grouped_allreduce_one_plan_two_ranks():
     )
     for out in outs:
         assert "GRAPH_GROUP_ONEPLAN 3" in out, outs
+
+
+def test_process_sets_two_ranks():
+    """Dynamic process sets (later-reference hvd.ProcessSet): singleton
+    sets alongside the global set. Each rank's set-allreduce sees only
+    its own contribution; global ops keep working around them."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        r = hvd.rank()
+        import jax.numpy as jnp
+
+        even = hvd.add_process_set([0])
+        odd = hvd.add_process_set([1])
+        mine = even if r == 0 else odd
+        other = odd if r == 0 else even
+        assert mine.included() and not other.included()
+        assert mine.rank() == 0 and mine.size() == 1
+        assert hvd.global_process_set.included()
+        assert hvd.global_process_set.size() == 2
+
+        x = jnp.full((4,), float(r + 1), jnp.float32)
+        s_set = hvd.allreduce(x, op=hvd.Sum, process_set=mine, name="ps.ar")
+        s_glob = hvd.allreduce(x, op=hvd.Sum, name="glob.ar")
+        assert np.allclose(np.asarray(s_set), r + 1), np.asarray(s_set)
+        assert np.allclose(np.asarray(s_glob), 3.0), np.asarray(s_glob)
+
+        # Non-member submission fails fast (local validation).
+        try:
+            hvd.allreduce(x, process_set=other, name="bad")
+            raise AssertionError("non-member enqueue should fail")
+        except RuntimeError as e:
+            assert "not a member" in str(e), e
+
+        # remove_process_set is collective: identical calls on every rank.
+        hvd.remove_process_set(even)
+        hvd.remove_process_set(odd)
+        assert even.process_set_id is None and odd.process_set_id is None
+        print("PS2 OK")
+        hvd.shutdown()
+        """,
+    )
+    for out in outs:
+        assert "PS2 OK" in out, outs
+
+
+def test_process_sets_disjoint_pairs_four_ranks():
+    """4-rank job split into two disjoint 2-rank sets: each pair's
+    collectives ride a sub-mesh of its member devices only. Covers
+    allreduce (set-local sum), uneven allgather (member-ordered
+    displacements), broadcast (GLOBAL root rank mapped to the member
+    position), grouped allreduce within a set, and set+global mixing."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        r = hvd.rank()
+        import jax.numpy as jnp
+
+        lo = hvd.add_process_set([0, 1])
+        hi = hvd.add_process_set([2, 3])
+        mine = lo if r < 2 else hi
+        assert mine.rank() == r % 2 and mine.size() == 2
+
+        x = jnp.full((3,), float(r + 1), jnp.float32)
+        s = hvd.allreduce(x, op=hvd.Sum, process_set=mine, name="pair.ar")
+        want = 3.0 if r < 2 else 7.0
+        assert np.allclose(np.asarray(s), want), (r, np.asarray(s))
+
+        # Uneven allgather within the set: member m contributes m+1 rows.
+        rows = mine.rank() + 1
+        g = hvd.allgather(
+            np.full((rows, 2), float(r), np.float32), name="pair.ag",
+            process_set=mine)
+        g = np.asarray(g)
+        base = 0 if r < 2 else 2
+        want_rows = [float(base)] * 1 + [float(base + 1)] * 2
+        assert g.shape == (3, 2) and g[:, 0].tolist() == want_rows, g
+
+        # Broadcast with a GLOBAL root rank (root 2 lives in `hi`).
+        root = 0 if r < 2 else 2
+        b = hvd.broadcast(
+            np.full((2,), float(r), np.float32), root_rank=root,
+            name="pair.bc", process_set=mine)
+        assert np.asarray(b).tolist() == [float(root)] * 2, np.asarray(b)
+
+        # Grouped allreduce stays one plan inside the set.
+        outs2 = hvd.grouped_allreduce(
+            [jnp.ones((2,)) * (r + 1), jnp.ones((1,)) * 10 * (r + 1)],
+            op=hvd.Sum, name="pair.grp", process_set=mine)
+        w0 = 3.0 if r < 2 else 7.0
+        assert np.allclose(np.asarray(outs2[0]), w0)
+        assert np.allclose(np.asarray(outs2[1]), 10 * w0)
+
+        # Global collective still healthy after set traffic.
+        tot = hvd.allreduce(jnp.ones((2,)), op=hvd.Sum, name="glob.ar2")
+        assert np.allclose(np.asarray(tot), 4.0)
+
+        # Set-local object gather (member-ordered).
+        objs = hvd.allgather_object({"r": r}, name="pair.obj",
+                                    process_set=mine)
+        assert [o["r"] for o in objs] == ([0, 1] if r < 2 else [2, 3]), objs
+        print("PS4 OK")
+        hvd.shutdown()
+        """,
+        np_=4,
+        timeout=300,
+    )
+    for out in outs:
+        assert "PS4 OK" in out, outs
+
+
+def test_process_set_divergent_registration_fails_loudly():
+    """A divergent add_process_set (different membership per rank) must
+    raise ValueError on EVERY rank — including the rank whose local
+    validation failed — instead of stranding peers in the barrier."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        r = hvd.rank()
+        ranks = [0, 1] if r == 0 else [0]
+        try:
+            hvd.add_process_set(ranks)
+            raise AssertionError("divergent registration should fail")
+        except ValueError as e:
+            assert "identically" in str(e), e
+        # Rank 1's id allocation diverged? No: both allocated id 1 and
+        # rolled back; a subsequent identical registration must agree.
+        ps = hvd.add_process_set([0, 1])
+        s = hvd.allreduce(np.ones(2, np.float32), op=hvd.Sum,
+                          process_set=ps, name="after.ar")
+        assert np.allclose(np.asarray(s), 2.0)
+        # Out-of-range ranks on ONE rank only: the failing rank raises
+        # its local error, the healthy rank raises the agreement error.
+        try:
+            hvd.add_process_set([0, 1] if r == 0 else [0, 99])
+            raise AssertionError("should fail")
+        except ValueError as e:
+            assert ("identically" in str(e)) or ("lie in" in str(e)), e
+        # Failed calls consume the shared id/barrier sequence on EVERY
+        # rank (even the locally-invalid one), so registration recovers.
+        ps3 = hvd.add_process_set([1])
+        if r == 1:
+            s3 = hvd.allreduce(np.ones(1, np.float32), op=hvd.Sum,
+                               process_set=ps3, name="solo.ar")
+            assert np.allclose(np.asarray(s3), 1.0)
+        # Fence before shutdown: the solo set op above needs the global
+        # coordinator (rank 0) alive until it completes.
+        hvd.allreduce(np.ones(1, np.float32), op=hvd.Sum, name="fence")
+        print("PSDIV OK")
+        hvd.shutdown()
+        """,
+    )
+    for out in outs:
+        assert "PSDIV OK" in out, outs

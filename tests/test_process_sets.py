@@ -1,0 +1,102 @@
+"""Process-set API unit tests (single process).
+
+Later-reference parity: ``horovod.ProcessSet`` / ``add_process_set`` /
+``remove_process_set`` / ``global_process_set`` and the ``process_set=``
+argument on the eager collectives. The multi-rank data-plane behavior
+(sub-mesh collectives, member-ordered gathers, global-root broadcasts)
+is covered by ``tests/test_multiprocess.py::test_process_sets_*``; this
+file pins the API contract and the single-process degenerate semantics.
+"""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture()
+def sess():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def test_global_process_set(sess):
+    g = hvd.global_process_set
+    assert g.process_set_id == 0
+    assert g.included()
+    assert g.size() == hvd.size() == 1
+    assert g.rank() == hvd.rank() == 0
+    # The implicit global set never needs (or allows) registration.
+    with pytest.raises(ValueError):
+        hvd.add_process_set(hvd.ProcessSet(None))
+
+
+def test_add_remove_lifecycle(sess):
+    ps = hvd.add_process_set([0])
+    assert ps.process_set_id == 1
+    assert ps.included() and ps.rank() == 0 and ps.size() == 1
+    # Ids are assigned sequentially and deterministically.
+    ps2 = hvd.add_process_set(hvd.ProcessSet([0]))
+    assert ps2.process_set_id == 2
+    # Double registration of the same object is rejected.
+    with pytest.raises(ValueError):
+        hvd.add_process_set(ps)
+    hvd.remove_process_set(ps2)
+    assert ps2.process_set_id is None
+    # Removing twice (or the global set) fails loudly.
+    with pytest.raises(ValueError):
+        hvd.remove_process_set(ps2)
+    with pytest.raises(ValueError):
+        hvd.remove_process_set(hvd.global_process_set)
+    hvd.remove_process_set(ps)
+
+
+def test_ranks_validation(sess):
+    with pytest.raises(ValueError):
+        hvd.add_process_set([1])  # out of range for size=1
+    with pytest.raises(ValueError):
+        hvd.add_process_set([-1])
+    with pytest.raises(ValueError):
+        hvd.add_process_set([])
+
+
+def test_unregistered_set_rejected(sess):
+    ps = hvd.ProcessSet([0])
+    with pytest.raises(ValueError, match="add_process_set"):
+        hvd.allreduce(np.ones(2, np.float32), process_set=ps)
+
+
+def test_collectives_over_singleton_set(sess):
+    """size=1 semantics: a set containing this rank behaves like the
+    global set (identity collectives), through the full negotiation
+    machinery — requests carry the set id end to end."""
+    ps = hvd.add_process_set([0])
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    assert np.allclose(hvd.allreduce(x, op=hvd.Sum, process_set=ps), x)
+    assert np.allclose(hvd.allgather(x, process_set=ps), x)
+    assert np.allclose(
+        hvd.broadcast(x, root_rank=0, process_set=ps), x
+    )
+    outs = hvd.grouped_allreduce(
+        [x, 2.0 * x], op=hvd.Sum, process_set=ps, name="psgrp"
+    )
+    assert np.allclose(outs[0], x) and np.allclose(outs[1], 2.0 * x)
+    objs = hvd.allgather_object({"k": 7}, process_set=ps)
+    assert objs == [{"k": 7}]
+    hvd.remove_process_set(ps)
+
+
+def test_shutdown_resets_registry():
+    hvd.init()
+    ps = hvd.add_process_set([0])
+    assert ps.process_set_id == 1
+    hvd.shutdown()
+    assert ps.process_set_id is None
+    # Fresh init restarts id assignment (all ranks stay aligned).
+    hvd.init()
+    try:
+        again = hvd.add_process_set([0])
+        assert again.process_set_id == 1
+    finally:
+        hvd.shutdown()
