@@ -595,9 +595,14 @@ def reducescatter_async(
     tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None,
     process_set: Optional[ProcessSet] = None,
 ) -> int:
-    """Sum/average across ranks, scatter dim0 shards: rank r receives rows
-    ``[r*d/size, (r+1)*d/size)`` of the reduction. TPU-native extension
-    (single ``lax.psum_scatter`` on the ICI ring); the reference op set
+    """Sum/average across ranks, scatter dim0 shards: rank r receives its
+    dim0 shard of the reduction — ``d//size`` rows each when ``size``
+    divides ``d``, and Allgatherv-parity uneven splits otherwise (rank r
+    gets ``d//size + (1 if r < d%size else 0)`` rows, earlier ranks
+    absorbing the remainder — the MPI_Reduce_scatter convention the
+    later reference adopted). TPU-native extension (single
+    ``lax.psum_scatter`` on the ICI ring, uneven dim0 via a static
+    pad-gather sliced off after the collective); the reference op set
     stops at broadcast (``message.h:48-50``)."""
     op = op if op is not None else ReduceOp.SUM
     # Validate here, not only in the multi-rank executor, so a size-1 dev

@@ -620,42 +620,70 @@ class XlaPlanExecutor(PlanExecutor):
 
     def _reducescatter(self, plan, entries,
                        ctx: Optional[_SetContext] = None) -> Dict[str, Any]:
-        """Sum-reduce across ranks and scatter dim0 shards: rank r gets
-        rows [r*d0/n, (r+1)*d0/n) of the sum. TPU-native extension (the
-        reference's op set stops at broadcast, message.h:48-50); lowers
-        through the one canonical ``ops.collectives.reducescatter``
-        psum_scatter. AVERAGE divides by the participant count like
+        """Sum-reduce across ranks and scatter dim0 shards. Even dim0:
+        rank r gets rows [r*d0/n, (r+1)*d0/n) of the sum. Uneven dim0
+        takes Allgatherv-parity split sizes (the later reference's
+        reducescatter semantics, mirroring MPI_Reduce_scatter): rank r
+        receives ``d0//n + (1 if r < d0%n else 0)`` rows, earlier ranks
+        taking the remainder. TPU-native extension (the reference's op
+        set stops at broadcast, message.h:48-50); lowers through the one
+        canonical ``ops.collectives.reducescatter`` psum_scatter — the
+        uneven case pre-permutes rows with a STATIC gather so each
+        rank's uneven shard (zero-padded to the even block size) lands
+        in its psum_scatter block, then slices the pad off after the
+        collective. AVERAGE divides by the participant count like
         allreduce. Device-resident inputs stay on device."""
         import jax
+        import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
         from ..ops.collectives import reducescatter as rs_lowering
 
         outputs: Dict[str, Any] = {}
         n = ctx.size if ctx is not None else self._topo.size
+        my = ctx.index if ctx is not None else self._topo.rank
         participants = int(plan.get("participants", n)) or n
         reduce_op = int(plan.get("op", int(ReduceOp.SUM)))
         if reduce_op not in (int(ReduceOp.SUM), int(ReduceOp.AVERAGE)):
             raise RuntimeError("reducescatter supports SUM/AVERAGE only")
         for e in entries:
             shape = tuple(int(d) for d in e.tensor.shape)
-            if not shape or shape[0] % n != 0:
+            if not shape:
                 raise RuntimeError(
-                    f"reducescatter dim0 "
-                    f"({shape[0] if shape else 'scalar'}) must be "
-                    f"divisible by size ({n})"
+                    "reducescatter needs a tensor with a dim0 to scatter"
                 )
+            d0 = shape[0]
+            base, rem = divmod(d0, n)
+            ceil_rows = base + (1 if rem else 0)
+            my_count = base + (1 if my < rem else 0)
+            if rem:
+                # Static row-gather: block r holds rank r's uneven shard
+                # (rows [r*base+min(r,rem), +count_r)) then pad slots
+                # pointing at one zero row appended at index d0.
+                idx = np.full(n * ceil_rows, d0, dtype=np.int32)
+                for r in range(n):
+                    start = r * base + min(r, rem)
+                    cnt = base + (1 if r < rem else 0)
+                    idx[r * ceil_rows: r * ceil_rows + cnt] = np.arange(
+                        start, start + cnt, dtype=np.int32
+                    )
+            else:
+                idx = None
             on_device = self._device_resident(e.tensor)
             key = ("rs", str(e.tensor.dtype), shape, reduce_op, participants,
                    on_device, ("ps", ctx.id if ctx else 0))
 
-            def build():
+            def build(idx=idx):
                 def body(x):
                     # Host layout carries a leading rank axis; the device
                     # (dim0-sharded) layout is the local block verbatim.
-                    out = rs_lowering(
-                        x if on_device else x[0], axis_name=_RANK_AXIS
-                    )
+                    t = x if on_device else x[0]
+                    if idx is not None:
+                        zero = jnp.zeros((1,) + t.shape[1:], t.dtype)
+                        t = jnp.take(
+                            jnp.concatenate([t, zero]), idx, axis=0
+                        )
+                    out = rs_lowering(t, axis_name=_RANK_AXIS)
                     if reduce_op == int(ReduceOp.AVERAGE):
                         out = (
                             out / np.asarray(participants, dtype=np.float32)
@@ -672,12 +700,15 @@ class XlaPlanExecutor(PlanExecutor):
             if on_device:
                 garr = self._global_from_device(e.tensor, ctx=ctx)
                 out = self._compiled(key, build)(garr)
-                outputs[e.name] = self._local_view(out)
+                view = self._local_view(out)
+                outputs[e.name] = view[:my_count] if rem else view
             else:
                 local = np.asarray(e.tensor)
                 garr = self._global_array(local, ctx=ctx)
                 out = self._compiled(key, build)(garr)
                 res = self._local_out(out)
+                if rem:
+                    res = res[:my_count]
                 outputs[e.name] = (
                     res if res.dtype == local.dtype
                     else res.astype(local.dtype)

@@ -306,7 +306,9 @@ def test_alltoall_two_ranks():
 
 def test_reducescatter_two_ranks():
     """Eager reducescatter (TPU-native extension): sum across ranks,
-    rank r keeps dim0 shard r; AVERAGE divides by participant count."""
+    rank r keeps dim0 shard r; AVERAGE divides by participant count.
+    Uneven dim0 takes Allgatherv-parity split sizes (later-reference
+    reducescatter): earlier ranks absorb the remainder rows."""
     outs = _run_workers(
         """
         import numpy as np, jax
@@ -320,11 +322,14 @@ def test_reducescatter_two_ranks():
         a = hvd.reducescatter(x, op=hvd.Average)
         print("RS", np.asarray(s).tolist())
         print("RSAVG", np.asarray(a).tolist())
-        try:
-            hvd.reducescatter(jnp.ones((3,), jnp.float32), name="bad")
-            print("NO_ERROR")
-        except RuntimeError:
-            print("DIV_ERROR")
+        # Uneven: sum=[1,3,5]; rank0 keeps 2 rows, rank1 keeps 1.
+        u = hvd.reducescatter(
+            jnp.asarray(np.arange(3, dtype=np.float32) + r), name="uneven")
+        print("RSU", np.asarray(u).tolist())
+        # Uneven 2-D, device-resident input, on-device output shard.
+        d = jax.device_put(np.full((5, 2), float(r + 1), np.float32))
+        du = hvd.reducescatter(d, name="uneven2d")
+        print("RSU2D", np.asarray(du).sum().item(), tuple(du.shape))
         hvd.shutdown()
         """
     )
@@ -332,8 +337,12 @@ def test_reducescatter_two_ranks():
     assert "RS [5.0, 7.0]" in outs[1], outs
     assert "RSAVG [0.5, 1.5]" in outs[0], outs
     assert "RSAVG [2.5, 3.5]" in outs[1], outs
-    for out in outs:
-        assert "DIV_ERROR" in out, outs
+    assert "RSU [1.0, 3.0]" in outs[0], outs
+    assert "RSU [5.0]" in outs[1], outs
+    # sum over ranks = 3.0 per element; rank0: 3 rows x 2 cols x 3 = 18,
+    # rank1: 2 rows x 2 cols x 3 = 12.
+    assert "RSU2D 18.0 (3, 2)" in outs[0], outs
+    assert "RSU2D 12.0 (2, 2)" in outs[1], outs
 
 
 _FAKE_GRID_PROLOGUE = """
