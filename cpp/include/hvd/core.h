@@ -99,6 +99,7 @@ class ResponseCache {
   // a cache bit on the wire is a compressed re-announcement).
   bool GetRequest(int32_t bit, Request* out) const;
   void Invalidate(const std::string& name);
+  void Clear();
   size_t size() const { return entries_.size(); }
   static std::string Key(const Request& r);
 
@@ -126,6 +127,10 @@ class StallInspector {
   }
   void Record(const std::string& name, int rank);
   void Clear(const std::string& name);
+  void Reset() {
+    std::lock_guard<std::mutex> l(mu_);
+    pending_.clear();
+  }
   // Returns true if shutdown threshold exceeded.
   bool Check(int size);
 

@@ -271,6 +271,14 @@ void ResponseCache::Invalidate(const std::string& name) {
   }
 }
 
+void ResponseCache::Clear() {
+  std::lock_guard<std::mutex> l(mu_);
+  entries_.clear();
+  index_.clear();
+  free_bits_.clear();
+  tick_ = 0;
+}
+
 // ------------------------------------------------------------ stall
 void StallInspector::Record(const std::string& name, int rank) {
   std::lock_guard<std::mutex> l(mu_);
@@ -424,6 +432,17 @@ void Core::Shutdown() {
     std::lock_guard<std::mutex> l(ps_mu_);
     process_sets_.clear();
   }
+  // The response cache MUST reset across re-init: a cache bit on the
+  // wire is a compressed re-announcement, and an elastic re-formation
+  // can seat a FRESH coordinator (respawned rank 0) that has no entry
+  // for a survivor's bit — negotiation would hang forever. Same for the
+  // grouped-collective bookkeeping, and for the stall inspector, whose
+  // stale first_seen timestamps from the dead generation would
+  // otherwise read as minutes-old stalls (spurious warnings, or an
+  // instant stall-shutdown of the fresh world).
+  cache_.Clear();
+  group_poisoned_.clear();
+  stall_.Reset();
   initialized_ = false;
 }
 
@@ -788,6 +807,12 @@ void Core::RunCycleOnce() {
     if (!s.ok()) {
       HVD_LOG(kError, "control gather failed: " + s.reason);
       shutdown_ = true;
+      // Fail every pending handle NOW: a waiter blocked in synchronize
+      // must surface the peer loss as an error, not hang until an
+      // external stall kill (elastic rollback depends on this).
+      FailAll(Status::Error(StatusCode::kAborted,
+                            "Horovod control plane lost a peer rank: " +
+                                s.reason));
       return;
     }
     verdict = Coordinate(lists);
@@ -795,6 +820,9 @@ void Core::RunCycleOnce() {
     if (!s.ok()) {
       HVD_LOG(kError, "control broadcast failed: " + s.reason);
       shutdown_ = true;
+      FailAll(Status::Error(StatusCode::kAborted,
+                            "Horovod control plane lost a peer rank: " +
+                                s.reason));
       return;
     }
   } else {
@@ -802,6 +830,9 @@ void Core::RunCycleOnce() {
     if (!s.ok()) {
       HVD_LOG(kError, "control exchange failed: " + s.reason);
       shutdown_ = true;
+      FailAll(Status::Error(StatusCode::kAborted,
+                            "Horovod control plane lost the coordinator: " +
+                                s.reason));
       return;
     }
     if (verdict.cycle_time_ms > 0 || verdict.fusion_threshold > 0) {

@@ -76,11 +76,27 @@ def init(config: Optional[Config] = None) -> None:
 
             if jax_coord:
                 # Must run before any backend use; tolerate re-init.
+                if _os.environ.get("HOROVOD_ELASTIC") == "1":
+                    # Elastic worlds need failure-tolerant coordination: a
+                    # dead peer must surface as a catchable collective
+                    # error on survivors, not a fatal coordination-service
+                    # abort — rollback re-forms the world in process
+                    # (horovod_tpu/elastic).
+                    _jax.config.update("jax_enable_recoverability", True)
+                    from .elastic import _jax_distributed_initialize
+
+                    def _dist_init():
+                        _jax_distributed_initialize(
+                            jax_coord, topo.size, topo.rank
+                        )
+                else:
+                    def _dist_init():
+                        _jax.distributed.initialize(
+                            jax_coord, num_processes=topo.size,
+                            process_id=topo.rank,
+                        )
                 try:
-                    _jax.distributed.initialize(
-                        jax_coord, num_processes=topo.size,
-                        process_id=topo.rank,
-                    )
+                    _dist_init()
                 except RuntimeError as exc:
                     if "already" not in str(exc).lower():
                         raise
@@ -745,12 +761,17 @@ def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
 
 def broadcast_variables(variables: Any, root_rank: int = 0) -> Any:
     """Broadcast a pytree of arrays from root (reference
-    ``broadcast_variables`` / ``broadcast_parameters``)."""
+    ``broadcast_variables`` / ``broadcast_parameters``). All leaves are
+    enqueued async first so one negotiation cycle can fuse them into a
+    single plan — latency scales with payload, not leaf count."""
     import jax
 
     leaves, treedef = jax.tree.flatten(variables)
-    out = [broadcast(leaf, root_rank, name=f"bcast.var.{i}") for i, leaf in enumerate(leaves)]
-    return jax.tree.unflatten(treedef, out)
+    handles = [
+        broadcast_async(leaf, root_rank, name=f"bcast.var.{i}")
+        for i, leaf in enumerate(leaves)
+    ]
+    return jax.tree.unflatten(treedef, [synchronize(h) for h in handles])
 
 
 __all__ = [
@@ -805,4 +826,8 @@ __all__ = [
     "mlsl_built",
     "xla_built",
     "xla_enabled",
+    "HorovodInternalError",
+    "elastic",
 ]
+
+from . import elastic  # noqa: E402  (hvd.elastic.run / State / ObjectState)

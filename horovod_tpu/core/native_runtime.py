@@ -359,7 +359,11 @@ class NativeRuntime:
                 with self._cv:
                     status, out = self._done.pop(handle)
                 if not status.ok():
-                    raise RuntimeError(status.reason)
+                    # HorovodInternalError so elastic rollback can
+                    # distinguish collective failures from user bugs.
+                    from .. import HorovodInternalError
+
+                    raise HorovodInternalError(status.reason)
                 return out
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError("Horovod operation timed out")

@@ -654,5 +654,9 @@ class Runtime:
         if status.in_progress():
             raise TimeoutError("Horovod operation timed out")
         if not status.ok():
-            raise RuntimeError(status.reason)
+            # HorovodInternalError (a RuntimeError subclass) so elastic
+            # rollback can distinguish collective failures from user bugs.
+            from .. import HorovodInternalError
+
+            raise HorovodInternalError(status.reason)
         return output

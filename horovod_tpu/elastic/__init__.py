@@ -1,0 +1,622 @@
+"""Elastic training: fault tolerance + dynamically changing membership.
+
+Later-reference parity (``horovod.elastic``, added upstream in v0.20 — not
+present in the v0.18.2 reference tree, like the ProcessSet and grouped-op
+APIs this build already ships): a training loop wrapped in
+``@hvd.elastic.run`` survives worker failures and host set changes by
+rolling back to the last committed ``State`` and re-forming the world with
+the surviving/new workers.
+
+TPU-native design — generation-based world re-formation, no process
+restart:
+
+- The elastic driver (``hvdrun --min-np/--max-np/--host-discovery-script``,
+  ``run/elastic_driver.py``) publishes each world *generation* (membership,
+  rank assignments, and FRESH control-plane + JAX-coordinator endpoints) in
+  its HTTP KV rendezvous store.
+- Workers re-rendezvous IN PROCESS: tear down the JAX distributed client
+  and the XLA backend caches (``jax.distributed.shutdown()`` +
+  ``xla_bridge._clear_backends()``), update the ``HOROVOD_*`` env from the
+  new generation, and ``hvd.init()`` again. Weights stay in host memory
+  (the committed ``State``); nothing is re-spawned, so recovery cost is one
+  re-rendezvous + one recompilation at the new world size.
+- ``State.check_host_updates()`` reaches cross-rank agreement with a tiny
+  allreduce before interrupting, so every live rank raises
+  ``HostsUpdatedInterrupt`` at the same step (upstream's notification
+  agreement, re-expressed as the collective it always was).
+
+Failure semantics: a crashed peer surfaces on survivors as
+``HorovodInternalError`` (transport abort or stall shutdown) → ``run``
+restores the last commit and rejoins the next generation. A graceful
+membership change (host added/removed by discovery) surfaces as
+``HostsUpdatedInterrupt`` → current in-memory state is KEPT (no rollback)
+and re-synced from the new rank 0.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("horovod_tpu.elastic")
+
+__all__ = [
+    "run",
+    "State",
+    "ObjectState",
+    "JaxState",
+    "TorchState",
+    "TensorFlowKerasState",
+    "HostsUpdatedInterrupt",
+]
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised inside the training function when the driver published a new
+    world generation (host added/removed). The in-memory state is kept;
+    ``run`` re-rendezvouses and re-syncs it."""
+
+
+# --------------------------------------------------------------- context
+class _ElasticContext:
+    """Worker-side view of the elastic rendezvous (driver KV store)."""
+
+    def __init__(self) -> None:
+        from ..run.http_server import KVStoreClient
+
+        self.worker_id = os.environ["HOROVOD_ELASTIC_WORKER_ID"]
+        self.gen = int(os.environ.get("HOROVOD_ELASTIC_GEN", "1"))
+        # Rank holding the authoritative state for the current generation
+        # (a survivor after a re-formation; see ElasticDriver._publish).
+        # From env at spawn (a respawned worker joins mid-job and never
+        # goes through apply() for its first generation), then updated by
+        # apply() on every re-formation.
+        self.sync_root = int(
+            os.environ.get("HOROVOD_ELASTIC_SYNC_ROOT", "0")
+        )
+        addr = os.environ["HOROVOD_ELASTIC_KV_ADDR"]
+        port = int(os.environ["HOROVOD_ELASTIC_KV_PORT"])
+        self._kv = KVStoreClient(addr, port)
+        self.timeout = float(
+            os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")
+        )
+
+    def fetch_world(self) -> Optional[Dict[str, Any]]:
+        raw = self._kv.get("elastic", "world")
+        if raw is None:
+            return None
+        return json.loads(raw.decode())
+
+    def confirm_joined(self) -> None:
+        """Tell the driver this worker completed a state sync in its
+        current generation — from then on it holds live training state
+        and is a valid sync_root for future re-formations."""
+        try:
+            self._kv.put(
+                "elastic", f"joined.{self.worker_id}",
+                str(self.gen).encode(),
+            )
+        except Exception:  # noqa: BLE001 - advisory signal
+            pass
+
+    def signal_rejoin(self) -> None:
+        """Tell the driver this worker abandoned its current generation
+        (rollback with every process still alive — stall shutdown,
+        transient control-plane error). The driver responds by bumping
+        the generation even though membership did not change; without
+        this, every rank would wait out the full elastic timeout for a
+        bump that nothing else triggers."""
+        try:
+            self._kv.put(
+                "elastic", f"rejoin.{self.worker_id}",
+                str(self.gen).encode(),
+            )
+        except Exception:  # noqa: BLE001 - advisory signal
+            pass
+
+    def poll_updated(self) -> bool:
+        """True when the driver has published a newer generation than the
+        one this worker is part of."""
+        try:
+            world = self.fetch_world()
+        except Exception:  # noqa: BLE001 - driver briefly unreachable
+            return False
+        return bool(world) and int(world["gen"]) > self.gen
+
+    def apply(self, world: Dict[str, Any]) -> bool:
+        """Point the ``HOROVOD_*`` env at this generation's assignment.
+        Returns False when this worker is not a member of the new world.
+        Deliberately does NOT advance ``self.gen`` — the caller commits
+        the generation only after ``hvd.init()`` succeeds, so a transient
+        init failure retries the SAME still-live generation instead of
+        waiting forever for a bump the driver has no reason to publish."""
+        a = world["assignments"].get(self.worker_id)
+        if a is None:
+            return False
+        os.environ.update(
+            {
+                "HOROVOD_RANK": str(a["rank"]),
+                "HOROVOD_SIZE": str(world["size"]),
+                "HOROVOD_LOCAL_RANK": str(a["local_rank"]),
+                "HOROVOD_LOCAL_SIZE": str(a["local_size"]),
+                "HOROVOD_CROSS_RANK": str(a["cross_rank"]),
+                "HOROVOD_CROSS_SIZE": str(a["cross_size"]),
+                "HOROVOD_CONTROLLER_ADDR": world["controller_addr"],
+                "HOROVOD_CONTROLLER_PORT": str(world["controller_port"]),
+                "HOROVOD_JAX_COORDINATOR": world["jax_coordinator"],
+                "HOROVOD_ELASTIC_GEN": str(world["gen"]),
+            }
+        )
+        self.sync_root = int(world.get("sync_root", 0))
+        return True
+
+
+_context: Optional[_ElasticContext] = None
+
+
+def _ctx() -> Optional[_ElasticContext]:
+    global _context
+    if _context is None and os.environ.get("HOROVOD_ELASTIC") == "1":
+        _context = _ElasticContext()
+    return _context
+
+
+def _jax_distributed_initialize(coord: str, num: int, pid: int) -> None:
+    """Stand up the JAX distributed runtime for an elastic world. Unlike
+    ``jax.distributed.initialize``:
+
+    - The coordination SERVICE is never created here — it lives in the
+      elastic DRIVER process (one per world generation), so no worker is
+      special: any worker, including generation rank 0, can crash without
+      taking the coordination plane down with it (the reference's elastic
+      driver owns the rendezvous for the same reason).
+    - The client is failure-tolerant: ``recoverable=True`` (peer death is
+      swallowed by the agent and surfaces as failed collectives, which the
+      runtime turns into ``HorovodInternalError`` → rollback) and
+      ``shutdown_on_destruction=False`` (leaving a world never issues the
+      ShutdownTask RPC, whose race against a dying service is fatal).
+      No ``missed_heartbeat_callback`` — the pybind functional bridge
+      std::bad_cast-aborts when the agent's error-poll thread invokes a
+      Python callback (jaxlib 0.9), and the driver-hosted service keeps
+      heartbeats answerable for stragglers anyway."""
+    from jax._src import distributed as _dist
+    from jax._src.lib import _jax as _jaxlib
+
+    state = _dist.global_state
+    if state.client is not None:
+        raise RuntimeError("jax distributed runtime is already initialized")
+    init_timeout = int(float(
+        os.environ.get("HOROVOD_ELASTIC_INIT_TIMEOUT", "120")
+    ))
+    heartbeat = int(float(
+        os.environ.get("HOROVOD_ELASTIC_HEARTBEAT_S", "10")
+    ))
+    state.client = _jaxlib.get_distributed_runtime_client(
+        coord, pid, init_timeout=init_timeout, use_compression=True,
+        heartbeat_timeout=heartbeat,
+        shutdown_on_destruction=False, recoverable=True,
+    )
+    logger.info("elastic: connecting to coordination service %s", coord)
+    state.client.connect()
+    state.process_id = pid
+    state.num_processes = num
+    state.coordinator_address = coord
+
+
+def _jax_distributed_teardown() -> None:
+    """Drop this process out of the current world WITHOUT the graceful
+    shutdown-barrier RPC (the world may be half dead): release the client
+    (built with ``shutdown_on_destruction=False``) and, on the coordinator,
+    stop the service."""
+    from jax._src import distributed as _dist
+
+    state = _dist.global_state
+    if state.preemption_sync_manager is not None:
+        try:
+            state.preemption_sync_manager.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        state.preemption_sync_manager = None
+    state.client = None
+    if state.service is not None:
+        try:
+            state.service.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        state.service = None
+
+
+def _reset_jax_world() -> None:
+    """Tear down the JAX distributed client and backend caches so the next
+    ``hvd.init()`` can stand up a DIFFERENT world size in this process.
+    (Validated: surviving processes of an N-world re-form an M-world and
+    produce correct collectives after this reset.)"""
+    import jax
+
+    try:
+        _jax_distributed_teardown()
+    except Exception:  # noqa: BLE001 - not initialized / already gone
+        pass
+    try:
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._clear_backends()
+    except Exception as exc:  # noqa: BLE001 - jax internals moved
+        logger.warning("could not clear XLA backends: %s", exc)
+
+
+def _rejoin(ctx: _ElasticContext) -> None:
+    """Leave the current (broken or stale) world and join the next
+    generation: wait for the driver to publish gen > current with this
+    worker in it, then re-init. A worker dropped from the new world exits
+    cleanly (the driver also terminates it as a backstop)."""
+    import horovod_tpu as hvd
+
+    ctx.signal_rejoin()
+    try:
+        hvd.shutdown()
+    except Exception:  # noqa: BLE001 - already torn down
+        pass
+    _reset_jax_world()
+    deadline = time.monotonic() + ctx.timeout
+    while True:
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                "elastic: no usable world generation within "
+                f"{ctx.timeout}s (last known gen {ctx.gen})"
+            )
+        world = None
+        try:
+            world = ctx.fetch_world()
+        except Exception:  # noqa: BLE001 - driver briefly unreachable
+            pass
+        if not world or int(world["gen"]) <= ctx.gen:
+            time.sleep(0.2)
+            continue
+        if not ctx.apply(world):
+            # Scaled down past this worker: graceful departure.
+            logger.info(
+                "elastic: worker %s not in generation %s; exiting",
+                ctx.worker_id, world["gen"],
+            )
+            sys.exit(0)
+        try:
+            hvd.init()
+            ctx.gen = int(world["gen"])  # committed only on success
+            return
+        except Exception as exc:  # noqa: BLE001 - racing another bump
+            logger.warning(
+                "elastic: init at gen %s failed (%s); retrying",
+                world["gen"], exc,
+            )
+            try:
+                hvd.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            _reset_jax_world()
+            time.sleep(0.5)
+
+
+def _sync_root() -> int:
+    """Rank whose state is authoritative for the current generation: a
+    survivor of the previous world (published by the driver), so a fresh
+    respawn that happened to land on rank 0 can never broadcast its
+    just-constructed state over everyone's progress."""
+    ctx = _ctx()
+    return ctx.sync_root if ctx is not None else 0
+
+
+# ----------------------------------------------------------------- state
+class State:
+    """Base class for elastic state (upstream ``horovod.elastic.State``):
+    ``commit()`` snapshots + checks for membership changes,
+    ``restore()`` rolls back to the last commit, ``sync()`` aligns all
+    ranks to rank 0's state after a re-rendezvous."""
+
+    def __init__(self) -> None:
+        self._reset_callbacks: List[Callable[[], None]] = []
+
+    def register_reset_callbacks(
+        self, callbacks: List[Callable[[], None]]
+    ) -> None:
+        """Callbacks invoked after each world re-formation (learning-rate
+        rescale, dataset re-partition, ...)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise ``HostsUpdatedInterrupt`` on EVERY rank when any rank has
+        seen a newer world generation — agreement by allreduce so no rank
+        runs ahead into a collective its peers abandoned."""
+        ctx = _ctx()
+        if ctx is None:
+            return
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        flag = np.asarray([1 if ctx.poll_updated() else 0], np.int32)
+        if hvd.size() > 1:
+            flag = np.asarray(
+                hvd.allreduce(flag, op=hvd.Sum, name="hvd.elastic.hostcheck")
+            )
+        if int(flag[0]) > 0:
+            raise HostsUpdatedInterrupt(
+                "host membership changed; re-forming the world"
+            )
+
+    # subclass responsibilities
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """State over arbitrary picklable attributes
+    (``ObjectState(batch=0, epoch=0)``); sync ships rank 0's values with
+    the object-allgather wire."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._tracked = sorted(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        # Snapshot through the subclass's save() (JaxState needs its
+        # device_get host copies, not deepcopied device arrays): a
+        # rollback can happen before the first commit — e.g. a peer dies
+        # during the initial sync — and restore() must already hold
+        # backend-independent state.
+        self._saved: Dict[str, Any] = {}
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {
+            k: copy.deepcopy(getattr(self, k)) for k in self._tracked
+        }
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        import horovod_tpu as hvd
+
+        if hvd.size() > 1:
+            values = {k: getattr(self, k) for k in self._tracked}
+            synced = hvd.allgather_object(
+                values, name="hvd.elastic.objsync"
+            )[_sync_root()]
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """State whose attributes are JAX pytrees (params, opt_state, plus
+    plain counters). Array-leaf pytrees sync with fused tensor broadcasts
+    (``broadcast_variables``); everything else rides the object wire.
+    Saves are host-side snapshots (``jax.device_get``) so a rollback
+    survives device-state teardown across generations."""
+
+    def save(self) -> None:
+        import jax
+
+        self._saved = {
+            k: jax.device_get(getattr(self, k)) for k in self._tracked
+        }
+
+    def sync(self) -> None:
+        import jax
+
+        import horovod_tpu as hvd
+
+        if hvd.size() > 1:
+            arrays = {}
+            objects = {}
+            for k in self._tracked:
+                v = getattr(self, k)
+                leaves = jax.tree.leaves(v)
+                if leaves and all(hasattr(l, "shape") for l in leaves):
+                    arrays[k] = v
+                else:
+                    # Plain counters / mixed pytrees ride the object wire.
+                    objects[k] = v
+            root = _sync_root()
+            for k in sorted(arrays):
+                setattr(
+                    self, k,
+                    hvd.broadcast_variables(arrays[k], root_rank=root),
+                )
+            if objects:
+                synced = hvd.allgather_object(
+                    objects, name="hvd.elastic.objsync"
+                )[root]
+                for k, v in synced.items():
+                    setattr(self, k, v)
+        self.save()
+
+
+class TorchState(ObjectState):
+    """State over a torch model + optimizer (plus plain counters):
+    upstream ``horovod.torch.elastic.TorchState`` role. save/restore use
+    ``state_dict`` deep copies; sync broadcasts rank 0's parameters and
+    optimizer state with the existing torch binding."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs: Any) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        # ObjectState.__init__ takes the initial snapshot via save().
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        super().save()
+        if self.model is not None:
+            self._saved_model = copy.deepcopy(self.model.state_dict())
+        if self.optimizer is not None:
+            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+
+    def restore(self) -> None:
+        super().restore()
+        if self.model is not None:
+            self.model.load_state_dict(self._saved_model)
+        if self.optimizer is not None:
+            self.optimizer.load_state_dict(self._saved_opt)
+
+    def sync(self) -> None:
+        import horovod_tpu as hvd
+
+        if hvd.size() > 1:
+            import horovod_tpu.torch as hvd_torch
+
+            root = _sync_root()
+            if self.model is not None:
+                hvd_torch.broadcast_parameters(
+                    self.model.state_dict(), root_rank=root
+                )
+            if self.optimizer is not None:
+                hvd_torch.broadcast_optimizer_state(
+                    self.optimizer, root_rank=root
+                )
+        super().sync()
+
+
+class TensorFlowKerasState(ObjectState):
+    """State over a Keras model (plus plain counters): upstream
+    ``horovod.elastic.TensorFlowKerasState`` role. save/restore use
+    weight-array copies; sync broadcasts rank 0's weights (and the
+    optimizer's variables when it exposes any) with the numpy wire."""
+
+    def __init__(self, model, optimizer=None, **kwargs: Any) -> None:
+        self.model = model
+        self.optimizer = optimizer or getattr(model, "optimizer", None)
+        # ObjectState.__init__ takes the initial snapshot via save().
+        super().__init__(**kwargs)
+
+    @staticmethod
+    def _opt_vars(optimizer):
+        # Keras 3 exposes .variables; tf-keras 2 .weights.
+        for attr in ("variables", "weights"):
+            v = getattr(optimizer, attr, None)
+            if v:
+                return list(v)
+        return []
+
+    def save(self) -> None:
+        super().save()
+        import numpy as np
+
+        self._saved_weights = [
+            np.array(w) for w in self.model.get_weights()
+        ]
+        if self.optimizer is not None:
+            self._saved_opt_vars = [
+                np.array(v) for v in self._opt_vars(self.optimizer)
+            ]
+
+    def restore(self) -> None:
+        super().restore()
+        self.model.set_weights(self._saved_weights)
+        if self.optimizer is not None:
+            ovars = self._opt_vars(self.optimizer)
+            if len(ovars) == len(self._saved_opt_vars):
+                for var, val in zip(ovars, self._saved_opt_vars):
+                    var.assign(val)
+            else:
+                # Keras builds slot variables lazily; a snapshot taken
+                # before the first apply cannot restore them. The weights
+                # ARE rolled back — warn that momentum/iteration state is
+                # not, instead of silently half-restoring.
+                logger.warning(
+                    "elastic: optimizer variable count changed since the "
+                    "last snapshot (%d saved vs %d now); optimizer state "
+                    "was NOT rolled back — commit() after the first "
+                    "optimizer step to make it restorable",
+                    len(self._saved_opt_vars), len(ovars),
+                )
+
+    def sync(self) -> None:
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        if hvd.size() > 1:
+            root = _sync_root()
+            synced = hvd.broadcast_variables(
+                [np.asarray(w) for w in self.model.get_weights()],
+                root_rank=root,
+            )
+            self.model.set_weights([np.asarray(w) for w in synced])
+            if self.optimizer is not None:
+                ovars = self._opt_vars(self.optimizer)
+                if ovars:
+                    vals = hvd.broadcast_variables(
+                        [np.asarray(v) for v in ovars], root_rank=root
+                    )
+                    for var, val in zip(ovars, vals):
+                        var.assign(np.asarray(val))
+        super().sync()
+
+
+# ------------------------------------------------------------------- run
+def run(func: Callable) -> Callable:
+    """Decorator making ``func(state, *args)`` elastic (upstream
+    ``hvd.elastic.run``). On ``HorovodInternalError`` (peer failure) the
+    state rolls back to the last commit; on ``HostsUpdatedInterrupt``
+    (graceful membership change) it is kept. Either way the worker
+    re-rendezvouses with the next world generation, re-syncs from the new
+    rank 0, fires reset callbacks, and re-enters ``func``.
+
+    Outside an elastic launch (no ``--host-discovery-script``/``--min-np``)
+    the wrapper is a plain call."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args: Any, **kwargs: Any) -> Any:
+        import horovod_tpu as hvd
+
+        ctx = _ctx()
+        if ctx is None:
+            return func(state, *args, **kwargs)
+        while True:
+            try:
+                state.sync()
+                # From here this worker holds live state: eligible as a
+                # future generation's sync source.
+                ctx.confirm_joined()
+                return func(state, *args, **kwargs)
+            except hvd.HorovodInternalError as exc:
+                logger.warning(
+                    "elastic: collective failure (%s); rolling back to the "
+                    "last commit and rejoining", exc,
+                )
+                state.restore()
+            except HostsUpdatedInterrupt:
+                logger.info(
+                    "elastic: membership change; rejoining with current "
+                    "state"
+                )
+            _rejoin(ctx)
+            state.on_reset()
+
+    return wrapper

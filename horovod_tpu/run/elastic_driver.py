@@ -1,0 +1,452 @@
+"""Elastic job driver: discovery, generations, worker supervision.
+
+Later-reference parity (upstream ``horovod/runner/elastic/driver.py`` +
+``discovery.py``, added in v0.20 — absent from the v0.18.2 reference):
+``hvdrun --min-np/--max-np/--host-discovery-script`` supervises an elastic
+job instead of the fixed fan-out in ``launcher.launch_job``.
+
+Mechanics (TPU-native, see ``horovod_tpu/elastic``):
+
+- The driver owns the HTTP KV rendezvous store. Each world *generation* —
+  membership, rank assignments, and fresh controller/JAX-coordinator
+  endpoints — is published under ``elastic/world``; workers poll it and
+  re-rendezvous in process.
+- A host-discovery script (prints ``host:slots`` lines, upstream
+  ``--host-discovery-script`` contract) is polled every
+  ``discovery_interval`` seconds; membership changes bump the generation.
+- A worker process that dies bumps the generation too; its host accrues a
+  failure count and is blacklisted at ``host_failure_threshold`` (upstream
+  blacklist role), otherwise the slot is re-spawned fresh.
+- The job fails when fewer than ``min_np`` slots remain; it caps at
+  ``max_np`` even when discovery offers more.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import launcher, safe_shell_exec
+from .http_server import KVStoreServer
+from .launcher import SlotInfo, _free_port, _is_local
+
+
+@dataclass
+class _Worker:
+    worker_id: str
+    host: str
+    proc: safe_shell_exec.ManagedProcess
+    outfiles: Tuple
+    done: bool = False
+
+
+def _run_discovery_script(script: str) -> List[Tuple[str, int]]:
+    """Run the host-discovery script; parse ``host`` / ``host:slots``
+    lines (the upstream contract)."""
+    import subprocess
+
+    out = subprocess.run(
+        [script], capture_output=True, text=True, timeout=60, check=True
+    ).stdout
+    hosts: List[Tuple[str, int]] = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if ":" in line:
+            name, slots = line.rsplit(":", 1)
+            hosts.append((name, int(slots)))
+        else:
+            hosts.append((line, 1))
+    return hosts
+
+
+class ElasticDriver:
+    def __init__(
+        self,
+        command: List[str],
+        min_np: int,
+        max_np: int,
+        hosts: Optional[List[Tuple[str, int]]] = None,
+        discovery_script: Optional[str] = None,
+        discovery_interval: float = 1.0,
+        env: Optional[Dict[str, str]] = None,
+        output_dir: Optional[str] = None,
+        verbose: bool = False,
+        host_failure_threshold: int = 3,
+        ssh_port: Optional[int] = None,
+        elastic_timeout: float = 600.0,
+    ) -> None:
+        if not hosts and not discovery_script:
+            raise ValueError(
+                "elastic mode needs -H/--hostfile or --host-discovery-script"
+            )
+        self._command = command
+        self._min_np = min_np
+        self._max_np = max_np
+        self._static_hosts = hosts
+        self._script = discovery_script
+        self._interval = discovery_interval
+        self._env = dict(env if env is not None else os.environ)
+        self._output_dir = output_dir
+        self._verbose = verbose
+        self._failure_threshold = host_failure_threshold
+        self._ssh_port = ssh_port
+        self._elastic_timeout = elastic_timeout
+
+        self._kv = KVStoreServer()
+        self._services: List[object] = []  # per-gen jax coordination svcs
+        self._last_hosts: List[Tuple[str, int]] = list(hosts or [])
+        self._stop_discovery = threading.Event()
+        self._gen = 0
+        self._workers: Dict[str, _Worker] = {}
+        # Workers dropped from the world, draining toward a voluntary
+        # exit (they see the new generation and leave cleanly); value is
+        # the terminate-anyway deadline.
+        self._removing: List[Tuple[_Worker, float]] = []
+        self._removal_grace = 15.0
+        self._current_ids: List[str] = []
+        self._failures: Dict[str, int] = {}
+        self._blacklist: set = set()
+        self._finishing = False
+
+    # ------------------------------------------------------------ pieces
+    def _log(self, msg: str) -> None:
+        print(f"[hvdrun elastic] {msg}", file=sys.stderr, flush=True)
+
+    def _discovery_loop(self) -> None:
+        """Background discovery poller (upstream ElasticDriver runs its
+        HostDiscovery on a thread for the same reason): a slow or hung
+        discovery script must not stall worker reaping, drain-grace
+        enforcement, or generation publishing. The supervision loop only
+        ever reads the latest snapshot."""
+        while not self._stop_discovery.is_set():
+            try:
+                self._last_hosts = _run_discovery_script(self._script)
+            except Exception as exc:  # noqa: BLE001 - transient failure
+                # A flaky discovery script must not take down a healthy
+                # job: keep the last known host set and retry next poll.
+                self._log(
+                    f"host discovery failed ({exc}); keeping last known "
+                    f"host set"
+                )
+            self._stop_discovery.wait(self._interval)
+
+    def _discover(self) -> List[Tuple[str, int]]:
+        hosts = (
+            self._last_hosts if self._script
+            else list(self._static_hosts or [])
+        )
+        return [(h, c) for h, c in hosts if h not in self._blacklist]
+
+    def _desired_slots(self) -> Optional[List[SlotInfo]]:
+        """Allocation over currently-available, non-blacklisted hosts;
+        None when below min_np."""
+        hosts = self._discover()
+        total = sum(c for _, c in hosts)
+        if total < self._min_np:
+            return None
+        return launcher.allocate(hosts, min(total, self._max_np))
+
+    @staticmethod
+    def _worker_id(slot: SlotInfo) -> str:
+        return f"{slot.hostname}:{slot.local_rank}"
+
+    def _start_coordination_service(
+        self, num_processes: int, all_local: bool
+    ) -> str:
+        """Host this generation's JAX coordination service IN THE DRIVER
+        (the reference's elastic driver owns the rendezvous the same way):
+        no worker is special, so any worker — including generation rank 0
+        — can die without collapsing the coordination plane. Old services
+        are kept alive until driver exit; they are one idle gRPC server
+        each, and answering stale heartbeats from stragglers of an
+        abandoned generation is exactly what prevents their fatal
+        connection-refused aborts."""
+        from jax._src.lib import _jax as _jaxlib
+
+        port = _free_port()
+        heartbeat = int(float(self._env.get(
+            "HOROVOD_ELASTIC_HEARTBEAT_S", "10"
+        )))
+        svc = _jaxlib.get_distributed_runtime_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_timeout=heartbeat, shutdown_timeout=5,
+        )
+        self._services.append(svc)
+        addr = "127.0.0.1" if all_local else socket.gethostname()
+        return f"{addr}:{port}"
+
+    def _publish(self, slots: List[SlotInfo]) -> Dict[str, str]:
+        """Publish the next generation; returns env additions for spawns."""
+        self._gen += 1
+        controller_addr = (
+            "127.0.0.1" if _is_local(slots[0].hostname) else slots[0].hostname
+        )
+        controller_port = _free_port()
+        jax_coordinator = self._start_coordination_service(
+            len(slots), all(_is_local(s.hostname) for s in slots)
+        )
+        # Sync source for the new generation: a surviving worker that has
+        # CONFIRMED completing a state sync (it holds live training
+        # state) — never a fresh respawn, whose just-constructed state
+        # would otherwise overwrite every survivor when it happened to
+        # land on rank 0, and not even a running worker that crashed out
+        # of its first generation before ever syncing. Fallback order:
+        # confirmed survivor, then any running worker, then rank 0.
+        joined = self._kv.snapshot("elastic")
+        confirmed = {
+            wid for wid in self._workers
+            if f"joined.{wid}" in joined
+        }
+        sync_root = 0
+        for pool in (confirmed, self._workers):
+            chosen = next(
+                (s.rank for s in slots if self._worker_id(s) in pool), None
+            )
+            if chosen is not None:
+                sync_root = chosen
+                break
+        world = {
+            "gen": self._gen,
+            "size": len(slots),
+            "sync_root": sync_root,
+            "controller_addr": controller_addr,
+            "controller_port": controller_port,
+            "jax_coordinator": jax_coordinator,
+            "assignments": {
+                self._worker_id(s): {
+                    "rank": s.rank,
+                    "local_rank": s.local_rank,
+                    "local_size": s.local_size,
+                    "cross_rank": s.cross_rank,
+                    "cross_size": s.cross_size,
+                }
+                for s in slots
+            },
+        }
+        self._kv.put("elastic", "world", json.dumps(world).encode())
+        self._log(
+            f"generation {self._gen}: size {len(slots)} over "
+            f"{sorted({s.hostname for s in slots})}"
+        )
+        return {
+            "controller_addr": controller_addr,
+            "controller_port": str(controller_port),
+            "jax_coordinator": jax_coordinator,
+            "sync_root": str(sync_root),
+        }
+
+    def _spawn(self, slot: SlotInfo, endpoints: Dict[str, str]) -> None:
+        wid = self._worker_id(slot)
+        rank_env = launcher.build_rank_env(
+            slot,
+            self._env,
+            endpoints["controller_addr"],
+            int(endpoints["controller_port"]),
+            endpoints["jax_coordinator"],
+        )
+        # The KV rendezvous lives in THIS driver process, not on rank 0's
+        # host — remote workers dial the driver's hostname.
+        kv_addr = (
+            "127.0.0.1" if _is_local(slot.hostname)
+            else socket.gethostname()
+        )
+        rank_env.update(
+            {
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_ELASTIC_WORKER_ID": wid,
+                "HOROVOD_ELASTIC_GEN": str(self._gen),
+                "HOROVOD_ELASTIC_SYNC_ROOT": endpoints["sync_root"],
+                "HOROVOD_ELASTIC_KV_ADDR": kv_addr,
+                "HOROVOD_ELASTIC_KV_PORT": str(self._kv.port),
+                "HOROVOD_ELASTIC_TIMEOUT": str(self._elastic_timeout),
+            }
+        )
+        if _is_local(slot.hostname):
+            cmd = self._command
+        else:
+            cmd = launcher.build_remote_command(
+                slot.hostname, rank_env, self._command, self._ssh_port
+            )
+        stdout = stderr = None
+        outfiles: Tuple = ()
+        if self._output_dir:
+            os.makedirs(self._output_dir, exist_ok=True)
+            stdout = open(
+                os.path.join(self._output_dir, f"worker.{wid}.out"), "ab"
+            )
+            stderr = open(
+                os.path.join(self._output_dir, f"worker.{wid}.err"), "ab"
+            )
+            outfiles = (stdout, stderr)
+        if self._verbose:
+            self._log(f"spawn {wid} rank {slot.rank}: {cmd}")
+        self._workers[wid] = _Worker(
+            wid,
+            slot.hostname,
+            safe_shell_exec.ManagedProcess(
+                cmd, env=rank_env, stdout=stdout, stderr=stderr
+            ),
+            outfiles,
+        )
+
+    def _reconcile(self, force: bool = False) -> bool:
+        """Re-form the world when the desired membership differs from the
+        running one — or unconditionally with ``force`` (surviving
+        workers abandoned the current generation and need a fresh one
+        even though membership is unchanged). Returns False when the job
+        must fail (below min_np)."""
+        slots = self._desired_slots()
+        if slots is None:
+            self._log(
+                f"available slots fell below --min-np {self._min_np}; "
+                "aborting"
+            )
+            return False
+        desired = {self._worker_id(s): s for s in slots}
+        desired_ids = [self._worker_id(s) for s in slots]
+        if desired_ids == self._current_ids and not force:
+            return True
+        # A slot whose previous process is still draining must not be
+        # re-assigned yet: two live processes claiming the same worker id
+        # would both join the new generation as the same rank. Defer the
+        # re-formation until the drain completes (exit or grace kill).
+        draining = {w.worker_id for w, _ in self._removing}
+        if draining & set(desired_ids):
+            return True
+        endpoints = self._publish(slots)
+        # Dropped workers drain gracefully: they poll the KV store, see
+        # they are not in the new generation, and exit 0 on their own —
+        # SIGTERMing them here would break survivors' in-flight
+        # collectives and force a needless rollback. Terminate only after
+        # the grace window.
+        for wid in list(self._workers):
+            if wid not in desired:
+                w = self._workers.pop(wid)
+                self._removing.append(
+                    (w, time.monotonic() + self._removal_grace)
+                )
+                self._log(f"removed {wid} (draining)")
+        for wid, slot in desired.items():
+            if wid not in self._workers:
+                self._spawn(slot, endpoints)
+        self._current_ids = desired_ids
+        return True
+
+    # -------------------------------------------------------------- loop
+    def run(self) -> int:
+        self._kv.start()
+        if self._script:
+            # Seed synchronously (the first allocation needs hosts when
+            # the script is the sole source), then poll on a thread.
+            try:
+                self._last_hosts = _run_discovery_script(self._script)
+            except Exception as exc:  # noqa: BLE001
+                self._log(f"initial host discovery failed: {exc}")
+            threading.Thread(
+                target=self._discovery_loop,
+                name="hvd_elastic_discovery", daemon=True,
+            ).start()
+        try:
+            return self._run()
+        finally:
+            self._stop_discovery.set()
+            for w in list(self._workers.values()) + [
+                w for w, _ in self._removing
+            ]:
+                if w.proc.poll() is None:
+                    w.proc.terminate()
+                for f in w.outfiles:
+                    f.close()
+            for svc in self._services:
+                try:
+                    svc.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._kv.stop()
+
+    def _run(self) -> int:
+        if not self._reconcile():
+            return 1
+        last_discovery = time.monotonic()
+        while True:
+            time.sleep(0.1)
+            changed = False
+            # Reap draining removed workers (exit code irrelevant);
+            # terminate stragglers past the grace window.
+            still_removing = []
+            for w, deadline in self._removing:
+                rc = w.proc.poll()
+                if rc is not None:
+                    for f in w.outfiles:
+                        f.close()
+                    continue
+                if time.monotonic() > deadline:
+                    w.proc.terminate()
+                    for f in w.outfiles:
+                        f.close()
+                    continue
+                still_removing.append((w, deadline))
+            self._removing = still_removing
+            for wid in list(self._workers):
+                w = self._workers[wid]
+                rc = w.proc.poll()
+                if rc is None or w.done:
+                    continue
+                if rc == 0:
+                    w.done = True
+                    # A clean exit means the training function returned —
+                    # the job is completing; stop re-forming the world.
+                    self._finishing = True
+                    self._log(f"{wid} finished")
+                else:
+                    self._failures[w.host] = self._failures.get(w.host, 0) + 1
+                    self._log(
+                        f"{wid} failed with exit code {rc} "
+                        f"(host failures: {self._failures[w.host]})"
+                    )
+                    if self._finishing:
+                        # A straggler crashing while the job winds down is
+                        # a real failure — there is no world left to
+                        # re-form it into.
+                        return 1
+                    if self._failures[w.host] >= self._failure_threshold:
+                        self._blacklist.add(w.host)
+                        self._log(f"blacklisted host {w.host}")
+                    del self._workers[wid]
+                    for f in w.outfiles:
+                        f.close()
+                    self._current_ids = [
+                        i for i in self._current_ids if i != wid
+                    ]
+                    changed = True
+            if self._finishing:
+                if all(w.done for w in self._workers.values()):
+                    return 0
+                continue
+            now = time.monotonic()
+            if self._script and now - last_discovery >= self._interval:
+                last_discovery = now
+                changed = True  # _reconcile no-ops when membership matches
+            # Worker-initiated rejoin: a surviving worker abandoned the
+            # CURRENT generation (rollback without any process dying —
+            # stall shutdown, transient control-plane error). Bump the
+            # generation even though membership is unchanged; signals for
+            # older generations are stale.
+            force = any(
+                k.startswith("rejoin.") and v.decode() == str(self._gen)
+                for k, v in self._kv.snapshot("elastic").items()
+            )
+            if force:
+                self._log(
+                    f"worker abandoned generation {self._gen}; re-forming"
+                )
+            if (changed or force) and not self._reconcile(force=force):
+                return 1

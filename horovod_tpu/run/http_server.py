@@ -79,6 +79,23 @@ class KVStoreServer:
             self._thread.join(timeout=5)
         self._server.server_close()
 
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        """In-process store (no HTTP round-trip) under the same lock the
+        handler uses — for the owning driver's own writes."""
+        with self._server.kv_lock:
+            self._server.kv[f"/{scope}/{key}"] = value
+
+    def snapshot(self, scope: str) -> Dict[str, bytes]:
+        """In-process read of every key under a scope (driver-side scan
+        of worker-written signals)."""
+        prefix = f"/{scope}/"
+        with self._server.kv_lock:
+            return {
+                k[len(prefix):]: v
+                for k, v in self._server.kv.items()
+                if k.startswith(prefix)
+            }
+
 
 class KVStoreClient:
     """Plain-TCP HTTP KV client built on ``http.client.HTTPConnection``.

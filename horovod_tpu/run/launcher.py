@@ -196,6 +196,28 @@ def check_hosts_reachable(hostnames, ssh_port=None, timeout=8.0,
         )
 
 
+def build_remote_command(
+    host: str,
+    rank_env: Dict[str, str],
+    command: List[str],
+    ssh_port: Optional[int] = None,
+) -> List[str]:
+    """The one ssh fan-out command builder (reference get_remote_command):
+    env must be inlined since ssh doesn't forward it. Shared by the fixed
+    launcher and the elastic driver so the env-prefix filter cannot
+    silently diverge between them."""
+    env_str = " ".join(
+        f"{k}={_shquote(v)}"
+        for k, v in rank_env.items()
+        if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH",
+                         "PYTHONPATH", "LD_LIBRARY"))
+    )
+    return ssh_base_cmd(host, ssh_port) + [
+        f"cd {_shquote(os.getcwd())} > /dev/null 2>&1 ; "
+        f"{env_str} " + " ".join(_shquote(c) for c in command),
+    ]
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("", 0))
@@ -279,18 +301,9 @@ def launch_job(
         if _is_local(slot.hostname):
             cmd = command
         else:
-            # ssh fan-out (reference get_remote_command): env must be
-            # inlined since ssh doesn't forward it.
-            env_str = " ".join(
-                f"{k}={_shquote(v)}"
-                for k, v in rank_env.items()
-                if k.startswith(("HOROVOD_", "JAX_", "XLA_", "PATH",
-                                 "PYTHONPATH", "LD_LIBRARY"))
+            cmd = build_remote_command(
+                slot.hostname, rank_env, command, ssh_port
             )
-            cmd = ssh_base_cmd(slot.hostname, ssh_port) + [
-                f"cd {_shquote(os.getcwd())} > /dev/null 2>&1 ; "
-                f"{env_str} {' '.join(_shquote(c) for c in command)}",
-            ]
         stdout = stderr = None
         if output_dir:
             os.makedirs(output_dir, exist_ok=True)

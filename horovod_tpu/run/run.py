@@ -76,6 +76,26 @@ def parse_args(argv: Optional[List[str]] = None):
                         default=None)
     parser.add_argument("--log-level", default=None,
                         choices=["trace", "debug", "info", "warning", "error"])
+    # elastic mode (later-reference horovodrun elastic flags)
+    parser.add_argument("--min-np", type=int, default=None,
+                        help="Elastic: minimum processes to keep running "
+                             "(job fails below this).")
+    parser.add_argument("--max-np", type=int, default=None,
+                        help="Elastic: cap on processes even when discovery "
+                             "offers more slots.")
+    parser.add_argument("--host-discovery-script", default=None,
+                        help="Elastic: executable printing one "
+                             '"host:slots" line per available host; polled '
+                             "for membership changes.")
+    parser.add_argument("--elastic-discovery-interval", type=float,
+                        default=1.0,
+                        help="Elastic: seconds between discovery polls.")
+    parser.add_argument("--blacklist-threshold", type=int, default=3,
+                        help="Elastic: worker failures before a host is "
+                             "blacklisted.")
+    parser.add_argument("--elastic-timeout", type=float, default=600.0,
+                        help="Elastic: seconds a worker waits for a usable "
+                             "world generation before giving up.")
     parser.add_argument("--network-interfaces", default=None,
                         help="Comma-separated NICs to use for the control "
                              "plane; skips the automatic ring probe.")
@@ -150,6 +170,48 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         print("hvdrun: no training command given", file=sys.stderr)
         return 2
 
+    # Runtime-knob env assembly shared by the elastic and fixed paths
+    # (--disable-cache, YAML/CLI knobs, explicit NIC pin; the fixed path
+    # additionally ring-probes NICs below when none is pinned).
+    if args.disable_cache:
+        args.cache_capacity = 0
+    env = dict(os.environ)
+    config_parser.set_env_from_args(env, args)
+    if args.network_interfaces:
+        env["HOROVOD_IFACE"] = args.network_interfaces
+
+    # Elastic mode: any elastic flag routes supervision to ElasticDriver
+    # (generation-based re-rendezvous) instead of the fixed fan-out.
+    if args.host_discovery_script or args.min_np or args.max_np:
+        if args.hostfile:
+            hosts = launcher.parse_hostfile(args.hostfile)
+        elif args.hosts:
+            hosts = launcher.parse_hosts(args.hosts)
+        elif args.host_discovery_script:
+            hosts = None  # discovery script is the sole source
+        elif args.num_proc:
+            hosts = [("localhost", args.num_proc)]
+        else:
+            print("hvdrun: elastic mode needs -np, -H/--hostfile, or "
+                  "--host-discovery-script", file=sys.stderr)
+            return 2
+        from .elastic_driver import ElasticDriver
+
+        return ElasticDriver(
+            command,
+            min_np=args.min_np or args.num_proc or 1,
+            max_np=args.max_np or args.num_proc or (1 << 30),
+            hosts=hosts,
+            discovery_script=args.host_discovery_script,
+            discovery_interval=args.elastic_discovery_interval,
+            env=env,
+            output_dir=args.output_dir,
+            verbose=args.verbose,
+            host_failure_threshold=args.blacklist_threshold,
+            ssh_port=args.ssh_port,
+            elastic_timeout=args.elastic_timeout,
+        ).run()
+
     if args.tpu_pod:
         slots = launcher.tpu_pod_allocation()
         if slots is None:
@@ -167,9 +229,6 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         else:
             hosts = [("localhost", args.num_proc)]
         slots = launcher.allocate(hosts, args.num_proc)
-
-    if args.disable_cache:
-        args.cache_capacity = 0
 
     # SSH pre-flight (reference run/run.py:62-115): fail fast with a
     # per-host message when a remote host is unreachable, instead of a
@@ -190,16 +249,12 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             print(str(e), file=sys.stderr)
             return 4
 
-    env = dict(os.environ)
-    config_parser.set_env_from_args(env, args)
-
     # NIC selection for the multi-host control plane (reference
-    # run/run.py:198-268 driver/task ring probe): explicit flag wins; with
-    # multiple distinct remote hosts we probe ring-wise over the
-    # HMAC-authed services and export the routable intersection.
-    if args.network_interfaces:
-        env["HOROVOD_IFACE"] = args.network_interfaces
-    elif not args.tpu_pod:
+    # run/run.py:198-268 driver/task ring probe): explicit flag wins
+    # (already exported above); with multiple distinct remote hosts we
+    # probe ring-wise over the HMAC-authed services and export the
+    # routable intersection.
+    if not args.network_interfaces and not args.tpu_pod:
         # TPU pods know their topology from slice metadata and have no
         # inter-worker ssh; the ring probe is only for the generic path.
         hostnames = sorted({s.hostname for s in slots})

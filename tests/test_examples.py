@@ -147,3 +147,31 @@ def test_jax_tp_pp_demo():
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "DEMO DONE" in proc.stdout
     assert "heterogeneous LM" in proc.stdout
+
+
+def test_jax_elastic_train():
+    """The elastic example completes under the elastic driver at a fixed
+    size of 2 and converges (later-reference elastic example role)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    with tempfile.TemporaryDirectory() as td:
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+             "--min-np", "2", "--max-np", "2", "--output-dir", td,
+             sys.executable,
+             os.path.join(REPO, "examples", "jax_elastic_train.py")],
+            env=env, cwd=td, capture_output=True, timeout=420, text=True,
+        )
+        out = ""
+        for fn in os.listdir(td):
+            if fn.startswith("worker.") and fn.endswith(".out"):
+                out += open(os.path.join(td, fn)).read()
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, out)
+    assert "done: 200 steps on 2 ranks" in out, out
+    err = float(out.split("|w - w*| = ")[1].split()[0])
+    assert err < 0.05, out

@@ -31,6 +31,10 @@ QUEUE = [
                         "--no-scan"]),
     ("lm_b8_s1024", ["--model", "transformer", "--batch-size", "8"]),
     ("lm_b16_s1024", ["--model", "transformer", "--batch-size", "16"]),
+    ("lm_b8_quantized", ["--model", "transformer", "--batch-size", "8",
+                         "--quantized"]),
+    ("lm_b8_zero1_quant", ["--model", "transformer", "--batch-size", "8",
+                           "--zero1", "--quantized"]),
     ("micro_r18_b32", ["--model", "resnet18", "--batch-size", "32",
                        "--micro"]),
     ("moe_b8", ["--model", "moe", "--batch-size", "8"]),
