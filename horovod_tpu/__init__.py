@@ -602,9 +602,71 @@ def alltoall_async(tensor: Any, name: Optional[str] = None,
     )
 
 
-def alltoall(tensor: Any, name: Optional[str] = None,
+def alltoall(tensor: Any, splits: Any = None, name: Optional[str] = None,
              process_set: Optional[ProcessSet] = None) -> Any:
-    return synchronize(alltoall_async(tensor, name, process_set))
+    """All-to-all scatter of dim0 blocks. Without ``splits``, dim0 must
+    divide evenly by the set size and rank r receives block r from every
+    rank. With ``splits`` (length ``size``, summing to dim0 — the later
+    reference's alltoallv API, ``horovod.alltoall(tensor, splits)``),
+    rank d receives the ``splits[d]``-row segment from every rank and the
+    call returns ``(collected, received_splits)``.
+
+    Uneven mechanics (MPI alltoallv re-expressed on the even TPU
+    collective): a tiny allgather shares every rank's splits vector, each
+    per-destination segment pads to the global max block, one even
+    ``lax.all_to_all`` moves the blocks, and the pads are sliced off —
+    two collectives total, the same count-exchange + v-call shape MPI
+    implementations use."""
+    if splits is None:
+        return synchronize(alltoall_async(tensor, name, process_set))
+    import numpy as np
+
+    name = _auto_name("alltoall", name)
+    rt = _rt()
+    if process_set is not None and process_set.ranks is not None:
+        n = process_set.size()
+        me = process_set.rank()
+    else:
+        n = rt.topology.size
+        me = rt.topology.rank
+    splits = np.asarray(splits, np.int32).reshape(-1)
+    local = np.asarray(tensor)
+    if splits.shape[0] != n:
+        raise ValueError(
+            f"splits must have one entry per rank ({n}), got "
+            f"{splits.shape[0]}"
+        )
+    if (splits < 0).any():
+        raise ValueError(f"splits must be non-negative, got {splits.tolist()}")
+    if int(splits.sum()) != int(local.shape[0]):
+        raise ValueError(
+            f"splits sum ({int(splits.sum())}) must equal dim0 "
+            f"({int(local.shape[0])})"
+        )
+    # Count exchange: matrix[src, dst] = rows src sends to dst.
+    matrix = np.asarray(
+        allgather(splits, name=f"{name}.splits", process_set=process_set)
+    ).reshape(n, n)
+    received_splits = matrix[:, me].copy()
+    max_block = int(matrix.max())
+    if max_block == 0:
+        empty = local[:0]
+        return empty, received_splits
+    rest = local.shape[1:]
+    padded = np.zeros((n * max_block,) + rest, local.dtype)
+    offs = np.concatenate([[0], np.cumsum(splits)[:-1]])
+    for d in range(n):
+        padded[d * max_block: d * max_block + splits[d]] = (
+            local[offs[d]: offs[d] + splits[d]]
+        )
+    out = np.asarray(
+        synchronize(alltoall_async(padded, name, process_set))
+    )
+    collected = np.concatenate([
+        out[s * max_block: s * max_block + received_splits[s]]
+        for s in range(n)
+    ]) if received_splits.sum() else out[:0]
+    return collected, received_splits
 
 
 def reducescatter_async(
@@ -759,6 +821,34 @@ def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
     return _rt().synchronize(handle, timeout)
 
 
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object from root (later-reference
+    API): a size broadcast then a uint8 payload broadcast — O(payload)
+    per rank, unlike an object allgather's O(size × payload)."""
+    import pickle
+
+    import numpy as np
+
+    name = name or _auto_name("bcast_obj", None)
+    # root_rank is a GLOBAL rank (same convention as broadcast, which
+    # maps it to the member position on a process set).
+    if rank() == root_rank:
+        data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+    else:
+        data = np.zeros((0,), np.uint8)
+    sz = np.asarray([data.shape[0]], np.int64)
+    sz = np.asarray(broadcast(sz, root_rank, name=f"{name}.size",
+                              process_set=process_set))
+    payload = (data if data.shape[0] == int(sz[0])
+               else np.zeros(int(sz[0]), np.uint8))
+    payload = np.asarray(broadcast(payload, root_rank,
+                                   name=f"{name}.data",
+                                   process_set=process_set))
+    return pickle.loads(payload.tobytes())
+
+
 def broadcast_variables(variables: Any, root_rank: int = 0) -> Any:
     """Broadcast a pytree of arrays from root (reference
     ``broadcast_variables`` / ``broadcast_parameters``). All leaves are
@@ -799,6 +889,7 @@ __all__ = [
     "grouped_allreduce",
     "grouped_allreduce_async",
     "allgather_object",
+    "broadcast_object",
     "ProcessSet",
     "global_process_set",
     "add_process_set",

@@ -405,9 +405,10 @@ class ObjectState(State):
 
         if hvd.size() > 1:
             values = {k: getattr(self, k) for k in self._tracked}
-            synced = hvd.allgather_object(
-                values, name="hvd.elastic.objsync"
-            )[_sync_root()]
+            synced = hvd.broadcast_object(
+                values, root_rank=_sync_root(),
+                name="hvd.elastic.objsync",
+            )
             for k, v in synced.items():
                 setattr(self, k, v)
         self.save()
@@ -450,9 +451,9 @@ class JaxState(ObjectState):
                     hvd.broadcast_variables(arrays[k], root_rank=root),
                 )
             if objects:
-                synced = hvd.allgather_object(
-                    objects, name="hvd.elastic.objsync"
-                )[root]
+                synced = hvd.broadcast_object(
+                    objects, root_rank=root, name="hvd.elastic.objsync"
+                )
                 for k, v in synced.items():
                     setattr(self, k, v)
         self.save()

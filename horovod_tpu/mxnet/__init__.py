@@ -101,24 +101,8 @@ else:  # pragma: no cover - exercised only where mxnet exists
                         )
 
     def broadcast_object(obj, root_rank=0, name=None):
-        """Pickle-based object broadcast using this module's own numpy
-        path (no torch dependency): length first, then the padded
-        uint8 payload."""
-        import pickle
+        """Object broadcast — delegates to the one core implementation
+        (size broadcast + uint8 payload broadcast)."""
+        from .. import broadcast_object as _bcast_obj
 
-        from .. import rank as _rank
-
-        name = name or "broadcast_object"
-        payload = pickle.dumps(obj) if _rank() == root_rank else b""
-        n = _broadcast_np(
-            _np.array([len(payload)], dtype=_np.int64), root_rank,
-            name=f"{name}.len",
-        )
-        n = int(_np.asarray(n)[0])
-        buf = _np.zeros(n, dtype=_np.uint8)
-        if _rank() == root_rank:
-            buf[:] = _np.frombuffer(payload, dtype=_np.uint8)
-        out = _np.asarray(
-            _broadcast_np(buf, root_rank, name=f"{name}.data")
-        )
-        return pickle.loads(out.tobytes())
+        return _bcast_obj(obj, root_rank=root_rank, name=name)

@@ -288,6 +288,12 @@ class ElasticDriver:
             outfiles = (stdout, stderr)
         if self._verbose:
             self._log(f"spawn {wid} rank {slot.rank}: {cmd}")
+        # A fresh incarnation must earn its own joined-confirmation: a
+        # stale key from a crashed predecessor under the same worker id
+        # would otherwise mark this never-synced respawn as a valid
+        # sync_root.
+        self._kv.delete("elastic", f"joined.{wid}")
+        self._kv.delete("elastic", f"rejoin.{wid}")
         self._workers[wid] = _Worker(
             wid,
             slot.hostname,

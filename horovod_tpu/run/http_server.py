@@ -85,6 +85,11 @@ class KVStoreServer:
         with self._server.kv_lock:
             self._server.kv[f"/{scope}/{key}"] = value
 
+    def delete(self, scope: str, key: str) -> None:
+        """In-process delete (driver-side retraction of worker signals)."""
+        with self._server.kv_lock:
+            self._server.kv.pop(f"/{scope}/{key}", None)
+
     def snapshot(self, scope: str) -> Dict[str, bytes]:
         """In-process read of every key under a scope (driver-side scan
         of worker-written signals)."""

@@ -423,23 +423,9 @@ def allgather_object(obj, name: Optional[str] = None) -> list:
 
 
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
-    """Broadcast an arbitrary picklable object (later-reference API,
-    included for completeness)."""
-    import io
-    import pickle
+    """Broadcast an arbitrary picklable object (later-reference API) —
+    delegates to the one core implementation (size broadcast + uint8
+    payload broadcast); objects never touch torch tensors."""
+    import horovod_tpu as _hvd
 
-    import numpy as np
-    import torch
-
-    if rank() == root_rank:
-        data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-        sz = torch.tensor([len(data)], dtype=torch.int64)
-    else:
-        sz = torch.tensor([0], dtype=torch.int64)
-    broadcast_(sz, root_rank, name=f"{name or 'bcast_obj'}.size")
-    if rank() == root_rank:
-        payload = torch.from_numpy(data)
-    else:
-        payload = torch.zeros(int(sz.item()), dtype=torch.uint8)
-    broadcast_(payload, root_rank, name=f"{name or 'bcast_obj'}.data")
-    return pickle.loads(payload.numpy().tobytes())
+    return _hvd.broadcast_object(obj, root_rank=root_rank, name=name)

@@ -297,11 +297,27 @@ def test_alltoall_two_ranks():
         x = jnp.asarray(np.arange(r * 2, r * 2 + 2, dtype=np.float32))
         out = hvd.alltoall(x.reshape(2, 1))
         print("A2A", np.asarray(out).reshape(-1).tolist())
+        # Uneven splits (later-reference alltoallv API): rank 0 sends
+        # [10] to itself and [11, 12] to rank 1; rank 1 sends [20, 21, 22]
+        # to rank 0 and nothing to itself.
+        data = [np.asarray([10.0, 11.0, 12.0], np.float32),
+                np.asarray([20.0, 21.0, 22.0], np.float32)][r]
+        splits = [[1, 2], [3, 0]][r]
+        got, rs = hvd.alltoall(data, splits=splits, name="a2av")
+        print("A2AV", np.asarray(got).tolist(), np.asarray(rs).tolist())
+        # Zero-row edge: nobody sends anything.
+        e, ers = hvd.alltoall(np.zeros((0, 2), np.float32),
+                              splits=[0, 0], name="a2av.empty")
+        print("A2AVE", tuple(e.shape), np.asarray(ers).tolist())
         hvd.shutdown()
         """
     )
     assert "A2A [0.0, 2.0]" in outs[0], outs
     assert "A2A [1.0, 3.0]" in outs[1], outs
+    assert "A2AV [10.0, 20.0, 21.0, 22.0] [1, 3]" in outs[0], outs
+    assert "A2AV [11.0, 12.0] [2, 0]" in outs[1], outs
+    for out in outs:
+        assert "A2AVE (0, 2) [0, 0]" in out, outs
 
 
 def test_reducescatter_two_ranks():
