@@ -813,6 +813,19 @@ def join() -> None:
     synchronize(_rt().enqueue_join())
 
 
+def barrier(name: Optional[str] = None,
+            process_set: Optional[ProcessSet] = None) -> None:
+    """Block until every member rank reaches the barrier (the later
+    reference's ``hvd.barrier``): expressed as a one-element allreduce,
+    whose negotiate-then-execute protocol IS a barrier."""
+    import numpy as np
+
+    allreduce(
+        np.zeros((1,), np.float32), op=ReduceOp.SUM,
+        name=_auto_name("barrier", name), process_set=process_set,
+    )
+
+
 def poll(handle: int) -> bool:
     return _rt().poll(handle)
 
@@ -895,6 +908,7 @@ __all__ = [
     "add_process_set",
     "remove_process_set",
     "join",
+    "barrier",
     "poll",
     "synchronize",
     "broadcast_variables",

@@ -19,6 +19,7 @@ from .. import (  # re-export basics (reference exposes these here too)
     Adasum,
     Average,
     Sum,
+    barrier,
     cross_rank,
     cross_size,
     init,
@@ -429,3 +430,16 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
     import horovod_tpu as _hvd
 
     return _hvd.broadcast_object(obj, root_rank=root_rank, name=name)
+
+
+def __getattr__(name):
+    # SyncBatchNorm subclasses torch.nn's _BatchNorm, so its class body
+    # needs torch — built on first access to keep this module importable
+    # without it.
+    if name == "SyncBatchNorm":
+        from .sync_batch_norm import _make_sync_batch_norm
+
+        cls = _make_sync_batch_norm()
+        globals()["SyncBatchNorm"] = cls
+        return cls
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -215,8 +215,24 @@ def alltoall_async(tensor, name=None) -> int:
     return handle
 
 
-def alltoall(tensor, name=None):
-    return synchronize(alltoall_async(tensor, name))
+def alltoall(tensor, splits=None, name=None):
+    """Even alltoall, or — with ``splits`` (the later reference's
+    alltoallv form) — returns ``(collected, received_splits)`` as torch
+    tensors, delegating to the core uneven implementation."""
+    if splits is None:
+        return synchronize(alltoall_async(tensor, name))
+    import torch
+
+    import horovod_tpu as _hvd
+
+    splits_np = (splits.detach().cpu().numpy()
+                 if isinstance(splits, torch.Tensor) else splits)
+    out, received = _hvd.alltoall(
+        _to_numpy(tensor), splits_np, name=_auto_name("alltoall.torch", name)
+    )
+    # _from_plane handles the plane's dtypes (incl. ml_dtypes bfloat16,
+    # which torch.from_numpy rejects).
+    return _from_plane(out, tensor), torch.from_numpy(received.copy())
 
 
 def poll(handle: int) -> bool:

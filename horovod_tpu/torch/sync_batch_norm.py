@@ -1,0 +1,164 @@
+"""Synchronized batch normalization for the torch binding.
+
+Later-reference parity: upstream added ``horovod.torch.SyncBatchNorm``
+(v0.21) so batch statistics are computed over the GLOBAL batch — small
+per-rank batches otherwise give noisy, rank-divergent statistics. This is
+an independent implementation of the standard two-allreduce scheme (the
+textbook sync-BN formulation): the forward allreduces per-channel
+[sum, sum-of-squares, count], the backward allreduces
+[sum(dy), sum(dy·(x-mean))] and applies the batch-norm gradient identity.
+
+Eval mode uses the (already synchronized) running stats and never
+communicates. Weight/bias gradients stay local — a DistributedOptimizer
+reduces them with every other gradient.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+_ids = itertools.count()
+
+
+def _hvd():
+    import horovod_tpu as hvd
+
+    return hvd
+
+
+class _SyncBatchNormFunction:
+    """Autograd function built lazily so importing this module never
+    requires torch."""
+
+    _cls = None
+
+    @classmethod
+    def get(cls):
+        if cls._cls is not None:
+            return cls._cls
+        import numpy as np
+        import torch
+
+        class F(torch.autograd.Function):
+            # Statistics are computed and allreduced in float32 (the
+            # reference implementation does the same): bf16 has no numpy
+            # path and f16 sums of squares overflow on realistic
+            # activations; only the final normalized output returns to
+            # the input dtype.
+            @staticmethod
+            def forward(ctx, x, weight, bias, eps, tag):
+                hvd = _hvd()
+                xf = x.float()
+                dims = [0] + list(range(2, x.dim()))
+                count_local = x.numel() // x.shape[1]
+                stats = torch.cat([
+                    xf.sum(dims),
+                    (xf * xf).sum(dims),
+                    torch.tensor([float(count_local)]),
+                ])
+                stats = torch.from_numpy(np.asarray(hvd.allreduce(
+                    stats.detach().cpu().numpy(), op=hvd.Sum,
+                    name=f"{tag}.fwd",
+                )))
+                c = x.shape[1]
+                count = stats[-1]
+                mean = stats[:c] / count
+                var = stats[c:2 * c] / count - mean * mean
+                invstd = torch.rsqrt(var + eps)
+                shape = [1, c] + [1] * (x.dim() - 2)
+                xhat = (xf - mean.view(shape)) * invstd.view(shape)
+                y = (xhat * weight.float().view(shape)
+                     + bias.float().view(shape)).to(x.dtype)
+                ctx.save_for_backward(x, weight, mean, invstd, count)
+                ctx.tag = tag
+                return y, mean, var, count
+
+            @staticmethod
+            def backward(ctx, dy, _dmean, _dvar, _dcount):
+                hvd = _hvd()
+                x, weight, mean, invstd, count = ctx.saved_tensors
+                c = x.shape[1]
+                dims = [0] + list(range(2, x.dim()))
+                shape = [1, c] + [1] * (x.dim() - 2)
+                dyf = dy.float()
+                xmu = x.float() - mean.view(shape)
+                grad_stats = torch.cat([
+                    dyf.sum(dims), (dyf * xmu).sum(dims)
+                ])
+                grad_stats = torch.from_numpy(np.asarray(hvd.allreduce(
+                    grad_stats.detach().cpu().numpy(), op=hvd.Sum,
+                    name=f"{ctx.tag}.bwd",
+                )))
+                sum_dy = grad_stats[:c] / count
+                sum_dy_xmu = grad_stats[c:] / count
+                # d/dx of (x - mean) * invstd * w  (batch-norm identity)
+                dx = ((
+                    dyf
+                    - sum_dy.view(shape)
+                    - xmu * (invstd.view(shape) ** 2)
+                    * sum_dy_xmu.view(shape)
+                ) * invstd.view(shape)
+                    * weight.float().view(shape)).to(x.dtype)
+                dweight = (
+                    (dyf * xmu * invstd.view(shape)).sum(dims)
+                ).to(weight.dtype)
+                dbias = dyf.sum(dims).to(weight.dtype)
+                return dx, dweight, dbias, None, None
+
+        cls._cls = F
+        return F
+
+
+def _make_sync_batch_norm():
+    import torch
+    from torch.nn.modules.batchnorm import _BatchNorm
+
+    class SyncBatchNorm(_BatchNorm):
+        """Batch norm over the global batch (all ranks). Drop-in for
+        ``nn.BatchNorm1d/2d/3d``; statistics are allreduced in training
+        mode, running stats follow the usual momentum update (unbiased
+        variance) and are identical on every rank by construction."""
+
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._tag = f"syncbn.{next(_ids)}"
+
+        def _check_input_dim(self, x):
+            if x.dim() < 2:
+                raise ValueError(
+                    f"expected at least 2D input (got {x.dim()}D)"
+                )
+
+        def forward(self, x):
+            self._check_input_dim(x)
+            hvd = _hvd()
+            if (not self.training) or hvd.size() == 1:
+                return super().forward(x)
+            # Momentum bookkeeping only applies with running stats (torch's
+            # own _BatchNorm.forward guards the same way; num_batches_tracked
+            # is None without them).
+            momentum = self.momentum
+            if self.track_running_stats and self.momentum is None:
+                self.num_batches_tracked += 1
+                momentum = 1.0 / float(self.num_batches_tracked)
+            weight = (self.weight if self.affine
+                      else torch.ones(x.shape[1], dtype=x.dtype))
+            bias = (self.bias if self.affine
+                    else torch.zeros(x.shape[1], dtype=x.dtype))
+            F = _SyncBatchNormFunction.get()
+            y, mean, var, count = F.apply(x, weight, bias, self.eps,
+                                          self._tag)
+            if self.track_running_stats:
+                with torch.no_grad():
+                    unbiased = var * (count / (count - 1).clamp(min=1.0))
+                    self.running_mean.mul_(1 - momentum).add_(
+                        mean.detach(), alpha=momentum
+                    )
+                    self.running_var.mul_(1 - momentum).add_(
+                        unbiased.detach(), alpha=momentum
+                    )
+                    if self.momentum is not None:
+                        self.num_batches_tracked += 1
+            return y
+
+    return SyncBatchNorm

@@ -1328,3 +1328,94 @@ def test_process_set_divergent_registration_fails_loudly():
     )
     for out in outs:
         assert "PSDIV OK" in out, outs
+
+
+def test_torch_sync_batch_norm_two_ranks():
+    """SyncBatchNorm (later-reference horovod.torch.SyncBatchNorm):
+    2-rank forward, input gradients, and running stats must match a
+    single-process BatchNorm2d over the CONCATENATED batch (float32
+    tolerances: the per-channel stats ride the f32 eager wire)."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import torch
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        r = hvd.rank()
+        torch.manual_seed(0)
+        xs = [torch.randn(2, 3, 2, 2) for _ in range(2)]
+        dys = [torch.randn(2, 3, 2, 2) for _ in range(2)]
+        x = xs[r].clone().requires_grad_(True)
+
+        sbn = hvd.SyncBatchNorm(3, eps=1e-5, momentum=0.1)
+        with torch.no_grad():
+            sbn.weight.mul_(0).add_(torch.tensor([1.5, 0.5, 2.0]))
+            sbn.bias.add_(torch.tensor([0.1, -0.2, 0.3]))
+        y = sbn(x)
+        y.backward(dys[r])
+
+        # single-process reference over the concatenated global batch
+        ref = torch.nn.BatchNorm2d(3, eps=1e-5, momentum=0.1)
+        with torch.no_grad():
+            ref.weight.copy_(sbn.weight.detach())
+            ref.bias.copy_(sbn.bias.detach())
+        xg = torch.cat(xs).clone().requires_grad_(True)
+        yg = ref(xg)
+        yg.backward(torch.cat(dys))
+
+        sl = slice(r * 2, r * 2 + 2)
+        ok_y = torch.allclose(y, yg[sl], atol=1e-5, rtol=1e-4)
+        ok_dx = torch.allclose(x.grad, xg.grad[sl], atol=1e-4, rtol=1e-3)
+        ok_rm = torch.allclose(sbn.running_mean, ref.running_mean,
+                               atol=1e-5)
+        ok_rv = torch.allclose(sbn.running_var, ref.running_var,
+                               atol=1e-5)
+        # eval mode: no communication, matches reference eval
+        sbn.eval(); ref.eval()
+        ok_eval = torch.allclose(sbn(xs[0]), ref(xs[0]),
+                                 atol=1e-5, rtol=1e-4)
+        # bf16 path: stats ride the f32 wire; output/grads stay bf16+finite
+        sbn_b = hvd.SyncBatchNorm(3).bfloat16()
+        xb = xs[r].bfloat16().clone().requires_grad_(True)
+        yb = sbn_b(xb)
+        yb.sum().backward()
+        ok_bf16 = (yb.dtype == torch.bfloat16
+                   and xb.grad.dtype == torch.bfloat16
+                   and bool(yb.float().isfinite().all())
+                   and bool(xb.grad.float().isfinite().all()))
+        # momentum=None + no running stats must not crash (torch parity)
+        sbn_n = hvd.SyncBatchNorm(3, momentum=None,
+                                  track_running_stats=False)
+        ok_none = bool(sbn_n(xs[r]).isfinite().all())
+        print("SBN", bool(ok_y), bool(ok_dx), bool(ok_rm), bool(ok_rv),
+              bool(ok_eval), bool(ok_bf16), bool(ok_none))
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "SBN True True True True True True True" in out, outs
+
+
+def test_barrier_two_ranks():
+    """hvd.barrier (later-reference API): rank 1 enters late; rank 0's
+    barrier return must wait for it."""
+    outs = _run_workers(
+        """
+        import time
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        if hvd.rank() == 1:
+            time.sleep(1.0)
+        t0 = time.monotonic()
+        hvd.barrier()
+        waited = time.monotonic() - t0
+        print("BARRIER", hvd.rank(), waited > 0.6 if hvd.rank() == 0
+              else True)
+        hvd.shutdown()
+        """
+    )
+    assert "BARRIER 0 True" in outs[0], outs
+    assert "BARRIER 1 True" in outs[1], outs
