@@ -223,6 +223,90 @@ def test_elastic_rank0_crash_preserves_state():
         assert size == "3" and step == "10" and float(w0) == 10.0, finals
 
 
+def test_elastic_compiled_mode_crash_recovery():
+    """Elastic + the COMPILED path (the TPU-native fast path): each
+    generation rebuilds the mesh and re-jits make_train_step at the new
+    world size; a crashed worker's generation rolls back to the last
+    commit and training converges at full size with identical params on
+    every rank."""
+    proc, outs = _run_elastic(
+        """
+        import optax
+        import horovod_tpu.jax as hvdj
+        from horovod_tpu.parallel.mesh import build_mesh
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        crash_flag = os.path.join(td, 'crashed')
+        rng = np.random.RandomState(7)
+        Wt = rng.randn(6, 1).astype(np.float32)
+
+        def loss_fn(params, batch):
+            xb, yb = batch
+            pred = xb @ params['w'] + params['b']
+            return jnp.mean((pred - yb) ** 2)
+
+        state = elastic.JaxState(
+            params={'w': np.zeros((6, 1), np.float32),
+                    'b': np.zeros((1,), np.float32)},
+            opt_state=None, step=0, losses=[])
+
+        @elastic.run
+        def train(state):
+            mesh = build_mesh()          # current generation's devices
+            tx = optax.sgd(0.1)
+            step_fn = hvdj.make_train_step(loss_fn, tx, mesh,
+                                           donate=False)
+            rep = NamedSharding(mesh, P())
+            shard = NamedSharding(mesh, P('data'))
+            params = jax.device_put(state.params, rep)
+            opt_state = (tx.init(params) if state.opt_state is None
+                         else jax.device_put(state.opt_state, rep))
+            while state.step < 12:
+                g = np.random.RandomState(state.step)   # same data any world
+                Xg = g.randn(8 * hvd.size(), 6).astype(np.float32)
+                Yg = Xg @ Wt
+                sl = slice(8 * hvd.rank(), 8 * (hvd.rank() + 1))
+                batch = (
+                    jax.make_array_from_process_local_data(shard, Xg[sl]),
+                    jax.make_array_from_process_local_data(shard, Yg[sl]),
+                )
+                params, opt_state, loss = step_fn(params, opt_state, batch)
+                state.params = jax.device_get(params)
+                state.opt_state = jax.device_get(opt_state)
+                state.losses.append(round(float(np.asarray(loss)), 6))
+                state.step += 1
+                if (os.environ['HOROVOD_ELASTIC_WORKER_ID'] == 'localhost:1'
+                        and state.step == 5
+                        and not os.path.exists(crash_flag)):
+                    open(crash_flag, 'w').close()
+                    os._exit(13)
+                state.commit()
+            return state
+
+        train(state)
+        wsum = float(np.asarray(state.params['w']).sum())
+        print('FINAL', hvd.rank(), hvd.size(), state.step,
+              round(wsum, 6), state.losses[0] > state.losses[-1],
+              flush=True)
+        hvd.shutdown()
+        """,
+        ["-np", "3", "--min-np", "3", "--max-np", "3"],
+    )
+    stderr = proc.stderr.decode()
+    assert proc.returncode == 0, (stderr, outs)
+    assert "failed with exit code 13" in stderr, stderr
+    assert "generation 2" in stderr, stderr
+    finals = [l for o in outs.values() for l in o.splitlines()
+              if l.startswith("FINAL")]
+    assert len(finals) == 3, (finals, stderr)
+    wsums = set()
+    for line in finals:
+        _, rank, size, step, wsum, improved = line.split()
+        assert size == "3" and step == "12" and improved == "True", finals
+        wsums.add(wsum)
+    assert len(wsums) == 1, finals  # identical params on every rank
+
+
 def test_elastic_scale_down_and_up():
     """Graceful membership changes through the discovery script: 3 -> 2
     (the dropped worker exits cleanly on its own; survivors keep state,
