@@ -400,10 +400,33 @@ class ObjectState(State):
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v))
 
+    @staticmethod
+    def _is_sampler(v: Any) -> bool:
+        # Duck-typed ElasticSampler (torch/elastic.py) — its processed
+        # set is PER-RANK state that must union across ranks, not be
+        # overwritten by the sync source's copy.
+        return hasattr(v, "processed") and hasattr(v, "record_batch")
+
     def sync(self) -> None:
         import horovod_tpu as hvd
 
         if hvd.size() > 1:
+            sampler_keys = [
+                k for k in self._tracked
+                if self._is_sampler(getattr(self, k))
+            ]
+            # Capture every rank's processed indices BEFORE the broadcast
+            # overwrites the samplers (upstream's SamplerStateHandler
+            # unions the same way): each rank trained a disjoint shard,
+            # so resume-without-repeat needs the union.
+            merged = (
+                hvd.allgather_object(
+                    {k: sorted(getattr(self, k).processed)
+                     for k in sampler_keys},
+                    name="hvd.elastic.sampsync",
+                )
+                if sampler_keys else []
+            )
             values = {k: getattr(self, k) for k in self._tracked}
             synced = hvd.broadcast_object(
                 values, root_rank=_sync_root(),
@@ -411,6 +434,12 @@ class ObjectState(State):
             )
             for k, v in synced.items():
                 setattr(self, k, v)
+            for k in sampler_keys:
+                s = getattr(self, k)
+                s.processed = set().union(
+                    *[set(m[k]) for m in merged]
+                )
+                s._local_order = []
         self.save()
 
 

@@ -1,0 +1,142 @@
+"""Elastic API for the Keras binding (upstream
+``horovod.tensorflow.keras.elastic``): ``run``/``KerasState`` plus the
+three state-keeping callbacks that make ``model.fit`` resumable across
+world re-formations.
+
+Usage (mirrors upstream):
+
+```python
+import horovod_tpu.keras as hvd
+import horovod_tpu.keras.elastic as elastic
+
+state = elastic.KerasState(model, batch=0, epoch=0)
+
+@elastic.run
+def train(state):
+    model.fit(
+        x, y,
+        initial_epoch=state.epoch, epochs=total_epochs,
+        callbacks=[
+            # Update callbacks FIRST so each commit snapshots the
+            # already-advanced counters (commit last, as upstream
+            # documents).
+            elastic.UpdateBatchStateCallback(state),
+            elastic.UpdateEpochStateCallback(state),
+            elastic.CommitStateCallback(state, batches_per_commit=50),
+        ],
+    )
+
+train(state)
+```
+"""
+
+from __future__ import annotations
+
+from ..elastic import (  # noqa: F401
+    HostsUpdatedInterrupt,
+    ObjectState,
+    State,
+    TensorFlowKerasState,
+    run,
+)
+
+# Upstream names it KerasState inside the keras module.
+KerasState = TensorFlowKerasState
+
+__all__ = [
+    "run",
+    "State",
+    "ObjectState",
+    "KerasState",
+    "TensorFlowKerasState",
+    "CommitStateCallback",
+    "UpdateBatchStateCallback",
+    "UpdateEpochStateCallback",
+    "HostsUpdatedInterrupt",
+]
+
+
+def _callback_base():
+    import tensorflow as tf
+
+    return tf.keras.callbacks.Callback
+
+
+class _LazyCallback:
+    """Build the tf.keras Callback subclass on first instantiation so
+    importing this module never requires tensorflow."""
+
+    _cls = None
+
+    def __new__(cls, *args, **kwargs):
+        if cls._cls is None:
+            cls._cls = cls._build()
+        return cls._cls(*args, **kwargs)
+
+
+class CommitStateCallback(_LazyCallback):
+    """``state.commit()`` every ``batches_per_commit`` batches (and at
+    every epoch end) — the commit is also where membership changes
+    surface (``HostsUpdatedInterrupt`` out of ``fit``, caught by
+    ``run``)."""
+
+    @staticmethod
+    def _build():
+        Base = _callback_base()
+
+        class _CommitStateCallback(Base):
+            def __init__(self, state, batches_per_commit: int = 100):
+                super().__init__()
+                self._state = state
+                self._every = max(1, int(batches_per_commit))
+                self._counter = 0
+
+            def on_batch_end(self, batch, logs=None):
+                self._counter += 1
+                if self._counter % self._every == 0:
+                    self._state.commit()
+
+            def on_epoch_end(self, epoch, logs=None):
+                self._state.commit()
+
+        return _CommitStateCallback
+
+
+class UpdateBatchStateCallback(_LazyCallback):
+    """Track ``state.batch`` through fit (reset to 0 at epoch end)."""
+
+    @staticmethod
+    def _build():
+        Base = _callback_base()
+
+        class _UpdateBatchStateCallback(Base):
+            def __init__(self, state):
+                super().__init__()
+                self._state = state
+
+            def on_batch_end(self, batch, logs=None):
+                self._state.batch = batch + 1
+
+            def on_epoch_end(self, epoch, logs=None):
+                self._state.batch = 0
+
+        return _UpdateBatchStateCallback
+
+
+class UpdateEpochStateCallback(_LazyCallback):
+    """Track ``state.epoch`` through fit (feed it back as
+    ``initial_epoch`` after a re-formation)."""
+
+    @staticmethod
+    def _build():
+        Base = _callback_base()
+
+        class _UpdateEpochStateCallback(Base):
+            def __init__(self, state):
+                super().__init__()
+                self._state = state
+
+            def on_epoch_end(self, epoch, logs=None):
+                self._state.epoch = epoch + 1
+
+        return _UpdateEpochStateCallback

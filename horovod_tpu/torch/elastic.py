@@ -1,0 +1,106 @@
+"""Elastic API for the torch binding (upstream ``horovod.torch.elastic``):
+``run``/``TorchState`` re-exported from the core elastic module, plus
+``ElasticSampler`` — a rank-sharding sampler that survives world resizes
+without repeating or dropping data within an epoch.
+"""
+
+from __future__ import annotations
+
+from ..elastic import (  # noqa: F401
+    HostsUpdatedInterrupt,
+    ObjectState,
+    State,
+    TorchState,
+    run,
+)
+
+__all__ = [
+    "run",
+    "State",
+    "ObjectState",
+    "TorchState",
+    "ElasticSampler",
+    "HostsUpdatedInterrupt",
+]
+
+
+class ElasticSampler:
+    """Shards dataset indices over the CURRENT world (re-reads
+    ``hvd.rank()/size()`` on every ``__iter__``, so a re-formed world
+    automatically re-partitions) and records processed batches so a
+    rollback or membership change resumes the epoch where it left off
+    instead of repeating data (upstream ``ElasticSampler`` role).
+
+    Usage (mirrors upstream):
+
+    ```python
+    sampler = hvd.elastic.ElasticSampler(len(dataset), shuffle=True)
+    loader = DataLoader(dataset, sampler=sampler, batch_size=B)
+    state = hvd.elastic.TorchState(model, opt, sampler=sampler, epoch=0)
+    # in the loop: sampler.record_batch(batch_idx, B); state.commit()
+    # on epoch end: sampler.set_epoch(epoch + 1)
+    ```
+
+    The instance is picklable, so tracking it as a state attribute gives
+    it commit/rollback/sync semantics for free (the sync source's
+    processed-set wins after a re-formation).
+    """
+
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0):
+        self.dataset_size = int(dataset_size)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.epoch = 0
+        self.processed: set = set()
+        self._local_order: list = []
+
+    # -- epoch lifecycle ------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        """Start a new epoch: reshuffle and forget processed indices."""
+        self.epoch = int(epoch)
+        self.processed.clear()
+        self._local_order = []
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark the ``batch_idx``-th batch of the current iteration order
+        as processed (call after training on it, before ``commit()``)."""
+        start = batch_idx * batch_size
+        self.processed.update(
+            self._local_order[start:start + batch_size]
+        )
+
+    # -- sampling -------------------------------------------------------
+    def _remaining(self) -> list:
+        import numpy as np
+
+        order = list(range(self.dataset_size))
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        return [i for i in order if i not in self.processed]
+
+    def __iter__(self):
+        import horovod_tpu as hvd
+
+        n = hvd.size() if hvd.is_initialized() else 1
+        r = hvd.rank() if hvd.is_initialized() else 0
+        remaining = self._remaining()
+        # Pad by wrapping (modulo, like torch's DistributedSampler) so
+        # every rank yields the same count even when fewer indices remain
+        # than the pad needs — unequal counts would desync collectives.
+        if remaining and len(remaining) % n:
+            total = len(remaining) + (n - len(remaining) % n)
+            remaining = [
+                remaining[i % len(remaining)] for i in range(total)
+            ]
+        self._local_order = remaining[r::n]
+        return iter(self._local_order)
+
+    def __len__(self) -> int:
+        import horovod_tpu as hvd
+
+        n = hvd.size() if hvd.is_initialized() else 1
+        rem = self.dataset_size - len(self.processed)
+        return -(-rem // n)  # ceil
+
+    # picklability: drop nothing — all attrs are plain data.

@@ -378,3 +378,82 @@ def test_elastic_scale_down_and_up():
     sizes = eval(" ".join(rank0.split()[5:]))  # noqa: S307 - our output
     assert 2 in sizes and sizes[0] == 3 and sizes[-1] == 3, sizes
     assert "generation 3" in stderr, stderr
+
+
+def test_elastic_sampler():
+    """ElasticSampler (upstream horovod.torch.elastic.ElasticSampler
+    role): rank-sharded iteration, processed-batch tracking that
+    survives re-iteration, wrap-padding, epoch reshuffle, pickling."""
+    import pickle
+
+    from horovod_tpu.torch.elastic import ElasticSampler
+
+    s = ElasticSampler(10, shuffle=False)
+    order = list(iter(s))  # size 1 outside a job: every index
+    assert order == list(range(10))
+    assert len(s) == 10
+
+    # consume two batches of 3, then resume: only the rest remains
+    s.record_batch(0, 3)
+    s.record_batch(1, 3)
+    assert s.processed == {0, 1, 2, 3, 4, 5}
+    assert list(iter(s)) == [6, 7, 8, 9]
+    assert len(s) == 4
+
+    # rollback semantics via pickling (what TorchState save/restore does)
+    blob = pickle.dumps(s)
+    s.record_batch(0, 2)
+    assert s.processed == {0, 1, 2, 3, 4, 5, 6, 7}
+    s2 = pickle.loads(blob)
+    assert s2.processed == {0, 1, 2, 3, 4, 5}
+
+    # new epoch: full order again, reshuffled deterministically
+    sh = ElasticSampler(8, shuffle=True, seed=3)
+    e0 = list(iter(sh))
+    sh.set_epoch(1)
+    e1 = list(iter(sh))
+    assert sorted(e0) == sorted(e1) == list(range(8))
+    assert e0 != e1
+
+
+def test_keras_elastic_callbacks():
+    """Keras elastic callbacks (upstream horovod.tensorflow.keras.elastic):
+    batch/epoch state tracked through fit, commits fired, and the state
+    restorable to the last commit."""
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+
+    import horovod_tpu.keras.elastic as kelastic
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1, input_shape=(2,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.01), loss="mse")
+    state = kelastic.KerasState(model, batch=0, epoch=0)
+
+    commits = []
+    orig_commit = state.commit
+    state.commit = lambda: (commits.append((state.epoch, state.batch)),
+                            orig_commit())[1]
+
+    x = np.random.RandomState(0).randn(8, 2).astype("float32")
+    y = x.sum(1, keepdims=True).astype("float32")
+    model.fit(
+        x, y, batch_size=4, epochs=2, verbose=0,
+        initial_epoch=state.epoch,
+        callbacks=[
+            # update-then-commit order: commits snapshot advanced counters
+            kelastic.UpdateBatchStateCallback(state),
+            kelastic.UpdateEpochStateCallback(state),
+            kelastic.CommitStateCallback(state, batches_per_commit=2),
+        ],
+    )
+    assert state.epoch == 2 and state.batch == 0
+    assert commits, "CommitStateCallback never fired"
+    # end-of-epoch commits carry the POST-update epoch counter
+    epoch_end_commits = [c for c in commits if c[1] == 0]
+    assert epoch_end_commits and epoch_end_commits[-1][0] == 2, commits
+    # restore rolls back to the last committed weights
+    committed = [np.array(w) for w in model.get_weights()]
+    model.set_weights([w + 5.0 for w in committed])
+    state.restore()
+    for a, b in zip(model.get_weights(), committed):
+        np.testing.assert_allclose(np.asarray(a), b)
