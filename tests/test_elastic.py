@@ -380,6 +380,52 @@ def test_elastic_scale_down_and_up():
     assert "generation 3" in stderr, stderr
 
 
+def test_elastic_worker_initiated_rejoin():
+    """A rollback with NO process death (stall shutdown, transient
+    control-plane error): the abandoning worker signals the driver,
+    which force-publishes a new generation even though membership never
+    changed — without the signal every rank would wait out the full
+    elastic timeout for a bump nothing else triggers."""
+    proc, outs = _run_elastic(
+        """
+        flag = os.path.join(td, 'rolled')
+        state = elastic.JaxState(w=np.zeros((2,), np.float32), step=0)
+
+        @elastic.run
+        def train(state):
+            while state.step < 8:
+                g = hvd.allreduce(jnp.ones((2,), jnp.float32),
+                                  op=hvd.Average, name='grad')
+                state.w = np.asarray(g) + np.asarray(state.w)
+                state.step += 1
+                if (hvd.rank() == 1 and state.step == 4
+                        and not os.path.exists(flag)):
+                    open(flag, 'w').close()
+                    # Simulated in-process collective failure: the
+                    # wrapper restores and rejoins WITHOUT this process
+                    # dying; the driver must re-form on the signal.
+                    raise hvd.HorovodInternalError('simulated failure')
+                state.commit()
+            return state.step
+
+        train(state)
+        print('FINAL', hvd.rank(), hvd.size(), state.step,
+              float(np.asarray(state.w)[0]), flush=True)
+        hvd.shutdown()
+        """,
+        ["-np", "2", "--min-np", "2", "--max-np", "2"],
+    )
+    stderr = proc.stderr.decode()
+    assert proc.returncode == 0, (stderr, outs)
+    assert "abandoned generation" in stderr, stderr
+    finals = [l for o in outs.values() for l in o.splitlines()
+              if l.startswith("FINAL")]
+    assert len(finals) == 2, (finals, stderr)
+    for line in finals:
+        _, rank, size, step, w0 = line.split()
+        assert size == "2" and step == "8" and float(w0) == 8.0, finals
+
+
 def test_elastic_sampler():
     """ElasticSampler (upstream horovod.torch.elastic.ElasticSampler
     role): rank-sharded iteration, processed-batch tracking that
