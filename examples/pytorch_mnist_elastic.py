@@ -1,0 +1,116 @@
+"""Elastic PyTorch MNIST (upstream ``examples/pytorch_mnist_elastic.py``
+role, v0.20+): the training loop survives worker crashes and host set
+changes — state rolls back to the last commit and the world re-forms
+with the survivors. Synthetic data for hermetic runs; the
+``ElasticSampler`` shards the (synthetic) dataset over the current
+world and resumes an interrupted epoch without repeating samples.
+
+Run:
+  python -m horovod_tpu.run -np 2 --min-np 1 --max-np 4 \
+      python examples/pytorch_mnist_elastic.py
+  # or with live discovery:
+  python -m horovod_tpu.run --min-np 1 --max-np 4 \
+      --host-discovery-script ./discover.sh \
+      python examples/pytorch_mnist_elastic.py
+"""
+
+import os as _os
+import sys as _sys
+
+try:  # allow running from a source checkout without installation
+    import horovod_tpu  # noqa: F401
+except ImportError:
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+import horovod_tpu.torch.elastic as elastic
+
+EPOCHS = 2
+BATCH = 32
+DATASET = 512  # synthetic samples per epoch
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(28 * 28, 64)
+        self.fc2 = torch.nn.Linear(64, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x.flatten(1))))
+
+
+def synthetic_sample(idx):
+    g = torch.Generator().manual_seed(idx)
+    x = torch.randn(1, 28, 28, generator=g)
+    y = idx % 10
+    return x, y
+
+
+BASE_LR = 0.01
+
+
+def main():
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=BASE_LR * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters()
+    )
+    sampler = elastic.ElasticSampler(DATASET, shuffle=True)
+    state = elastic.TorchState(
+        model, optimizer, sampler=sampler, epoch=0
+    )
+
+    def on_reset():
+        # LR scales with the world (upstream's elastic example does the
+        # same): gradients now average over the new rank count.
+        for group in optimizer.param_groups:
+            group["lr"] = BASE_LR * hvd.size()
+        print(f"[rank {hvd.rank()}] world re-formed: size {hvd.size()}",
+              flush=True)
+
+    state.register_reset_callbacks([on_reset])
+
+    @elastic.run
+    def train(state):
+        while state.epoch < EPOCHS:
+            batches = 0
+            # one pass over this rank's shard of the REMAINING samples
+            # (after a re-formation the pass resumes where the epoch
+            # left off, re-partitioned over the new world)
+            local = list(iter(state.sampler))
+            for bidx in range(0, len(local), BATCH):
+                idxs = local[bidx:bidx + BATCH]
+                xs, ys = zip(*(synthetic_sample(i) for i in idxs))
+                x = torch.stack(xs)
+                y = torch.tensor(ys)
+                optimizer.zero_grad()
+                loss = F.cross_entropy(model(x), y)
+                loss.backward()
+                optimizer.step()
+                state.sampler.record_batch(bidx // BATCH, BATCH)
+                batches += 1
+                if batches % 4 == 0:
+                    state.commit()
+            state.epoch += 1
+            state.sampler.set_epoch(state.epoch)
+            state.commit()
+        return state
+
+    train(state)
+    if hvd.rank() == 0:
+        print(f"done: {state.epoch} epochs on {hvd.size()} ranks",
+              flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -507,8 +507,18 @@ class TorchState(ObjectState):
         if self.optimizer is not None:
             self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
 
+    def _reset_optimizer_handles(self) -> None:
+        # A DistributedOptimizer's in-flight allreduce handles reference
+        # a dead world after a rollback; a failure raised OUTSIDE its own
+        # synchronize() (e.g. a logging allreduce between backward and
+        # step) leaves them set, and the next zero_grad() would refuse.
+        reset = getattr(self.optimizer, "reset", None)
+        if callable(reset):
+            reset()
+
     def restore(self) -> None:
         super().restore()
+        self._reset_optimizer_handles()
         if self.model is not None:
             self.model.load_state_dict(self._saved_model)
         if self.optimizer is not None:
@@ -517,6 +527,7 @@ class TorchState(ObjectState):
     def sync(self) -> None:
         import horovod_tpu as hvd
 
+        self._reset_optimizer_handles()
         if hvd.size() > 1:
             import horovod_tpu.torch as hvd_torch
 

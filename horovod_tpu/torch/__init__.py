@@ -129,23 +129,44 @@ class _DistributedOptimizer:
     def synchronize(self) -> None:
         import torch
 
-        for group in self._opt.param_groups:
-            for p in group["params"]:
-                if p not in self._handles and p.requires_grad \
-                        and p.grad is not None:
-                    # backward() was not run (or hook missed): reduce now,
-                    # matching the reference's missing-handle path.
-                    self._allreduce_grad_async(p)
-        for p, (handle, ctx) in list(self._handles.items()):
-            out = synchronize(handle)
-            out = self._compression.decompress(out, ctx)
-            with torch.no_grad():
-                p.grad.copy_(out.reshape(p.grad.shape).to(p.grad.dtype))
-        self._handles.clear()
+        try:
+            for group in self._opt.param_groups:
+                for p in group["params"]:
+                    if p not in self._handles and p.requires_grad \
+                            and p.grad is not None:
+                        # backward() was not run (or hook missed): reduce
+                        # now, matching the reference's missing-handle
+                        # path.
+                        self._allreduce_grad_async(p)
+            for p, (handle, ctx) in list(self._handles.items()):
+                out = synchronize(handle)
+                out = self._compression.decompress(out, ctx)
+                with torch.no_grad():
+                    p.grad.copy_(
+                        out.reshape(p.grad.shape).to(p.grad.dtype)
+                    )
+            self._handles.clear()
+        except Exception:
+            # A failed collective (peer loss, shutdown) leaves the whole
+            # in-flight set dead — drop it and reset the accumulation
+            # counters so an elastic rollback can re-enter training
+            # instead of tripping zero_grad()'s outstanding-handle guard.
+            self._handles.clear()
+            for k in self._passes:
+                self._passes[k] = 0
+            raise
 
     def step(self, closure=None):
         self.synchronize()
         return self._opt.step(closure)
+
+    def reset(self) -> None:
+        """Drop in-flight allreduce handles and accumulation counters —
+        they reference a dead world after an elastic rollback. Called by
+        ``TorchState`` restore/sync; harmless when idle."""
+        self._handles.clear()
+        for k in self._passes:
+            self._passes[k] = 0
 
     def zero_grad(self, *args, **kwargs):
         if self._handles:
@@ -354,13 +375,23 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
 
     state_dict = optimizer.state_dict()
     # Newly constructed optimizers have no state: run a dummy step on zero
-    # grads to materialize it (reference does exactly this).
+    # grads to materialize it (reference does exactly this). The zeroing
+    # must be UNCONDITIONAL — a live gradient left from an interrupted
+    # step (elastic rollback) would otherwise be applied as a real
+    # parameter update here, silently moving the just-restored weights.
+    # Existing grads are stashed and put back so callers keep theirs.
     if not state_dict.get("state"):
-        for group in optimizer.param_groups:
-            for p in group["params"]:
-                if p.requires_grad and p.grad is None:
-                    p.grad = torch.zeros_like(p)
-        optimizer.step()
+        stashed = []
+        try:
+            for group in optimizer.param_groups:
+                for p in group["params"]:
+                    if p.requires_grad:
+                        stashed.append((p, p.grad))
+                        p.grad = torch.zeros_like(p)
+            optimizer.step()
+        finally:
+            for p, g in stashed:
+                p.grad = g
         state_dict = optimizer.state_dict()
 
     callbacks = []

@@ -44,3 +44,46 @@ def hvd_session():
     hvd.init()
     yield hvd
     hvd.shutdown()
+
+
+def run_elastic_job(hvdrun_args, script_text=None, script_path=None,
+                    extra_env=None, timeout=300):
+    """Shared harness for elastic-driver jobs (used by test_elastic and
+    test_examples): scrubbed CPU env, launch under ``hvdrun`` with the
+    given elastic flags, collect per-worker ``worker.<id>.out`` files.
+    Returns (completed_process, {worker_id_or_errname: text})."""
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo, env.get("PYTHONPATH", "")]
+    ).rstrip(os.pathsep)
+    env.update(extra_env or {})
+    with tempfile.TemporaryDirectory() as td:
+        if script_path is None:
+            script_path = os.path.join(td, "worker.py")
+            with open(script_path, "w") as f:
+                f.write(script_text)
+        env["ELASTIC_TD"] = td
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.run", *hvdrun_args,
+             "--output-dir", td, sys.executable, script_path],
+            env=env, cwd=repo, capture_output=True, timeout=timeout,
+        )
+        outs = {}
+        for fn in os.listdir(td):
+            if fn.startswith("worker.") and fn.endswith(".out"):
+                outs[fn[len("worker."):-len(".out")]] = open(
+                    os.path.join(td, fn)
+                ).read()
+            if fn.startswith("worker.") and fn.endswith(".err"):
+                outs[fn[len("worker."):]] = open(
+                    os.path.join(td, fn)
+                ).read()
+    return proc, outs

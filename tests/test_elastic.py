@@ -85,41 +85,15 @@ def test_elastic_keras_state_primitives():
 
 def _run_elastic(worker_body: str, hvdrun_args, extra_env=None,
                  timeout=300):
-    """Run an elastic job; returns (proc, {worker_id: stdout}) plus the
-    driver's stderr on the proc object."""
-    import tempfile
+    """Prologue + dedented body through the shared conftest harness."""
+    from conftest import run_elastic_job
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO, env.get("PYTHONPATH", "")]
-    ).rstrip(os.pathsep)
-    env.update(extra_env or {})
-    with tempfile.TemporaryDirectory() as td:
-        worker = os.path.join(td, "worker.py")
-        with open(worker, "w") as f:
-            # Prologue and body are dedented separately: they come from
-            # string literals at different nesting depths.
-            f.write(textwrap.dedent(_TRAIN_PROLOGUE)
-                    + textwrap.dedent(worker_body))
-        env["ELASTIC_TD"] = td
-        proc = subprocess.run(
-            [sys.executable, "-m", "horovod_tpu.run", *hvdrun_args,
-             "--output-dir", td, sys.executable, worker],
-            env=env, cwd=REPO, capture_output=True, timeout=timeout,
-        )
-        outs = {}
-        for fn in os.listdir(td):
-            if fn.startswith("worker.") and fn.endswith(".out"):
-                wid = fn[len("worker."):-len(".out")]
-                outs[wid] = open(os.path.join(td, fn)).read()
-            if fn.startswith("worker.") and fn.endswith(".err"):
-                outs[fn[len("worker."):]] = open(
-                    os.path.join(td, fn)
-                ).read()
-    return proc, outs
+    return run_elastic_job(
+        hvdrun_args,
+        script_text=(textwrap.dedent(_TRAIN_PROLOGUE)
+                     + textwrap.dedent(worker_body)),
+        extra_env=extra_env, timeout=timeout,
+    )
 
 
 _TRAIN_PROLOGUE = """
@@ -424,6 +398,65 @@ def test_elastic_worker_initiated_rejoin():
     for line in finals:
         _, rank, size, step, w0 = line.split()
         assert size == "2" and step == "8" and float(w0) == 8.0, finals
+
+
+def test_elastic_torch_crash_recovery():
+    """Elastic + the torch binding: a crash mid-training recovers through
+    TorchState (DistributedOptimizer handles cleared, optimizer-state
+    materialization must NOT apply stale gradients as an update) and
+    every rank ends with IDENTICAL parameters."""
+    proc, outs = _run_elastic(
+        """
+        import torch
+        import torch.nn.functional as TF
+        import horovod_tpu.torch as hvdt
+        import horovod_tpu.torch.elastic as telastic
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 2)
+        opt = hvdt.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters())
+        state = telastic.TorchState(model, opt, step=0)
+        flag = os.path.join(td, 'crashed')
+
+        @telastic.run
+        def train(state):
+            while state.step < 8:
+                x = torch.randn(8, 4); y = torch.randn(8, 2)
+                opt.zero_grad()
+                TF.mse_loss(model(x), y).backward()
+                opt.step()
+                state.step += 1
+                if (os.environ['HOROVOD_ELASTIC_WORKER_ID'] == 'localhost:1'
+                        and state.step == 4
+                        and not os.path.exists(flag)):
+                    open(flag, 'w').close()
+                    os._exit(11)
+                state.commit()
+            return state
+
+        train(state)
+        w = [round(float(x), 6) for x in
+             torch.cat([p.detach().flatten() for p in model.parameters()])]
+        print('FINAL', hvd.rank(), hvd.size(), state.step, w, flush=True)
+        hvd.shutdown()
+        """,
+        ["-np", "2", "--min-np", "2", "--max-np", "2"],
+    )
+    stderr = proc.stderr.decode()
+    assert proc.returncode == 0, (stderr, outs)
+    assert "failed with exit code 11" in stderr, stderr
+    finals = [l for o in outs.values() for l in o.splitlines()
+              if l.startswith("FINAL")]
+    assert len(finals) == 2, (finals, stderr)
+    params = set()
+    for line in finals:
+        parts = line.split(None, 4)
+        assert parts[2] == "2" and parts[3] == "8", finals
+        params.add(parts[4])
+    # Identical parameters on every rank — catches both the stale-handle
+    # crash and the stale-gradient dummy-step corruption.
+    assert len(params) == 1, finals
 
 
 def test_elastic_sampler():

@@ -149,29 +149,33 @@ def test_jax_tp_pp_demo():
     assert "heterogeneous LM" in proc.stdout
 
 
-def test_jax_elastic_train():
-    """The elastic example completes under the elastic driver at a fixed
-    size of 2 and converges (later-reference elastic example role)."""
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["PYTHONPATH"] = os.pathsep.join(
-        [REPO, env.get("PYTHONPATH", "")]
-    ).rstrip(os.pathsep)
-    with tempfile.TemporaryDirectory() as td:
-        proc = subprocess.run(
-            [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
-             "--min-np", "2", "--max-np", "2", "--output-dir", td,
-             sys.executable,
-             os.path.join(REPO, "examples", "jax_elastic_train.py")],
-            env=env, cwd=td, capture_output=True, timeout=420, text=True,
-        )
-        out = ""
-        for fn in os.listdir(td):
-            if fn.startswith("worker.") and fn.endswith(".out"):
-                out += open(os.path.join(td, fn)).read()
+def _run_elastic_example(script, expect, np_=2):
+    """Elastic example smoke run through the shared conftest harness."""
+    from conftest import run_elastic_job
+
+    proc, outs = run_elastic_job(
+        ["-np", str(np_), "--min-np", str(np_), "--max-np", str(np_)],
+        script_path=os.path.join(REPO, "examples", script),
+        timeout=420,
+    )
+    out = "".join(v for k, v in outs.items() if not k.endswith(".err"))
     assert proc.returncode == 0, (proc.stdout, proc.stderr, out)
-    assert "done: 200 steps on 2 ranks" in out, out
+    assert expect in out, out
+    return out
+
+
+def test_jax_elastic_train():
+    """The jax elastic example completes under the elastic driver at a
+    fixed size of 2 and converges (later-reference elastic example
+    role)."""
+    out = _run_elastic_example("jax_elastic_train.py",
+                               "done: 200 steps on 2 ranks")
     err = float(out.split("|w - w*| = ")[1].split()[0])
     assert err < 0.05, out
+
+
+def test_pytorch_mnist_elastic():
+    """The elastic pytorch example (upstream pytorch_mnist_elastic role)
+    completes under the elastic driver."""
+    _run_elastic_example("pytorch_mnist_elastic.py",
+                         "done: 2 epochs on 2 ranks")
