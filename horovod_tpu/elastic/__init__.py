@@ -52,6 +52,7 @@ __all__ = [
     "ObjectState",
     "JaxState",
     "TorchState",
+    "TensorFlowState",
     "TensorFlowKerasState",
     "HostsUpdatedInterrupt",
 ]
@@ -540,6 +541,60 @@ class TorchState(ObjectState):
                 hvd_torch.broadcast_optimizer_state(
                     self.optimizer, root_rank=root
                 )
+        super().sync()
+
+
+class TensorFlowState(ObjectState):
+    """State over raw ``tf.Variable`` collections (upstream
+    ``horovod.tensorflow.elastic.TensorFlowState`` role): pass the
+    variables plus plain counters. ``variables`` may be a CALLABLE
+    (e.g. ``lambda: model.trainable_variables``) so lazily built
+    variables are picked up at every save/restore/sync; a plain list is
+    frozen at construction — commit after the model is built, or a
+    count mismatch is warned about and the optimizer-style half-restore
+    skipped. sync broadcasts the sync root's values through the TF
+    binding's ``broadcast_variables``."""
+
+    def __init__(self, variables=None, **kwargs: Any) -> None:
+        self.variables = (
+            variables if callable(variables)
+            else list(variables) if variables is not None else []
+        )
+        super().__init__(**kwargs)
+
+    def _vars(self) -> list:
+        return list(self.variables() if callable(self.variables)
+                    else self.variables)
+
+    def save(self) -> None:
+        super().save()
+        import numpy as np
+
+        self._saved_vars = [np.array(v) for v in self._vars()]
+
+    def restore(self) -> None:
+        super().restore()
+        cur = self._vars()
+        if len(cur) != len(self._saved_vars):
+            logger.warning(
+                "elastic: variable count changed since the last snapshot "
+                "(%d saved vs %d now); variables were NOT rolled back — "
+                "commit() after the model is built, or pass a callable "
+                "so new variables are tracked",
+                len(self._saved_vars), len(cur),
+            )
+            return
+        for var, val in zip(cur, self._saved_vars):
+            var.assign(val)
+
+    def sync(self) -> None:
+        import horovod_tpu as hvd
+
+        cur = self._vars()
+        if hvd.size() > 1 and cur:
+            from ..tensorflow import broadcast_variables as _tf_bcast
+
+            _tf_bcast(cur, root_rank=_sync_root())
         super().sync()
 
 

@@ -1,0 +1,23 @@
+"""Elastic API for the TensorFlow binding (upstream
+``horovod.tensorflow.elastic``): ``run`` and ``TensorFlowState`` (raw
+``tf.Variable`` collections + plain counters) re-exported from the core
+elastic module. For Keras models use ``horovod_tpu.keras.elastic``.
+"""
+
+from __future__ import annotations
+
+from ..elastic import (  # noqa: F401
+    HostsUpdatedInterrupt,
+    ObjectState,
+    State,
+    TensorFlowState,
+    run,
+)
+
+__all__ = [
+    "run",
+    "State",
+    "ObjectState",
+    "TensorFlowState",
+    "HostsUpdatedInterrupt",
+]

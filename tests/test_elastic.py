@@ -536,3 +536,73 @@ def test_keras_elastic_callbacks():
     state.restore()
     for a, b in zip(model.get_weights(), committed):
         np.testing.assert_allclose(np.asarray(a), b)
+
+
+def test_tensorflow_state_primitives():
+    """TensorFlowState (upstream horovod.tensorflow.elastic role):
+    commit/restore over raw tf.Variables."""
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+
+    import horovod_tpu.tensorflow.elastic as tfelastic
+
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    st = tfelastic.TensorFlowState([v1, v2], step=0)
+    st.commit()
+    v1.assign([9.0, 9.0])
+    v2.assign([[9.0]])
+    st.step = 7
+    st.restore()
+    assert st.step == 0
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(v2.numpy(), [[3.0]])
+
+
+def test_elastic_repeated_crashes_stress():
+    """Stress: the SAME job survives THREE separate crash/re-formation
+    cycles (different workers, different steps) and still converges to
+    consistent state on every rank."""
+    proc, outs = _run_elastic(
+        """
+        state = elastic.JaxState(w=np.zeros((2,), np.float32), step=0)
+        crashes = [('localhost:1', 3), ('localhost:0', 7),
+                   ('localhost:2', 11)]
+
+        @elastic.run
+        def train(state):
+            while state.step < 15:
+                g = hvd.allreduce(jnp.ones((2,), jnp.float32),
+                                  op=hvd.Average, name='grad')
+                state.w = np.asarray(g) + np.asarray(state.w)
+                state.step += 1
+                for i, (wid, at) in enumerate(crashes):
+                    flag = os.path.join(td, f'crashed{i}')
+                    if (os.environ['HOROVOD_ELASTIC_WORKER_ID'] == wid
+                            and state.step == at
+                            and not os.path.exists(flag)):
+                        open(flag, 'w').close()
+                        os._exit(30 + i)
+                state.commit()
+            return state.step
+
+        train(state)
+        print('FINAL', hvd.rank(), hvd.size(), state.step,
+              float(np.asarray(state.w)[0]), flush=True)
+        hvd.shutdown()
+        """,
+        ["-np", "3", "--min-np", "3", "--max-np", "3",
+         "--blacklist-threshold", "10"],
+        timeout=420,
+    )
+    stderr = proc.stderr.decode()
+    assert proc.returncode == 0, (stderr, outs)
+    for code in ("30", "31", "32"):
+        assert f"failed with exit code {code}" in stderr, stderr
+    assert "generation 4" in stderr, stderr
+    finals = [l for o in outs.values() for l in o.splitlines()
+              if l.startswith("FINAL")]
+    assert len(finals) == 3, (finals, stderr)
+    for line in finals:
+        _, rank, size, step, w0 = line.split()
+        assert size == "3" and step == "15" and float(w0) == 15.0, finals
