@@ -146,7 +146,9 @@ class NativeRuntime:
         process_set_id: int = 0,
     ) -> int:
         if not self.running:
-            raise RuntimeError(
+            from .. import HorovodInternalError
+
+            raise HorovodInternalError(
                 "Horovod runtime is shut down or was never initialized; "
                 "call hvd.init() first."
             )
@@ -172,18 +174,38 @@ class NativeRuntime:
         except _CoreError as e:
             with self._entries_lock:
                 q = self._entries.get(name)
-                if q:
-                    q.remove(entry)
+                # The entry may already have been consumed by the
+                # executor-exit drain (which fired its callback); only the
+                # thread that removes it owns the completion. Identity
+                # comparison — dataclass equality would compare tensor
+                # payloads (ambiguous for arrays, and an equal-valued
+                # sibling entry must not be confused with ours).
+                idx = next(
+                    (i for i, e in enumerate(q or ()) if e is entry), None
+                )
+                owned = idx is not None
+                if owned:
+                    del q[idx]
                     if not q:
                         del self._entries[name]
+            status = Status(
+                StatusType(e.code if 0 < e.code <= 5 else 1), str(e)
+            )
+            # Callback-completed consumers (TF async op kernels) wait on
+            # the callback, not the handle — fire it or they hang forever
+            # when the core is already down.
+            if owned and entry.callback is not None:
+                try:
+                    entry.callback(status, None)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "error callback for %s raised", entry.name
+                    )
             # Surface as a failed handle, like the reference's callback
             # error path.
             with self._cv:
                 fake = -int(time.monotonic_ns() % (1 << 62)) - 1
-                self._done[fake] = (
-                    Status(StatusType(e.code if 0 < e.code <= 5 else 1), str(e)),
-                    None,
-                )
+                self._done[fake] = (status, None)
                 return fake
         with self._cv:
             self._ticket_names[ticket] = name
@@ -212,7 +234,9 @@ class NativeRuntime:
 
     def enqueue_join(self) -> int:
         if not self.running:
-            raise RuntimeError("Horovod runtime is shut down.")
+            from .. import HorovodInternalError
+
+            raise HorovodInternalError("Horovod runtime is shut down.")
         return self.core.enqueue_join()
 
     # --- process sets (later-reference horovod.ProcessSet parity) ---
@@ -242,13 +266,44 @@ class NativeRuntime:
 
     # --- executor loop ---
     def _executor_loop(self) -> None:
-        while not self._stop.is_set():
-            plan = self.core.next_plan(timeout_ms=100)
-            if plan == -1:
-                break
-            if plan in (0, -2):
-                continue
-            self._execute_plan(plan)
+        try:
+            while not self._stop.is_set():
+                plan = self.core.next_plan(timeout_ms=100)
+                if plan == -1:
+                    break
+                if plan in (0, -2):
+                    continue
+                self._execute_plan(plan)
+        finally:
+            # Core is down (peer loss, shutdown) or the loop itself died:
+            # entries that never made it into a plan still hold
+            # completion callbacks — e.g. TF async op kernels blocked
+            # inside a tf.function train step. Fire them with an error so
+            # graph-mode training surfaces the failure instead of hanging
+            # forever (the handle-based waiters are failed by the core's
+            # own FailAll). try/finally: an exception escaping the loop
+            # must still drain, or the hang returns.
+            self._drain_entry_callbacks(
+                Status.Aborted(
+                    "Horovod control plane is down (peer loss or "
+                    "shutdown)."
+                )
+            )
+
+    def _drain_entry_callbacks(self, status: Status) -> None:
+        with self._entries_lock:
+            orphaned = [
+                e for q in self._entries.values() for e in q
+            ]
+            self._entries.clear()
+        for entry in orphaned:
+            if entry.callback is not None:
+                try:
+                    entry.callback(status, None)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "error callback for %s raised", entry.name
+                    )
         # drain: nothing further; core fails outstanding tickets itself.
 
     def _execute_plan(self, plan: dict) -> None:

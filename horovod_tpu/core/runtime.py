@@ -486,7 +486,9 @@ class Runtime:
         process_set_id: int = 0,
     ) -> int:
         if self._shutdown.is_set() or self._thread is None:
-            raise RuntimeError(
+            from .. import HorovodInternalError
+
+            raise HorovodInternalError(
                 "Horovod runtime is shut down or was never initialized; "
                 "call hvd.init() first."
             )

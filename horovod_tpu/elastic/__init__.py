@@ -180,12 +180,15 @@ def _jax_distributed_initialize(coord: str, num: int, pid: int) -> None:
     - The client is failure-tolerant: ``recoverable=True`` (peer death is
       swallowed by the agent and surfaces as failed collectives, which the
       runtime turns into ``HorovodInternalError`` → rollback) and
-      ``shutdown_on_destruction=False`` (leaving a world never issues the
-      ShutdownTask RPC, whose race against a dying service is fatal).
-      No ``missed_heartbeat_callback`` — the pybind functional bridge
-      std::bad_cast-aborts when the agent's error-poll thread invokes a
-      Python callback (jaxlib 0.9), and the driver-hosted service keeps
-      heartbeats answerable for stragglers anyway."""
+      ``shutdown_on_destruction=False`` — OBJECT DESTRUCTION never issues
+      the ShutdownTask RPC; the explicit, graceful
+      ``_jax_distributed_teardown`` shuts the client down instead (safe
+      because the driver-hosted service is alive to answer), which stops
+      the error-poll/heartbeat threads before the channel dies under
+      them. No ``missed_heartbeat_callback`` — the pybind functional
+      bridge std::bad_cast-aborts when the agent's error-poll thread
+      invokes a Python callback (jaxlib 0.9), and the driver-hosted
+      service keeps heartbeats answerable for stragglers anyway."""
     from jax._src import distributed as _dist
     from jax._src.lib import _jax as _jaxlib
 
@@ -211,10 +214,13 @@ def _jax_distributed_initialize(coord: str, num: int, pid: int) -> None:
 
 
 def _jax_distributed_teardown() -> None:
-    """Drop this process out of the current world WITHOUT the graceful
-    shutdown-barrier RPC (the world may be half dead): release the client
-    (built with ``shutdown_on_destruction=False``) and, on the coordinator,
-    stop the service."""
+    """Leave the current world. The client's background error-poll and
+    heartbeat threads treat a dying channel as FATAL (client.h), so the
+    client must be shut down gracefully BEFORE the object is dropped —
+    safe here because the coordination service lives in the always-alive
+    driver (a live endpoint to answer the ShutdownTask RPC) and the
+    recoverable flag waives the shutdown barrier; a short-lived failure
+    of that RPC is swallowed rather than escalated."""
     from jax._src import distributed as _dist
 
     state = _dist.global_state
@@ -224,6 +230,11 @@ def _jax_distributed_teardown() -> None:
         except Exception:  # noqa: BLE001
             pass
         state.preemption_sync_manager = None
+    if state.client is not None:
+        try:
+            state.client.shutdown()
+        except Exception as exc:  # noqa: BLE001 - half-dead world
+            logger.info("elastic: client shutdown reported %s", exc)
     state.client = None
     if state.service is not None:
         try:
@@ -573,17 +584,21 @@ class TensorFlowState(ObjectState):
         self._saved_vars = [np.array(v) for v in self._vars()]
 
     def restore(self) -> None:
-        super().restore()
         cur = self._vars()
         if len(cur) != len(self._saved_vars):
+            # Nothing is rolled back — counters included: a half-restore
+            # (old counters, new weights) would silently re-apply
+            # training on already-trained weights if this rank becomes
+            # the sync root.
             logger.warning(
                 "elastic: variable count changed since the last snapshot "
-                "(%d saved vs %d now); variables were NOT rolled back — "
+                "(%d saved vs %d now); NOTHING was rolled back — "
                 "commit() after the model is built, or pass a callable "
                 "so new variables are tracked",
                 len(self._saved_vars), len(cur),
             )
             return
+        super().restore()
         for var, val in zip(cur, self._saved_vars):
             var.assign(val)
 
@@ -675,6 +690,37 @@ class TensorFlowKerasState(ObjectState):
         super().sync()
 
 
+def _is_collective_failure(exc: BaseException) -> bool:
+    """True when ``exc`` is (or wraps) a failed collective. Framework
+    runtimes re-raise our op failures under their own exception types —
+    a TF async op kernel fails a ``tf.function`` step with
+    ``tf.errors.InternalError`` carrying the collective's message — so
+    the elastic wrapper matches on origin + message, not only on
+    ``HorovodInternalError`` (upstream's TF elastic does the same)."""
+    import horovod_tpu as hvd
+
+    if isinstance(exc, hvd.HorovodInternalError):
+        return True
+    if type(exc).__module__.partition(".")[0] == "tensorflow":
+        # Only failures our own runtime emits into failed op kernels — a
+        # deterministic user error inside a horovod-named op (shape
+        # mismatch, unregistered op) must SURFACE, not spin the rollback
+        # loop forever. Every graph-op failure carries the stable
+        # [hvd-collective-failure] prefix (graph_ops.finish_error); the
+        # remaining substrings cover enqueue-time raises that reach TF
+        # before an op kernel exists.
+        msg = str(exc)
+        return ("[hvd-collective-failure]" in msg
+                or "Horovod control plane" in msg
+                or "Horovod has been shut down" in msg
+                or "lost a peer rank" in msg
+                or "lost the coordinator" in msg
+                # Enqueue raced the teardown of a dying world:
+                or "core is not running" in msg
+                or "Horovod runtime is shut down" in msg)
+    return False
+
+
 # ------------------------------------------------------------------- run
 def run(func: Callable) -> Callable:
     """Decorator making ``func(state, *args)`` elastic (upstream
@@ -701,17 +747,19 @@ def run(func: Callable) -> Callable:
                 # future generation's sync source.
                 ctx.confirm_joined()
                 return func(state, *args, **kwargs)
-            except hvd.HorovodInternalError as exc:
-                logger.warning(
-                    "elastic: collective failure (%s); rolling back to the "
-                    "last commit and rejoining", exc,
-                )
-                state.restore()
             except HostsUpdatedInterrupt:
                 logger.info(
                     "elastic: membership change; rejoining with current "
                     "state"
                 )
+            except Exception as exc:  # noqa: BLE001 - filtered below
+                if not _is_collective_failure(exc):
+                    raise
+                logger.warning(
+                    "elastic: collective failure (%s); rolling back to the "
+                    "last commit and rejoining", exc,
+                )
+                state.restore()
             _rejoin(ctx)
             state.on_reset()
 

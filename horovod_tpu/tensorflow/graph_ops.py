@@ -117,7 +117,14 @@ def _trampoline(handle, out_index, kind, ptr, shape, tf_dtype, name,
     buf = (ctypes.c_char * (n * np_dtype.itemsize)).from_address(ptr)
     view = np.frombuffer(buf, dtype=np_dtype).reshape(shape)
 
-    def finish_error(msg: str) -> None:
+    def finish_error(msg: str, runtime_failure: bool = False) -> None:
+        # [hvd-collective-failure] is the stable marker elastic's
+        # matcher keys on (horovod_tpu/elastic: _is_collective_failure).
+        # ONLY runtime failures carry it — a deterministic validation
+        # error (int64 range, unknown kind, duplicate name) must surface
+        # to the user, not spin the elastic rollback loop forever.
+        if runtime_failure:
+            msg = f"[hvd-collective-failure] {msg}"
         cdll.hvd_tf_finish(
             ctypes.c_longlong(handle), out_index, 1, msg.encode(),
             None, None, 0, ctypes.c_longlong(0),
@@ -137,7 +144,8 @@ def _trampoline(handle, out_index, kind, ptr, shape, tf_dtype, name,
     def callback(status, output) -> None:
         try:
             if not status.ok():
-                finish_error(status.reason or "collective failed")
+                finish_error(status.reason or "collective failed",
+                             runtime_failure=True)
                 return
             out = np.asarray(output)
             if out.dtype != np_dtype:
@@ -179,7 +187,12 @@ def _trampoline(handle, out_index, kind, ptr, shape, tf_dtype, name,
         else:
             finish_error(f"unknown collective kind {kind!r}")
     except Exception as exc:  # noqa: BLE001
-        finish_error(str(exc))
+        import horovod_tpu as _hvd
+
+        finish_error(
+            str(exc),
+            runtime_failure=isinstance(exc, _hvd.HorovodInternalError),
+        )
 
 
 def load():
