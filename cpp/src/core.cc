@@ -1135,10 +1135,16 @@ void Core::FuseAndEmit(
   int64_t threshold = params_.fusion_threshold();
   std::vector<bool> used(ready.size(), false);
   std::map<int64_t, int> group_responses;
+  std::set<int64_t> group_fusable;
   for (size_t i = 0; i < ready.size(); ++i) {
     if (used[i]) continue;
     const Request& base = ready[i];
-    if (base.group_id != 0) ++group_responses[base.group_id];
+    const bool fusable_type = base.type == RequestType::kAllreduce ||
+                              base.type == RequestType::kAdasum;
+    if (base.group_id != 0) {
+      ++group_responses[base.group_id];
+      if (fusable_type) group_fusable.insert(base.group_id);
+    }
     Response r;
     r.group_id = base.group_id;
     r.process_set_id = base.process_set_id;
@@ -1196,9 +1202,7 @@ void Core::FuseAndEmit(
       }
     }
     used[i] = true;
-    bool fusable = base.type == RequestType::kAllreduce ||
-                   base.type == RequestType::kAdasum;
-    if (fusable) {
+    if (fusable_type) {
       for (size_t j = i + 1; j < ready.size(); ++j) {
         if (used[j]) continue;
         const Request& cand = ready[j];
@@ -1223,7 +1227,11 @@ void Core::FuseAndEmit(
     out->responses.push_back(std::move(r));
   }
   for (auto& [gid, n] : group_responses) {
-    if (n > 1) {
+    // Only allreduce/adasum groups are expected to fuse into ONE
+    // response; a grouped allgather/reducescatter intentionally yields
+    // one per-member plan (they only share the atomic HOLD), so multiple
+    // responses there are by design, not a signature split.
+    if (n > 1 && group_fusable.count(gid)) {
       grouped_splits_ += n - 1;
       HVD_LOG(kWarn, "grouped collective " + std::to_string(gid) +
                          " split into " + std::to_string(n) +

@@ -539,10 +539,12 @@ def allreduce(
 
 
 def allgather_async(tensor: Any, name: Optional[str] = None,
-                    process_set: Optional[ProcessSet] = None) -> int:
+                    process_set: Optional[ProcessSet] = None,
+                    _group: tuple = (0, 0)) -> int:
     return _rt().enqueue_allgather(
         _auto_name("allgather", name), tensor,
         process_set_id=_psid(process_set),
+        group_id=_group[0], group_size=_group[1],
     )
 
 
@@ -672,6 +674,7 @@ def alltoall(tensor: Any, splits: Any = None, name: Optional[str] = None,
 def reducescatter_async(
     tensor: Any, name: Optional[str] = None, op: Optional[ReduceOp] = None,
     process_set: Optional[ProcessSet] = None,
+    _group: tuple = (0, 0),
 ) -> int:
     """Sum/average across ranks, scatter dim0 shards: rank r receives its
     dim0 shard of the reduction — ``d//size`` rows each when ``size``
@@ -692,6 +695,7 @@ def reducescatter_async(
     return _rt().enqueue_reducescatter(
         _auto_name("reducescatter", name), tensor, reduce_op=op,
         process_set_id=_psid(process_set),
+        group_id=_group[0], group_size=_group[1],
     )
 
 
@@ -724,28 +728,92 @@ def grouped_allreduce_async(
     stall inspector warns and can shut the job down) — validate inputs
     before submission when cross-rank failure atomicity matters."""
     base = name if name is not None else _auto_name("grouped_allreduce", None)
-    tensors = list(tensors)
-    # Validate every member before enqueuing any: a mid-group failure
-    # leaves peers holding an incompletable group (see _drain_group).
+    return _grouped_async(
+        lambda t, n, g: allreduce_async(
+            t, average=average, name=n, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set, _group=g,
+        ),
+        tensors, base,
+    )
+
+
+def _grouped_async(enqueue_one, tensors, base, validate_one=None) -> list:
+    """Shared grouped-submission shape (the later reference's grouped
+    APIs): every member carries the group id + count, so the coordinator
+    HOLDS the group until all members are ready on all ranks — members
+    complete together (one per-member plan; only allreduce groups
+    additionally fuse into a single buffer). Every member is validated
+    BEFORE any is enqueued: a mid-group failure would leave peers
+    holding a never-completable group (see ``_drain_group``)."""
     from .common.types import dtype_from_array
 
+    tensors = list(tensors)
     for t in tensors:
         dtype_from_array(t)
+        if validate_one is not None:
+            validate_one(t)
     gid = _group_id(base)
     handles = []
     try:
         for i, t in enumerate(tensors):
-            handles.append(allreduce_async(
-                t, average=average, name=f"{base}.{i}", op=op,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-                process_set=process_set,
-                _group=(gid, len(tensors)),
-            ))
+            handles.append(
+                enqueue_one(t, f"{base}.{i}", (gid, len(tensors)))
+            )
     except Exception:
         _drain_group(handles)
         raise
     return handles
+
+
+def grouped_allgather_async(tensors, name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None):
+    """Allgather a list of tensors as ONE group: the coordinator holds
+    the members until every one is ready on every rank, so they complete
+    atomically (later-reference ``grouped_allgather``)."""
+    base = name if name is not None else _auto_name("grouped_allgather", None)
+    return _grouped_async(
+        lambda t, n, g: allgather_async(t, n, process_set, _group=g),
+        tensors, base,
+    )
+
+
+def grouped_allgather(tensors, name: Optional[str] = None,
+                      process_set: Optional[ProcessSet] = None):
+    return [synchronize(h) for h in
+            grouped_allgather_async(tensors, name, process_set)]
+
+
+def grouped_reducescatter_async(tensors, name: Optional[str] = None,
+                                op: Optional[ReduceOp] = None,
+                                process_set: Optional[ProcessSet] = None):
+    """Reduce-scatter a list of tensors as ONE group (atomic completion;
+    later-reference ``grouped_reducescatter``)."""
+    base = (name if name is not None
+            else _auto_name("grouped_reducescatter", None))
+    rs_op = op if op is not None else ReduceOp.SUM
+    if rs_op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM/AVERAGE only")
+
+    def validate_one(t):
+        if not getattr(t, "shape", ()):
+            raise ValueError(
+                "reducescatter needs a tensor with a dim0 to scatter"
+            )
+
+    return _grouped_async(
+        lambda t, n, g: reducescatter_async(t, n, op, process_set,
+                                            _group=g),
+        tensors, base, validate_one=validate_one,
+    )
+
+
+def grouped_reducescatter(tensors, name: Optional[str] = None,
+                          op: Optional[ReduceOp] = None,
+                          process_set: Optional[ProcessSet] = None):
+    return [synchronize(h) for h in
+            grouped_reducescatter_async(tensors, name, op, process_set)]
 
 
 def _group_id(base: str) -> int:
@@ -909,6 +977,10 @@ __all__ = [
     "remove_process_set",
     "join",
     "barrier",
+    "grouped_allgather",
+    "grouped_allgather_async",
+    "grouped_reducescatter",
+    "grouped_reducescatter_async",
     "poll",
     "synchronize",
     "broadcast_variables",

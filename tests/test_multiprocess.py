@@ -1419,3 +1419,34 @@ def test_barrier_two_ranks():
     )
     assert "BARRIER 0 True" in outs[0], outs
     assert "BARRIER 1 True" in outs[1], outs
+
+
+def test_grouped_allgather_reducescatter_two_ranks():
+    """grouped_allgather / grouped_reducescatter (later-reference v0.28):
+    heterogeneous members complete atomically as one held group."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        r = hvd.rank()
+        outs = hvd.grouped_allgather([
+            jnp.full((1, 2), float(r), jnp.float32),       # -> (2, 2)
+            jnp.full((3,), float(10 + r), jnp.float32),    # -> (6,)
+        ], name="gag")
+        print("GAG", [np.asarray(o).reshape(-1).tolist() for o in outs])
+        rs = hvd.grouped_reducescatter([
+            jnp.full((2,), float(r + 1), jnp.float32),     # sum=[3,3]
+            jnp.asarray(np.arange(4, dtype=np.float32)),   # sum=2*arange
+        ], name="grs")
+        print("GRS", [np.asarray(o).tolist() for o in rs])
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert ("GAG [[0.0, 0.0, 1.0, 1.0], "
+                "[10.0, 10.0, 10.0, 11.0, 11.0, 11.0]]") in out, outs
+    assert "GRS [[3.0], [0.0, 2.0]]" in outs[0], outs
+    assert "GRS [[3.0], [4.0, 6.0]]" in outs[1], outs
