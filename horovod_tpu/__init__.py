@@ -781,8 +781,9 @@ def grouped_allgather_async(tensors, name: Optional[str] = None,
 
 def grouped_allgather(tensors, name: Optional[str] = None,
                       process_set: Optional[ProcessSet] = None):
-    return [synchronize(h) for h in
-            grouped_allgather_async(tensors, name, process_set)]
+    return grouped_sync_first_error(
+        grouped_allgather_async(tensors, name, process_set), synchronize
+    )
 
 
 def grouped_reducescatter_async(tensors, name: Optional[str] = None,
@@ -812,8 +813,10 @@ def grouped_reducescatter_async(tensors, name: Optional[str] = None,
 def grouped_reducescatter(tensors, name: Optional[str] = None,
                           op: Optional[ReduceOp] = None,
                           process_set: Optional[ProcessSet] = None):
-    return [synchronize(h) for h in
-            grouped_reducescatter_async(tensors, name, op, process_set)]
+    return grouped_sync_first_error(
+        grouped_reducescatter_async(tensors, name, op, process_set),
+        synchronize,
+    )
 
 
 def _group_id(base: str) -> int:

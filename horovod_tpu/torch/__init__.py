@@ -61,10 +61,11 @@ class _DistributedOptimizer:
 
     def __init__(self, optimizer, named_parameters=None,
                  compression=Compression.none, backward_passes_per_step=1,
-                 op=Average):
+                 op=Average, sparse_as_dense=False):
         self._opt = optimizer
         self._compression = compression
         self._op = op
+        self._sparse_as_dense = sparse_as_dense
         self.backward_passes_per_step = backward_passes_per_step
         if named_parameters is not None:
             named = list(named_parameters)
@@ -116,8 +117,25 @@ class _DistributedOptimizer:
                 )
 
     def _allreduce_grad_async(self, p) -> None:
+        import torch
+
         name = self._param_names.get(p, f"param.{id(p)}")
         grad = p.grad
+        if grad.is_sparse:
+            # Sparse (embedding) gradients: the XLA wire is dense-only.
+            # With sparse_as_dense=True the gradient densifies before the
+            # allreduce (reference DistributedOptimizer option); without
+            # it, fail with the reference's guidance instead of a deep
+            # DLPack error.
+            if not self._sparse_as_dense:
+                raise ValueError(
+                    "Gradient for parameter is sparse; construct "
+                    "DistributedOptimizer with sparse_as_dense=True to "
+                    "densify sparse gradients before the allreduce."
+                )
+            grad = grad.to_dense()
+            with torch.no_grad():
+                p.grad = grad
         if self.backward_passes_per_step > 1:
             grad = grad / self.backward_passes_per_step
         compressed, ctx = self._compression.compress(grad)
@@ -326,11 +344,20 @@ class _DistributedAdasumOptimizer:
 
 def DistributedOptimizer(optimizer, named_parameters=None,  # noqa: N802
                          compression=Compression.none,
-                         backward_passes_per_step=1, op=Average):
+                         backward_passes_per_step=1, op=Average,
+                         sparse_as_dense=False):
     """API parity with ``hvd.DistributedOptimizer``
     (``horovod/torch/__init__.py:381-435``): ``op=Adasum`` dispatches to
-    the delta-space Adasum optimizer exactly as the reference does."""
+    the delta-space Adasum optimizer exactly as the reference does;
+    ``sparse_as_dense`` densifies sparse (embedding) gradients before
+    the allreduce."""
     if op == Adasum:
+        if sparse_as_dense:
+            raise ValueError(
+                "sparse_as_dense is not supported with op=Adasum: the "
+                "delta-space Adasum optimizer reduces parameter deltas "
+                "(always dense), not gradients."
+            )
         return _DistributedAdasumOptimizer(
             optimizer, named_parameters=named_parameters,
             compression=compression,
@@ -339,6 +366,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,  # noqa: N802
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters, compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
+        sparse_as_dense=sparse_as_dense,
     )
 
 

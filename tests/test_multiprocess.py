@@ -1450,3 +1450,49 @@ def test_grouped_allgather_reducescatter_two_ranks():
                 "[10.0, 10.0, 10.0, 11.0, 11.0, 11.0]]") in out, outs
     assert "GRS [[3.0], [0.0, 2.0]]" in outs[0], outs
     assert "GRS [[3.0], [4.0, 6.0]]" in outs[1], outs
+
+
+def test_torch_sparse_as_dense_two_ranks():
+    """sparse_as_dense (reference DistributedOptimizer option): sparse
+    embedding gradients densify before the allreduce; without the flag
+    the submission fails with actionable guidance."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import torch
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        r = hvd.rank()
+        torch.manual_seed(0)
+        emb = torch.nn.Embedding(8, 4, sparse=True)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=0.1),
+            named_parameters=emb.named_parameters(),
+            sparse_as_dense=True)
+        # rank r touches rows {r, 4}: row 4 overlaps, rows 0/1 disjoint
+        idx = torch.tensor([r, 4])
+        emb(idx).sum().backward()
+        opt.step()
+        w = emb.weight.detach()
+        print("SPARSE", [round(float(x), 4) for x in w.sum(1)[:5]])
+
+        emb2 = torch.nn.Embedding(4, 2, sparse=True)
+        opt2 = hvd.DistributedOptimizer(
+            torch.optim.SGD(emb2.parameters(), lr=0.1),
+            named_parameters=emb2.named_parameters())
+        try:
+            emb2(torch.tensor([0])).sum().backward()
+            opt2.step()
+            print("NOERR")
+        except Exception as e:   # raised from the grad hook in backward
+            print("SPARSE_ERR", "sparse_as_dense" in str(e))
+        hvd.shutdown()
+        """
+    )
+    vals = None
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("SPARSE ")][0]
+        vals = vals or line
+        assert line == vals, outs          # identical updates both ranks
+        assert "SPARSE_ERR True" in out, outs
