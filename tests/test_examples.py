@@ -179,3 +179,10 @@ def test_pytorch_mnist_elastic():
     completes under the elastic driver."""
     _run_elastic_example("pytorch_mnist_elastic.py",
                          "done: 2 epochs on 2 ranks")
+
+
+def test_tensorflow2_keras_mnist_elastic():
+    """The elastic Keras example (upstream tensorflow2_keras_mnist_elastic
+    role) completes under the elastic driver."""
+    _run_elastic_example("tensorflow2_keras_mnist_elastic.py",
+                         "done: 4 epochs on 2 ranks")
