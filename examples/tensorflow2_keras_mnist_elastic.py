@@ -50,12 +50,15 @@ def main() -> None:
     y = g.randint(0, 10, (512,)).astype("int64")
 
     state = elastic.KerasState(model, batch=0, epoch=0)
-    state.register_reset_callbacks([
-        lambda: print(
-            f"[rank {hvd.rank()}] world re-formed: size {hvd.size()}",
-            flush=True,
-        )
-    ])
+
+    def on_reset():
+        # LR scales with the world (upstream's elastic example does the
+        # same): gradients now average over the new rank count.
+        model.optimizer.learning_rate.assign(BASE_LR * hvd.size())
+        print(f"[rank {hvd.rank()}] world re-formed: size {hvd.size()}",
+              flush=True)
+
+    state.register_reset_callbacks([on_reset])
 
     @elastic.run
     def train(state):
