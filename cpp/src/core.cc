@@ -397,6 +397,17 @@ Status Core::Init(const CoreConfig& cfg) {
   }
   shutdown_ = false;
   joined_ = false;
+  {
+    // Shutdown() sets wake_ to rouse the old loop; a re-init must not
+    // inherit it — a stale wake fires one immediate cycle on the fresh
+    // core, defeating the fixed cadence until the first real wakeup.
+    // last_cycle_nreq_ likewise: a solo final cycle of the OLD world
+    // would put the fresh world's first burst on the 100us solo-seal
+    // path instead of the full fusion window.
+    std::lock_guard<std::mutex> l(table_mu_);
+    wake_ = false;
+    last_cycle_nreq_ = 2;
+  }
   thread_ = std::thread(&Core::BackgroundLoop, this);
   initialized_ = true;
   HVD_LOG(kDebug, "core initialized");
