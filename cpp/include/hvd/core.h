@@ -63,7 +63,6 @@ class Timeline {
 
  private:
   int Tid(const std::string& tensor);
-  void Emit(const std::string& json);
   void WriterLoop();
   double NowUs();
 
@@ -275,6 +274,10 @@ class Core {
   int tuned_flags() const { return params_.Flags(); }
 
   Timeline& timeline() { return timeline_; }
+  // Runtime timeline control (later-reference hvd.start_timeline /
+  // stop_timeline): start/stop the catapult writer while training runs.
+  Status StartTimeline(const std::string& path, bool mark_cycles);
+  void StopTimeline();
   size_t cache_size() const { return cache_.size(); }
 
  private:
@@ -374,6 +377,7 @@ class Core {
   StallInspector stall_;
   ParameterManager params_;
   Timeline timeline_;
+  std::atomic<bool> timeline_mark_cycles_{true};
   ControlTransport* transport_ = nullptr;
 };
 

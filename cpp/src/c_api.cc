@@ -242,6 +242,16 @@ long long hvd_core_fusion_threshold() {
   return Core::Get().fusion_threshold();
 }
 
+// Runtime timeline control (later-reference hvd.start_timeline /
+// stop_timeline). Returns 0 ok, nonzero = StatusCode.
+int hvd_core_start_timeline(const char* path, int mark_cycles) {
+  hvd::Status s = Core::Get().StartTimeline(path ? path : "",
+                                            mark_cycles != 0);
+  return static_cast<int>(s.code);
+}
+
+void hvd_core_stop_timeline() { Core::Get().StopTimeline(); }
+
 void hvd_core_timeline_activity(const char* tensor, const char* activity,
                                 int begin) {
   if (!tensor || !activity) return;

@@ -39,6 +39,11 @@ void Log(LogLevel level, const std::string& msg) {
 
 // ------------------------------------------------------------ timeline
 void Timeline::Initialize(const std::string& path, int rank) {
+  // All shared-state writes under mu_: runtime start/stop
+  // (hvd.start_timeline) races recording threads, which read
+  // start_/rank_/queue_ under the same lock after re-checking
+  // initialized_.
+  std::lock_guard<std::mutex> l(mu_);
   if (initialized_.load() || path.empty()) return;
   file_ = std::fopen(path.c_str(), "w");
   if (!file_) {
@@ -50,6 +55,10 @@ void Timeline::Initialize(const std::string& path, int rank) {
   std::fputs("[\n", file_);
   first_event_ = true;
   stop_ = false;
+  // A restarted session must re-emit thread_name metadata into ITS file.
+  tids_.clear();
+  next_tid_ = 1;
+  queue_.clear();
   writer_ = std::thread(&Timeline::WriterLoop, this);
   initialized_ = true;
   char buf[256];
@@ -57,21 +66,27 @@ void Timeline::Initialize(const std::string& path, int rank) {
                 "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":%d,"
                 "\"args\":{\"name\":\"rank %d\"}}",
                 rank_, rank_);
-  Emit(buf);
+  queue_.push_back(buf);
+  cv_.notify_one();
 }
 
 void Timeline::Shutdown() {
-  if (!initialized_.load()) return;
   {
+    // Flip initialized_ first, under the lock: recorders re-check it
+    // after acquiring mu_, so no event can slip in past this point and
+    // leak into the next session's file.
     std::lock_guard<std::mutex> l(mu_);
+    if (!initialized_.load()) return;
+    initialized_ = false;
     stop_ = true;
   }
   cv_.notify_all();
   if (writer_.joinable()) writer_.join();
+  std::lock_guard<std::mutex> l(mu_);
   std::fputs("\n]\n", file_);
   std::fclose(file_);
   file_ = nullptr;
-  initialized_ = false;
+  queue_.clear();
 }
 
 double Timeline::NowUs() { return (NowSec() - start_) * 1e6; }
@@ -86,12 +101,6 @@ int Timeline::Tid(const std::string& tensor) {
      << ",\"tid\":" << tid << ",\"args\":{\"name\":\"" << tensor << "\"}}";
   queue_.push_back(os.str());
   return tid;
-}
-
-void Timeline::Emit(const std::string& json) {
-  std::lock_guard<std::mutex> l(mu_);
-  queue_.push_back(json);
-  cv_.notify_one();
 }
 
 void Timeline::WriterLoop() {
@@ -127,16 +136,16 @@ std::string DurEvent(const char* ph, int pid, int tid, double ts,
 
 void Timeline::NegotiateStart(const std::string& tensor,
                               const std::string& op) {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("B", rank_, tid, NowUs(), "NEGOTIATE_" + op));
   cv_.notify_one();
 }
 
 void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   int tid = Tid(tensor);
   std::ostringstream os;
   os << "{\"name\":\"" << rank << "\",\"ph\":\"i\",\"s\":\"t\",\"pid\":"
@@ -146,16 +155,16 @@ void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
 }
 
 void Timeline::NegotiateEnd(const std::string& tensor, const std::string& op) {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("E", rank_, tid, NowUs(), "NEGOTIATE_" + op));
   cv_.notify_one();
 }
 
 void Timeline::Begin(const std::string& tensor, const std::string& activity) {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("B", rank_, tid, NowUs(), activity));
   cv_.notify_one();
@@ -163,8 +172,8 @@ void Timeline::Begin(const std::string& tensor, const std::string& activity) {
 
 void Timeline::BeginPlan(const std::string& tensor,
                          const std::string& activity, uint64_t plan_id) {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   int tid = Tid(tensor);
   queue_.push_back(DurEvent(
       "B", rank_, tid, NowUs(), activity,
@@ -173,16 +182,16 @@ void Timeline::BeginPlan(const std::string& tensor,
 }
 
 void Timeline::End(const std::string& tensor, const std::string& activity) {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("E", rank_, tid, NowUs(), activity));
   cv_.notify_one();
 }
 
 void Timeline::MarkCycle() {
-  if (!initialized_.load()) return;
   std::lock_guard<std::mutex> l(mu_);
+  if (!initialized_.load()) return;
   std::ostringstream os;
   os << "{\"name\":\"CYCLE\",\"ph\":\"i\",\"s\":\"g\",\"pid\":" << rank_
      << ",\"tid\":0,\"ts\":" << NowUs() << "}";
@@ -381,6 +390,13 @@ Status Core::Init(const CoreConfig& cfg) {
   if (const char* e = std::getenv("HOROVOD_TPU_LINGER_US")) {
     linger_s_ = std::atof(e) * 1e-6;
   }
+  // HOROVOD_TIMELINE_MARK_CYCLES gates cycle marks for the env-started
+  // timeline (reference default: off; runtime start_timeline overrides
+  // per session). Re-read each Init so a prior session's override never
+  // leaks across re-init.
+  const char* mc = std::getenv("HOROVOD_TIMELINE_MARK_CYCLES");
+  timeline_mark_cycles_ =
+      mc && mc[0] && std::string(mc) != "0" && std::string(mc) != "false";
   if (cfg.timeline_path[0]) timeline_.Initialize(cfg.timeline_path, cfg.rank);
   if (cfg.size > 1) {
     if (!cfg.coord_addr[0] || cfg.coord_port == 0) {
@@ -582,6 +598,22 @@ Status Core::EnqueueJoin(uint64_t* ticket) {
   return Status::OK();
 }
 
+Status Core::StartTimeline(const std::string& path, bool mark_cycles) {
+  if (timeline_.initialized()) {
+    return Status::Error(StatusCode::kPreconditionError,
+                         "timeline is already active");
+  }
+  timeline_mark_cycles_ = mark_cycles;
+  timeline_.Initialize(path, cfg_.rank);
+  if (!timeline_.initialized()) {
+    return Status::Error(StatusCode::kUnknownError,
+                         "cannot open timeline file " + path);
+  }
+  return Status::OK();
+}
+
+void Core::StopTimeline() { timeline_.Shutdown(); }
+
 int Core::NextPlan(Plan* out, int timeout_ms) {
   std::unique_lock<std::mutex> l(plan_mu_);
   if (!plan_cv_.wait_for(l, std::chrono::milliseconds(timeout_ms),
@@ -768,7 +800,7 @@ std::vector<int32_t> BitsToList(const std::vector<uint8_t>& bits) {
 }  // namespace
 
 void Core::RunCycleOnce() {
-  timeline_.MarkCycle();
+  if (timeline_mark_cycles_.load()) timeline_.MarkCycle();
   RequestList mine;
   {
     std::lock_guard<std::mutex> l(table_mu_);

@@ -878,6 +878,18 @@ def grouped_allreduce(
     return grouped_sync_first_error(handles, synchronize)
 
 
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start the catapult timeline at runtime (later-reference
+    ``hvd.start_timeline``): same trace the ``HOROVOD_TIMELINE`` env var
+    produces, but scoped to the interesting window of a long run."""
+    _rt().start_timeline(file_path, mark_cycles)
+
+
+def stop_timeline() -> None:
+    """Stop a runtime-started timeline (later-reference API)."""
+    _rt().stop_timeline()
+
+
 def join() -> None:
     """Signal this rank is out of data; blocks until all ranks join
     (reference ``hvd.join``, ``operations.cc:910-934``)."""
@@ -980,6 +992,8 @@ __all__ = [
     "remove_process_set",
     "join",
     "barrier",
+    "start_timeline",
+    "stop_timeline",
     "grouped_allgather",
     "grouped_allgather_async",
     "grouped_reducescatter",

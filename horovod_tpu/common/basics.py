@@ -99,6 +99,9 @@ def load() -> ctypes.CDLL:
     lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
     lib.hvd_core_tuned_flags.restype = ctypes.c_int
     lib.hvd_core_cache_size.restype = ctypes.c_longlong
+    lib.hvd_core_start_timeline.restype = ctypes.c_int
+    lib.hvd_core_start_timeline.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_core_stop_timeline.restype = None
     lib.hvd_core_fusion_threshold.restype = ctypes.c_longlong
     lib.hvd_core_timeline_activity.restype = None
     lib.hvd_core_timeline_activity.argtypes = [
@@ -226,6 +229,16 @@ class NativeCore:
 
     def cache_size(self) -> int:
         return int(self.lib.hvd_core_cache_size())
+
+    def start_timeline(self, path: str, mark_cycles: bool = False) -> int:
+        """Start the catapult timeline at runtime (later-reference
+        hvd.start_timeline). Returns 0 ok, nonzero StatusCode."""
+        return int(self.lib.hvd_core_start_timeline(
+            path.encode(), 1 if mark_cycles else 0
+        ))
+
+    def stop_timeline(self) -> None:
+        self.lib.hvd_core_stop_timeline()
 
     def timeline_activity(self, tensor: str, activity: str, begin: bool):
         self.lib.hvd_core_timeline_activity(

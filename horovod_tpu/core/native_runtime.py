@@ -129,6 +129,18 @@ class NativeRuntime:
                     )
             self._cv.notify_all()
 
+    # --- runtime timeline control (later-reference API) ---
+    def start_timeline(self, file_path: str, mark_cycles: bool = False):
+        code = self.core.start_timeline(file_path, mark_cycles)
+        if code:
+            raise ValueError(
+                f"could not start timeline at {file_path!r} "
+                f"(status {code}: already active, or unwritable path)"
+            )
+
+    def stop_timeline(self) -> None:
+        self.core.stop_timeline()
+
     # --- enqueue API ---
     def _enqueue(
         self,

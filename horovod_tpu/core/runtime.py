@@ -647,6 +647,23 @@ class Runtime:
             if entry.callback is not None:
                 entry.callback(status, entry.output if status.ok() else None)
 
+    # --- runtime timeline control (later-reference API) ---
+    def start_timeline(self, file_path: str, mark_cycles: bool = False):
+        if self.timeline.initialized:
+            raise ValueError("timeline is already active")
+        # The writer opens its file on a background thread, so probe
+        # writability HERE — otherwise an unwritable path would succeed
+        # silently and block any later start ("already active").
+        with open(file_path, "w"):
+            pass
+        self.config.timeline_mark_cycles = bool(mark_cycles)
+        self.timeline.initialize(file_path, self.topology.rank)
+        if not self.timeline.initialized:
+            raise ValueError(f"could not start timeline at {file_path!r}")
+
+    def stop_timeline(self) -> None:
+        self.timeline.shutdown()
+
     # --- sync helpers ---
     def poll(self, handle: int) -> bool:
         return self.handle_manager.poll(handle)

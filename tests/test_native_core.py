@@ -358,3 +358,34 @@ def test_grouped_heterogeneous_dtypes_split_counted(core):
     plans = _drain_plans(core, max_plans=3, timeout_ms=500)
     assert len(plans) == 2, plans
     assert core.grouped_splits() == before + 1
+
+
+def test_runtime_timeline_start_stop(tmp_path):
+    """hvd.start_timeline / stop_timeline (later-reference API): the
+    catapult trace can be scoped to a window at runtime."""
+    import json
+
+    hvd.shutdown()
+    hvd.init()
+    try:
+        path = str(tmp_path / "tl.json")
+        hvd.start_timeline(path, mark_cycles=True)
+        with pytest.raises(ValueError):
+            hvd.start_timeline(path)        # already active
+        import numpy as np
+
+        hvd.allreduce(np.ones((4,), np.float32), name="tl.t")
+        hvd.stop_timeline()
+        events = json.load(open(path))
+        names = {e.get("name") for e in events}
+        assert any("XLA_" in str(n) or "ENQUEUE" in str(n) for n in names), names
+        assert "CYCLE" in names, names
+        # restartable after stop
+        path2 = str(tmp_path / "tl2.json")
+        hvd.start_timeline(path2, mark_cycles=False)
+        hvd.allreduce(np.ones((2,), np.float32), name="tl.t2")
+        hvd.stop_timeline()
+        events2 = json.load(open(path2))
+        assert all(e.get("name") != "CYCLE" for e in events2), events2
+    finally:
+        hvd.shutdown()
