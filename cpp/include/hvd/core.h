@@ -75,6 +75,9 @@ class Timeline {
   std::unordered_map<std::string, int> tids_;
   int next_tid_ = 1;
   std::mutex mu_;
+  // Serializes whole Initialize/Shutdown sessions against each other
+  // (held across the writer join, which mu_ must not be).
+  std::mutex session_mu_;
   std::condition_variable cv_;
   std::deque<std::string> queue_;
   std::thread writer_;

@@ -39,10 +39,11 @@ void Log(LogLevel level, const std::string& msg) {
 
 // ------------------------------------------------------------ timeline
 void Timeline::Initialize(const std::string& path, int rank) {
-  // All shared-state writes under mu_: runtime start/stop
-  // (hvd.start_timeline) races recording threads, which read
-  // start_/rank_/queue_ under the same lock after re-checking
-  // initialized_.
+  // session_mu_ serializes concurrent Initialize/Shutdown pairs (a
+  // Shutdown mid-join must complete before the next session may touch
+  // writer_/file_); mu_ covers the shared state recording threads read
+  // after re-checking initialized_.
+  std::lock_guard<std::mutex> sl(session_mu_);
   std::lock_guard<std::mutex> l(mu_);
   if (initialized_.load() || path.empty()) return;
   file_ = std::fopen(path.c_str(), "w");
@@ -71,6 +72,7 @@ void Timeline::Initialize(const std::string& path, int rank) {
 }
 
 void Timeline::Shutdown() {
+  std::lock_guard<std::mutex> sl(session_mu_);
   {
     // Flip initialized_ first, under the lock: recorders re-check it
     // after acquiring mu_, so no event can slip in past this point and
@@ -136,16 +138,18 @@ std::string DurEvent(const char* ph, int pid, int tid, double ts,
 
 void Timeline::NegotiateStart(const std::string& tensor,
                               const std::string& op) {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("B", rank_, tid, NowUs(), "NEGOTIATE_" + op));
   cv_.notify_one();
 }
 
 void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   int tid = Tid(tensor);
   std::ostringstream os;
   os << "{\"name\":\"" << rank << "\",\"ph\":\"i\",\"s\":\"t\",\"pid\":"
@@ -155,16 +159,18 @@ void Timeline::NegotiateRankReady(const std::string& tensor, int rank) {
 }
 
 void Timeline::NegotiateEnd(const std::string& tensor, const std::string& op) {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("E", rank_, tid, NowUs(), "NEGOTIATE_" + op));
   cv_.notify_one();
 }
 
 void Timeline::Begin(const std::string& tensor, const std::string& activity) {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("B", rank_, tid, NowUs(), activity));
   cv_.notify_one();
@@ -172,8 +178,9 @@ void Timeline::Begin(const std::string& tensor, const std::string& activity) {
 
 void Timeline::BeginPlan(const std::string& tensor,
                          const std::string& activity, uint64_t plan_id) {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   int tid = Tid(tensor);
   queue_.push_back(DurEvent(
       "B", rank_, tid, NowUs(), activity,
@@ -182,16 +189,18 @@ void Timeline::BeginPlan(const std::string& tensor,
 }
 
 void Timeline::End(const std::string& tensor, const std::string& activity) {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   int tid = Tid(tensor);
   queue_.push_back(DurEvent("E", rank_, tid, NowUs(), activity));
   cv_.notify_one();
 }
 
 void Timeline::MarkCycle() {
+  if (!initialized_.load()) return;  // lock-free disabled-path fast exit
   std::lock_guard<std::mutex> l(mu_);
-  if (!initialized_.load()) return;
+  if (!initialized_.load()) return;  // re-check: shutdown raced us
   std::ostringstream os;
   os << "{\"name\":\"CYCLE\",\"ph\":\"i\",\"s\":\"g\",\"pid\":" << rank_
      << ",\"tid\":0,\"ts\":" << NowUs() << "}";

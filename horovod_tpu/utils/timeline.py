@@ -95,6 +95,12 @@ class Timeline:
             if self._writer is not None or not filename:
                 return
             self._rank = rank
+            # A restarted session (runtime start/stop_timeline) gets its
+            # own clock origin and re-emits thread_name metadata into
+            # ITS file — stale tids would leave unnamed tracks.
+            self._start = time.perf_counter()
+            self._tensor_tids.clear()
+            self._next_tid = 1
             self._writer = TimelineWriter(filename)
             self._emit(
                 {

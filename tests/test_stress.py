@@ -25,7 +25,9 @@ r, n = hvd.rank(), hvd.size()
 rng = np.random.RandomState(1234)  # SAME seed on every rank: shared plan
 
 OPS = ("allreduce_sum", "allreduce_avg", "allreduce_min", "broadcast",
-       "allgather", "alltoall", "reducescatter", "grouped")
+       "allgather", "alltoall", "alltoallv", "reducescatter",
+       "reducescatter_uneven", "grouped", "grouped_allgather",
+       "grouped_reducescatter", "barrier")
 DTYPES = (np.float32, np.float64, np.int32)
 
 pending = []  # (handle/list, kind, expected)
@@ -77,6 +79,59 @@ for i in range(60):
         k = L // n
         total = sum(mine(j).astype(np.float64) for j in range(n))
         pending.append((h, "one", total[r * k:(r + 1) * k].astype(dt)))
+    elif kind == "alltoallv":
+        # Uneven splits derived from the shared plan: rank k sends
+        # (k + d + 1) rows to destination d.
+        def splits_of(rank):
+            return [rank + d + 1 for d in range(n)]
+        rows = sum(splits_of(r))
+        data = (np.arange(rows, dtype=np.float64) + 100 * r).astype(dt)
+        got, rs_counts = hvd.alltoall(data, splits_of(r), name=name)
+        segs = []
+        for src in range(n):
+            off = sum(splits_of(src)[:r])
+            cnt = splits_of(src)[r]
+            segs.append(
+                (np.arange(sum(splits_of(src)), dtype=np.float64)
+                 + 100 * src)[off:off + cnt]
+            )
+        exp = np.concatenate(segs).astype(dt)
+        assert list(rs_counts) == [src + r + 1 for src in range(n)], rs_counts
+        assert np.allclose(np.asarray(got).astype(np.float64),
+                           exp.astype(np.float64)), (i, got, exp)
+    elif kind == "reducescatter_uneven":
+        d0 = L + 1  # not divisible by n: MPI split sizes
+        xu = (np.arange(d0, dtype=np.float64) * (r + 1)).astype(np.float32)
+        h = hvd.reducescatter_async(xu, name=name)
+        total = np.arange(d0, dtype=np.float64) * sum(
+            k + 1 for k in range(n))
+        bs, rem = divmod(d0, n)
+        start = r * bs + min(r, rem)
+        cnt = bs + (1 if r < rem else 0)
+        pending.append((h, "one",
+                        total[start:start + cnt].astype(np.float32)))
+    elif kind == "grouped_allgather":
+        members = [(base[: m + 1] * (r + 1)).astype(np.float32)
+                   for m in range(2)]
+        hs = hvd.grouped_allgather_async(members, name=name)
+        exps = [
+            np.concatenate([
+                (base[: m + 1].astype(np.float64) * (k + 1))
+                for k in range(n)
+            ]).astype(np.float32)
+            for m in range(2)
+        ]
+        pending.append((hs, "group", exps))
+    elif kind == "grouped_reducescatter":
+        members = [(base[: 2 * n] * (r + 1)).astype(np.float32)
+                   for _ in range(2)]
+        hs = hvd.grouped_reducescatter_async(members, name=name)
+        tot = sum((base[: 2 * n].astype(np.float64) * (k + 1))
+                  for k in range(n))
+        exps = [tot[r * 2:(r + 1) * 2].astype(np.float32)] * 2
+        pending.append((hs, "group", exps))
+    elif kind == "barrier":
+        hvd.barrier(name=name)
     else:  # grouped
         members = [
             (base[:4] * (r + 1) * (m + 1)).astype(np.float32)
