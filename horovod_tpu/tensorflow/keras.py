@@ -1,0 +1,8 @@
+"""Alias module: the reference exposes the Keras binding as BOTH
+``horovod.keras`` and ``horovod.tensorflow.keras``; scripts written
+against the latter import path port unchanged
+(``import horovod_tpu.tensorflow.keras as hvd``)."""
+
+from ..keras import *  # noqa: F401,F403
+from ..keras import DistributedOptimizer, callbacks, load_model  # noqa: F401
+from ..keras import elastic  # noqa: F401  (hvd.elastic.* attribute access)

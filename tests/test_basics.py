@@ -223,3 +223,16 @@ def test_profiler_session_env(tmp_path, monkeypatch):
         for f in fs
     ]
     assert written, "profiler session produced no trace files"
+
+
+def test_tensorflow_keras_alias_module():
+    """``horovod_tpu.tensorflow.keras`` mirrors the reference's dual
+    import path for the Keras binding."""
+    pytest.importorskip("tensorflow")
+    import horovod_tpu.keras as hk
+    import horovod_tpu.tensorflow.keras as htk
+
+    assert htk.DistributedOptimizer is hk.DistributedOptimizer
+    assert htk.callbacks is hk.callbacks
+    assert htk.load_model is hk.load_model
+    assert htk.elastic.KerasState is hk.elastic.KerasState

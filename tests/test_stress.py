@@ -123,12 +123,13 @@ for i in range(60):
         ]
         pending.append((hs, "group", exps))
     elif kind == "grouped_reducescatter":
-        members = [(base[: 2 * n] * (r + 1)).astype(np.float32)
-                   for _ in range(2)]
+        # L is always a multiple of n (drawn above), so rank stride is
+        # L // n — never hard-coded (L can be as small as n).
+        stride = L // n
+        members = [(base * (r + 1)).astype(np.float32) for _ in range(2)]
         hs = hvd.grouped_reducescatter_async(members, name=name)
-        tot = sum((base[: 2 * n].astype(np.float64) * (k + 1))
-                  for k in range(n))
-        exps = [tot[r * 2:(r + 1) * 2].astype(np.float32)] * 2
+        tot = sum((base.astype(np.float64) * (k + 1)) for k in range(n))
+        exps = [tot[r * stride:(r + 1) * stride].astype(np.float32)] * 2
         pending.append((hs, "group", exps))
     elif kind == "barrier":
         hvd.barrier(name=name)
