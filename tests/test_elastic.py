@@ -559,30 +559,32 @@ def test_tensorflow_state_primitives():
     np.testing.assert_allclose(v2.numpy(), [[3.0]])
 
 
-def test_elastic_repeated_crashes_stress():
-    """Stress: the SAME job survives THREE separate crash/re-formation
-    cycles (different workers, different steps) and still converges to
-    consistent state on every rank."""
+def _run_crash_schedule(schedule, total_steps, exit_base,
+                        blacklist_threshold, timeout):
+    """One 3-rank elastic job with a crash schedule [(worker_id, step)];
+    asserts every crash fired and the w == step invariant held through
+    every recovery."""
     proc, outs = _run_elastic(
-        """
+        f"""
+        schedule = {schedule!r}
+        exit_base = {exit_base}
+        total_steps = {total_steps}
         state = elastic.JaxState(w=np.zeros((2,), np.float32), step=0)
-        crashes = [('localhost:1', 3), ('localhost:0', 7),
-                   ('localhost:2', 11)]
 
         @elastic.run
         def train(state):
-            while state.step < 15:
+            while state.step < total_steps:
                 g = hvd.allreduce(jnp.ones((2,), jnp.float32),
                                   op=hvd.Average, name='grad')
                 state.w = np.asarray(g) + np.asarray(state.w)
                 state.step += 1
-                for i, (wid, at) in enumerate(crashes):
-                    flag = os.path.join(td, f'crashed{i}')
+                for i, (wid, at) in enumerate(schedule):
+                    flag = os.path.join(td, f'crash{{i}}')
                     if (os.environ['HOROVOD_ELASTIC_WORKER_ID'] == wid
                             and state.step == at
                             and not os.path.exists(flag)):
                         open(flag, 'w').close()
-                        os._exit(30 + i)
+                        os._exit(exit_base + i)
                 state.commit()
             return state.step
 
@@ -592,20 +594,33 @@ def test_elastic_repeated_crashes_stress():
         hvd.shutdown()
         """,
         ["-np", "3", "--min-np", "3", "--max-np", "3",
-         "--blacklist-threshold", "10"],
-        timeout=420,
+         "--blacklist-threshold", str(blacklist_threshold)],
+        timeout=timeout,
     )
     stderr = proc.stderr.decode()
     assert proc.returncode == 0, (stderr, outs)
-    for code in ("30", "31", "32"):
-        assert f"failed with exit code {code}" in stderr, stderr
-    assert "generation 4" in stderr, stderr
+    fired = sum(f"failed with exit code {exit_base + i}" in stderr
+                for i in range(len(schedule)))
+    assert fired == len(schedule), (schedule, stderr)
     finals = [l for o in outs.values() for l in o.splitlines()
               if l.startswith("FINAL")]
     assert len(finals) == 3, (finals, stderr)
     for line in finals:
         _, rank, size, step, w0 = line.split()
-        assert size == "3" and step == "15" and float(w0) == 15.0, finals
+        assert (size == "3" and step == str(total_steps)
+                and float(w0) == float(total_steps)), finals
+    return stderr
+
+
+def test_elastic_repeated_crashes_stress():
+    """Stress: the SAME job survives THREE separate crash/re-formation
+    cycles (different workers, different steps) and still converges to
+    consistent state on every rank."""
+    stderr = _run_crash_schedule(
+        [("localhost:1", 3), ("localhost:0", 7), ("localhost:2", 11)],
+        total_steps=15, exit_base=30, blacklist_threshold=10, timeout=420,
+    )
+    assert "generation 4" in stderr, stderr
 
 
 def test_elastic_keras_fit_crash_recovery():
@@ -669,3 +684,19 @@ def test_elastic_keras_fit_crash_recovery():
         assert size == "2" and epoch == "6", finals
         ws.add(w)
     assert len(ws) == 1, finals
+
+
+def test_elastic_randomized_crash_soak():
+    """Soak: a seeded-random crash schedule (5 cycles, random victims at
+    random steps) against one 3-rank job — every recovery must preserve
+    the w == step invariant through arbitrary crash/rollback
+    interleavings."""
+    import numpy as np
+
+    rng = np.random.RandomState(20260731)
+    steps = sorted(rng.choice(range(3, 28), size=5, replace=False))
+    victims = [f"localhost:{rng.randint(3)}" for _ in steps]
+    _run_crash_schedule(
+        list(zip(victims, [int(s) for s in steps])),
+        total_steps=30, exit_base=40, blacklist_threshold=20, timeout=600,
+    )
