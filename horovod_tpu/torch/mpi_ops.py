@@ -123,6 +123,39 @@ def allreduce_(tensor, average=None, name=None, op=None,
     )
 
 
+def _grouped_async_torch(kind, enqueue_name, tensors, name,
+                         **enqueue_kwargs):
+    """Shared grouped submission for the torch binding (later-reference
+    grouped APIs): members convert BEFORE any enqueue, carry one group
+    id, and complete atomically (held by the coordinator until all are
+    ready on all ranks). A mid-group failure drains the already-
+    submitted members AND drops their _handle_meta entries (the drain
+    bypasses this module's synchronize, which is what normally pops
+    them — leaking entries would pin the tensors forever)."""
+    from .. import _drain_group, _group_id
+
+    tensors = list(tensors)
+    arrs = [_to_numpy(t) for t in tensors]
+    base = _auto_name(f"{kind}.torch", name)
+    gid = _group_id(base)
+    rt = _rt()
+    enqueue = getattr(rt, enqueue_name)
+    handles = []
+    try:
+        for i, (t, arr) in enumerate(zip(tensors, arrs)):
+            h = enqueue(f"{base}.{i}", arr,
+                        group_id=gid, group_size=len(tensors),
+                        **enqueue_kwargs)
+            _handle_meta[h] = (None, t)
+            handles.append(h)
+    except Exception:
+        _drain_group(handles)
+        for h in handles:
+            _handle_meta.pop(h, None)
+        raise
+    return handles
+
+
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             prescale_factor=1.0, postscale_factor=1.0):
     """Enqueue ``tensors`` as ONE first-class group and return their
@@ -130,36 +163,17 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
     torch): the coordinator holds the group until every member is ready
     on every rank and fuses it into a single plan regardless of cycle
     boundaries or the fusion threshold."""
-    from .. import _drain_group, _group_id
-
     rop = _resolve_op(average, op)
     if rop == ReduceOp.ADASUM:
         raise ValueError(
             "grouped_allreduce does not support op=Adasum; use the "
             "DistributedAdasumOptimizer (delta-space) path instead"
         )
-    tensors = list(tensors)
-    # Convert every member BEFORE enqueuing any: a mid-group failure
-    # leaves peers holding an incompletable group.
-    arrs = [_to_numpy(t) for t in tensors]
-    base = _auto_name("grouped_allreduce.torch", name)
-    gid = _group_id(base)
-    rt = _rt()
-    handles = []
-    try:
-        for i, (t, arr) in enumerate(zip(tensors, arrs)):
-            h = rt.enqueue_allreduce(
-                f"{base}.{i}", arr, reduce_op=rop,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-                group_id=gid, group_size=len(tensors),
-            )
-            _handle_meta[h] = (None, t)
-            handles.append(h)
-    except Exception:
-        _drain_group(handles)
-        raise
-    return handles
+    return _grouped_async_torch(
+        "grouped_allreduce", "enqueue_allreduce", tensors, name,
+        reduce_op=rop, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
@@ -172,6 +186,44 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     )
     return grouped_sync_first_error(handles, synchronize)
+
+
+def grouped_allgather_async(tensors, name=None):
+    return _grouped_async_torch(
+        "grouped_allgather", "enqueue_allgather", tensors, name
+    )
+
+
+def grouped_allgather(tensors, name=None):
+    from .. import grouped_sync_first_error
+
+    return grouped_sync_first_error(
+        grouped_allgather_async(tensors, name), synchronize
+    )
+
+
+def grouped_reducescatter_async(tensors, name=None, op=None):
+    rop = op if op is not None else ReduceOp.SUM
+    if rop not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports SUM/AVERAGE only")
+    tensors = list(tensors)  # a generator must survive validation
+    for t in tensors:
+        if not getattr(t, "shape", ()):
+            raise ValueError(
+                "reducescatter needs a tensor with a dim0 to scatter"
+            )
+    return _grouped_async_torch(
+        "grouped_reducescatter", "enqueue_reducescatter", tensors, name,
+        reduce_op=rop,
+    )
+
+
+def grouped_reducescatter(tensors, name=None, op=None):
+    from .. import grouped_sync_first_error
+
+    return grouped_sync_first_error(
+        grouped_reducescatter_async(tensors, name, op), synchronize
+    )
 
 
 def allgather_async(tensor, name=None) -> int:

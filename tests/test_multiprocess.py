@@ -1496,3 +1496,38 @@ def test_torch_sparse_as_dense_two_ranks():
         vals = vals or line
         assert line == vals, outs          # identical updates both ranks
         assert "SPARSE_ERR True" in out, outs
+
+
+def test_torch_grouped_allgather_reducescatter_two_ranks():
+    """torch binding surfaces for the grouped allgather/reducescatter
+    (later-reference v0.28): conversion, handle wiring, op=Average, and
+    atomic completion through the torch wrappers."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import torch
+        import horovod_tpu.torch as hvd
+        hvd.init()
+        r, n = hvd.rank(), hvd.size()
+        outs = hvd.grouped_allgather([
+            torch.full((1, 2), float(r)),
+            torch.full((3,), float(10 + r)),
+        ])
+        ok_g = (outs[0].shape == (n, 2) and outs[1].shape == (3 * n,)
+                and bool(outs[1][:3].eq(10.0).all())
+                and bool(outs[1][3:].eq(11.0).all()))
+        rs = hvd.grouped_reducescatter(
+            (t for t in [torch.ones(4) * (r + 1),      # generator input
+                         torch.arange(4.0)]),
+            op=hvd.Average)
+        ok_r = (bool(rs[0].eq(1.5).all())               # avg of 1,2
+                and rs[0].shape == (2,)
+                and bool(torch.allclose(
+                    rs[1], torch.arange(4.0)[r * 2:(r + 1) * 2])))
+        print("TGROUPED", bool(ok_g), bool(ok_r))
+        hvd.shutdown()
+        """
+    )
+    for out in outs:
+        assert "TGROUPED True True" in out, outs
