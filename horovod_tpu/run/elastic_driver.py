@@ -100,6 +100,8 @@ class ElasticDriver:
         self._ssh_port = ssh_port
         self._elastic_timeout = elastic_timeout
 
+        if output_dir:
+            os.makedirs(output_dir, exist_ok=True)
         self._kv = KVStoreServer()
         self._services: List[object] = []  # per-gen jax coordination svcs
         self._last_hosts: List[Tuple[str, int]] = list(hosts or [])
@@ -118,7 +120,20 @@ class ElasticDriver:
 
     # ------------------------------------------------------------ pieces
     def _log(self, msg: str) -> None:
-        print(f"[hvdrun elastic] {msg}", file=sys.stderr, flush=True)
+        line = f"[hvdrun elastic] {msg}"
+        print(line, file=sys.stderr, flush=True)
+        # Postmortem artifact: with --output-dir, the generation history
+        # (publishes, failures, blacklists, drains) persists next to the
+        # per-worker logs instead of living only on the driver's stderr.
+        # (Dir is created once in __init__; logging must never kill the
+        # driver, hence the silent OSError.)
+        if self._output_dir:
+            try:
+                with open(os.path.join(self._output_dir, "driver.log"),
+                          "a") as f:
+                    f.write(time.strftime("%H:%M:%S ") + line + "\n")
+            except OSError:
+                pass
 
     def _discovery_loop(self) -> None:
         """Background discovery poller (upstream ElasticDriver runs its

@@ -86,4 +86,6 @@ def run_elastic_job(hvdrun_args, script_text=None, script_path=None,
                 outs[fn[len("worker."):]] = open(
                     os.path.join(td, fn)
                 ).read()
+            if fn == "driver.log":
+                outs[fn] = open(os.path.join(td, fn)).read()
     return proc, outs

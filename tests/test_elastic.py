@@ -150,6 +150,9 @@ def test_elastic_worker_failure_recovery():
         assert size == "3" and step == "10" and float(w0) == 10.0, finals
     assert "generation 2" in stderr, stderr
     assert "failed with exit code 17" in stderr, stderr
+    # the same history persists as a postmortem artifact in --output-dir
+    assert "driver.log" in outs and "generation 2" in outs["driver.log"], (
+        sorted(outs))
 
 
 def test_elastic_rank0_crash_preserves_state():
