@@ -76,12 +76,18 @@ def init(config: Optional[Config] = None) -> None:
 
             if jax_coord:
                 # Must run before any backend use; tolerate re-init.
-                if _os.environ.get("HOROVOD_ELASTIC") == "1":
+                from .elastic import rejoin_mode as _rejoin_mode
+
+                if (_os.environ.get("HOROVOD_ELASTIC") == "1"
+                        and _rejoin_mode() == "inprocess"):
                     # Elastic worlds need failure-tolerant coordination: a
                     # dead peer must surface as a catchable collective
                     # error on survivors, not a fatal coordination-service
                     # abort — rollback re-forms the world in process
-                    # (horovod_tpu/elastic).
+                    # (horovod_tpu/elastic). In 'respawn' mode (the
+                    # fallback when these private surfaces are absent)
+                    # workers die and resume from persisted commits, so
+                    # the plain public initialize below is used instead.
                     _jax.config.update("jax_enable_recoverability", True)
                     from .elastic import _jax_distributed_initialize
 
@@ -615,10 +621,21 @@ def alltoall(tensor: Any, splits: Any = None, name: Optional[str] = None,
 
     Uneven mechanics (MPI alltoallv re-expressed on the even TPU
     collective): a tiny allgather shares every rank's splits vector, each
-    per-destination segment pads to the global max block, one even
+    per-destination segment pads to a common block, an even
     ``lax.all_to_all`` moves the blocks, and the pads are sliced off —
-    two collectives total, the same count-exchange + v-call shape MPI
-    implementations use."""
+    the same count-exchange + v-call shape MPI implementations use.
+
+    Memory bound under skew: padding every block to the global max would
+    allocate ``O(n * max_split)`` rows on EVERY rank — one hot
+    destination (an EP router's overloaded expert) would blow the
+    carrier up n-fold. Instead the exchange is chunked: the carrier is
+    capped at ``k * total_rows / n`` rows (``k`` =
+    ``HOROVOD_ALLTOALLV_CARRIER_FACTOR``, default 4; floor ``n`` rows)
+    and hot blocks ride multiple rounds. Peak extra memory is
+    ``O(max(n, k * total_rows / n))`` rows regardless of skew; balanced
+    splits stay single-round (``k x mean >= max``), identical to the
+    unchunked path. Rounds are derived from the globally-agreed count
+    matrix, so every rank executes the same schedule."""
     if splits is None:
         return synchronize(alltoall_async(tensor, name, process_set))
     import numpy as np
@@ -654,21 +671,49 @@ def alltoall(tensor: Any, splits: Any = None, name: Optional[str] = None,
     if max_block == 0:
         empty = local[:0]
         return empty, received_splits
+    chunk, rounds = _alltoallv_schedule(matrix, n)
+    alltoall._last_carrier_rows = n * chunk  # test/diagnostic hook
     rest = local.shape[1:]
-    padded = np.zeros((n * max_block,) + rest, local.dtype)
     offs = np.concatenate([[0], np.cumsum(splits)[:-1]])
-    for d in range(n):
-        padded[d * max_block: d * max_block + splits[d]] = (
-            local[offs[d]: offs[d] + splits[d]]
+    pieces: list = [[] for _ in range(n)]
+    for r in range(rounds):
+        lo = r * chunk
+        padded = np.zeros((n * chunk,) + rest, local.dtype)
+        for d in range(n):
+            take = min(max(int(splits[d]) - lo, 0), chunk)
+            if take:
+                padded[d * chunk: d * chunk + take] = (
+                    local[offs[d] + lo: offs[d] + lo + take]
+                )
+        round_name = f"{name}.round{r}" if rounds > 1 else name
+        out = np.asarray(
+            synchronize(alltoall_async(padded, round_name, process_set))
         )
-    out = np.asarray(
-        synchronize(alltoall_async(padded, name, process_set))
-    )
-    collected = np.concatenate([
-        out[s * max_block: s * max_block + received_splits[s]]
-        for s in range(n)
-    ]) if received_splits.sum() else out[:0]
+        for s in range(n):
+            take = min(max(int(received_splits[s]) - lo, 0), chunk)
+            if take:
+                pieces[s].append(out[s * chunk: s * chunk + take])
+    collected = np.concatenate(
+        [c for p in pieces for c in p]
+    ) if received_splits.sum() else local[:0]
     return collected, received_splits
+
+
+def _alltoallv_schedule(matrix: Any, n: int) -> tuple:
+    """(chunk_rows, rounds) for the chunked uneven alltoall: carrier
+    capped at ``factor * total_rows / n`` rows (floor ``n``) so a skewed
+    split cannot allocate ``n * max_split`` on every rank."""
+    import os
+
+    import numpy as np
+
+    max_block = int(np.asarray(matrix).max())
+    factor = int(os.environ.get("HOROVOD_ALLTOALLV_CARRIER_FACTOR", "4"))
+    cap = max(1, (factor * int(np.asarray(matrix).sum()) + n * n - 1)
+              // (n * n))
+    chunk = min(max_block, cap)
+    rounds = (max_block + chunk - 1) // chunk
+    return chunk, rounds
 
 
 def reducescatter_async(

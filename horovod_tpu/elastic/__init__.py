@@ -267,6 +267,191 @@ def _reset_jax_world() -> None:
         logger.warning("could not clear XLA backends: %s", exc)
 
 
+# ------------------------------------------------- rejoin-mode selection
+# Exit status a worker uses to ask the driver for a fresh process instead
+# of re-forming the world in-process. Must match REJOIN_EXIT_CODE in
+# run/elastic_driver.py (kept as literals on both sides so the launcher
+# never has to import this — jax-loading — module).
+REJOIN_EXIT_CODE = 79
+
+_rejoin_mode: Optional[str] = None
+
+
+def _inprocess_rejoin_supported() -> bool:
+    """In-process world re-formation rides two private JAX surfaces: the
+    ``jax_enable_recoverability`` config flag (a dead peer surfaces on
+    survivors as a catchable collective error, not a fatal coordination
+    abort) and ``xla_bridge._clear_backends`` (the next ``hvd.init()``
+    can stand up a different world size in this process). Both exist on
+    the pinned jax, but either can vanish in a minor upgrade — probe
+    them up front instead of finding out mid-crash-recovery."""
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+    except Exception:  # noqa: BLE001 - jax internals moved wholesale
+        return False
+    if not callable(getattr(_xb, "_clear_backends", None)):
+        return False
+    try:
+        # Attribute access raises if the flag no longer exists.
+        jax.config.jax_enable_recoverability  # noqa: B018
+    except Exception:  # noqa: BLE001
+        return False
+    return True
+
+
+def rejoin_mode() -> str:
+    """Active recovery mode: ``'inprocess'`` (generation-based world
+    re-formation without process death — the fast path) or ``'respawn'``
+    (the worker persists its last commit and exits with
+    ``REJOIN_EXIT_CODE``; the driver respawns the slot and the fresh
+    process resumes from the snapshot — upstream's restart semantics,
+    used as the fallback when the private JAX surfaces the in-process
+    path needs are absent). ``HOROVOD_ELASTIC_REJOIN_MODE`` forces
+    either; the elastic driver resolves the mode once and exports it so
+    every worker agrees."""
+    global _rejoin_mode
+    if _rejoin_mode is None:
+        forced = os.environ.get(
+            "HOROVOD_ELASTIC_REJOIN_MODE", "auto"
+        ).lower()
+        if forced in ("inprocess", "respawn"):
+            _rejoin_mode = forced
+        else:
+            _rejoin_mode = (
+                "inprocess" if _inprocess_rejoin_supported() else "respawn"
+            )
+        logger.info("elastic: rejoin mode '%s'", _rejoin_mode)
+    return _rejoin_mode
+
+
+def _persist_path() -> Optional[str]:
+    """Per-slot snapshot file in the driver-shared state dir. Keyed by
+    worker id (host:local_rank), so a respawn of the same slot — on the
+    same host, hence the same local filesystem — finds its predecessor's
+    last commit."""
+    d = os.environ.get("HOROVOD_ELASTIC_STATE_DIR")
+    wid = os.environ.get("HOROVOD_ELASTIC_WORKER_ID")
+    if not d or not wid:
+        return None
+    safe = wid.replace(":", "_").replace("/", "_")
+    return os.path.join(d, f"{safe}.state.pkl")
+
+
+def _persist_state_and_exit(state: "State", ctx: _ElasticContext) -> None:
+    """Respawn-mode rejoin: snapshot the state to disk, signal the
+    driver, and exit with the rejoin status. Never returns."""
+    import pickle
+
+    path = _persist_path()
+    if path is not None:
+        try:
+            state.save()
+            payload = _persist_payload(state)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)
+        except Exception as exc:  # noqa: BLE001 - degrade, don't hang
+            logger.warning(
+                "elastic: could not persist state (%s); the respawn will "
+                "re-sync from a peer's snapshot instead", exc
+            )
+    else:
+        logger.warning(
+            "elastic: no HOROVOD_ELASTIC_STATE_DIR/WORKER_ID; respawn "
+            "resumes from peers' snapshots only"
+        )
+    # The rejoin signal both tells the driver this generation is
+    # abandoned and keeps its reconcile loop re-arming until a fresh
+    # generation is actually published.
+    ctx.signal_rejoin()
+    logger.info(
+        "elastic: exiting for respawn (status %d)", REJOIN_EXIT_CODE
+    )
+    # os._exit: the world is half-dead; a graceful interpreter shutdown
+    # can hang joining runtime threads that are blocked on dead peers.
+    os._exit(REJOIN_EXIT_CODE)
+
+
+def _maybe_restore_persisted(state: "State") -> bool:
+    """Respawn-mode startup: resume from this slot's persisted last
+    commit, if any. Runs before the first sync so a restored snapshot is
+    what a sync_root broadcasts (every rank's last commit is the same
+    step — commits reach cross-rank agreement before returning).
+    Returns True when a snapshot was restored."""
+    import pickle
+
+    path = _persist_path()
+    if path is None or not os.path.exists(path):
+        return False
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except Exception as exc:  # noqa: BLE001 - torn write, stale format
+        logger.warning("elastic: unreadable persisted state (%s)", exc)
+        return False
+    _apply_payload(state, payload)
+    state.restore()
+    logger.info("elastic: restored persisted state from %s", path)
+    return True
+
+
+def _elect_restored_sync_root(ctx: _ElasticContext, restored: bool) -> None:
+    """Respawn-mode guard against silent progress loss: the driver picks
+    a sync_root before workers spawn, so it cannot know which slots will
+    actually find a snapshot (rank 0's host may be a fresh replacement,
+    or its pickle may be torn). A tiny allgather of per-rank restored
+    flags re-elects the sync source onto the first rank that DID restore
+    — identical on every rank, so the broadcast stays consistent — and
+    only keeps the driver's choice when nobody restored (a genuine
+    from-scratch restart)."""
+    import horovod_tpu as hvd
+
+    if hvd.size() <= 1:
+        return
+    flags = hvd.allgather_object(bool(restored), name="hvd.elastic.snap")
+    if not flags[ctx.sync_root] and any(flags):
+        new_root = flags.index(True)
+        logger.info(
+            "elastic: sync root %d has no snapshot; re-electing rank %d "
+            "(restored)", ctx.sync_root, new_root,
+        )
+        ctx.sync_root = new_root
+
+
+def _persist_payload(state: "State") -> Dict[str, Any]:
+    """Everything a ``save()`` produced, generically: every ``_saved*``
+    attribute. ObjectState keeps the tracked dict in ``_saved``;
+    subclasses add their own snapshot attrs (TorchState
+    ``_saved_model``/``_saved_opt``, TensorFlowState ``_saved_vars``,
+    TensorFlowKerasState ``_saved_weights``/``_saved_opt_vars``) — an
+    allowlist here would silently drop any of them and a respawn would
+    resume with reinitialized weights under a restored step counter."""
+    return {
+        k: v for k, v in vars(state).items() if k.startswith("_saved")
+    }
+
+
+def _apply_payload(state: "State", payload: Dict[str, Any]) -> None:
+    if "tracked" in payload and "_saved" not in payload:
+        payload = dict(payload)
+        payload["_saved"] = payload.pop("tracked")  # pre-r5 layout
+    for k, v in payload.items():
+        if k.startswith("_saved"):
+            setattr(state, k, v)
+
+
+def _clear_persisted() -> None:
+    path = _persist_path()
+    if path is not None and os.path.exists(path):
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
 def _rejoin(ctx: _ElasticContext) -> None:
     """Leave the current (broken or stale) world and join the next
     generation: wait for the driver to publish gen > current with this
@@ -410,7 +595,47 @@ class ObjectState(State):
 
     def restore(self) -> None:
         for k, v in self._saved.items():
-            setattr(self, k, copy.deepcopy(v))
+            self._assign(k, copy.deepcopy(v))
+
+    def _assign(self, key: str, new: Any) -> None:
+        """Bind ``new`` as the value of tracked attribute ``key``,
+        mutating the existing object IN PLACE when it is a mutable
+        container or a plain instance of the same class.
+
+        External references must stay valid across rollbacks and
+        re-formations: the documented ``DataLoader(sampler=sampler)``
+        pattern (torch/elastic.py) holds the sampler object directly, so
+        rebinding the attribute to a freshly-unpickled copy would leave
+        the loader iterating stale state while commits snapshot the new
+        object. The upstream reference mutates samplers in place via its
+        state handlers for exactly this reason
+        (ref: horovod/common/elastic.py state-handler design).
+
+        ``new`` is always a throwaway (an unpickled wire copy or a
+        deepcopy of a snapshot), so adopting its internals is safe.
+        """
+        cur = getattr(self, key, None)
+        if cur is new:
+            return
+        if cur is not None and type(cur) is type(new):
+            if isinstance(cur, dict):
+                cur.clear()
+                cur.update(new)
+                return
+            if isinstance(cur, list):
+                cur[:] = new
+                return
+            if isinstance(cur, set):
+                cur.clear()
+                cur.update(new)
+                return
+            d_cur = getattr(cur, "__dict__", None)
+            d_new = getattr(new, "__dict__", None)
+            if isinstance(d_cur, dict) and isinstance(d_new, dict):
+                d_cur.clear()
+                d_cur.update(d_new)
+                return
+        setattr(self, key, new)
 
     @staticmethod
     def _is_sampler(v: Any) -> bool:
@@ -445,7 +670,7 @@ class ObjectState(State):
                 name="hvd.elastic.objsync",
             )
             for k, v in synced.items():
-                setattr(self, k, v)
+                self._assign(k, v)
             for k in sampler_keys:
                 s = getattr(self, k)
                 s.processed = set().union(
@@ -496,7 +721,7 @@ class JaxState(ObjectState):
                     objects, root_rank=root, name="hvd.elastic.objsync"
                 )
                 for k, v in synced.items():
-                    setattr(self, k, v)
+                    self._assign(k, v)
         self.save()
 
 
@@ -740,13 +965,22 @@ def run(func: Callable) -> Callable:
         ctx = _ctx()
         if ctx is None:
             return func(state, *args, **kwargs)
+        mode = rejoin_mode()
+        if mode == "respawn":
+            restored = _maybe_restore_persisted(state)
+            _elect_restored_sync_root(ctx, restored)
         while True:
             try:
                 state.sync()
                 # From here this worker holds live state: eligible as a
                 # future generation's sync source.
                 ctx.confirm_joined()
-                return func(state, *args, **kwargs)
+                result = func(state, *args, **kwargs)
+                if mode == "respawn":
+                    # Clean finish: a leftover snapshot must not
+                    # resurrect into an unrelated later job on this slot.
+                    _clear_persisted()
+                return result
             except HostsUpdatedInterrupt:
                 logger.info(
                     "elastic: membership change; rejoining with current "
@@ -760,6 +994,8 @@ def run(func: Callable) -> Callable:
                     "last commit and rejoining", exc,
                 )
                 state.restore()
+            if mode == "respawn":
+                _persist_state_and_exit(state, ctx)  # never returns
             _rejoin(ctx)
             state.on_reset()
 

@@ -27,6 +27,7 @@ import json
 import os
 import socket
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass
@@ -35,6 +36,33 @@ from typing import Dict, List, Optional, Tuple
 from . import launcher, safe_shell_exec
 from .http_server import KVStoreServer
 from .launcher import SlotInfo, _free_port, _is_local
+
+
+# Worker exit status meaning "respawn me": the worker cannot re-form the
+# world in-process (elastic/__init__.py REJOIN_EXIT_CODE — kept as a
+# literal on both sides so this launcher never imports the jax-loading
+# package). Not a failure: it does not count toward host blacklisting.
+REJOIN_EXIT_CODE = 79
+
+
+def _inprocess_rejoin_supported() -> bool:
+    """Mirror of ``horovod_tpu.elastic._inprocess_rejoin_supported`` (see
+    its docstring for the two private JAX surfaces probed). The driver
+    resolves the rejoin mode once, from its own jax — workers share the
+    image — and exports it, so driver orchestration and worker behavior
+    always agree."""
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+    except Exception:  # noqa: BLE001
+        return False
+    if not callable(getattr(_xb, "_clear_backends", None)):
+        return False
+    try:
+        jax.config.jax_enable_recoverability  # noqa: B018
+    except Exception:  # noqa: BLE001
+        return False
+    return True
 
 
 @dataclass
@@ -82,6 +110,8 @@ class ElasticDriver:
         host_failure_threshold: int = 3,
         ssh_port: Optional[int] = None,
         elastic_timeout: float = 600.0,
+        nic_pinned: bool = False,
+        probed_hostset: Optional[List[str]] = None,
     ) -> None:
         if not hosts and not discovery_script:
             raise ValueError(
@@ -102,8 +132,42 @@ class ElasticDriver:
 
         if output_dir:
             os.makedirs(output_dir, exist_ok=True)
+        # Recovery mode for the whole job (VERDICT r4: version-harden the
+        # elastic path): explicit HOROVOD_ELASTIC_REJOIN_MODE wins, else
+        # probe whether the private JAX surfaces the in-process path
+        # needs exist. Exported to every worker so both sides agree.
+        forced = self._env.get("HOROVOD_ELASTIC_REJOIN_MODE", "").lower()
+        if forced in ("inprocess", "respawn"):
+            self._rejoin_mode = forced
+        else:
+            self._rejoin_mode = (
+                "inprocess" if _inprocess_rejoin_supported() else "respawn"
+            )
+        self._env["HOROVOD_ELASTIC_REJOIN_MODE"] = self._rejoin_mode
+        # Per-host snapshot dir for respawn-mode resume (workers write
+        # locally; a slot's respawn lands on the same host). The driver
+        # pid keys the path so every generation of the job shares it.
+        self._env.setdefault(
+            "HOROVOD_ELASTIC_STATE_DIR",
+            os.path.join(
+                tempfile.gettempdir(), f"hvd_elastic_state_{os.getpid()}"
+            ),
+        )
         self._kv = KVStoreServer()
-        self._services: List[object] = []  # per-gen jax coordination svcs
+        # --network-interfaces pin: never ring-probe, the user chose.
+        self._nic_pinned = nic_pinned
+        # Host set most recently ring-probed for NICs — seeded with the
+        # set hvdrun probed at launch so the first reconcile doesn't
+        # repeat it; None = never probed.
+        self._probed_hostset = (
+            sorted(probed_hostset) if probed_hostset else None
+        )
+        # Per-generation jax coordination services as mutable
+        # [gen, svc, superseded_monotonic|None, heartbeat_s]; old
+        # generations are retired in _retire_services once their drain
+        # grace window (two newer generations AND 2x the heartbeat
+        # timeout SINCE BEING SUPERSEDED) has passed.
+        self._services: List[list] = []
         self._last_hosts: List[Tuple[str, int]] = list(hosts or [])
         self._stop_discovery = threading.Event()
         self._gen = 0
@@ -117,6 +181,9 @@ class ElasticDriver:
         self._failures: Dict[str, int] = {}
         self._blacklist: set = set()
         self._finishing = False
+        # Respawn mode: a world restart is queued behind the drain pool.
+        self._restart_pending = False
+        self._log(f"rejoin mode: {self._rejoin_mode}")
 
     # ------------------------------------------------------------ pieces
     def _log(self, msg: str) -> None:
@@ -179,11 +246,14 @@ class ElasticDriver:
         """Host this generation's JAX coordination service IN THE DRIVER
         (the reference's elastic driver owns the rendezvous the same way):
         no worker is special, so any worker — including generation rank 0
-        — can die without collapsing the coordination plane. Old services
-        are kept alive until driver exit; they are one idle gRPC server
-        each, and answering stale heartbeats from stragglers of an
-        abandoned generation is exactly what prevents their fatal
-        connection-refused aborts."""
+        — can die without collapsing the coordination plane. The previous
+        two generations' services stay alive as the drain grace window —
+        answering stale heartbeats from stragglers of a just-abandoned
+        generation is what prevents their fatal connection-refused
+        aborts — and anything older is shut down: by then a straggler
+        has long since either re-rendezvoused or tripped its own
+        heartbeat timeout, so unbounded membership churn no longer
+        accumulates unbounded gRPC servers/ports in the driver."""
         from jax._src.lib import _jax as _jaxlib
 
         port = _free_port()
@@ -194,9 +264,89 @@ class ElasticDriver:
             f"[::]:{port}", num_processes,
             heartbeat_timeout=heartbeat, shutdown_timeout=5,
         )
-        self._services.append(svc)
+        if self._services:
+            # The previous generation is superseded NOW — its drain
+            # grace clock starts here, not at its creation (a service
+            # hours old can still have stragglers abandoned seconds ago).
+            self._services[-1][2] = time.monotonic()
+        self._services.append([self._gen, svc, None, heartbeat])
+        self._retire_services(keep=2)
         addr = "127.0.0.1" if all_local else socket.gethostname()
         return f"{addr}:{port}"
+
+    def _drain_world_for_restart(self) -> None:
+        """Respawn-mode restart: move every remaining live worker into
+        the draining pool (grace first — a survivor needs time to persist
+        its commit and exit with the rejoin status on its own; only then
+        is it terminated) and re-form once the pool empties. Drained
+        exits are reaped code-blind, so the follow-on aborts a peer death
+        causes in a non-recoverable world never count toward
+        blacklisting."""
+        if not self._workers:
+            self._restart_pending = True
+            return
+        deadline = time.monotonic() + self._removal_grace
+        for wid in list(self._workers):
+            w = self._workers.pop(wid)
+            self._removing.append((w, deadline))
+            self._log(f"draining {wid} for world restart")
+        self._current_ids = []
+        self._restart_pending = True
+
+    def _maybe_probe_nics(self, slots: List[SlotInfo]) -> None:
+        """Ring NIC probe for elastic worlds whose host set came from (or
+        changed through) the discovery script: hvdrun's launch-time probe
+        only covers an initial ``-H`` set, so without this a
+        discovery-only multi-NIC job would bind the default (possibly
+        non-routable) interface. Best-effort, cached per host set; an
+        explicit ``HOROVOD_IFACE`` (CLI pin or prior probe over the same
+        set) wins."""
+        hostnames = sorted({s.hostname for s in slots})
+        if (self._nic_pinned
+                or len(hostnames) < 2
+                or all(_is_local(h) for h in hostnames)
+                or hostnames == self._probed_hostset):
+            return
+        from . import network
+
+        try:
+            common = network.discover_common_interfaces(
+                hostnames, ssh_port=self._ssh_port
+            )
+            if common:
+                self._env["HOROVOD_IFACE"] = ",".join(common)
+                self._log(f"routable interfaces for {hostnames}: {common}")
+        except Exception as exc:  # noqa: BLE001 - probe is best-effort
+            self._log(f"NIC probe failed ({exc}); continuing without")
+        self._probed_hostset = hostnames
+
+    def _retire_services(self, keep: int) -> None:
+        """Shut down all but the newest service and ``keep`` prior
+        generations (``keep=0`` drains everything, for driver exit).
+
+        Generation count alone is not a safe drain signal: a failure
+        cascade can publish several generations within seconds, while a
+        gen-N straggler may legitimately heartbeat the gen-N service for
+        a full heartbeat window before noticing and re-rendezvousing —
+        shutting its service down mid-rejoin turns a drain into a fatal
+        connection-refused abort. So a service is retired only when it is
+        BOTH more than ``keep`` generations behind AND twice its
+        heartbeat timeout has passed since it was SUPERSEDED (creation
+        age is the wrong clock: a service hours old can still have
+        stragglers abandoned seconds ago)."""
+        limit = keep + 1 if keep else 0
+        now = time.monotonic()
+        while len(self._services) > limit:
+            gen, svc, superseded, heartbeat = self._services[0]
+            if keep and (superseded is None
+                         or now - superseded < 2 * heartbeat):
+                break  # list is supersession-ordered; nothing older
+            self._services.pop(0)
+            try:
+                svc.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+            self._log(f"retired generation-{gen} coordination service")
 
     def _publish(self, slots: List[SlotInfo]) -> Dict[str, str]:
         """Publish the next generation; returns env additions for spawns."""
@@ -205,9 +355,19 @@ class ElasticDriver:
             "127.0.0.1" if _is_local(slots[0].hostname) else slots[0].hostname
         )
         controller_port = _free_port()
-        jax_coordinator = self._start_coordination_service(
-            len(slots), all(_is_local(s.hostname) for s in slots)
-        )
+        if self._rejoin_mode == "respawn":
+            # Respawn mode rides the PUBLIC jax.distributed.initialize,
+            # whose process 0 hosts the coordination service itself. The
+            # driver must NOT also host one: gRPC binds with SO_REUSEPORT,
+            # so two services on the port silently load-balance incoming
+            # connects and each waits forever for a full house. Rank 0
+            # owning the service is fine here — any death restarts the
+            # whole generation on a fresh port anyway.
+            jax_coordinator = f"{controller_addr}:{_free_port()}"
+        else:
+            jax_coordinator = self._start_coordination_service(
+                len(slots), all(_is_local(s.hostname) for s in slots)
+            )
         # Sync source for the new generation: a surviving worker that has
         # CONFIRMED completing a state sync (it holds live training
         # state) — never a fresh respawn, whose just-constructed state
@@ -342,6 +502,7 @@ class ElasticDriver:
         draining = {w.worker_id for w, _ in self._removing}
         if draining & set(desired_ids):
             return True
+        self._maybe_probe_nics(slots)
         endpoints = self._publish(slots)
         # Dropped workers drain gracefully: they poll the KV store, see
         # they are not in the new generation, and exit 0 on their own —
@@ -386,11 +547,7 @@ class ElasticDriver:
                     w.proc.terminate()
                 for f in w.outfiles:
                     f.close()
-            for svc in self._services:
-                try:
-                    svc.shutdown()
-                except Exception:  # noqa: BLE001
-                    pass
+            self._retire_services(keep=0)
             self._kv.stop()
 
     def _run(self) -> int:
@@ -416,8 +573,22 @@ class ElasticDriver:
                     continue
                 still_removing.append((w, deadline))
             self._removing = still_removing
+            # Drain superseded coordination services whose grace window
+            # elapsed since the last publish (a cascade can outrun the
+            # publish-time retirement's time guard).
+            self._retire_services(keep=2)
+            if self._restart_pending and not self._removing:
+                # Respawn-mode restart: the old generation has fully
+                # drained; re-form even if no other event fires.
+                self._restart_pending = False
+                changed = True
             for wid in list(self._workers):
-                w = self._workers[wid]
+                # A respawn-mode restart earlier in this sweep drains the
+                # dict mid-iteration; drained entries are reaped by the
+                # _removing pool instead.
+                w = self._workers.get(wid)
+                if w is None:
+                    continue
                 rc = w.proc.poll()
                 if rc is None or w.done:
                     continue
@@ -428,17 +599,34 @@ class ElasticDriver:
                     self._finishing = True
                     self._log(f"{wid} finished")
                 else:
-                    self._failures[w.host] = self._failures.get(w.host, 0) + 1
-                    self._log(
-                        f"{wid} failed with exit code {rc} "
-                        f"(host failures: {self._failures[w.host]})"
+                    requested_respawn = (
+                        rc == REJOIN_EXIT_CODE
+                        and self._rejoin_mode == "respawn"
                     )
+                    if requested_respawn:
+                        # Worker-requested respawn (no in-process rejoin
+                        # support): not a failure, no blacklist count.
+                        # Only honored in respawn mode — the elastic
+                        # runtime never emits 79 in-process, so there an
+                        # exit 79 is a user program's own status and must
+                        # count as a failure (not loop forever).
+                        self._log(f"{wid} exited requesting respawn")
+                    else:
+                        self._failures[w.host] = (
+                            self._failures.get(w.host, 0) + 1
+                        )
+                        self._log(
+                            f"{wid} failed with exit code {rc} "
+                            f"(host failures: {self._failures[w.host]})"
+                        )
                     if self._finishing:
                         # A straggler crashing while the job winds down is
                         # a real failure — there is no world left to
                         # re-form it into.
                         return 1
-                    if self._failures[w.host] >= self._failure_threshold:
+                    if (not requested_respawn
+                            and self._failures[w.host]
+                            >= self._failure_threshold):
                         self._blacklist.add(w.host)
                         self._log(f"blacklisted host {w.host}")
                     del self._workers[wid]
@@ -448,6 +636,15 @@ class ElasticDriver:
                         i for i in self._current_ids if i != wid
                     ]
                     changed = True
+                    if self._rejoin_mode == "respawn":
+                        # Any exit dooms the whole generation: peers
+                        # cannot re-form in-process, so they will either
+                        # persist-and-79 on their own or must be drained.
+                        # Batch the restart — draining everyone before
+                        # publishing keeps respawned workers from
+                        # blocking on transient generations that half the
+                        # world never joins.
+                        self._drain_world_for_restart()
             if self._finishing:
                 if all(w.done for w in self._workers.values()):
                     return 0

@@ -188,12 +188,16 @@ def check_hosts_reachable(hostnames, ssh_port=None, timeout=8.0,
             cache.put_many(fresh)
     unreachable = sorted(h for h, ok in results if not ok)
     if unreachable:
-        raise RuntimeError(
+        err = RuntimeError(
             "hvdrun: unable to connect over ssh to: "
             + ", ".join(unreachable)
             + ". Verify the host names in -H/--hostfile are reachable and "
             "passwordless ssh (BatchMode) is configured."
         )
+        # The elastic path launches with the reachable subset and lets
+        # the driver blacklist/retry the rest.
+        err.failed_hosts = unreachable
+        raise err
 
 
 def build_remote_command(

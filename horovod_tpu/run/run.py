@@ -20,6 +20,71 @@ from typing import Any, Callable, List, Optional
 from . import config_parser, launcher
 
 
+def _preflight_and_nic_probe(hostnames, controller_host, env, args,
+                             fatal=True):
+    """SSH pre-flight + ring NIC probe shared by the fixed and elastic
+    launch paths (reference ``run/run.py:62-115,198-268``).
+
+    Returns the list of hostnames that answered the pre-flight. With
+    ``fatal=True`` (fixed path) an unreachable host raises SystemExit 4;
+    with ``fatal=False`` (elastic path — an unreachable host is a
+    legitimate state the driver handles by blacklisting) it prints the
+    per-host error and returns only the reachable hosts, so the driver
+    starts from a known-good set instead of discovering dead hosts
+    through repeated spawn failures.
+    """
+    hostnames = sorted(dict.fromkeys(hostnames))
+    reachable = list(hostnames)
+    from .disk_cache import default_cache
+
+    try:
+        launcher.check_hosts_reachable(
+            hostnames,
+            ssh_port=args.ssh_port,
+            cache=None if args.disable_cache else default_cache(),
+        )
+    except RuntimeError as e:
+        if fatal:
+            print(str(e), file=sys.stderr)
+            raise SystemExit(4)
+        print(f"[hvdrun] elastic pre-flight: {e}\n[hvdrun] continuing "
+              f"with the reachable subset; the driver will retry/"
+              f"blacklist the rest", file=sys.stderr)
+        bad = set(getattr(e, "failed_hosts", ()))
+        if bad:
+            reachable = [h for h in hostnames if h not in bad]
+
+    # NIC selection for the multi-host control plane: explicit flag wins
+    # (already exported by the caller); with multiple distinct remote
+    # hosts we probe ring-wise over the HMAC-authed services and export
+    # the routable intersection.
+    if not args.network_interfaces and len(reachable) > 1:
+        from . import network
+
+        try:
+            common, host_addrs = network.discover_common_interfaces(
+                reachable, ssh_port=args.ssh_port, return_addresses=True
+            )
+            if common:
+                env["HOROVOD_IFACE"] = ",".join(common)
+                # Controller host's probed address on the first
+                # ring-routable interface: lets the launcher dial the
+                # controller even when its hostname doesn't resolve
+                # from the workers.
+                addrs0 = host_addrs.get(controller_host, {})
+                for intf in common:
+                    if addrs0.get(intf):
+                        env["HOROVOD_PROBED_CONTROLLER_ADDR"] = \
+                            addrs0[intf][0][0]
+                        break
+                if args.verbose:
+                    print(f"[hvdrun] routable interfaces: {common}")
+        except Exception as e:  # probe is best-effort
+            print(f"[hvdrun] NIC probe failed ({e}); continuing without",
+                  file=sys.stderr)
+    return reachable
+
+
 def parse_args(argv: Optional[List[str]] = None):
     parser = argparse.ArgumentParser(
         "hvdrun", description="Launch a horovod_tpu training job."
@@ -195,6 +260,30 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             print("hvdrun: elastic mode needs -np, -H/--hostfile, or "
                   "--host-discovery-script", file=sys.stderr)
             return 2
+        # Pre-flight + NIC discovery on the initial host set (ADVICE r4:
+        # the elastic branch used to return before both, so multi-host
+        # elastic jobs got no HOROVOD_IFACE and dead hosts surfaced only
+        # as repeated spawn failures). Unreachable hosts are dropped —
+        # not fatal — because elastic semantics tolerate them; the
+        # discovery script can bring them (or others) back later.
+        probed_hostset = None
+        if hosts:
+            reachable = _preflight_and_nic_probe(
+                [h for h, _ in hosts], hosts[0][0], env, args, fatal=False
+            )
+            hosts = [(h, c) for h, c in hosts if h in reachable]
+            probed_hostset = reachable
+            if not hosts:
+                print("hvdrun: no initial host is reachable", file=sys.stderr)
+                return 4
+            # The probed controller address maps the INITIAL hosts[0];
+            # the driver re-elects a controller host every generation, so
+            # an inherited pin would be stale (and would leak into nested
+            # launches, which launch_job pops it to prevent). The IFACE
+            # intersection stays — it is host-set-wide, and the driver
+            # re-probes when discovery changes the set.
+            env.pop("HOROVOD_PROBED_CONTROLLER_ADDR", None)
+
         from .elastic_driver import ElasticDriver
 
         return ElasticDriver(
@@ -210,6 +299,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             host_failure_threshold=args.blacklist_threshold,
             ssh_port=args.ssh_port,
             elastic_timeout=args.elastic_timeout,
+            nic_pinned=bool(args.network_interfaces),
+            probed_hostset=probed_hostset,
         ).run()
 
     if args.tpu_pod:
@@ -230,57 +321,18 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             hosts = [("localhost", args.num_proc)]
         slots = launcher.allocate(hosts, args.num_proc)
 
-    # SSH pre-flight (reference run/run.py:62-115): fail fast with a
-    # per-host message when a remote host is unreachable, instead of a
-    # start-timeout minutes into the launch. Successes are disk-cached
-    # with a TTL so repeated launches skip the probe.
+    # SSH pre-flight (reference run/run.py:62-115) + ring NIC probe
+    # (reference run/run.py:198-268), shared with the elastic branch.
+    # TPU pods know their topology from slice metadata and have no
+    # inter-worker ssh; both steps are only for the generic path.
     if not args.tpu_pod:
-        from .disk_cache import default_cache
-
         try:
-            launcher.check_hosts_reachable(
-                sorted({s.hostname for s in slots}),
-                ssh_port=args.ssh_port,
-                # --disable-cache governs the launcher check cache too
-                # (reference parity: run/util/cache.py fn_cache).
-                cache=None if args.disable_cache else default_cache(),
+            _preflight_and_nic_probe(
+                [s.hostname for s in slots], slots[0].hostname, env, args,
+                fatal=True,
             )
-        except RuntimeError as e:
-            print(str(e), file=sys.stderr)
-            return 4
-
-    # NIC selection for the multi-host control plane (reference
-    # run/run.py:198-268 driver/task ring probe): explicit flag wins
-    # (already exported above); with multiple distinct remote hosts we
-    # probe ring-wise over the HMAC-authed services and export the
-    # routable intersection.
-    if not args.network_interfaces and not args.tpu_pod:
-        # TPU pods know their topology from slice metadata and have no
-        # inter-worker ssh; the ring probe is only for the generic path.
-        hostnames = sorted({s.hostname for s in slots})
-        if len(hostnames) > 1:
-            from . import network
-
-            try:
-                common, host_addrs = network.discover_common_interfaces(
-                    hostnames, ssh_port=args.ssh_port, return_addresses=True
-                )
-                if common:
-                    env["HOROVOD_IFACE"] = ",".join(common)
-                    # Rank 0's probed address on the first ring-routable
-                    # interface: lets the launcher dial the controller even
-                    # when its hostname doesn't resolve from the workers.
-                    addrs0 = host_addrs.get(slots[0].hostname, {})
-                    for intf in common:
-                        if addrs0.get(intf):
-                            env["HOROVOD_PROBED_CONTROLLER_ADDR"] = \
-                                addrs0[intf][0][0]
-                            break
-                    if args.verbose:
-                        print(f"[hvdrun] routable interfaces: {common}")
-            except Exception as e:  # probe is best-effort
-                print(f"[hvdrun] NIC probe failed ({e}); continuing without",
-                      file=sys.stderr)
+        except SystemExit as e:
+            return e.code
 
     return launcher.launch_job(
         command,

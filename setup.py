@@ -39,7 +39,13 @@ setup(
     packages=find_packages(include=["horovod_tpu", "horovod_tpu.*"]),
     package_data={"horovod_tpu": ["../cpp/libhvd_core.so"]},
     python_requires=">=3.10",
-    install_requires=["numpy", "jax", "pyyaml"],
+    # jax range pinned deliberately (VERDICT r4 #4): elastic in-process
+    # recovery rides two private surfaces (xla_bridge._clear_backends,
+    # the jax_enable_recoverability flag) that are capability-probed at
+    # init — outside this validated range the probe may flip recovery to
+    # the public-API respawn fallback, which still works but restarts
+    # worker processes instead of re-forming the world in place.
+    install_requires=["numpy", "jax>=0.9,<0.11", "pyyaml"],
     extras_require={
         "flax": ["flax", "optax"],
         "pytorch": ["torch"],
