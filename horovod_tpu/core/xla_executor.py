@@ -154,6 +154,17 @@ class XlaPlanExecutor(PlanExecutor):
         )
         self._fn_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
+        # Device-order fence: the previous plan's output arrays. XLA
+        # dispatch is async (CPU included), and plans may be consumed by
+        # DIFFERENT threads (the executor thread or an inline
+        # synchronize() caller — native_runtime._consumer_lock): without
+        # an explicit fence, two in-flight collective executions can
+        # reach the backend's rendezvous out of plan order on one rank
+        # and deadlock/mismatch against its peers ("received data size
+        # doesn't match expected size"). Blocking on plan K's outputs
+        # before dispatching K+1 pins the device-side order to the plan
+        # order on every rank.
+        self._inflight_outs: Optional[list] = None
 
     # --- process sets ---
     def register_process_set(self, psid: int, ranks) -> None:
@@ -309,21 +320,36 @@ class XlaPlanExecutor(PlanExecutor):
     # --- execution ---
     def execute(self, plan: dict, entries, topo: Topology) -> Dict[str, Any]:
         ptype = plan["type"]
+        # Device-order fence (see _inflight_outs): the previous plan's
+        # collective must be fully done before this one dispatches.
+        prev = self._inflight_outs
+        if prev is not None:
+            self._inflight_outs = None
+            try:
+                self._jax.block_until_ready(prev)
+            except Exception:  # noqa: BLE001 - its plan already reported
+                pass
         # Non-members never receive set plans (the core skips them at
         # dispatch), so ctx.index >= 0 here by construction.
         ctx = self._set_ctx(plan)
         if ptype in (0, 6):  # allreduce / adasum
-            return self._allreduce(plan, entries, adasum=(ptype == 6),
-                                   ctx=ctx)
-        if ptype == 1:
-            return self._allgather(plan, entries, ctx=ctx)
-        if ptype == 2:
-            return self._broadcast(plan, entries, ctx=ctx)
-        if ptype == 4:
-            return self._alltoall(plan, entries, ctx=ctx)
-        if ptype == 5:
-            return self._reducescatter(plan, entries, ctx=ctx)
-        raise RuntimeError(f"unsupported plan type {ptype}")
+            out = self._allreduce(plan, entries, adasum=(ptype == 6),
+                                  ctx=ctx)
+        elif ptype == 1:
+            out = self._allgather(plan, entries, ctx=ctx)
+        elif ptype == 2:
+            out = self._broadcast(plan, entries, ctx=ctx)
+        elif ptype == 4:
+            out = self._alltoall(plan, entries, ctx=ctx)
+        elif ptype == 5:
+            out = self._reducescatter(plan, entries, ctx=ctx)
+        else:
+            raise RuntimeError(f"unsupported plan type {ptype}")
+        self._inflight_outs = [
+            v for v in out.values()
+            if v is not None and not isinstance(v, np.ndarray)
+        ] or None
+        return out
 
     def _pack(self, entries) -> Tuple[np.ndarray, List[Tuple[int, ...]], str]:
         shapes = [tuple(int(d) for d in e.tensor.shape) for e in entries]
