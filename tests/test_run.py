@@ -238,3 +238,86 @@ def test_ssh_preflight_failure_not_cached(monkeypatch, tmp_path):
     rc["v"] = 0
     launcher.check_hosts_reachable(["flaky"], cache=cache)
     assert len(calls) == 2
+
+
+def test_ssh_fanout_end_to_end_via_shim(tmp_path):
+    """Two-'host' end-to-end through the REAL ssh fan-out (VERDICT r4 #8:
+    the ssh path + ring NIC probe had only unit/mock coverage). A PATH
+    shim stands in for the ssh binary — it consumes the option prefix and
+    execs the remote command string locally — so every production layer
+    runs for real: hostfile parsing, the BatchMode pre-flight, the
+    HMAC-authed ring NIC probe over 'hosta'/'hostb' (whose probed
+    127.0.0.1 answer is the ONLY reason the unresolvable fake hostnames
+    can rendezvous — exercising HOROVOD_PROBED_CONTROLLER_ADDR for
+    real), build_remote_command's cd+env-prefix quoting, and the fan-out
+    kill/collect loop. For real two-container coverage see
+    docker-compose.ssh.yml + tools/ssh_e2e_compose.sh."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shim_dir = tmp_path / "bin"
+    shim_dir.mkdir()
+    shim = shim_dir / "ssh"
+    shim.write_text(textwrap.dedent("""\
+        #!/bin/sh
+        # Fake ssh: swallow options, record the target host, run locally.
+        while [ $# -gt 0 ]; do
+          case "$1" in
+            -o|-p) shift 2 ;;
+            -*) shift ;;
+            *) break ;;
+          esac
+        done
+        host="$1"; shift
+        echo "$host" >> "$SSH_SHIM_LOG"
+        exec /bin/sh -c "$*"
+        """))
+    shim.chmod(0o755)
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""\
+        import os
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        import numpy as np
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        s = hvd.allreduce(jnp.full((2,), float(hvd.rank() + 1)),
+                          op=hvd.Sum, name='e2e')
+        print('SSHE2E', hvd.rank(), hvd.size(), float(np.asarray(s)[0]),
+              flush=True)
+        hvd.shutdown()
+        """))
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PATH"] = f"{shim_dir}{os.pathsep}" + env.get("PATH", "")
+    env["SSH_SHIM_LOG"] = str(tmp_path / "ssh_calls.log")
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo, env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    out_dir = tmp_path / "out"
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "-H", "hosta:1,hostb:1", "--disable-cache",
+         "--output-dir", str(out_dir), sys.executable, str(worker)],
+        env=env, cwd=repo, capture_output=True, timeout=240, text=True,
+    )
+    outs = {}
+    for fn in os.listdir(out_dir):
+        outs[fn] = (out_dir / fn).read_text()
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, outs)
+    lines = sorted(
+        l for o in outs.values() for l in o.splitlines()
+        if l.startswith("SSHE2E")
+    )
+    # Sum over ranks: 1.0 + 2.0 = 3.0 on both.
+    assert lines == ["SSHE2E 0 2 3.0", "SSHE2E 1 2 3.0"], (lines, outs)
+    # Both fake hosts went through the ssh binary (pre-flight + probe +
+    # fan-out), not through any local-spawn shortcut.
+    ssh_hosts = set(
+        (tmp_path / "ssh_calls.log").read_text().split()
+    )
+    assert {"hosta", "hostb"} <= ssh_hosts, ssh_hosts
