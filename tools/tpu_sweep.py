@@ -17,7 +17,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "BENCH_TPU_SWEEP_R04.jsonl")
+OUT = os.path.join(REPO, "BENCH_TPU_SWEEP_R05.jsonl")
 PY = sys.executable
 
 # label, extra bench.py args. Ordered by information value: the MFU
