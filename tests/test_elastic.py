@@ -612,6 +612,67 @@ def test_elastic_respawn_fallback_recovery():
     assert hadsnaps, (outs, stderr)
 
 
+def test_driver_service_retirement_supersession_clock():
+    """_retire_services must measure the drain grace from when a service
+    was SUPERSEDED, not created (review r5): a generation stable for an
+    hour still has stragglers abandoned only seconds before the next
+    publish, and retiring its service instantly would fatally abort
+    them; conversely keep=0 (driver exit) drains everything."""
+    import time as _time
+
+    from horovod_tpu.run.elastic_driver import ElasticDriver
+
+    class _Svc:
+        def __init__(self):
+            self.down = False
+
+        def shutdown(self):
+            self.down = True
+
+    drv = ElasticDriver.__new__(ElasticDriver)  # no __init__: unit scope
+    drv._services = []
+    drv._verbose = False
+    drv._log = lambda msg: None
+    now = _time.monotonic()
+    old = _Svc()
+    # Service created an hour ago but superseded only now.
+    drv._services.append([1, old, None, 10])
+    drv._services[-1][2] = now  # superseded at this instant
+    for gen in (2, 3, 4):
+        drv._services.append([gen, _Svc(), now, 10])
+    drv._retire_services(keep=2)
+    assert not old.down  # superseded seconds ago: still in grace
+    # Past the grace window (2x heartbeat) it retires.
+    drv._services[0][2] = now - 21
+    drv._retire_services(keep=2)
+    assert old.down
+    # keep=0 ignores grace: driver exit drains everything.
+    remaining = [s[1] for s in drv._services]
+    drv._retire_services(keep=0)
+    assert not drv._services and all(s.down for s in remaining)
+
+
+def test_driver_79_exit_is_failure_in_inprocess_mode():
+    """Exit status 79 is the respawn request ONLY in respawn mode; the
+    in-process runtime never emits it, so there a user program exiting
+    79 must count toward failure/blacklisting instead of respawning
+    forever (review r5)."""
+    proc, outs = _run_elastic(
+        """
+        sys.exit(79)
+        """,
+        ["-np", "2", "--min-np", "2", "--max-np", "2",
+         "--blacklist-threshold", "2"],
+        extra_env={"HOROVOD_ELASTIC_REJOIN_MODE": "inprocess"},
+        timeout=120,
+    )
+    stderr = proc.stderr.decode()
+    assert proc.returncode != 0, (stderr, outs)
+    assert "failed with exit code 79" in stderr, stderr
+    assert "requesting respawn" not in stderr, stderr
+    assert "blacklisted" in stderr, stderr
+
+
 def test_respawn_persist_payload_covers_all_snapshots():
     """The respawn snapshot must carry EVERY ``_saved*`` attribute a
     subclass's save() produces — an allowlist would silently drop e.g.
