@@ -253,6 +253,12 @@ class Core {
   Status Enqueue(const Request& req, uint64_t* ticket);
   Status EnqueueJoin(uint64_t* ticket);
 
+  // Latency hint from a synchronously-waiting producer: everything this
+  // caller will submit is already queued, so the next cycle may seal
+  // immediately instead of holding the fusion grace/linger for
+  // companions that are not coming.
+  void FlushHint();
+
   // Process sets (later-reference horovod.ProcessSet parity): register a
   // rank subset under a nonzero id. MUST be called identically on every
   // rank before any collective uses the id (the Python layer enforces
@@ -309,6 +315,7 @@ class Core {
   std::vector<Request> queued_;
   std::condition_variable wake_cv_;
   bool wake_ = false;
+  bool flush_hint_ = false;        // guarded by table_mu_
   // Groups that could not fuse into a single response (heterogeneous
   // member signatures): observability for grouped_allreduce.
   std::atomic<long long> grouped_splits_{0};

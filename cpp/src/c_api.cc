@@ -131,6 +131,8 @@ int hvd_core_init(int rank, int size, int local_rank, int local_size,
 
 void hvd_core_shutdown() { Core::Get().Shutdown(); }
 
+void hvd_core_flush_hint() { Core::Get().FlushHint(); }
+
 int hvd_core_initialized() { return Core::Get().initialized() ? 1 : 0; }
 int hvd_core_rank() { return Core::Get().config().rank; }
 int hvd_core_size() { return Core::Get().config().size; }

@@ -431,6 +431,7 @@ Status Core::Init(const CoreConfig& cfg) {
     // path instead of the full fusion window.
     std::lock_guard<std::mutex> l(table_mu_);
     wake_ = false;
+    flush_hint_ = false;
     last_cycle_nreq_ = 2;
   }
   thread_ = std::thread(&Core::BackgroundLoop, this);
@@ -607,6 +608,16 @@ Status Core::EnqueueJoin(uint64_t* ticket) {
   return Status::OK();
 }
 
+void Core::FlushHint() {
+  {
+    std::lock_guard<std::mutex> l(table_mu_);
+    if (queued_.empty()) return;  // nothing pending; no cycle to hurry
+    flush_hint_ = true;
+    wake_ = true;
+  }
+  wake_cv_.notify_one();
+}
+
 Status Core::StartTimeline(const std::string& path, bool mark_cycles) {
   if (timeline_.initialized()) {
     return Status::Error(StatusCode::kPreconditionError,
@@ -715,7 +726,20 @@ void Core::BackgroundLoop() {
       wake_ = false;
     }
     if (shutdown_.load()) break;
-    if (woke_early && linger_s_ > 0) {
+    // Consume a pending flush hint (a synchronize() caller is already
+    // blocked: everything it will submit is queued). Checked again
+    // inside the grace/linger waits below — with eager wakeup the
+    // common timing is enqueue-wakes-the-loop BEFORE the producer
+    // reaches synchronize(), so the hint lands mid-wait and must be
+    // able to cut that wait short, not leak into the next cycle.
+    auto take_flush = [&]() {
+      std::lock_guard<std::mutex> l(table_mu_);
+      bool f = flush_hint_;
+      flush_hint_ = false;
+      return f;
+    };
+    bool flush = take_flush();
+    if (woke_early && !flush && linger_s_ > 0) {
       // Quiescence-based fusion window: wait until no new submission has
       // arrived for the window (each arrival restarts it), bounded by one
       // cycle_time — a burst with gaps under the linger always fuses
@@ -752,6 +776,11 @@ void Core::BackgroundLoop() {
         while (!shutdown_.load() && NowSec() - start < grace) {
           {
             std::lock_guard<std::mutex> l(table_mu_);
+            if (flush_hint_) {
+              // Producer is blocked waiting: seal now.
+              flush_hint_ = false;
+              break;
+            }
             if (queued_.size() > 1) {
               window = linger_s_;
               break;
@@ -766,11 +795,19 @@ void Core::BackgroundLoop() {
         double since;
         {
           std::lock_guard<std::mutex> l(table_mu_);
+          if (flush_hint_) {
+            // All of the burst is queued (its producer moved on to
+            // synchronize): the rest of the linger buys nothing.
+            flush_hint_ = false;
+            break;
+          }
           since = NowSec() - last_enqueue_;
         }
         if (since >= window) break;
-        std::this_thread::sleep_for(
-            std::chrono::duration<double>(window - since));
+        // Bounded slices so a flush hint landing mid-linger cuts the
+        // wait within ~200us instead of sleeping the full window.
+        std::this_thread::sleep_for(std::chrono::duration<double>(
+            std::min(window - since, 2e-4)));
       }
     }
     RunCycleOnce();
@@ -815,6 +852,9 @@ void Core::RunCycleOnce() {
     std::lock_guard<std::mutex> l(table_mu_);
     mine.requests = std::move(queued_);
     queued_.clear();
+    // A hint raced in for requests this cycle is about to carry; it
+    // must not suppress the NEXT cycle's fusion window.
+    flush_hint_ = false;
     // Burst history for the adaptive linger: only non-empty cycles count
     // (idle cadence ticks between training steps must not erase the
     // "this workload fuses" signal, or every step's burst would re-enter

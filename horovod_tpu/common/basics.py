@@ -58,6 +58,9 @@ def load() -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int,
     ]
     lib.hvd_core_shutdown.restype = None
+    # Older prebuilt cores may predate the flush-hint export.
+    if hasattr(lib, "hvd_core_flush_hint"):
+        lib.hvd_core_flush_hint.restype = None
     lib.hvd_core_initialized.restype = ctypes.c_int
     for fn in ("rank", "size", "local_rank", "local_size", "cross_rank",
                "cross_size"):
@@ -148,6 +151,14 @@ class NativeCore:
 
     def shutdown(self) -> None:
         self.lib.hvd_core_shutdown()
+
+    def flush_hint(self) -> None:
+        """Tell the core a producer is now blocked waiting: the next
+        cycle may seal immediately (skip the fusion grace/linger). No-op
+        on cores built before the export existed."""
+        fn = getattr(self.lib, "hvd_core_flush_hint", None)
+        if fn is not None:
+            fn()
 
     def initialized(self) -> bool:
         return bool(self.lib.hvd_core_initialized())

@@ -116,6 +116,9 @@ class NativeRuntime:
         self._inline_sync = _os.environ.get(
             "HOROVOD_INLINE_SYNC", "1"
         ) not in ("0", "false")
+        self._flush_hint = _os.environ.get(
+            "HOROVOD_FLUSH_HINT", "1"
+        ) not in ("0", "false")
         # Count of threads currently blocked in synchronize(): while any
         # exist, the executor thread parks so the hot thread wins the
         # consumer role (with a plain race, the executor — usually
@@ -454,6 +457,16 @@ class NativeRuntime:
         if self._inline_sync:
             with self._cv:
                 self._sync_waiters += 1
+        # This thread is now committed to waiting: anything it was going
+        # to submit is already queued, so the core may seal the next
+        # cycle immediately instead of holding the fusion grace for
+        # companions that are not coming. Independent of the inline-sync
+        # knob — a non-inline waiter is equally committed.
+        if self._flush_hint:
+            try:
+                self.core.flush_hint()
+            except Exception:  # noqa: BLE001 - hint only
+                pass
         try:
             while True:
                 if self.poll(handle):
