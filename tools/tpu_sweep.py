@@ -106,11 +106,26 @@ def main():
                     pass
     pending = [(lb, ex, 0) for lb, ex in QUEUE if lb not in done]
     parked = []
+    overlap_json = os.path.join(REPO, "PROFILE_OVERLAP.json")
     while pending and time.monotonic() - T0 < TOTAL_BUDGET:
         if not probe():
             log("tunnel down; waiting")
             time.sleep(PROBE_GAP)
             continue
+        # First contact with a live tunnel: grab the overlap profile
+        # (VERDICT r5 directive 3) before the long bench configs — the
+        # tunnel can die again at any time and this artifact is cheap.
+        if not os.path.exists(overlap_json):
+            log("running overlap profile")
+            try:
+                subprocess.run(
+                    [PY, os.path.join(REPO, "tools",
+                                      "tpu_profile_overlap.py")],
+                    timeout=900, cwd=REPO,
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                )
+            except subprocess.TimeoutExpired:
+                log("overlap profile timed out")
         label, extra, tries = pending[0]
         cap = run_config(label, extra)
         if cap is not None:
