@@ -320,6 +320,33 @@ def test_alltoall_two_ranks():
         assert "A2AVE (0, 2) [0, 0]" in out, outs
 
 
+def test_eager_latency_knobs_disabled_path():
+    """HOROVOD_INLINE_SYNC=0 / HOROVOD_FLUSH_HINT=0 restore the
+    executor-thread-only consumption and the plain fusion grace; the
+    kill switches must keep producing correct numerics (they are the
+    documented escape hatch if the round-5 fast paths misbehave on
+    some backend)."""
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        for i in range(4):
+            r = hvd.allreduce(jnp.full((8,), float(hvd.rank() + 1)),
+                              op=hvd.Sum, name=f'k{i}')
+        g = hvd.allgather(jnp.full((2,), float(hvd.rank())), name='kg')
+        print('KNOBS', float(np.asarray(r)[0]),
+              np.asarray(g).reshape(-1).tolist())
+        hvd.shutdown()
+        """,
+        extra_env={"HOROVOD_INLINE_SYNC": "0", "HOROVOD_FLUSH_HINT": "0"},
+    )
+    for out in outs:
+        assert "KNOBS 3.0 [0.0, 0.0, 1.0, 1.0]" in out, outs
+
+
 def test_alltoallv_skewed_splits_bounded_carrier():
     """VERDICT r4 #7: a heavily skewed split (one destination 1000x the
     others) must NOT allocate an O(n * max_split) carrier — the chunked
