@@ -612,6 +612,59 @@ def test_elastic_respawn_fallback_recovery():
     assert hadsnaps, (outs, stderr)
 
 
+def test_driver_nic_probe_on_host_set_change(monkeypatch):
+    """The driver ring-probes NICs when discovery changes the host set
+    (ADVICE r4: discovery-only elastic jobs got no HOROVOD_IFACE):
+    probed once per distinct multi-remote set, skipped for local-only
+    sets, for sets already probed at launch, and under an explicit
+    --network-interfaces pin."""
+    from horovod_tpu.run import network
+    from horovod_tpu.run.elastic_driver import ElasticDriver
+    from horovod_tpu.run.launcher import SlotInfo
+
+    calls = []
+
+    def fake_probe(hostnames, ssh_port=None):
+        calls.append(tuple(hostnames))
+        return ["eth1"]
+
+    monkeypatch.setattr(network, "discover_common_interfaces", fake_probe)
+
+    def slots(*hosts):
+        return [
+            SlotInfo(hostname=h, rank=i, local_rank=0, local_size=1,
+                     cross_rank=i, cross_size=len(hosts), size=len(hosts))
+            for i, h in enumerate(hosts)
+        ]
+
+    drv = ElasticDriver.__new__(ElasticDriver)
+    drv._env = {}
+    drv._ssh_port = None
+    drv._nic_pinned = False
+    drv._probed_hostset = ["hosta", "hostb"]  # launch-time probe
+    drv._verbose = False
+    drv._log = lambda msg: None
+
+    # Same set as launch: no re-probe.
+    drv._maybe_probe_nics(slots("hosta", "hostb"))
+    assert calls == []
+    # Discovery adds a host: probe fires and exports the intersection.
+    drv._maybe_probe_nics(slots("hosta", "hostb", "hostc"))
+    assert calls == [("hosta", "hostb", "hostc")]
+    assert drv._env["HOROVOD_IFACE"] == "eth1"
+    # Unchanged set: cached.
+    drv._maybe_probe_nics(slots("hostc", "hostb", "hosta"))
+    assert len(calls) == 1
+    # Local-only world: never probed.
+    drv._probed_hostset = None
+    drv._maybe_probe_nics(slots("localhost", "localhost"))
+    assert len(calls) == 1
+    # Explicit pin wins.
+    drv._nic_pinned = True
+    drv._maybe_probe_nics(slots("hostx", "hosty"))
+    assert len(calls) == 1
+
+
 def test_driver_service_retirement_supersession_clock():
     """_retire_services must measure the drain grace from when a service
     was SUPERSEDED, not created (review r5): a generation stable for an
