@@ -655,9 +655,10 @@ def test_driver_nic_probe_on_host_set_change(monkeypatch):
     # Unchanged set: cached.
     drv._maybe_probe_nics(slots("hostc", "hostb", "hosta"))
     assert len(calls) == 1
-    # Local-only world: never probed.
+    # Local-only world (two DISTINCT local spellings, so the all-local
+    # guard is what fires, not the single-hostname one): never probed.
     drv._probed_hostset = None
-    drv._maybe_probe_nics(slots("localhost", "localhost"))
+    drv._maybe_probe_nics(slots("localhost", "127.0.0.1"))
     assert len(calls) == 1
     # Explicit pin wins.
     drv._nic_pinned = True
