@@ -707,10 +707,10 @@ def _alltoallv_schedule(matrix: Any, n: int) -> tuple:
 
     import numpy as np
 
-    max_block = int(np.asarray(matrix).max())
+    m = np.asarray(matrix)
+    max_block = int(m.max())
     factor = int(os.environ.get("HOROVOD_ALLTOALLV_CARRIER_FACTOR", "4"))
-    cap = max(1, (factor * int(np.asarray(matrix).sum()) + n * n - 1)
-              // (n * n))
+    cap = max(1, (factor * int(m.sum()) + n * n - 1) // (n * n))
     chunk = min(max_block, cap)
     rounds = (max_block + chunk - 1) // chunk
     return chunk, rounds

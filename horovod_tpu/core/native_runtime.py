@@ -12,6 +12,7 @@ the core's autotuner and timeline).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -111,20 +112,23 @@ class NativeRuntime:
         # a completion callback may legally synchronize() another handle
         # (nested consumption by the same thread must not deadlock).
         self._consumer_lock = threading.RLock()
-        import os as _os
-
-        self._inline_sync = _os.environ.get(
+        self._inline_sync = os.environ.get(
             "HOROVOD_INLINE_SYNC", "1"
         ) not in ("0", "false")
-        self._flush_hint = _os.environ.get(
+        self._flush_hint = os.environ.get(
             "HOROVOD_FLUSH_HINT", "1"
         ) not in ("0", "false")
         # Count of threads currently blocked in synchronize(): while any
         # exist, the executor thread parks so the hot thread wins the
         # consumer role (with a plain race, the executor — usually
         # already blocked inside next_plan's C++ wait — would keep
-        # winning and the fast path would never engage).
+        # winning and the fast path would never engage). _no_waiters is
+        # the park signal: set while the count is zero, so the executor
+        # blocks on it instead of busy-polling and wakes the moment the
+        # last waiter leaves.
         self._sync_waiters = 0
+        self._no_waiters = threading.Event()
+        self._no_waiters.set()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._executor_loop, name="hvd_plan_executor", daemon=True
@@ -307,8 +311,9 @@ class NativeRuntime:
             while not self._stop.is_set():
                 if self._sync_waiters > 0:
                     # A synchronize() caller is inline-draining; park so
-                    # the hot thread keeps the consumer role.
-                    time.sleep(0.0005)
+                    # the hot thread keeps the consumer role. Bounded
+                    # wait: _stop has no channel into this Event.
+                    self._no_waiters.wait(timeout=0.05)
                     continue
                 with self._consumer_lock:
                     if self._sync_waiters > 0:
@@ -457,6 +462,7 @@ class NativeRuntime:
         if self._inline_sync:
             with self._cv:
                 self._sync_waiters += 1
+                self._no_waiters.clear()
         # This thread is now committed to waiting: anything it was going
         # to submit is already queued, so the core may seal the next
         # cycle immediately instead of holding the fusion grace for
@@ -504,3 +510,5 @@ class NativeRuntime:
             if self._inline_sync:
                 with self._cv:
                     self._sync_waiters -= 1
+                    if self._sync_waiters == 0:
+                        self._no_waiters.set()

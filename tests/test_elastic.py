@@ -660,6 +660,9 @@ def test_driver_nic_probe_on_host_set_change(monkeypatch):
     drv._probed_hostset = None
     drv._maybe_probe_nics(slots("localhost", "127.0.0.1"))
     assert len(calls) == 1
+    # Single remote hostname (all slots on one box): nothing to ring.
+    drv._maybe_probe_nics(slots("hostz", "hostz"))
+    assert len(calls) == 1
     # Explicit pin wins.
     drv._nic_pinned = True
     drv._maybe_probe_nics(slots("hostx", "hosty"))
