@@ -149,14 +149,14 @@ def test_jax_tp_pp_demo():
     assert "heterogeneous LM" in proc.stdout
 
 
-def _run_elastic_example(script, expect, np_=2):
+def _run_elastic_example(script, expect, np_=2, extra_env=None):
     """Elastic example smoke run through the shared conftest harness."""
     from conftest import run_elastic_job
 
     proc, outs = run_elastic_job(
         ["-np", str(np_), "--min-np", str(np_), "--max-np", str(np_)],
         script_path=os.path.join(REPO, "examples", script),
-        timeout=420,
+        timeout=420, extra_env=extra_env,
     )
     out = "".join(v for k, v in outs.items() if not k.endswith(".err"))
     assert proc.returncode == 0, (proc.stdout, proc.stderr, out)
@@ -170,6 +170,19 @@ def test_jax_elastic_train():
     role)."""
     out = _run_elastic_example("jax_elastic_train.py",
                                "done: 200 steps on 2 ranks")
+    err = float(out.split("|w - w*| = ")[1].split()[0])
+    assert err < 0.05, out
+
+
+def test_jax_elastic_train_respawn_mode():
+    """The same unmodified elastic example under the respawn fallback
+    (HOROVOD_ELASTIC_REJOIN_MODE=respawn): user code needs zero changes
+    when the private-API in-process path is unavailable — the mode is a
+    launcher/runtime concern."""
+    out = _run_elastic_example(
+        "jax_elastic_train.py", "done: 200 steps on 2 ranks",
+        extra_env={"HOROVOD_ELASTIC_REJOIN_MODE": "respawn"},
+    )
     err = float(out.split("|w - w*| = ")[1].split()[0])
     assert err < 0.05, out
 
