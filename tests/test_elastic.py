@@ -912,13 +912,27 @@ def _run_crash_schedule(schedule, total_steps, exit_base,
     )
     stderr = proc.stderr.decode()
     assert proc.returncode == 0, (stderr, outs)
-    # Count the crashes from the victims' own markers, not driver log
-    # lines: in respawn mode a crash is often reaped code-blind (a
-    # fellow worker's rejoin exit wins the race and the victim drains),
-    # so its exit code never reaches the driver log.
+    # Count the crashes from the victims' own markers: in respawn mode a
+    # crash is often reaped code-blind (a fellow worker's rejoin exit
+    # wins the race and the victim drains), so its exit code never
+    # reaches the driver log.
     all_out = "\n".join(outs.values())
     fired = sum(f"CRASHED {i}" in all_out for i in range(len(schedule)))
     assert fired == len(schedule), (schedule, all_out, stderr)
+    respawn = (extra_env or {}).get(
+        "HOROVOD_ELASTIC_REJOIN_MODE") == "respawn"
+    if respawn:
+        # Pin the path: the respawn machinery must actually be active.
+        assert "rejoin mode: respawn" in stderr, stderr
+        assert "world restart" in stderr, stderr
+    else:
+        # In-process mode reaps every crash itself — keep the stricter
+        # driver-side exit-code attribution there.
+        attributed = sum(
+            f"failed with exit code {exit_base + i}" in stderr
+            for i in range(len(schedule))
+        )
+        assert attributed == len(schedule), (schedule, stderr)
     finals = [l for o in outs.values() for l in o.splitlines()
               if l.startswith("FINAL")]
     assert len(finals) == 3, (finals, stderr)
