@@ -189,9 +189,21 @@ def phase_a(args):
 
 
 def phase_b(args):
-    """Topology AOT: compile the 8-chip step without chips and read the
-    optimized schedule for async collective overlap."""
+    """Topology AOT: compile the REAL 8-chip DP ResNet-50 train step
+    against a TPU topology description (no chips needed — the PJRT
+    plugin serves topologies offline) and read XLA's OPTIMIZED SCHEDULE
+    for latency hiding: async ``all-reduce-start``/``-done`` pairs with
+    compute (fusions/convolutions) scheduled between them are the
+    overlap, straight from the program that would run."""
     import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.jax import _shard_map
+    from horovod_tpu.models import get_model
 
     try:
         from jax.experimental import topologies
@@ -204,44 +216,136 @@ def phase_b(args):
     except Exception as exc:  # noqa: BLE001 - plugin can't serve topology
         return {"status": f"topology '{args.topology}' unavailable: {exc!r}"}
     try:
-        from jax.sharding import PartitionSpec as P  # noqa: F401
+        devs = np.array(topo.devices)
+        n = devs.size
+        mesh = Mesh(devs.reshape(n), ("data",))
+        global_batch = args.batch_size * n
 
-        devs = topo.devices
-        saved = args.devices
-        args.devices = len(devs)
-        # Rebuild against topology devices via AOT lowering.
-        jits, inputs, _, _ = _build_step_for_devices(args, devs)
-        lowered = jits["step"].lower(*jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-            inputs["step"],
-        ))
-        compiled = lowered.compile(
-            compiler_options=None, topology=topo,
+        model = get_model("resnet50", num_classes=1000)
+        img_aval = jax.ShapeDtypeStruct(
+            (global_batch, args.image_size, args.image_size, 3),
+            jnp.float32,
         )
-        hlo = compiled.as_text()
-        args.devices = saved
+        lbl_aval = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
+        # Abstract init: shapes only, nothing executes on any backend —
+        # the rng must be an aval too (a concrete PRNGKey would
+        # materialize on the default device, and with the tunnel down
+        # that first backend touch hangs).
+        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        var_avals = jax.eval_shape(
+            lambda r, x: model.init(r, x, train=False),
+            rng_aval,
+            jax.ShapeDtypeStruct((2,) + img_aval.shape[1:], jnp.float32),
+        )
+        params_aval = var_avals["params"]
+        bs_aval = var_avals["batch_stats"]
+        tx = optax.sgd(0.01, momentum=0.9)
+        opt_aval = jax.eval_shape(tx.init, params_aval)
+
+        def loss_fn(p, bs, x, y):
+            out = model.apply(
+                {"params": p, "batch_stats": bs}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            logits, new_state = out
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).mean()
+            return loss, new_state["batch_stats"]
+
+        def full_step(p, bs, s, x, y):
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(p, bs, x, y)
+            grads = hvdj.allreduce_gradients(
+                grads,
+                fusion_threshold_bytes=args.fusion_mb * 1024 * 1024,
+            )
+            new_bs = jax.tree.map(
+                lambda v: jax.lax.pmean(v, "data"), new_bs
+            )
+            updates, s = tx.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return p, new_bs, s, jax.lax.pmean(loss, "data")
+
+        fn = jax.jit(_shard_map(
+            full_step, mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P()),
+        ), donate_argnums=(0, 1, 2))
+
+        rep = NamedSharding(mesh, P())
+        dat = NamedSharding(mesh, P("data"))
+
+        def shard(aval, sharding):
+            return jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=sharding
+                ),
+                aval,
+            )
+
+        opts = {}
+        if args.latency_hiding:
+            opts["xla_tpu_enable_latency_hiding_scheduler"] = "true"
+        for kv in args.compiler_opt:
+            k, _, v = kv.partition("=")
+            opts[k] = v
+        hlo = fn.lower(
+            shard(params_aval, rep), shard(bs_aval, rep),
+            shard(opt_aval, rep), shard(img_aval, dat),
+            shard(lbl_aval, dat),
+        ).compile(compiler_options=opts or None).as_text()
+        if args.dump_hlo:
+            with open(args.dump_hlo, "w") as f:
+                f.write(hlo)
     except Exception as exc:  # noqa: BLE001
         return {"status": f"AOT compile failed: {exc!r}"}
-    starts = hlo.count("all-reduce-start")
-    dones = hlo.count("all-reduce-done")
-    # Rough overlap witness: in a latency-hidden schedule the -start and
-    # -done of each pair are separated by compute instructions.
     return {
         "status": "ok",
-        "async_all_reduce_pairs": min(starts, dones),
-        "hlo_bytes": len(hlo),
+        "fusion_mb": args.fusion_mb,
+        "latency_hiding_flag": bool(args.latency_hiding),
+        **_schedule_overlap_stats(hlo),
     }
 
 
-def _build_step_for_devices(args, devices):
-    import jax
+def _schedule_overlap_stats(hlo: str) -> dict:
+    """Overlap evidence from an optimized-HLO schedule: for every async
+    collective pair, how many compute instructions (fusions /
+    convolutions) the scheduler placed between -start and -done."""
+    import re
 
-    real = jax.devices
-    jax.devices = lambda *a, **k: list(devices)  # noqa: E731
-    try:
-        return _build_step(args)
-    finally:
-        jax.devices = real
+    lines = hlo.splitlines()
+    starts = {}  # var name -> line index
+    pairs = []
+    compute_re = re.compile(r"=\s*\S*\s*(fusion|convolution)\(")
+    start_re = re.compile(r"(%?\S+)\s*=\s*\S+\s+all-reduce-start\(")
+    done_re = re.compile(r"all-reduce-done\((%?\S+?)[),]")
+    for i, ln in enumerate(lines):
+        m = start_re.search(ln)
+        if m:
+            starts[m.group(1).rstrip(")")] = i
+            continue
+        m = done_re.search(ln)
+        if m:
+            op = m.group(1)
+            j = starts.pop(op, None)
+            if j is not None:
+                between = sum(
+                    1 for k in range(j + 1, i)
+                    if compute_re.search(lines[k])
+                )
+                pairs.append(between)
+    return {
+        "async_all_reduce_pairs": len(pairs),
+        "compute_ops_overlapped_per_pair": pairs,
+        "pairs_with_overlap": sum(1 for p in pairs if p > 0),
+        "sync_all_reduce_count": sum(
+            1 for ln in lines
+            if " all-reduce(" in ln and "start" not in ln
+        ),
+        "hlo_bytes": len(hlo),
+    }
 
 
 def main() -> int:
@@ -252,8 +356,42 @@ def main() -> int:
     ap.add_argument("--devices", type=int, default=0)
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--topology", default="v5e:2x4")
+    ap.add_argument("--fusion-mb", type=int, default=64,
+                    help="gradient fusion bucket size for phase B")
+    ap.add_argument("--latency-hiding", action="store_true",
+                    help="compile phase B with the TPU latency-hiding "
+                         "scheduler / async collectives enabled")
+    ap.add_argument("--compiler-opt", action="append", default=[],
+                    help="extra XLA option for phase B as key=value "
+                         "(repeatable)")
+    ap.add_argument("--dump-hlo", default=None,
+                    help="write phase B's optimized HLO text here")
     ap.add_argument("--skip-phase-b", action="store_true")
+    ap.add_argument(
+        "--phase-b-only", action="store_true",
+        help="Topology AOT schedule inspection only — works with the "
+             "tunnel DOWN (topology descriptions are served offline).",
+    )
     args = ap.parse_args()
+
+    if args.phase_b_only:
+        # Keep any stray concrete-array op off the axon backend (a dead
+        # tunnel would hang the first backend touch); the topology
+        # compile client is independent of the default platform.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = {
+            "captured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "phase_b": phase_b(args),
+        }
+        path = os.path.join(REPO, "PROFILE_OVERLAP_PHASEB.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[overlap] wrote {path}")
+        return 0 if out["phase_b"].get("status") == "ok" else 4
 
     if args.platform == "cpu":
         os.environ["XLA_FLAGS"] = (
