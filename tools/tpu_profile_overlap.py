@@ -44,37 +44,17 @@ V5E_ICI_BYTES_PER_S = 4.5e10  # per link, unidirectional (scaling book)
 V5E_ICI_LINKS = 2             # one per torus axis usable by a 1D ring
 
 
-def _build_step(args):
+def _model_and_step(tx, fusion_bytes=None):
+    """The ONE model + loss + train-step definition both phases measure
+    — factoring it is what guarantees phase A (timed on the chip) and
+    phase B (AOT schedule inspection) describe the same program."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
     import optax
-    from jax.sharding import PartitionSpec as P
 
     import horovod_tpu.jax as hvdj
-    from horovod_tpu.jax import _shard_map
     from horovod_tpu.models import get_model
-    from horovod_tpu.parallel.mesh import build_mesh
-
-    devices = jax.devices()[: args.devices] if args.devices else jax.devices()
-    n = len(devices)
-    mesh = build_mesh({"data": n}, devices=devices)
-    global_batch = args.batch_size * n
 
     model = get_model("resnet50", num_classes=1000)
-    rng = jax.random.PRNGKey(0)
-    images = jnp.asarray(
-        np.random.RandomState(0)
-        .randn(global_batch, args.image_size, args.image_size, 3)
-        .astype(np.float32)
-    )
-    labels = jnp.asarray(
-        np.random.RandomState(1).randint(0, 1000, (global_batch,)), jnp.int32
-    )
-    variables = model.init(rng, images[:2], train=False)
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    tx = optax.sgd(0.01, momentum=0.9)
-    opt_state = tx.init(params)
 
     def loss_fn(p, bs, x, y):
         out = model.apply(
@@ -87,6 +67,54 @@ def _build_step(args):
         ).mean()
         return loss, new_state["batch_stats"]
 
+    ar_kw = (
+        {} if fusion_bytes is None
+        else {"fusion_threshold_bytes": fusion_bytes}
+    )
+
+    def full_step(p, bs, s, x, y):
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, bs, x, y
+        )
+        grads = hvdj.allreduce_gradients(grads, **ar_kw)
+        new_bs = jax.tree.map(lambda v: jax.lax.pmean(v, "data"), new_bs)
+        updates, s = tx.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_bs, s, jax.lax.pmean(loss, "data")
+
+    return model, loss_fn, full_step
+
+
+def _build_step(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.jax import _shard_map
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    devices = jax.devices()[: args.devices] if args.devices else jax.devices()
+    n = len(devices)
+    mesh = build_mesh({"data": n}, devices=devices)
+    global_batch = args.batch_size * n
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    model, loss_fn, full_step = _model_and_step(tx)
+    rng = jax.random.PRNGKey(0)
+    images = jnp.asarray(
+        np.random.RandomState(0)
+        .randn(global_batch, args.image_size, args.image_size, 3)
+        .astype(np.float32)
+    )
+    labels = jnp.asarray(
+        np.random.RandomState(1).randint(0, 1000, (global_batch,)), jnp.int32
+    )
+    variables = model.init(rng, images[:2], train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = tx.init(params)
+
     def fwd_only(p, bs, x, y):
         loss, _ = loss_fn(p, bs, x, y)
         return jax.lax.pmean(loss, "data")
@@ -98,16 +126,6 @@ def _build_step(args):
         # Consume the grads without collectives/optimizer: one scalar.
         gsum = sum(jnp.sum(g) for g in jax.tree.leaves(grads))
         return jax.lax.pmean(loss + 0.0 * gsum, "data")
-
-    def full_step(p, bs, s, x, y):
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            p, bs, x, y
-        )
-        grads = hvdj.allreduce_gradients(grads)
-        new_bs = jax.tree.map(lambda v: jax.lax.pmean(v, "data"), new_bs)
-        updates, s = tx.update(grads, s, p)
-        p = optax.apply_updates(p, updates)
-        return p, new_bs, s, jax.lax.pmean(loss, "data")
 
     jits = {
         "fwd": jax.jit(_shard_map(
@@ -201,9 +219,7 @@ def phase_b(args):
     import optax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    import horovod_tpu.jax as hvdj
     from horovod_tpu.jax import _shard_map
-    from horovod_tpu.models import get_model
 
     try:
         from jax.experimental import topologies
@@ -221,7 +237,10 @@ def phase_b(args):
         mesh = Mesh(devs.reshape(n), ("data",))
         global_batch = args.batch_size * n
 
-        model = get_model("resnet50", num_classes=1000)
+        tx = optax.sgd(0.01, momentum=0.9)
+        model, _, full_step = _model_and_step(
+            tx, fusion_bytes=args.fusion_mb * 1024 * 1024
+        )
         img_aval = jax.ShapeDtypeStruct(
             (global_batch, args.image_size, args.image_size, 3),
             jnp.float32,
@@ -239,34 +258,7 @@ def phase_b(args):
         )
         params_aval = var_avals["params"]
         bs_aval = var_avals["batch_stats"]
-        tx = optax.sgd(0.01, momentum=0.9)
         opt_aval = jax.eval_shape(tx.init, params_aval)
-
-        def loss_fn(p, bs, x, y):
-            out = model.apply(
-                {"params": p, "batch_stats": bs}, x, train=True,
-                mutable=["batch_stats"],
-            )
-            logits, new_state = out
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, y
-            ).mean()
-            return loss, new_state["batch_stats"]
-
-        def full_step(p, bs, s, x, y):
-            (loss, new_bs), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(p, bs, x, y)
-            grads = hvdj.allreduce_gradients(
-                grads,
-                fusion_threshold_bytes=args.fusion_mb * 1024 * 1024,
-            )
-            new_bs = jax.tree.map(
-                lambda v: jax.lax.pmean(v, "data"), new_bs
-            )
-            updates, s = tx.update(grads, s, p)
-            p = optax.apply_updates(p, updates)
-            return p, new_bs, s, jax.lax.pmean(loss, "data")
 
         fn = jax.jit(_shard_map(
             full_step, mesh,
@@ -305,6 +297,7 @@ def phase_b(args):
         "status": "ok",
         "fusion_mb": args.fusion_mb,
         "latency_hiding_flag": bool(args.latency_hiding),
+        "compiler_opts": sorted(opts),
         **_schedule_overlap_stats(hlo),
     }
 
@@ -318,9 +311,12 @@ def _schedule_overlap_stats(hlo: str) -> dict:
     lines = hlo.splitlines()
     starts = {}  # var name -> line index
     pairs = []
-    compute_re = re.compile(r"=\s*\S*\s*(fusion|convolution)\(")
-    start_re = re.compile(r"(%?\S+)\s*=\s*\S+\s+all-reduce-start\(")
-    done_re = re.compile(r"all-reduce-done\((%?\S+?)[),]")
+    # Result types may be TUPLES containing spaces ("%f = (f32[64]{0},
+    # f32[32]{0}) fusion(...)"), so never assume one token between '='
+    # and the opcode — match the opcode anywhere right of '='.
+    compute_re = re.compile(r"=\s.*\b(fusion|convolution)\(")
+    start_re = re.compile(r"^\s*(%\S+)\s*=\s.*\ball-reduce-start\(")
+    done_re = re.compile(r"\ball-reduce-done\((%\S+?)[),]")
     for i, ln in enumerate(lines):
         m = start_re.search(ln)
         if m:
