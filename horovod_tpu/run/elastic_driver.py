@@ -147,6 +147,10 @@ class ElasticDriver:
         # Per-host snapshot dir for respawn-mode resume (workers write
         # locally; a slot's respawn lands on the same host). The driver
         # pid keys the path so every generation of the job shares it.
+        # Owned only when WE invented the path (pid-keyed tmp dir): a
+        # user-provided HOROVOD_ELASTIC_STATE_DIR must survive driver
+        # exit, ours must not outlive the pid that keys it.
+        self._state_dir_owned = "HOROVOD_ELASTIC_STATE_DIR" not in self._env
         self._env.setdefault(
             "HOROVOD_ELASTIC_STATE_DIR",
             os.path.join(
@@ -549,6 +553,17 @@ class ElasticDriver:
                     f.close()
             self._retire_services(keep=0)
             self._kv.stop()
+            # Local respawn snapshots are keyed by this driver's pid —
+            # nothing can legitimately read them after it exits. (Remote
+            # hosts' dirs are out of reach; they are tmp-reaped. A
+            # user-provided dir is theirs to keep.)
+            if self._state_dir_owned:
+                import shutil
+
+                shutil.rmtree(
+                    self._env["HOROVOD_ELASTIC_STATE_DIR"],
+                    ignore_errors=True,
+                )
 
     def _run(self) -> int:
         if not self._reconcile():
