@@ -237,35 +237,6 @@ def phase_b(args):
         mesh = Mesh(devs.reshape(n), ("data",))
         global_batch = args.batch_size * n
 
-        tx = optax.sgd(0.01, momentum=0.9)
-        model, _, full_step = _model_and_step(
-            tx, fusion_bytes=args.fusion_mb * 1024 * 1024
-        )
-        img_aval = jax.ShapeDtypeStruct(
-            (global_batch, args.image_size, args.image_size, 3),
-            jnp.float32,
-        )
-        lbl_aval = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
-        # Abstract init: shapes only, nothing executes on any backend —
-        # the rng must be an aval too (a concrete PRNGKey would
-        # materialize on the default device, and with the tunnel down
-        # that first backend touch hangs).
-        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        var_avals = jax.eval_shape(
-            lambda r, x: model.init(r, x, train=False),
-            rng_aval,
-            jax.ShapeDtypeStruct((2,) + img_aval.shape[1:], jnp.float32),
-        )
-        params_aval = var_avals["params"]
-        bs_aval = var_avals["batch_stats"]
-        opt_aval = jax.eval_shape(tx.init, params_aval)
-
-        fn = jax.jit(_shard_map(
-            full_step, mesh,
-            in_specs=(P(), P(), P(), P("data"), P("data")),
-            out_specs=(P(), P(), P(), P()),
-        ), donate_argnums=(0, 1, 2))
-
         rep = NamedSharding(mesh, P())
         dat = NamedSharding(mesh, P("data"))
 
@@ -277,17 +248,92 @@ def phase_b(args):
                 aval,
             )
 
+        # Abstract init everywhere: shapes only, nothing executes on any
+        # backend — the rng must be an aval too (a concrete PRNGKey
+        # would materialize on the default device, and with the tunnel
+        # down that first backend touch hangs).
+        rng_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        fusion_bytes = args.fusion_mb * 1024 * 1024
+
+        if args.model == "transformer":
+            import horovod_tpu.jax as hvdj
+            from horovod_tpu.models.transformer import TransformerLM
+
+            T = args.seq_len
+            model = TransformerLM(
+                vocab_size=32768, d_model=768, n_heads=12, n_layers=12,
+                max_len=T,
+            )
+            tx = optax.adamw(3e-4)
+            tok_aval = jax.ShapeDtypeStruct((global_batch, T), jnp.int32)
+            lbl_aval = tok_aval
+
+            def lm_loss(p, tok, lab):
+                logits = model.apply({"params": p}, tok)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, lab
+                ).mean()
+
+            def full_step(p, s, tok, lab):
+                loss, grads = jax.value_and_grad(lm_loss)(p, tok, lab)
+                grads = hvdj.allreduce_gradients(
+                    grads, fusion_threshold_bytes=fusion_bytes
+                )
+                updates, s = tx.update(grads, s, p)
+                p = optax.apply_updates(p, updates)
+                return p, s, jax.lax.pmean(loss, "data")
+
+            var_avals = jax.eval_shape(
+                lambda r, t: model.init(r, t), rng_aval,
+                jax.ShapeDtypeStruct((1, T), jnp.int32),
+            )
+            params_aval = var_avals["params"]
+            opt_aval = jax.eval_shape(tx.init, params_aval)
+            fn = jax.jit(_shard_map(
+                full_step, mesh,
+                in_specs=(P(), P(), P("data"), P("data")),
+                out_specs=(P(), P(), P()),
+            ), donate_argnums=(0, 1))
+            avals = (shard(params_aval, rep), shard(opt_aval, rep),
+                     shard(tok_aval, dat), shard(lbl_aval, dat))
+        else:
+            tx = optax.sgd(0.01, momentum=0.9)
+            model, _, full_step = _model_and_step(
+                tx, fusion_bytes=fusion_bytes
+            )
+            img_aval = jax.ShapeDtypeStruct(
+                (global_batch, args.image_size, args.image_size, 3),
+                jnp.float32,
+            )
+            lbl_aval = jax.ShapeDtypeStruct((global_batch,), jnp.int32)
+            var_avals = jax.eval_shape(
+                lambda r, x: model.init(r, x, train=False),
+                rng_aval,
+                jax.ShapeDtypeStruct(
+                    (2,) + img_aval.shape[1:], jnp.float32
+                ),
+            )
+            params_aval = var_avals["params"]
+            bs_aval = var_avals["batch_stats"]
+            opt_aval = jax.eval_shape(tx.init, params_aval)
+            fn = jax.jit(_shard_map(
+                full_step, mesh,
+                in_specs=(P(), P(), P(), P("data"), P("data")),
+                out_specs=(P(), P(), P(), P()),
+            ), donate_argnums=(0, 1, 2))
+            avals = (shard(params_aval, rep), shard(bs_aval, rep),
+                     shard(opt_aval, rep), shard(img_aval, dat),
+                     shard(lbl_aval, dat))
+
         opts = {}
         if args.latency_hiding:
             opts["xla_tpu_enable_latency_hiding_scheduler"] = "true"
         for kv in args.compiler_opt:
             k, _, v = kv.partition("=")
             opts[k] = v
-        hlo = fn.lower(
-            shard(params_aval, rep), shard(bs_aval, rep),
-            shard(opt_aval, rep), shard(img_aval, dat),
-            shard(lbl_aval, dat),
-        ).compile(compiler_options=opts or None).as_text()
+        hlo = fn.lower(*avals).compile(
+            compiler_options=opts or None
+        ).as_text()
         if args.dump_hlo:
             with open(args.dump_hlo, "w") as f:
                 f.write(hlo)
@@ -295,6 +341,7 @@ def phase_b(args):
         return {"status": f"AOT compile failed: {exc!r}"}
     return {
         "status": "ok",
+        "model": args.model,
         "fusion_mb": args.fusion_mb,
         "latency_hiding_flag": bool(args.latency_hiding),
         "compiler_opts": sorted(opts),
@@ -354,6 +401,11 @@ def main() -> int:
     ap.add_argument("--topology", default="v5e:2x4")
     ap.add_argument("--fusion-mb", type=int, default=64,
                     help="gradient fusion bucket size for phase B")
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "transformer"],
+                    help="phase B program: ResNet-50 DP or the GPT-2-"
+                         "small-class LM DP step (Pallas flash attn)")
+    ap.add_argument("--seq-len", type=int, default=512)
     ap.add_argument("--latency-hiding", action="store_true",
                     help="compile phase B with the TPU latency-hiding "
                          "scheduler / async collectives enabled")
