@@ -1,0 +1,18 @@
+# Developer/CI entry points. Tier-1 tests invoke lint-collectives via
+# tests/test_analysis.py::test_cli_clean_on_shipped_code as well, so the
+# analyzer gates both paths.
+
+PY ?= python
+
+.PHONY: test lint-collectives ci
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# Collective-safety static analysis: Pass 1 over the example train steps
+# and Pass 2 over the runtime sources (docs/static_analysis.md).
+lint-collectives:
+	bash tools/ci_checks.sh
+
+ci: lint-collectives test

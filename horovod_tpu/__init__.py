@@ -74,6 +74,19 @@ def init(config: Optional[Config] = None) -> None:
                 )
             import jax as _jax
 
+            if _os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+                # Multi-process CPU runs (test clusters, the launcher's
+                # -np N mode) need the gloo cross-process collective
+                # backend; without it every collective fails with
+                # "Multiprocess computations aren't implemented on the
+                # CPU backend".
+                try:
+                    _jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:  # noqa: BLE001 - newer jax: on by default
+                    pass
+
             if jax_coord:
                 # Must run before any backend use; tolerate re-init.
                 from .elastic import rejoin_mode as _rejoin_mode
@@ -295,6 +308,16 @@ def _auto_name(prefix: str, name: Optional[str]) -> str:
     return f"{prefix}.noname.{n}"
 
 
+def _preflight_record(op: str, name: str, psid: int, tensor: Any) -> None:
+    """Opt-in submission-ledger hook (HOROVOD_TPU_STATIC_CHECKS=1): feeds
+    the cross-rank ordering lint (analysis/ordering.py). No-op — a single
+    cached env read — when the knob is off."""
+    from .analysis import preflight
+
+    if preflight.enabled():
+        preflight.record_submission(op, name, psid, tensor)
+
+
 def _resolve_op(average: Optional[bool], op: Optional[ReduceOp]) -> ReduceOp:
     # Reference horovod/torch/mpi_ops.py:101-124: `average` and `op` are
     # mutually exclusive; default Average.
@@ -500,6 +523,7 @@ def allreduce_async(
     rt = _rt()
     tensor_name = _auto_name("allreduce", name)
     psid = _psid(process_set)
+    _preflight_record("allreduce", tensor_name, psid, tensor)
     if rop == ReduceOp.ADASUM:
         return rt.enqueue_adasum(
             tensor_name,
@@ -547,9 +571,12 @@ def allreduce(
 def allgather_async(tensor: Any, name: Optional[str] = None,
                     process_set: Optional[ProcessSet] = None,
                     _group: tuple = (0, 0)) -> int:
+    tensor_name = _auto_name("allgather", name)
+    psid = _psid(process_set)
+    _preflight_record("allgather", tensor_name, psid, tensor)
     return _rt().enqueue_allgather(
-        _auto_name("allgather", name), tensor,
-        process_set_id=_psid(process_set),
+        tensor_name, tensor,
+        process_set_id=psid,
         group_id=_group[0], group_size=_group[1],
     )
 
@@ -591,9 +618,12 @@ def broadcast_async(
     # root_rank is a GLOBAL rank even within a process set (reference
     # process-set API semantics; the executor maps it to the member
     # position on the sub-mesh).
+    tensor_name = _auto_name("broadcast", name)
+    psid = _psid(process_set)
+    _preflight_record("broadcast", tensor_name, psid, tensor)
     return _rt().enqueue_broadcast(
-        _auto_name("broadcast", name), tensor, root_rank,
-        process_set_id=_psid(process_set),
+        tensor_name, tensor, root_rank,
+        process_set_id=psid,
     )
 
 
@@ -604,9 +634,12 @@ def broadcast(tensor: Any, root_rank: int, name: Optional[str] = None,
 
 def alltoall_async(tensor: Any, name: Optional[str] = None,
                    process_set: Optional[ProcessSet] = None) -> int:
+    tensor_name = _auto_name("alltoall", name)
+    psid = _psid(process_set)
+    _preflight_record("alltoall", tensor_name, psid, tensor)
     return _rt().enqueue_alltoall(
-        _auto_name("alltoall", name), tensor,
-        process_set_id=_psid(process_set),
+        tensor_name, tensor,
+        process_set_id=psid,
     )
 
 
@@ -737,9 +770,12 @@ def reducescatter_async(
         raise ValueError("reducescatter supports SUM/AVERAGE only")
     if not getattr(tensor, "shape", ()):
         raise ValueError("reducescatter needs a tensor with a dim0 to scatter")
+    tensor_name = _auto_name("reducescatter", name)
+    psid = _psid(process_set)
+    _preflight_record("reducescatter", tensor_name, psid, tensor)
     return _rt().enqueue_reducescatter(
-        _auto_name("reducescatter", name), tensor, reduce_op=op,
-        process_set_id=_psid(process_set),
+        tensor_name, tensor, reduce_op=op,
+        process_set_id=psid,
         group_id=_group[0], group_size=_group[1],
     )
 
@@ -799,6 +835,16 @@ def _grouped_async(enqueue_one, tensors, base, validate_one=None) -> list:
         dtype_from_array(t)
         if validate_one is not None:
             validate_one(t)
+    from .analysis import preflight as _preflight
+
+    if _preflight.enabled():
+        # Static group lint BEFORE any member is enqueued: a group that
+        # can never fuse as one collective (mixed dtypes) or that blows
+        # the fusion-buffer budget is reported here instead of stranding
+        # peers holding an incomplete group.
+        _preflight.check_grouped(
+            tensors, _rt().config.fusion_threshold_bytes, base
+        )
     gid = _group_id(base)
     handles = []
     try:

@@ -1,0 +1,79 @@
+"""horovod_tpu.analysis — collective-safety static analyzers.
+
+Two passes over two layers of the system:
+
+ - **Pass 1 (collective lint)** inspects what a training step *will* do
+   before it runs: trace a jitted fn to its jaxpr and check collective
+   axis names, ``ppermute`` bijectivity, and fusion-bucket budgets
+   (:mod:`.jaxpr_lint`); simulate eager ranks against the tensor-name
+   registry and diff their submission orders — the deadlock class the
+   dynamic stall inspector only reports after its timeout
+   (:mod:`.ordering`); validate grouped-collective dtype/budget
+   composition (:mod:`.groups`).
+ - **Pass 2 (runtime thread-safety lint)** checks the runtime's own
+   sources against its declared lock discipline (:mod:`.runtime_lint`).
+
+``tools/collective_lint.py`` exposes both as a CLI (JSON + human output,
+nonzero exit on findings); ``HOROVOD_TPU_STATIC_CHECKS=1`` wires Pass 1
+into ``DistributedOptimizer`` / ``allreduce`` setup as a pre-flight
+(:mod:`.preflight`). See ``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+from .findings import (
+    CollectiveSafetyError,
+    Finding,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    errors,
+    findings_to_json,
+    sort_findings,
+)
+from .groups import check_fusion_plan, check_group
+from .jaxpr_lint import (
+    CollectiveSite,
+    collect_collectives,
+    lint_jaxpr,
+    lint_step,
+)
+from .ordering import (
+    CollectiveCall,
+    check_cross_rank_order,
+    record_rank_trace,
+    simulate_ranks,
+)
+from .runtime_lint import (
+    AttrRule,
+    ClassRule,
+    DEFAULT_DISCIPLINE,
+    lint_file,
+    lint_runtime,
+    lint_source,
+)
+
+__all__ = [
+    "AttrRule",
+    "ClassRule",
+    "CollectiveCall",
+    "CollectiveSafetyError",
+    "CollectiveSite",
+    "DEFAULT_DISCIPLINE",
+    "Finding",
+    "SEVERITY_ERROR",
+    "SEVERITY_WARNING",
+    "check_cross_rank_order",
+    "check_fusion_plan",
+    "check_group",
+    "collect_collectives",
+    "errors",
+    "findings_to_json",
+    "lint_file",
+    "lint_jaxpr",
+    "lint_runtime",
+    "lint_source",
+    "lint_step",
+    "record_rank_trace",
+    "simulate_ranks",
+    "sort_findings",
+]

@@ -1,0 +1,112 @@
+"""Finding model shared by both analyzer passes.
+
+A Finding is one rule violation with a stable, machine-readable shape: the
+CLI emits findings as JSON with deterministic key order so CI diffs stay
+meaningful, and the human renderer prints ``severity rule location message``
+lines. Rule ids are the vocabulary of the suppression syntax
+(``# hvd-analysis: ignore[rule-id]``) and of the docs in
+``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Sequence
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+# --- rule ids (Pass 1: collective lint) ---
+RULE_UNKNOWN_AXIS = "unknown-axis"
+RULE_ORDER_MISMATCH = "cross-rank-order"
+RULE_SIGNATURE_MISMATCH = "cross-rank-signature"
+RULE_MISSING_COLLECTIVE = "cross-rank-missing"
+RULE_PPERMUTE = "ppermute-non-bijective"
+RULE_GROUP_DTYPE = "group-dtype-mismatch"
+RULE_GROUP_BUDGET = "group-over-budget"
+RULE_FUSION_BUDGET = "fusion-over-budget"
+
+# --- rule ids (Pass 2: runtime thread-safety lint) ---
+RULE_UNGUARDED = "unguarded-shared-state"
+
+ALL_RULES = (
+    RULE_UNKNOWN_AXIS,
+    RULE_ORDER_MISMATCH,
+    RULE_SIGNATURE_MISMATCH,
+    RULE_MISSING_COLLECTIVE,
+    RULE_PPERMUTE,
+    RULE_GROUP_DTYPE,
+    RULE_GROUP_BUDGET,
+    RULE_FUSION_BUDGET,
+    RULE_UNGUARDED,
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    message: str
+    location: str = ""
+    details: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        # Insertion order is the stable JSON key order.
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "location": self.location,
+            "message": self.message,
+            "details": {k: self.details[k] for k in sorted(self.details)},
+        }
+
+    def render(self) -> str:
+        loc = f" {self.location}" if self.location else ""
+        return f"{self.severity}[{self.rule}]{loc}: {self.message}"
+
+
+class CollectiveSafetyError(RuntimeError):
+    """Raised by the opt-in pre-flight (HOROVOD_TPU_STATIC_CHECKS=1) when a
+    static check finds an error-severity problem before the collective is
+    submitted/traced."""
+
+    def __init__(self, findings: Sequence[Finding]):
+        self.findings = list(findings)
+        super().__init__(
+            "collective-safety pre-flight failed:\n"
+            + "\n".join(f"  {f.render()}" for f in self.findings)
+        )
+
+
+def sort_findings(findings: Sequence[Finding]) -> List[Finding]:
+    """Deterministic order: errors first, then by rule, location, message."""
+    sev_rank = {SEVERITY_ERROR: 0, SEVERITY_WARNING: 1}
+    return sorted(
+        findings,
+        key=lambda f: (
+            sev_rank.get(f.severity, 2), f.rule, f.location, f.message
+        ),
+    )
+
+
+def findings_to_json(findings: Sequence[Finding], **extra: Any) -> str:
+    ordered = sort_findings(findings)
+    doc = {
+        "findings": [f.to_dict() for f in ordered],
+        "summary": {
+            "total": len(ordered),
+            "errors": sum(
+                1 for f in ordered if f.severity == SEVERITY_ERROR
+            ),
+            "warnings": sum(
+                1 for f in ordered if f.severity == SEVERITY_WARNING
+            ),
+        },
+    }
+    doc.update(extra)
+    return json.dumps(doc, indent=2, sort_keys=False)
+
+
+def errors(findings: Sequence[Finding]) -> List[Finding]:
+    return [f for f in findings if f.severity == SEVERITY_ERROR]

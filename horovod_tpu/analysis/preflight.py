@@ -1,0 +1,184 @@
+"""Opt-in pre-flight hooks (``HOROVOD_TPU_STATIC_CHECKS=1``).
+
+When the knob is set, the framework entry points run the static analyzers
+before work is traced/submitted:
+
+ - ``horovod_tpu.jax.allreduce_gradients`` (and therefore
+   ``DistributedOptimizer`` / ``make_train_step``) validates the fusion
+   bucket plan of the gradient pytree at trace time and that the reduction
+   axis is actually bound;
+ - eager ``hvd.grouped_allreduce*`` validates group dtype/budget before
+   any member is enqueued (a bad group would otherwise strand peers
+   holding an incomplete group);
+ - every eager named collective is recorded into a per-process submission
+   ledger whose entries feed :func:`horovod_tpu.analysis.ordering
+   .check_cross_rank_order` — either offline (simulated ranks) or via an
+   explicit :func:`verify_cross_rank_order` barrier a job can call at a
+   known-quiet point.
+
+Error-severity findings raise :class:`CollectiveSafetyError`; warnings are
+logged. The knob is read once and cached — set it before the first
+collective.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from .findings import (
+    CollectiveSafetyError,
+    Finding,
+    SEVERITY_ERROR,
+    errors,
+)
+from .ordering import CollectiveCall, check_cross_rank_order
+
+logger = logging.getLogger("horovod_tpu")
+
+ENV_KNOB = "HOROVOD_TPU_STATIC_CHECKS"
+
+_enabled_cache: Optional[bool] = None
+_ledger_lock = threading.Lock()
+_ledger: List[CollectiveCall] = []
+
+
+def enabled() -> bool:
+    """True when HOROVOD_TPU_STATIC_CHECKS is set truthy (cached after the
+    first read)."""
+    global _enabled_cache
+    if _enabled_cache is None:
+        _enabled_cache = os.environ.get(ENV_KNOB, "").strip().lower() in (
+            "1", "true", "yes", "on"
+        )
+    return _enabled_cache
+
+
+def _reset_for_tests(value: Optional[bool] = None) -> None:
+    global _enabled_cache
+    _enabled_cache = value
+    with _ledger_lock:
+        _ledger.clear()
+
+
+def _raise_or_log(findings: Sequence[Finding]) -> None:
+    errs = errors(findings)
+    for f in findings:
+        if f.severity != SEVERITY_ERROR:
+            logger.warning("static check: %s", f.render())
+    if errs:
+        raise CollectiveSafetyError(errs)
+
+
+# --- compiled-mode (trace-time) checks ---
+def check_gradient_tree(
+    grads: Any, threshold_bytes: int, axis_name: Any
+) -> None:
+    """Trace-time pre-flight for ``allreduce_gradients``: the fusion
+    bucket plan must be well-formed and the reduction axis bound."""
+    import jax
+
+    from .groups import check_fusion_plan
+    from .findings import RULE_UNKNOWN_AXIS
+
+    findings: List[Finding] = []
+    axes = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    for ax in axes:
+        try:
+            jax.lax.psum(1, ax)
+        except NameError:
+            findings.append(
+                Finding(
+                    rule=RULE_UNKNOWN_AXIS,
+                    severity=SEVERITY_ERROR,
+                    message=(
+                        f"allreduce_gradients over axis {ax!r} but no such "
+                        "axis is bound — the step is not running inside a "
+                        "shard_map/pmap over that mesh axis"
+                    ),
+                    location="preflight:allreduce_gradients",
+                    details={"axis": str(ax)},
+                )
+            )
+    leaves = jax.tree.flatten(grads)[0]
+    if leaves:
+        findings.extend(check_fusion_plan(leaves, threshold_bytes))
+    _raise_or_log(findings)
+
+
+# --- eager checks ---
+def check_grouped(
+    tensors: Sequence[Any], threshold_bytes: Optional[int], name: str
+) -> None:
+    from .groups import check_group
+
+    _raise_or_log(
+        check_group(tensors, threshold_bytes=threshold_bytes, name=name)
+    )
+
+
+def record_submission(
+    op: str,
+    name: str,
+    process_set_id: int,
+    tensor: Any = None,
+) -> None:
+    """Append one eager submission to this process's ledger."""
+    dtype, shape = "", ()
+    try:
+        dtype = str(tensor.dtype)
+        shape = tuple(int(d) for d in tensor.shape)
+    except Exception:  # noqa: BLE001 - scalars / None
+        pass
+    with _ledger_lock:
+        _ledger.append(
+            CollectiveCall(
+                op=op, name=name, process_set_id=int(process_set_id),
+                dtype=dtype, shape=shape,
+            )
+        )
+
+
+def ledger() -> List[CollectiveCall]:
+    with _ledger_lock:
+        return list(_ledger)
+
+
+def clear_ledger() -> None:
+    with _ledger_lock:
+        _ledger.clear()
+
+
+def verify_cross_rank_order(
+    allgather_object_fn=None,
+) -> List[Finding]:
+    """Cross-rank agreement check over the recorded ledgers: every rank
+    gathers every rank's submission sequence and diffs them. Call at a
+    known-quiet point (all ranks must call it, like a barrier). Raises
+    :class:`CollectiveSafetyError` on divergence; returns the findings
+    list ([] when orders agree)."""
+    import horovod_tpu as hvd
+
+    gather = allgather_object_fn or hvd.allgather_object
+    mine = ledger()
+    payload = [
+        (c.op, c.name, c.process_set_id, c.dtype, tuple(c.shape))
+        for c in mine
+    ]
+    all_payloads = gather(payload, name="hvd.analysis.order")
+    traces = {
+        r: [
+            CollectiveCall(
+                op=p[0], name=p[1], process_set_id=p[2], dtype=p[3],
+                shape=tuple(p[4]),
+            )
+            for p in rank_payload
+        ]
+        for r, rank_payload in enumerate(all_payloads)
+    }
+    findings = check_cross_rank_order(traces)
+    if errors(findings):
+        raise CollectiveSafetyError(errors(findings))
+    return findings

@@ -1,0 +1,371 @@
+"""Pass 2 — runtime thread-safety lint (AST-based lock-discipline checker).
+
+The eager runtime is a multi-threaded producer/consumer system: framework
+threads enqueue, a background/executor thread consumes, and inline
+``synchronize()`` callers may steal the consumer role. Its correctness
+rests on a small set of invariants — *this attribute is only ever mutated
+under that lock* — that ordinary tests can't pin down (races are timing-
+dependent). This checker makes the discipline explicit and machine-checked:
+
+ - :data:`DEFAULT_DISCIPLINE` declares, per runtime class, which
+   attributes are shared state and which lock guards them (or which
+   methods they are confined to — e.g. state touched only by the
+   coordinator thread's cycle loop, or by the plan consumer serialized
+   under ``NativeRuntime._consumer_lock``);
+ - the checker walks each method's AST, tracks the lexically-held locks
+   (``with self._lock:`` blocks, including aliases like
+   ``Condition(self._lock)`` exposed as ``self._cv``), and flags any
+   mutation of a guarded attribute outside its lock
+   (:data:`RULE_UNGUARDED`);
+ - a finding can be suppressed in-source with
+   ``# hvd-analysis: ignore[unguarded-shared-state]`` on the flagged line
+   or the line directly above it.
+
+Lexical, not dynamic: aliased mutations (``q = self._table[k]; q.pop()``)
+are out of scope, as is cross-object access (``rt.queue._table``) — the
+discipline table names the hot shared state where a missed lock means a
+corrupted tensor table or a hung training job.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding, RULE_UNGUARDED, SEVERITY_ERROR
+
+# Method names that mutate common containers in place.
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "update", "setdefault",
+    "add", "discard", "sort", "reverse",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvd-analysis:\s*ignore(?:\[(?P<rules>[\w\s,-]+)\])?"
+)
+
+
+@dataclass
+class AttrRule:
+    """Discipline for one shared attribute: guarded by ``lock`` (an
+    attribute name on the same object), and/or mutation-confined to the
+    listed methods (single-thread confinement, e.g. coordinator-loop-only
+    state). ``__init__`` is always exempt — construction is
+    single-threaded."""
+
+    lock: Optional[str] = None
+    confined_to: Tuple[str, ...] = ()
+    note: str = ""
+
+
+@dataclass
+class ClassRule:
+    attrs: Dict[str, AttrRule]
+    # Lock attributes that wrap/alias another (a Condition built on a
+    # Lock): holding the alias counts as holding the canonical lock.
+    lock_aliases: Dict[str, str] = field(default_factory=dict)
+
+    def canonical(self, lock_name: str) -> str:
+        return self.lock_aliases.get(lock_name, lock_name)
+
+    def lock_names(self) -> Set[str]:
+        names = {r.lock for r in self.attrs.values() if r.lock}
+        names |= set(self.lock_aliases)
+        names |= set(self.lock_aliases.values())
+        return names
+
+
+# The runtime's lock discipline, by source basename. This table IS the
+# documentation of which state is shared and how it is protected — see
+# docs/static_analysis.md for prose.
+DEFAULT_DISCIPLINE: Dict[str, Dict[str, ClassRule]] = {
+    "runtime.py": {
+        "TensorQueue": ClassRule(
+            attrs={
+                "_table": AttrRule("_lock"),
+                "_pending": AttrRule("_lock"),
+            },
+        ),
+        "HandleManager": ClassRule(
+            attrs={
+                "_results": AttrRule("_lock"),
+                "_next": AttrRule("_lock"),
+            },
+            lock_aliases={"_cv": "_lock"},
+        ),
+        "Runtime": ClassRule(
+            attrs={
+                # Mutated by user threads (register/remove/enqueue_join)
+                # AND read/cleared on the background thread — must hold
+                # _state_lock.
+                "_process_sets": AttrRule("_state_lock"),
+                "joined": AttrRule("_state_lock"),
+            },
+        ),
+        "StallInspector": ClassRule(
+            attrs={
+                # Coordinator-thread confined: only the cycle loop calls
+                # these methods (operations.cc keeps the same invariant).
+                "_first_seen": AttrRule(
+                    None, confined_to=("record", "clear", "check")
+                ),
+                "_warned": AttrRule(
+                    None, confined_to=("record", "clear", "check")
+                ),
+                "should_shutdown": AttrRule(None, confined_to=("check",)),
+            },
+        ),
+    },
+    "native_runtime.py": {
+        "NativeRuntime": ClassRule(
+            attrs={
+                "_entries": AttrRule("_entries_lock"),
+                "_outputs": AttrRule("_cv"),
+                "_ticket_names": AttrRule("_cv"),
+                "_done": AttrRule("_cv"),
+                "_sync_waiters": AttrRule("_cv"),
+            },
+        ),
+    },
+    "xla_executor.py": {
+        "XlaPlanExecutor": ClassRule(
+            attrs={
+                "_fn_cache": AttrRule("_lock"),
+                "_sets": AttrRule("_lock"),
+                # Plan execution is serialized by NativeRuntime's
+                # _consumer_lock (pop+execute is one atomic unit), so the
+                # fence state is consumer-confined to execute().
+                "_inflight_outs": AttrRule(
+                    None, confined_to=("execute",),
+                    note="serialized by NativeRuntime._consumer_lock",
+                ),
+            },
+        ),
+    },
+}
+
+
+def _base_self_attr(node: ast.AST) -> Optional[str]:
+    """Resolve an expression chain (self.X.method(...).other[...]) down to
+    the ``self.X`` base attribute name, or None."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            if (
+                isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                return node.attr
+            node = node.value
+        elif isinstance(node, (ast.Subscript, ast.Starred)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return None
+
+
+def _direct_self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walks one method body tracking lexically-held locks."""
+
+    def __init__(self, cls_name: str, method: str, rule: ClassRule,
+                 filename: str, src_lines: Sequence[str]):
+        self.cls_name = cls_name
+        self.method = method
+        self.rule = rule
+        self.filename = filename
+        self.src_lines = src_lines
+        self.held: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    # -- lock tracking --
+    def visit_With(self, node: ast.With) -> None:
+        acquired: Set[str] = set()
+        for item in node.items:
+            attr = _direct_self_attr(item.context_expr)
+            if attr is not None and attr in self.rule.lock_names():
+                acquired.add(self.rule.canonical(attr))
+                acquired.add(attr)
+        newly = acquired - self.held
+        self.held |= newly
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= newly
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # A nested def runs later, on whatever thread calls it: locks held
+        # at definition time are NOT held at call time.
+        saved, self.held = self.held, set()
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    # -- mutation detection --
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for target in node.targets:
+            self._check_target(target, node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check_target(node.target, node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for target in node.targets:
+            self._check_target(target, node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            attr = _base_self_attr(func.value)
+            if attr is not None:
+                self._flag_if_unguarded(attr, node, f".{func.attr}(...)")
+        self.generic_visit(node)
+
+    def _check_target(self, target: ast.AST, node: ast.AST) -> None:
+        attr = _direct_self_attr(target)
+        how = "assignment"
+        if attr is None and isinstance(target, ast.Subscript):
+            attr = _base_self_attr(target.value)
+            how = "item assignment"
+        if attr is None and isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._check_target(elt, node)
+            return
+        if attr is not None:
+            self._flag_if_unguarded(attr, node, how)
+
+    def _flag_if_unguarded(self, attr: str, node: ast.AST,
+                           how: str) -> None:
+        arule = self.rule.attrs.get(attr)
+        if arule is None:
+            return
+        if self.method == "__init__":
+            return
+        if arule.confined_to and self.method in arule.confined_to:
+            return
+        if arule.lock and self.rule.canonical(arule.lock) in {
+            self.rule.canonical(h) for h in self.held
+        }:
+            return
+        if arule.lock is None and not arule.confined_to:
+            return
+        line = getattr(node, "lineno", 0)
+        if self._suppressed(line):
+            return
+        if arule.lock:
+            expectation = f"must hold self.{arule.lock}"
+        else:
+            expectation = (
+                "mutation is confined to "
+                + "/".join(arule.confined_to)
+                + (f" ({arule.note})" if arule.note else "")
+            )
+        self.findings.append(
+            Finding(
+                rule=RULE_UNGUARDED,
+                severity=SEVERITY_ERROR,
+                message=(
+                    f"unguarded mutation of shared state "
+                    f"self.{attr} ({how}) in "
+                    f"{self.cls_name}.{self.method}: {expectation}"
+                ),
+                location=f"{self.filename}:{line}",
+                details={
+                    "class": self.cls_name,
+                    "method": self.method,
+                    "attribute": attr,
+                    "expected_lock": arule.lock or "",
+                },
+            )
+        )
+
+    def _suppressed(self, lineno: int) -> bool:
+        for ln in (lineno, lineno - 1):
+            if 1 <= ln <= len(self.src_lines):
+                m = _SUPPRESS_RE.search(self.src_lines[ln - 1])
+                if m:
+                    rules = m.group("rules")
+                    if rules is None:
+                        return True
+                    wanted = {r.strip() for r in rules.split(",")}
+                    if RULE_UNGUARDED in wanted:
+                        return True
+        return False
+
+
+def lint_source(
+    src: str,
+    rules: Dict[str, ClassRule],
+    filename: str = "<memory>",
+) -> List[Finding]:
+    """Lint python source text against a class→discipline mapping."""
+    tree = ast.parse(src, filename=filename)
+    src_lines = src.splitlines()
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        rule = rules.get(node.name)
+        if rule is None:
+            continue
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker = _MethodChecker(
+                    node.name, item.name, rule, filename, src_lines
+                )
+                for stmt in item.body:
+                    checker.visit(stmt)
+                findings.extend(checker.findings)
+    return findings
+
+
+def lint_file(
+    path: str, rules: Optional[Dict[str, ClassRule]] = None
+) -> List[Finding]:
+    if rules is None:
+        rules = DEFAULT_DISCIPLINE.get(os.path.basename(path), {})
+    if not rules:
+        return []
+    with open(path, "r") as f:
+        src = f.read()
+    return lint_source(src, rules, filename=os.path.basename(path))
+
+
+def default_runtime_paths() -> List[str]:
+    core = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "core")
+    return [
+        os.path.join(core, name)
+        for name in ("runtime.py", "native_runtime.py", "xla_executor.py")
+    ]
+
+
+def lint_runtime(paths: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the lock-discipline check over the runtime sources (the three
+    core modules by default)."""
+    findings: List[Finding] = []
+    for path in paths or default_runtime_paths():
+        findings.extend(lint_file(path))
+    return findings

@@ -48,6 +48,8 @@ HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 # TPU-native additions.
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
 HOROVOD_TPU_EAGER_BACKEND = "HOROVOD_TPU_EAGER_BACKEND"
+# Opt-in collective-safety pre-flight (docs/static_analysis.md).
+HOROVOD_TPU_STATIC_CHECKS = "HOROVOD_TPU_STATIC_CHECKS"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64
@@ -115,6 +117,9 @@ class Config:
     log_level: str = "warning"
     eager_backend: str = "auto"  # auto | xla | local
     mesh_axes: str = ""  # e.g. "data:8" or "data:4,model:2"
+    # Run the collective-safety static analyzers as a pre-flight on
+    # DistributedOptimizer/allreduce setup (analysis/preflight.py).
+    static_checks: bool = False
     extra: dict = field(default_factory=dict)
 
     @staticmethod
@@ -160,4 +165,5 @@ class Config:
         cfg.log_level = os.environ.get(HOROVOD_LOG_LEVEL, cfg.log_level)
         cfg.eager_backend = os.environ.get(HOROVOD_TPU_EAGER_BACKEND, cfg.eager_backend)
         cfg.mesh_axes = os.environ.get(HOROVOD_TPU_MESH_AXES, cfg.mesh_axes)
+        cfg.static_checks = _get_bool(HOROVOD_TPU_STATIC_CHECKS)
         return cfg

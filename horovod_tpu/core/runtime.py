@@ -425,6 +425,12 @@ class Runtime:
         self._wake = threading.Event()
         self._initialized = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Guards cross-thread runtime state: the process-set table
+        # (mutated by user threads, read during enqueue) and the joined
+        # flag (set by the caller's enqueue_join, cleared on the
+        # background thread). Found by the Pass-2 lock-discipline lint
+        # (analysis/runtime_lint.py) — see docs/static_analysis.md.
+        self._state_lock = threading.Lock()
         # Registered process sets (id -> sorted ranks). The single-process
         # data plane executes any set containing rank 0 as an identity,
         # matching the reference's size=1 behavior.
@@ -435,11 +441,13 @@ class Runtime:
         rs = sorted(int(r) for r in ranks)
         if not rs or rs[0] < 0 or rs[-1] >= self.topology.size:
             raise ValueError("process set ranks must lie in [0, size)")
-        self._process_sets[int(psid)] = rs
+        with self._state_lock:
+            self._process_sets[int(psid)] = rs
 
     def remove_process_set(self, psid: int) -> None:
-        if self._process_sets.pop(int(psid), None) is None:
-            raise ValueError(f"process set {psid} is not registered")
+        with self._state_lock:
+            if self._process_sets.pop(int(psid), None) is None:
+                raise ValueError(f"process set {psid} is not registered")
 
     # --- lifecycle ---
     def start(self) -> None:
@@ -493,7 +501,8 @@ class Runtime:
                 "call hvd.init() first."
             )
         if process_set_id != 0:
-            members = self._process_sets.get(process_set_id)
+            with self._state_lock:
+                members = self._process_sets.get(process_set_id)
             if members is None:
                 raise RuntimeError(
                     f"process set {process_set_id} is not registered on "
@@ -567,7 +576,8 @@ class Runtime:
         return self._enqueue(RequestType.REDUCESCATTER, name, tensor, **kw)
 
     def enqueue_join(self) -> int:
-        self.joined = True
+        with self._state_lock:
+            self.joined = True
         return self._enqueue(RequestType.JOIN, f"join.{self.topology.rank}", None)
 
     # --- background loop (reference RunLoopOnce, operations.cc:531-581) ---
@@ -607,7 +617,8 @@ class Runtime:
     def _perform_operation(self, response: Response) -> None:
         # Reference PerformOperation (operations.cc:227-304).
         if response.response_type == ResponseType.JOIN:
-            self.joined = False
+            with self._state_lock:
+                self.joined = False
             self.stall_inspector.clear(response.tensor_names)
             for name in response.tensor_names:
                 entry = self.tensor_queue.take_entry(name)

@@ -278,20 +278,30 @@ _rejoin_mode: Optional[str] = None
 
 
 def _inprocess_rejoin_supported() -> bool:
-    """In-process world re-formation rides two private JAX surfaces: the
-    ``jax_enable_recoverability`` config flag (a dead peer surfaces on
-    survivors as a catchable collective error, not a fatal coordination
-    abort) and ``xla_bridge._clear_backends`` (the next ``hvd.init()``
-    can stand up a different world size in this process). Both exist on
-    the pinned jax, but either can vanish in a minor upgrade — probe
-    them up front instead of finding out mid-crash-recovery."""
+    """In-process world re-formation rides three private JAX surfaces:
+    the ``jax_enable_recoverability`` config flag (a dead peer surfaces
+    on survivors as a catchable collective error, not a fatal
+    coordination abort), ``xla_bridge._clear_backends`` (the next
+    ``hvd.init()`` can stand up a different world size in this process),
+    and the ``jax._src.lib._jax`` distributed-runtime factories (the
+    recoverable client here, the driver-hosted coordination service in
+    ``run/elastic_driver.py`` — older jaxlibs keep them under a
+    different module name and without the ``recoverable`` kwarg). Any of
+    these can vanish or move in a minor upgrade — probe them up front
+    instead of finding out mid-crash-recovery."""
     try:
         import jax
         from jax._src import xla_bridge as _xb
+        from jax._src.lib import _jax as _jaxlib
     except Exception:  # noqa: BLE001 - jax internals moved wholesale
         return False
     if not callable(getattr(_xb, "_clear_backends", None)):
         return False
+    for factory in (
+        "get_distributed_runtime_service", "get_distributed_runtime_client"
+    ):
+        if not callable(getattr(_jaxlib, factory, None)):
+            return False
     try:
         # Attribute access raises if the flag no longer exists.
         jax.config.jax_enable_recoverability  # noqa: B018
@@ -315,7 +325,17 @@ def rejoin_mode() -> str:
         forced = os.environ.get(
             "HOROVOD_ELASTIC_REJOIN_MODE", "auto"
         ).lower()
-        if forced in ("inprocess", "respawn"):
+        if forced == "inprocess" and not _inprocess_rejoin_supported():
+            # Honoring the pin anyway would fatal-abort the first
+            # crash recovery (the private JAX surfaces are absent);
+            # degrade loudly instead.
+            logger.warning(
+                "elastic: HOROVOD_ELASTIC_REJOIN_MODE=inprocess but this "
+                "jax lacks the required private surfaces; falling back "
+                "to 'respawn'"
+            )
+            _rejoin_mode = "respawn"
+        elif forced in ("inprocess", "respawn"):
             _rejoin_mode = forced
         else:
             _rejoin_mode = (

@@ -129,6 +129,15 @@ def allreduce_gradients(
     gradient noise at 8 ranks) instead of a full-precision ``psum``.
     """
     axis_name = _normalize_axis(axis_name, hierarchical)
+    from ..analysis import preflight as _preflight
+
+    if _preflight.enabled():
+        # Opt-in trace-time pre-flight (HOROVOD_TPU_STATIC_CHECKS=1):
+        # validates the fusion bucket plan and that the reduction axis is
+        # actually bound before the collective is traced in.
+        _preflight.check_gradient_tree(
+            grads, fusion_threshold_bytes, axis_name
+        )
     if quantized:
         if hierarchical or op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             raise ValueError(

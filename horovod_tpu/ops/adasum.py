@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
 from ..parallel.mesh import DATA_AXIS
 
 
@@ -54,7 +55,7 @@ def _pairwise_combine(a: jax.Array, b: jax.Array) -> jax.Array:
 
 def adasum_allreduce(x: jax.Array, *, axis_name: str = DATA_AXIS) -> jax.Array:
     """In-jit Adasum over a named mesh axis (power-of-2 size)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n & (n - 1) != 0:
         raise ValueError(
             f"Adasum requires a power-of-2 number of ranks, got {n} "
@@ -143,7 +144,7 @@ def hierarchical_adasum_allreduce(
     """
     flat = x.reshape(-1)
     n = flat.shape[0]
-    local_size = lax.axis_size(local_axis)
+    local_size = _axis_size(local_axis)
     pad = (-n) % local_size
     if pad:
         flat = jnp.pad(flat, (0, pad))

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
 from ..common.types import ReduceOp
 from ..parallel.mesh import DATA_AXIS
 
@@ -80,7 +81,7 @@ def _product_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
     ``lax.pprod``; the earlier ``all_gather``+``prod`` formulation held
     n copies of the tensor live. Non-power-of-2 axes fall back to the
     gather (rare: TPU slices are power-of-2)."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return x
     if n & (n - 1):
@@ -136,7 +137,7 @@ def broadcast(
     O(bytes) per link with log-depth latency — unlike the earlier masked
     ``psum``, which paid a full ring allreduce (O(size x bytes) ICI
     traffic) to move one rank's tensor."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return x
     # Virtual rank: root is 0; holders after round t are vr < 2^(t+1).
@@ -181,7 +182,7 @@ def reducescatter(
     """Reduce-scatter (TPU-native extension; the reference reaches it only
     inside NCCL hierarchical allreduce, ``nccl_operations.cc:151-346``)."""
     if op == ReduceOp.AVERAGE:
-        x = x / lax.axis_size(axis_name)
+        x = x / _axis_size(axis_name)
     elif op not in (ReduceOp.SUM, ReduceOp.ADASUM):
         raise ValueError(f"reducescatter supports SUM/AVERAGE, got {op}")
     return lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis, tiled=True)
@@ -204,7 +205,7 @@ def hierarchical_allreduce(
     """
     flat = x.reshape(-1)
     n = flat.shape[0]
-    local_size = lax.axis_size(local_axis)
+    local_size = _axis_size(local_axis)
     pad = (-n) % local_size
     if pad:
         flat = jnp.pad(flat, (0, pad))
@@ -215,5 +216,5 @@ def hierarchical_allreduce(
         full = full[:n]
     out = full.reshape(x.shape)
     if op == ReduceOp.AVERAGE:
-        out = out / (lax.axis_size(local_axis) * lax.axis_size(cross_axis))
+        out = out / (_axis_size(local_axis) * _axis_size(cross_axis))
     return out

@@ -41,6 +41,14 @@ _LANES = 128  # TPU lane width; m/l carriers keep a lane dim like the
               # upstream jax flash kernel's lse outputs.
 
 
+def _compiler_params(**kw):
+    """pltpu.CompilerParams was named TPUCompilerParams before jax 0.5."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kw)
+
+
 def _pick_block(t: int, pref: int) -> int:
     """Largest block <= pref that divides t (XLA/Mosaic needs an exact
     grid). Degrading a little below ``pref`` is fine; degrading to a tiny
@@ -194,7 +202,7 @@ def _flash_call(q, k, v, delta, *, sm_scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((bh, t_q, _LANES), jnp.float32),
         ],
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
 from ..parallel.mesh import DATA_AXIS
 
 __all__ = ["quantized_ring_allreduce", "quantized_ring_reduce_scatter"]
@@ -113,7 +114,7 @@ def quantized_ring_reduce_scatter(
     (the plain ring finishes at chunk (r+1) mod n), which is exactly the
     gradient shard ZeRO-1 needs — composing the int8 wire with sharded
     optimizer state costs no extra hop."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     orig_dtype = x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     total = flat.shape[0]
@@ -146,7 +147,7 @@ def quantized_ring_allreduce(
     Must run inside shard_map/pmap with the axis bound. The result has
     ``x``'s shape and dtype; internal accumulation is float32.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return x
     r = lax.axis_index(axis_name)

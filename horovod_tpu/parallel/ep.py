@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..common.compat import axis_size as _axis_size
 from .mesh import DATA_AXIS, EXPERT_AXIS
 
 
@@ -87,7 +88,7 @@ def moe_ffn(
     S tokens over ALL ``E_total`` experts; token shards travel to the
     expert's owner via all_to_all and come back combined.
     """
-    n_exp = lax.axis_size(expert_axis)
+    n_exp = _axis_size(expert_axis)
     e_local, d_model, _ = params.w_in.shape
     e_total = e_local * n_exp
     s_tokens = x.shape[0]
@@ -211,7 +212,7 @@ def make_ep_train_step(
                 # every device in the expert group into the owner's shard;
                 # divide so expert grads share the replicated params' scale
                 # (grad of the loss pmean'd over both axes).
-                g = g / lax.axis_size(expert_axis)
+                g = g / _axis_size(expert_axis)
             else:
                 g = lax.pmean(g, expert_axis)
             return g

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..common.compat import axis_size as _axis_size
 from .mesh import SEQ_AXIS
 
 
@@ -83,8 +84,15 @@ def ring_attention(
     log-sum-exp combination. ``use_flash=False`` falls back to the dense
     jnp block (kept for A/B numerics testing).
     """
-    n = lax.axis_size(axis_name)
-    rank = lax.axis_index(axis_name)
+    n = _axis_size(axis_name)
+    # Only materialize the rank when a code path consumes it: a dead
+    # axis_index survives shard_map lowering as a PartitionId HLO, which
+    # the SPMD partitioner rejects (the non-causal flash kernel never
+    # reads the block offset).
+    rank = (
+        lax.axis_index(axis_name) if (causal or not use_flash)
+        else jnp.int32(0)
+    )
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     # Ring: after s steps this rank holds the K/V block originally owned by
@@ -175,7 +183,7 @@ def ulysses_attention(
     re-shard [B, T/n, H, D] -> [B, T, H/n, D], local attention over the
     full sequence (the Pallas flash kernel by default), then re-shard
     back. Requires heads % axis_size == 0."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     B, T, H, D = q.shape
     if H % n != 0:
         raise ValueError(f"ulysses needs heads ({H}) divisible by axis ({n})")

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..common.compat import axis_size as _axis_size
+from ..common.compat import psum_replicated_grad
 from .mesh import DATA_AXIS
 
 MODEL_AXIS = "model"
@@ -54,7 +56,7 @@ def row_parallel(x_shard: jax.Array, w_shard: jax.Array, b_shard=None, *,
     scales every upstream gradient by the axis size."""
     y = x_shard @ w_shard
     if b_shard is not None:
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         f = b_shard.shape[-1]
         if f * n != w_shard.shape[-1]:
             # A full-size bias would silently be added n times (the
@@ -67,7 +69,9 @@ def row_parallel(x_shard: jax.Array, w_shard: jax.Array, b_shard=None, *,
         full = jnp.zeros((w_shard.shape[-1],), b_shard.dtype)
         full = lax.dynamic_update_slice(full, b_shard, (i * f,))
         y = y + full
-    return lax.psum(y, axis_name)
+    # Replicated-cotangent psum: the block output feeds an SPMD-identical
+    # loss, so the transpose must be the identity (see compat).
+    return psum_replicated_grad(y, axis_name)
 
 
 def tp_mlp(params: dict, x: jax.Array, *,
@@ -199,6 +203,7 @@ def make_tp_train_step(
     model rank owns its shard); the loss/replicated stats reduce over both
     axes.
     """
+    from ..common.compat import assert_replicated
     from ..jax import _shard_map
     from ._stacked import stacked_train_update
 
@@ -208,6 +213,10 @@ def make_tp_train_step(
             jax.value_and_grad(lambda p: loss_fn(p, batch)), data_axis,
         )
         loss = lax.pmean(lax.pmean(loss, data_axis), model_axis)
+        # Old-jax check_rep cannot infer the data-axis replication of the
+        # updated shards through optax; no-op on new jax.
+        params = assert_replicated(params, data_axis)
+        opt_state = assert_replicated(opt_state, data_axis)
         return params, opt_state, loss
 
     fn = _shard_map(

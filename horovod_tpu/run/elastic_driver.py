@@ -47,17 +47,26 @@ REJOIN_EXIT_CODE = 79
 
 def _inprocess_rejoin_supported() -> bool:
     """Mirror of ``horovod_tpu.elastic._inprocess_rejoin_supported`` (see
-    its docstring for the two private JAX surfaces probed). The driver
+    its docstring for the private JAX surfaces probed). The driver
     resolves the rejoin mode once, from its own jax — workers share the
     image — and exports it, so driver orchestration and worker behavior
     always agree."""
     try:
         import jax
         from jax._src import xla_bridge as _xb
+        from jax._src.lib import _jax as _jaxlib
     except Exception:  # noqa: BLE001
         return False
     if not callable(getattr(_xb, "_clear_backends", None)):
         return False
+    # The driver hosts the coordination service, workers the clients —
+    # both factories live on the same jaxlib module, so one probe keeps
+    # the exported mode consistent for both sides.
+    for factory in (
+        "get_distributed_runtime_service", "get_distributed_runtime_client"
+    ):
+        if not callable(getattr(_jaxlib, factory, None)):
+            return False
     try:
         jax.config.jax_enable_recoverability  # noqa: B018
     except Exception:  # noqa: BLE001
@@ -137,7 +146,19 @@ class ElasticDriver:
         # probe whether the private JAX surfaces the in-process path
         # needs exist. Exported to every worker so both sides agree.
         forced = self._env.get("HOROVOD_ELASTIC_REJOIN_MODE", "").lower()
-        if forced in ("inprocess", "respawn"):
+        if forced == "inprocess" and not _inprocess_rejoin_supported():
+            # Honoring the pin would crash the first rendezvous (the
+            # driver-hosted coordination service rides the same private
+            # jaxlib surfaces the workers' in-process rejoin does);
+            # degrade loudly instead, same policy as
+            # elastic.rejoin_mode().
+            self._log(
+                "HOROVOD_ELASTIC_REJOIN_MODE=inprocess but this jax "
+                "lacks the required private surfaces; falling back to "
+                "'respawn'"
+            )
+            self._rejoin_mode = "respawn"
+        elif forced in ("inprocess", "respawn"):
             self._rejoin_mode = forced
         else:
             self._rejoin_mode = (
@@ -578,6 +599,17 @@ class ElasticDriver:
             for w, deadline in self._removing:
                 rc = w.proc.poll()
                 if rc is not None:
+                    if rc not in (0, REJOIN_EXIT_CODE):
+                        # Code-blind for blacklisting, but not for the
+                        # postmortem log: a crash reaped during a world
+                        # restart (its peer's rejoin exit won the reap
+                        # race) must still be attributable in the driver
+                        # log, same phrasing as a directly-reaped
+                        # failure.
+                        self._log(
+                            f"{w.worker_id} failed with exit code {rc} "
+                            "(reaped while draining for restart)"
+                        )
                     for f in w.outfiles:
                         f.close()
                     continue

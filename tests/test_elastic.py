@@ -20,6 +20,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 pytestmark = pytest.mark.multiproc
 
 
+def _driver_inprocess_supported() -> bool:
+    """Whether the driver would actually run a forced-inprocess job as
+    inprocess on this jax pin (it degrades to respawn otherwise)."""
+    from horovod_tpu.run.elastic_driver import _inprocess_rejoin_supported
+
+    return _inprocess_rejoin_supported()
+
+
 def test_elastic_state_primitives():
     """ObjectState/JaxState commit/restore and the run decorator's
     pass-through outside an elastic launch (no driver involved)."""
@@ -512,7 +520,27 @@ def test_elastic_rejoin_mode_probe(monkeypatch):
 
     import horovod_tpu.elastic as elastic
 
-    assert elastic._inprocess_rejoin_supported()  # pinned jax has both
+    # The probe must agree with the actual surfaces on the running jax
+    # (some pins have them all, some — e.g. pre-recoverability 0.4.x —
+    # not).
+    has_clear = callable(getattr(_xb, "_clear_backends", None))
+    try:
+        jax.config.jax_enable_recoverability  # noqa: B018
+        has_flag = True
+    except AttributeError:
+        has_flag = False
+    try:
+        from jax._src.lib import _jax as _jaxlib
+
+        has_factories = all(
+            callable(getattr(_jaxlib, f, None))
+            for f in ("get_distributed_runtime_service",
+                      "get_distributed_runtime_client")
+        )
+    except ImportError:
+        has_factories = False
+    baseline = elastic._inprocess_rejoin_supported()
+    assert baseline == (has_clear and has_flag and has_factories)
 
     with pytest.MonkeyPatch.context() as mp:
         mp.setattr(_xb, "_clear_backends", None, raising=True)
@@ -526,12 +554,18 @@ def test_elastic_rejoin_mode_probe(monkeypatch):
         mp.delattr(_xb, "_clear_backends", raising=True)
         assert not elastic._inprocess_rejoin_supported()
 
-    # Explicit pin wins over the probe.
+    # Explicit pin wins over the probe (respawn always; inprocess only
+    # when the surfaces exist — otherwise it degrades to respawn).
     with pytest.MonkeyPatch.context() as mp:
         mp.setenv("HOROVOD_ELASTIC_REJOIN_MODE", "respawn")
         mp.setattr(elastic, "_rejoin_mode", None)
         assert elastic.rejoin_mode() == "respawn"
-    assert elastic._inprocess_rejoin_supported()  # undo restored it
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("HOROVOD_ELASTIC_REJOIN_MODE", "inprocess")
+        mp.setattr(elastic, "_rejoin_mode", None)
+        expected = "inprocess" if baseline else "respawn"
+        assert elastic.rejoin_mode() == expected
+    assert elastic._inprocess_rejoin_supported() == baseline  # undo held
 
 
 def test_elastic_respawn_fallback_recovery():
@@ -709,6 +743,54 @@ def test_driver_service_retirement_supersession_clock():
     assert not drv._services and all(s.down for s in remaining)
 
 
+def test_driver_forced_inprocess_degrades_without_surfaces(tmp_path):
+    """A forced HOROVOD_ELASTIC_REJOIN_MODE=inprocess on a jax whose
+    private distributed-runtime surfaces are missing must degrade to
+    respawn in the DRIVER too (not only in the worker-side
+    elastic.rejoin_mode()): the driver hosts the coordination service on
+    those same surfaces, so honoring the pin would crash the first
+    rendezvous instead of the job running degraded."""
+    from horovod_tpu.run import elastic_driver as ed
+
+    drivers = []
+
+    def _mk(forced=None):
+        env = {"PATH": os.environ.get("PATH", "")}
+        if forced:
+            env["HOROVOD_ELASTIC_REJOIN_MODE"] = forced
+        d = ed.ElasticDriver(
+            ["true"], min_np=1, max_np=1, hosts=[("localhost", 1)],
+            env=env, output_dir=str(tmp_path),
+        )
+        drivers.append(d)
+        return d
+
+    try:
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(ed, "_inprocess_rejoin_supported", lambda: False)
+            d = _mk("inprocess")
+            assert d._rejoin_mode == "respawn"
+            # Workers read the exported mode — both sides must agree.
+            assert d._env["HOROVOD_ELASTIC_REJOIN_MODE"] == "respawn"
+            assert _mk()._rejoin_mode == "respawn"
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(ed, "_inprocess_rejoin_supported", lambda: True)
+            assert _mk("inprocess")._rejoin_mode == "inprocess"
+            assert _mk("respawn")._rejoin_mode == "respawn"
+            assert _mk()._rejoin_mode == "inprocess"
+    finally:
+        for d in drivers:
+            # The KV server socket is bound at construction but its
+            # serve thread never started here, so close the socket
+            # directly (stop() would block on the serve loop).
+            d._kv._server.server_close()
+
+
+@pytest.mark.skipif(
+    not _driver_inprocess_supported(),
+    reason="pinned jax lacks the private surfaces for in-process rejoin "
+           "(the driver degrades this job to respawn mode)",
+)
 def test_driver_79_exit_is_failure_in_inprocess_mode():
     """Exit status 79 is the respawn request ONLY in respawn mode; the
     in-process runtime never emits it, so there a user program exiting

@@ -70,6 +70,71 @@ def test_stall_check_disable():
     assert not insp.should_shutdown
 
 
+def test_static_preflight_beats_stall_checker(caplog):
+    """A deliberately mis-ordered pair of named allreduces is caught by
+    the static pre-flight (analysis.check_cross_rank_order) immediately —
+    while a default-configured StallInspector, fed the same tensors,
+    still has ~60s to go before its first warning — and the error names
+    both tensors and both ranks."""
+    import horovod_tpu as hvd
+    from horovod_tpu import analysis
+    from horovod_tpu.analysis.findings import CollectiveSafetyError
+
+    def step():
+        a = np.ones(4, np.float32)
+        # Rank 1 submits the pair in the opposite order: the classic
+        # eager-mode deadlock the coordinator can only time out on.
+        if hvd.rank() == 1:
+            hvd.allreduce_async(a, name="grad.bias")
+            hvd.allreduce_async(a, name="grad.weight")
+        else:
+            hvd.allreduce_async(a, name="grad.weight")
+            hvd.allreduce_async(a, name="grad.bias")
+
+    # Dynamic path: a default (60s-warn) inspector that just saw these
+    # tensors has not warned yet — the deadlock would sit silent.
+    insp = StallInspector(_cfg(warn=60.0))
+    insp.record(["grad.weight", "grad.bias"])
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        insp.check()
+    assert not [r for r in caplog.records if "Stalled ops" in r.getMessage()]
+    assert not insp.should_shutdown
+
+    # Static path: the same divergence is a hard error before anything
+    # is submitted.
+    traces = analysis.simulate_ranks(step, 2)
+    findings = analysis.check_cross_rank_order(traces)
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "grad.weight" in msg and "grad.bias" in msg
+    assert "rank 0" in msg and "rank 1" in msg
+
+    # The raising form used by the runtime pre-flight carries the same
+    # diagnostic.
+    with pytest.raises(CollectiveSafetyError) as exc:
+        raise CollectiveSafetyError(findings)
+    for needle in ("grad.weight", "grad.bias", "rank 0", "rank 1"):
+        assert needle in str(exc.value)
+
+
+def test_preflight_ledger_records_submissions(hvd_session, monkeypatch):
+    """With HOROVOD_TPU_STATIC_CHECKS on, eager submissions land in the
+    per-process ledger that verify_cross_rank_order exchanges."""
+    from horovod_tpu.analysis import preflight
+
+    monkeypatch.setattr(preflight, "_enabled_cache", True)
+    preflight.clear_ledger()
+    try:
+        hvd_session.allreduce(np.ones(4, np.float32), name="led.a")
+        hvd_session.allgather(np.ones(2, np.float32), name="led.b")
+        names = [c.name for c in preflight.ledger()]
+        assert names == ["led.a", "led.b"]
+        # size=1: the gathered "cross-rank" view trivially agrees.
+        assert preflight.verify_cross_rank_order() == []
+    finally:
+        preflight._reset_for_tests(None)
+
+
 def test_runtime_clears_stall_on_completion(hvd_session):
     """End-to-end: a tensor that completes promptly never trips the
     inspector even with a tiny warn window."""

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Collective-safety static analysis gate (make lint-collectives).
+#
+# Runs tools/collective_lint.py over the example train steps (Pass 1) and
+# the runtime sources' lock discipline (Pass 2). Exits nonzero on any
+# finding. Budget: must stay under 60s on CPU — the example steps are
+# traced (make_jaxpr), never compiled or executed.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+start=$(date +%s)
+python tools/collective_lint.py all "$@"
+rc=$?
+elapsed=$(( $(date +%s) - start ))
+echo "ci_checks: collective lint clean in ${elapsed}s"
+exit $rc

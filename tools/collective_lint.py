@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Collective-safety static analyzer CLI.
+
+Runs the two analyzer passes from ``horovod_tpu.analysis``:
+
+ - ``examples``: Pass 1 over the repo's canonical example train steps —
+   the compiled-mode steps the jax examples build (MNIST-CNN
+   ``make_train_step``, flat and hierarchical ``allreduce_gradients``,
+   Adasum) traced on a virtual 8-device CPU mesh, plus a two-rank
+   simulation of the eager MNIST gradient loop's submission order.
+ - ``runtime``: Pass 2 (lock-discipline lint) over
+   ``core/runtime.py`` / ``core/native_runtime.py`` /
+   ``core/xla_executor.py``.
+ - ``all``: both.
+
+Exit status is nonzero when any finding is reported. ``--json`` prints a
+stable machine-readable document (sorted findings, deterministic key
+order) for CI diffing. See docs/static_analysis.md.
+
+Usage:
+  python tools/collective_lint.py [--json] [--threshold BYTES] \
+      {examples,runtime,all}
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# The example steps trace on a virtual 8-device CPU mesh (same harness as
+# tests/conftest.py). Must be set before jax initializes its backend.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _lint_examples(threshold: int):
+    """Pass 1 over the example train steps."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu import analysis
+    from horovod_tpu.common.types import Adasum
+    from horovod_tpu.models.mnist_cnn import MnistCNN
+    from horovod_tpu.parallel.mesh import (
+        build_hierarchical_mesh,
+        build_mesh,
+    )
+
+    findings = []
+
+    # --- compiled-mode steps (examples/jax_adasum.py shape) ---
+    model = MnistCNN()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+    )["params"]
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply({"params": p}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    batch = (
+        jnp.zeros((8, 28, 28, 1), jnp.float32),
+        jnp.zeros((8,), jnp.int32),
+    )
+    mesh = build_mesh({"data": 8})
+    for label, kwargs in (
+        ("mnist_sgd", {}),
+        ("mnist_adasum", {"op": Adasum}),
+    ):
+        tx = hvdj.DistributedOptimizer(
+            optax.sgd(0.01), fusion_threshold_bytes=threshold, **kwargs
+        )
+        step = hvdj.make_train_step(
+            loss_fn, tx, mesh, fusion_threshold_bytes=threshold,
+            donate=False,
+        )
+        opt_state = tx.init(params)
+        fs = analysis.lint_step(
+            step, params, opt_state, batch,
+            mesh=mesh, fusion_threshold_bytes=threshold,
+        )
+        for f in fs:
+            f.location = f"examples:{label}/{f.location}"
+        findings.extend(fs)
+
+    # --- hierarchical (cross, local) step ---
+    hmesh = build_hierarchical_mesh(4)
+    tx = hvdj.DistributedOptimizer(
+        optax.sgd(0.01), hierarchical=True,
+        fusion_threshold_bytes=threshold,
+    )
+    step = hvdj.make_train_step(
+        loss_fn, tx, hmesh, hierarchical=True,
+        fusion_threshold_bytes=threshold, donate=False,
+    )
+    opt_state = tx.init(params)
+    fs = analysis.lint_step(
+        step, params, opt_state, batch,
+        mesh=hmesh, fusion_threshold_bytes=threshold,
+    )
+    for f in fs:
+        f.location = f"examples:mnist_hierarchical/{f.location}"
+    findings.extend(fs)
+
+    # --- eager submission order (examples/jax_mnist.py loop shape) ---
+    def eager_loop():
+        grads = [np.ones((4, 4), np.float32) for _ in range(4)]
+        handles = [
+            hvd.allreduce_async(g, name=f"grad.{i}")
+            for i, g in enumerate(grads)
+        ]
+        for h in handles:
+            hvd.synchronize(h)
+
+    traces = analysis.simulate_ranks(lambda: eager_loop(), 2)
+    fs = analysis.check_cross_rank_order(traces)
+    for f in fs:
+        f.location = f"examples:jax_mnist_eager/{f.location}"
+    findings.extend(fs)
+    return findings
+
+
+def _lint_runtime():
+    from horovod_tpu import analysis
+
+    return analysis.lint_runtime()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="collective_lint",
+        description="Collective-safety static analyzer "
+                    "(see docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "target", choices=("examples", "runtime", "all"),
+        help="examples = Pass 1 over example train steps; "
+             "runtime = Pass 2 over the runtime sources; all = both",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (stable key/finding order)",
+    )
+    parser.add_argument(
+        "--threshold", type=int, default=64 * 1024 * 1024,
+        help="fusion-buffer budget in bytes (default 64 MiB)",
+    )
+    args = parser.parse_args(argv)
+
+    from horovod_tpu.analysis import findings_to_json, sort_findings
+
+    findings = []
+    if args.target in ("examples", "all"):
+        findings.extend(_lint_examples(args.threshold))
+    if args.target in ("runtime", "all"):
+        findings.extend(_lint_runtime())
+
+    findings = sort_findings(findings)
+    if args.json:
+        print(findings_to_json(findings, target=args.target))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"collective_lint[{args.target}]: "
+            f"{len(findings)} finding(s)"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
