@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives ci
+.PHONY: test lint-collectives chaos-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -13,6 +13,11 @@ test:
 # Collective-safety static analysis: Pass 1 over the example train steps
 # and Pass 2 over the runtime sources (docs/static_analysis.md).
 lint-collectives:
-	bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 bash tools/ci_checks.sh
 
-ci: lint-collectives test
+# Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
+# slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
+
+ci: lint-collectives chaos-smoke test

@@ -94,6 +94,7 @@ DEFAULT_DISCIPLINE: Dict[str, Dict[str, ClassRule]] = {
             attrs={
                 "_results": AttrRule("_lock"),
                 "_next": AttrRule("_lock"),
+                "_names": AttrRule("_lock"),
             },
             lock_aliases={"_cv": "_lock"},
         ),
@@ -104,6 +105,12 @@ DEFAULT_DISCIPLINE: Dict[str, Dict[str, ClassRule]] = {
                 # _state_lock.
                 "_process_sets": AttrRule("_state_lock"),
                 "joined": AttrRule("_state_lock"),
+                # Background-thread confined: written by the cycle loop
+                # before it sets _shutdown, read by the loop's final
+                # drain (the Event is the happens-before edge).
+                "_drain_status": AttrRule(
+                    None, confined_to=("_run_cycle_once",)
+                ),
             },
         ),
         "StallInspector": ClassRule(
@@ -113,7 +120,7 @@ DEFAULT_DISCIPLINE: Dict[str, Dict[str, ClassRule]] = {
                 "_first_seen": AttrRule(
                     None, confined_to=("record", "clear", "check")
                 ),
-                "_warned": AttrRule(
+                "_last_warned": AttrRule(
                     None, confined_to=("record", "clear", "check")
                 ),
                 "should_shutdown": AttrRule(None, confined_to=("check",)),

@@ -50,6 +50,30 @@ HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
 HOROVOD_TPU_EAGER_BACKEND = "HOROVOD_TPU_EAGER_BACKEND"
 # Opt-in collective-safety pre-flight (docs/static_analysis.md).
 HOROVOD_TPU_STATIC_CHECKS = "HOROVOD_TPU_STATIC_CHECKS"
+# Fault tolerance (docs/fault_tolerance.md).
+# Stall escalation ladder: periodic re-warn and per-tensor abort windows
+# on top of the reference's warn/shutdown pair.
+HOROVOD_STALL_REWARN_TIME_SECONDS = "HOROVOD_STALL_REWARN_TIME_SECONDS"
+HOROVOD_STALL_ABORT_TIME_SECONDS = "HOROVOD_STALL_ABORT_TIME_SECONDS"
+# Control-plane RPC retry budget (fault/backoff.py reads these directly —
+# launcher-side processes never construct a Config).
+HOROVOD_RPC_RETRIES = "HOROVOD_RPC_RETRIES"
+HOROVOD_RPC_BACKOFF_BASE_S = "HOROVOD_RPC_BACKOFF_BASE_S"
+HOROVOD_RPC_BACKOFF_MAX_S = "HOROVOD_RPC_BACKOFF_MAX_S"
+HOROVOD_RPC_BACKOFF_JITTER = "HOROVOD_RPC_BACKOFF_JITTER"
+# Rendezvous server-side wait window (replaces the old hardcoded 60 s).
+HOROVOD_COORD_WAIT_TIMEOUT_S = "HOROVOD_COORD_WAIT_TIMEOUT_S"
+# Elastic blacklist quarantine: a blacklisted host is re-admitted after
+# this many seconds (0 = never), and failure counts decay after it too.
+HOROVOD_BLACKLIST_COOLDOWN_S = "HOROVOD_BLACKLIST_COOLDOWN_S"
+# Graceful preemption drain (elastic workers): 0 disables the SIGTERM
+# notice handler.
+HOROVOD_PREEMPTION_GRACEFUL = "HOROVOD_PREEMPTION_GRACEFUL"
+# Deterministic fault injection (fault/plan.py): the plan itself, the
+# event-log path, and the seed for retry jitter in chaos runs.
+HOROVOD_FAULT_PLAN = "HOROVOD_FAULT_PLAN"
+HOROVOD_FAULT_EVENT_LOG = "HOROVOD_FAULT_EVENT_LOG"
+HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64
@@ -113,6 +137,12 @@ class Config:
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
+    # Escalation ladder between warn and shutdown: re-warn every
+    # ``stall_rewarn_seconds`` (0 = reuse the warn interval) and abort the
+    # individual stalled tensor — a named Status.Aborted handed to its
+    # waiters — after ``stall_abort_time_seconds`` (0 = disabled).
+    stall_rewarn_seconds: float = 0.0
+    stall_abort_time_seconds: float = 0.0
     adasum_chunk_size: int = 1 << 26
     log_level: str = "warning"
     eager_backend: str = "auto"  # auto | xla | local
@@ -158,6 +188,12 @@ class Config:
         )
         cfg.stall_shutdown_time_seconds = _get_float(
             HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, cfg.stall_shutdown_time_seconds
+        )
+        cfg.stall_rewarn_seconds = _get_float(
+            HOROVOD_STALL_REWARN_TIME_SECONDS, cfg.stall_rewarn_seconds
+        )
+        cfg.stall_abort_time_seconds = _get_float(
+            HOROVOD_STALL_ABORT_TIME_SECONDS, cfg.stall_abort_time_seconds
         )
         cfg.adasum_chunk_size = _get_int(
             HOROVOD_ADASUM_MPI_CHUNK_SIZE, cfg.adasum_chunk_size

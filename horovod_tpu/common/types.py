@@ -36,6 +36,12 @@ class Status:
     def in_progress(self) -> bool:
         return self.type == StatusType.IN_PROGRESS
 
+    def timed_out(self) -> bool:
+        """A wait gave up while the operation was still in progress: the
+        type stays IN_PROGRESS (the op may yet complete) but the reason
+        carries the diagnostic (tensor name, configured timeout)."""
+        return self.type == StatusType.IN_PROGRESS and bool(self.reason)
+
     @staticmethod
     def OK() -> "Status":  # noqa: N802 - parity with reference naming
         return Status(StatusType.OK)
@@ -59,6 +65,10 @@ class Status:
     @staticmethod
     def InProgress() -> "Status":  # noqa: N802
         return Status(StatusType.IN_PROGRESS)
+
+    @staticmethod
+    def TimedOut(msg: str) -> "Status":  # noqa: N802
+        return Status(StatusType.IN_PROGRESS, msg)
 
 
 # Shutdown message text, parity with reference common.h:153-158.

@@ -23,6 +23,7 @@ import numpy as np
 from ..common.basics import NativeCore, _CoreError
 from ..common.env import Config
 from ..common.topology import Topology
+from ..fault import injector as _fault
 from ..common.types import (
     DataType,
     ReduceOp,
@@ -193,6 +194,10 @@ class NativeRuntime:
                 "Horovod runtime is shut down or was never initialized; "
                 "call hvd.init() first."
             )
+        if _fault.ACTIVE:
+            # Chaos tap, same site name as the pure-Python runtime so one
+            # fault plan drives either core (docs/fault_tolerance.md).
+            _fault.fault_point("enqueue", name)
         entry = TensorTableEntry(
             name=name,
             tensor=tensor,
@@ -486,7 +491,14 @@ class NativeRuntime:
                         raise HorovodInternalError(status.reason)
                     return out
                 if deadline is not None and time.monotonic() > deadline:
-                    raise TimeoutError("Horovod operation timed out")
+                    with self._cv:
+                        name = self._ticket_names.get(handle, "")
+                    raise TimeoutError(
+                        "operation "
+                        + (f"'{name}' " if name else f"handle {handle} ")
+                        + f"did not complete within {timeout}s; it is "
+                        "still in progress"
+                    )
                 # Inline fast path: consume the next plan on THIS thread
                 # (see _consumer_lock comment). Non-blocking acquire —
                 # another synchronize() caller may already be consuming,

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..common.env import Config
 from ..common.topology import Topology
+from ..fault import injector as _fault
 from ..common.types import (
     DUPLICATE_NAME_ERROR_FMT,
     ReduceOp,
@@ -159,14 +160,21 @@ class HandleManager:
         self._lock = threading.Lock()
         self._next = 0
         self._results: Dict[int, Tuple[Status, Any]] = {}
+        self._names: Dict[int, str] = {}
         self._cv = threading.Condition(self._lock)
 
-    def allocate(self) -> int:
+    def allocate(self, name: str = "") -> int:
         with self._lock:
             h = self._next
             self._next += 1
             self._results[h] = (Status.InProgress(), None)
+            if name:
+                self._names[h] = name
             return h
+
+    def name_of(self, handle: int) -> str:
+        with self._lock:
+            return self._names.get(handle, "")
 
     def mark_done(self, handle: int, status: Status, output: Any) -> None:
         with self._cv:
@@ -190,21 +198,56 @@ class HandleManager:
                 st, out = self._results.get(handle, (Status.InProgress(), None))
                 if not st.in_progress():
                     self._results.pop(handle, None)
+                    self._names.pop(handle, None)
                     return st, out
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
-                    return Status.InProgress(), None
+                    # Descriptive timeout status — NOT a bare InProgress:
+                    # callers historically treated (InProgress, None) as
+                    # data. The handle stays allocated; the op may still
+                    # complete and a later wait() can collect it.
+                    name = self._names.get(handle, "")
+                    return Status.TimedOut(
+                        f"operation "
+                        + (f"'{name}' " if name else f"handle {handle} ")
+                        + f"did not complete within {timeout}s; it is "
+                        "still in progress"
+                    ), None
                 self._cv.wait(timeout=0.1 if remaining is None else min(0.1, remaining))
 
 
+@dataclass
+class StallReport:
+    """One check()'s escalation verdict: tensors (re-)warned about,
+    tensors whose waiters must be aborted, and whether the whole runtime
+    should shut down for an elastic reset."""
+
+    warned: List[str] = field(default_factory=list)
+    aborted: List[str] = field(default_factory=list)
+    shutdown: bool = False
+
+
 class StallInspector:
-    """Warns when tensors sit in the queue too long (reference
-    stall_inspector.cc; 60 s default warn, optional shutdown)."""
+    """Escalation ladder for tensors sitting in the queue too long
+    (reference stall_inspector.cc ships only the first rung):
+
+    1. warn after ``stall_warning_time_seconds`` and RE-warn every
+       ``stall_rewarn_seconds`` (default: the warn interval) — a stall is
+       a live incident, not a one-shot log line;
+    2. abort the individual tensor after ``stall_abort_time_seconds``
+       (optional): its waiters receive a named ``Status.Aborted`` instead
+       of hanging, and the rest of the queue keeps flowing;
+    3. shut the runtime down after ``stall_shutdown_time_seconds``
+       (optional): every queued tensor drains with a named abort status,
+       which in an elastic job triggers rollback + re-rendezvous.
+
+    Warnings include the set of missing ranks when the coordinator knows
+    them (``Coordinator.missing_ranks``)."""
 
     def __init__(self, config: Config):
         self._config = config
         self._first_seen: Dict[str, float] = {}
-        self._warned: set = set()
+        self._last_warned: Dict[str, float] = {}
         self.should_shutdown = False
 
     def record(self, names: Sequence[str]) -> None:
@@ -215,31 +258,63 @@ class StallInspector:
     def clear(self, names: Sequence[str]) -> None:
         for n in names:
             self._first_seen.pop(n, None)
-            self._warned.discard(n)
+            self._last_warned.pop(n, None)
 
-    def check(self) -> None:
+    def stalled_names(self) -> List[str]:
+        return sorted(self._first_seen)
+
+    def check(
+        self, missing_ranks: Optional[Dict[str, List[int]]] = None
+    ) -> StallReport:
+        report = StallReport()
         if self._config.stall_check_disable:
-            return
+            return report
         now = time.monotonic()
-        stalled = [
-            n
-            for n, t in self._first_seen.items()
-            if now - t > self._config.stall_warning_time_seconds and n not in self._warned
-        ]
-        if stalled:
+        rewarn = (
+            self._config.stall_rewarn_seconds
+            or self._config.stall_warning_time_seconds
+        )
+        for n, t in self._first_seen.items():
+            if now - t <= self._config.stall_warning_time_seconds:
+                continue
+            last = self._last_warned.get(n)
+            if last is None or now - last > rewarn:
+                report.warned.append(n)
+        if report.warned:
+            detail = ""
+            if missing_ranks:
+                known = {
+                    n: missing_ranks[n]
+                    for n in report.warned
+                    if missing_ranks.get(n)
+                }
+                if known:
+                    detail = " Missing ranks: " + "; ".join(
+                        f"{n} <- {sorted(r)}" for n, r in sorted(known.items())
+                    )
             logger.warning(
                 "One or more tensors were submitted to be reduced, gathered or "
                 "broadcasted by subset of ranks and are waiting for remainder of "
-                "ranks for more than %d seconds. Stalled ops: %s",
+                "ranks for more than %d seconds. Stalled ops: %s.%s",
                 int(self._config.stall_warning_time_seconds),
-                ", ".join(sorted(stalled)),
+                ", ".join(sorted(report.warned)),
+                detail,
             )
-            self._warned.update(stalled)
+            for n in report.warned:
+                self._last_warned[n] = now
+        if self._config.stall_abort_time_seconds > 0:
+            report.aborted = [
+                n
+                for n, t in self._first_seen.items()
+                if now - t > self._config.stall_abort_time_seconds
+            ]
         if self._config.stall_shutdown_time_seconds > 0:
             for n, t in self._first_seen.items():
                 if now - t > self._config.stall_shutdown_time_seconds:
                     self.should_shutdown = True
+                    report.shutdown = True
                     break
+        return report
 
 
 class Coordinator:
@@ -256,6 +331,13 @@ class Coordinator:
         self, requests: List[Request], queue: TensorQueue, config: Config
     ) -> List[Response]:
         raise NotImplementedError
+
+    def missing_ranks(self) -> Dict[str, List[int]]:
+        """tensor name → ranks that have NOT announced it yet, for tensors
+        this coordinator is still holding. Feeds the stall inspector's
+        warnings; the single-process coordinator never holds anything, so
+        the default is empty."""
+        return {}
 
     def shutdown(self) -> None:
         pass
@@ -421,6 +503,10 @@ class Runtime:
         self.timeline = Timeline()
         self.stall_inspector = StallInspector(config)
         self.joined = False
+        # Status used for the final queue drain; replaced with a named
+        # abort when the stall ladder (not a user shutdown) kills the
+        # loop, so waiters learn WHICH tensors wedged the runtime.
+        self._drain_status: Optional[Status] = None
         self._shutdown = threading.Event()
         self._wake = threading.Event()
         self._initialized = threading.Event()
@@ -513,7 +599,12 @@ class Runtime:
                     f"rank {self.topology.rank} is not a member of process "
                     f"set {process_set_id}"
                 )
-        handle = self.handle_manager.allocate()
+        if _fault.ACTIVE:
+            # Chaos tap: scheduled kills/delays for this rank's
+            # submissions (docs/fault_tolerance.md). Inactive → not
+            # reached (the ACTIVE check is the whole overhead).
+            _fault.fault_point("enqueue", name)
+        handle = self.handle_manager.allocate(name)
 
         def _done(status: Status, output: Any) -> None:
             if callback is not None:
@@ -597,7 +688,7 @@ class Runtime:
                     Status.UnknownError("background loop failure")
                 )
         # Final drain so no handle hangs.
-        self.tensor_queue.drain(SHUT_DOWN_ERROR)
+        self.tensor_queue.drain(self._drain_status or SHUT_DOWN_ERROR)
 
     def _run_cycle_once(self) -> None:
         if self.timeline.initialized and self.config.timeline_mark_cycles:
@@ -609,9 +700,40 @@ class Runtime:
         )
         for response in responses:
             self._perform_operation(response)
-        self.stall_inspector.check()
+        missing = self.coordinator.missing_ranks()
+        report = self.stall_inspector.check(missing)
+        for name in report.aborted:
+            # Rung 2: abort the individual stalled tensor — hand its
+            # waiter a named status instead of letting it hang — and keep
+            # the rest of the queue flowing.
+            entry = self.tensor_queue.take_entry(name)
+            self.stall_inspector.clear([name])
+            if entry is None:
+                continue
+            ranks = missing.get(name) if missing else None
+            status = Status.Aborted(
+                f"collective '{name}' aborted: waited longer than "
+                f"HOROVOD_STALL_ABORT_TIME_SECONDS="
+                f"{self.config.stall_abort_time_seconds:g}s for peer ranks"
+                + (f" {sorted(ranks)}" if ranks else "")
+                + " to submit it"
+            )
+            logger.error("%s", status.reason)
+            if entry.callback is not None:
+                entry.callback(status, None)
         if self.stall_inspector.should_shutdown:
-            logger.error("Stall shutdown time exceeded; aborting runtime.")
+            # Rung 3: the whole runtime is wedged — drain every queued
+            # tensor with a named abort (elastic waiters roll back and
+            # re-rendezvous; see docs/fault_tolerance.md).
+            stalled = self.stall_inspector.stalled_names()
+            self._drain_status = Status.Aborted(
+                "stall shutdown: tensors ["
+                + ", ".join(stalled)
+                + "] exceeded HOROVOD_STALL_SHUTDOWN_TIME_SECONDS="
+                f"{self.config.stall_shutdown_time_seconds:g}s; aborting "
+                "the runtime so elastic recovery can re-form the world"
+            )
+            logger.error("%s", self._drain_status.reason)
             self._shutdown.set()
 
     def _perform_operation(self, response: Response) -> None:
@@ -632,6 +754,9 @@ class Runtime:
                 entries.append(entry)
         if not entries:
             return
+        if _fault.ACTIVE:
+            # Chaos tap: delay/abort a fused response before execution.
+            _fault.fault_point("response", entries[0].name)
         self.stall_inspector.clear([e.name for e in entries])
         timeline_name = _REQ_TO_TIMELINE.get(
             RequestType(int(response.response_type))
@@ -682,7 +807,9 @@ class Runtime:
     def synchronize(self, handle: int, timeout: Optional[float] = None) -> Any:
         status, output = self.handle_manager.wait(handle, timeout)
         if status.in_progress():
-            raise TimeoutError("Horovod operation timed out")
+            raise TimeoutError(
+                status.reason or "Horovod operation timed out"
+            )
         if not status.ok():
             # HorovodInternalError (a RuntimeError subclass) so elastic
             # rollback can distinguish collective failures from user bugs.

@@ -44,6 +44,10 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..fault import injector as _fault_injector
+from ..fault import preemption as _preemption
+from ..fault.preemption import PreemptionInterrupt  # noqa: F401 (re-export)
+
 logger = logging.getLogger("horovod_tpu.elastic")
 
 __all__ = [
@@ -55,6 +59,7 @@ __all__ = [
     "TensorFlowState",
     "TensorFlowKerasState",
     "HostsUpdatedInterrupt",
+    "PreemptionInterrupt",
 ]
 
 
@@ -555,13 +560,20 @@ class State:
             cb()
 
     def commit(self) -> None:
+        if _fault_injector.ACTIVE:
+            # Chaos tap: one commit == one training step; kill/preempt
+            # actions with at_step target this counter.
+            _fault_injector.fault_point("step")
         self.save()
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
         """Raise ``HostsUpdatedInterrupt`` on EVERY rank when any rank has
         seen a newer world generation — agreement by allreduce so no rank
-        runs ahead into a collective its peers abandoned."""
+        runs ahead into a collective its peers abandoned. A pending
+        preemption notice rides the same agreement: the preempted rank
+        raises ``PreemptionInterrupt`` (drain + rejoin with the state just
+        committed), its peers a plain membership interrupt."""
         ctx = _ctx()
         if ctx is None:
             return
@@ -569,12 +581,26 @@ class State:
 
         import horovod_tpu as hvd
 
-        flag = np.asarray([1 if ctx.poll_updated() else 0], np.int32)
+        preempted = _preemption.preemption_requested()
+        flag = np.asarray(
+            [(2 if preempted else 0) + (1 if ctx.poll_updated() else 0)],
+            np.int32,
+        )
         if hvd.size() > 1:
             flag = np.asarray(
                 hvd.allreduce(flag, op=hvd.Sum, name="hvd.elastic.hostcheck")
             )
-        if int(flag[0]) > 0:
+        total = int(flag[0])
+        if preempted:
+            raise PreemptionInterrupt(
+                _preemption.preemption_reason() or "preemption notice"
+            )
+        if total >= 2:
+            raise HostsUpdatedInterrupt(
+                "a peer rank received a preemption notice; re-forming "
+                "the world"
+            )
+        if total > 0:
             raise HostsUpdatedInterrupt(
                 "host membership changed; re-forming the world"
             )
@@ -986,6 +1012,14 @@ def run(func: Callable) -> Callable:
         if ctx is None:
             return func(state, *args, **kwargs)
         mode = rejoin_mode()
+        if os.environ.get(
+            "HOROVOD_PREEMPTION_GRACEFUL", "1"
+        ).strip().lower() not in ("0", "false", "no", "off"):
+            # SIGTERM is the platform's maintenance/preemption notice:
+            # turn it into a graceful drain (commit → drain → rejoin)
+            # instead of an instant death. The driver's SIGKILL escalation
+            # still bounds a worker that never reaches another commit.
+            _preemption.install_sigterm_handler()
         if mode == "respawn":
             restored = _maybe_restore_persisted(state)
             _elect_restored_sync_root(ctx, restored)
@@ -1006,6 +1040,17 @@ def run(func: Callable) -> Callable:
                     "elastic: membership change; rejoining with current "
                     "state"
                 )
+            except PreemptionInterrupt as exc:
+                # The notice was observed inside commit(): the state is
+                # already saved. Keep it (no rollback), drain the
+                # in-flight collectives with the runtime teardown below
+                # (_persist_state_and_exit / _rejoin both shut the
+                # runtime down), and rejoin through the elastic path.
+                logger.warning(
+                    "elastic: preemption notice (%s); draining and "
+                    "rejoining with the just-committed state", exc,
+                )
+                _preemption.clear()
             except Exception as exc:  # noqa: BLE001 - filtered below
                 if not _is_collective_failure(exc):
                     raise

@@ -1,0 +1,68 @@
+"""Deterministic fault injection + the recovery machinery it exercises.
+
+Two halves (docs/fault_tolerance.md):
+
+- **Injection** (:mod:`.plan`, :mod:`.injector`): a seeded fault schedule
+  from ``HOROVOD_FAULT_PLAN`` — kill worker N at step K, delay a rank's
+  submissions, drop/duplicate control-plane messages, deliver a simulated
+  TPU maintenance notice — executed at fixed taps in the runtime, the
+  launcher control plane, and the elastic driver.  Zero overhead when the
+  env var is unset.
+- **Recovery** (:mod:`.backoff`, :mod:`.preemption`): bounded retry with
+  exponential backoff + deterministic jitter for control-plane RPCs, and
+  the graceful-preemption drain path (notice → commit → drain → rejoin).
+"""
+
+from .backoff import (  # noqa: F401
+    Backoff,
+    retry_call,
+    HOROVOD_FAULT_SEED,
+    HOROVOD_RPC_BACKOFF_BASE_S,
+    HOROVOD_RPC_BACKOFF_JITTER,
+    HOROVOD_RPC_BACKOFF_MAX_S,
+    HOROVOD_RPC_RETRIES,
+)
+from . import injector  # noqa: F401  (live ACTIVE flag: injector.ACTIVE)
+from .injector import (  # noqa: F401
+    FAULT_EVENT_LOG_ENV,
+    InjectedFault,
+    activate_from_env,
+    active_plan,
+    events,
+    fault_point,
+    install_plan,
+    record_event,
+    reset,
+    step,
+)
+from .plan import FAULT_PLAN_ENV, FaultAction, FaultPlan  # noqa: F401
+from .preemption import (  # noqa: F401
+    PreemptionInterrupt,
+    clear as clear_preemption,
+    install_sigterm_handler,
+    preemption_requested,
+    request_preemption,
+)
+
+__all__ = [
+    "Backoff",
+    "FAULT_EVENT_LOG_ENV",
+    "FAULT_PLAN_ENV",
+    "FaultAction",
+    "FaultPlan",
+    "InjectedFault",
+    "PreemptionInterrupt",
+    "activate_from_env",
+    "active_plan",
+    "clear_preemption",
+    "events",
+    "fault_point",
+    "install_plan",
+    "install_sigterm_handler",
+    "preemption_requested",
+    "record_event",
+    "request_preemption",
+    "reset",
+    "retry_call",
+    "step",
+]

@@ -1,0 +1,200 @@
+"""Fault-injection taps.
+
+``fault_point(site)`` is called from a handful of fixed places in the
+runtime, the launcher control plane, and the elastic driver.  With no plan
+loaded (the production default) the module-level :data:`ACTIVE` flag is
+False and instrumented call sites skip the call entirely — zero overhead.
+With ``HOROVOD_FAULT_PLAN`` set, each hit advances a per-site counter,
+matches the plan's actions against (site, counter, rank, worker,
+generation), and executes whatever the plan schedules: sleep, raise
+:class:`InjectedFault`, deliver a preemption notice, or kill the process.
+
+Every executed injection is appended to the event log — in memory always,
+and to the file named by ``HOROVOD_FAULT_EVENT_LOG`` when set.  Event
+lines carry only deterministic fields (sequence number, site, hit count,
+action) so logs from two runs of the same plan can be compared directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .plan import FAULT_PLAN_ENV, FaultAction, FaultPlan
+
+FAULT_EVENT_LOG_ENV = "HOROVOD_FAULT_EVENT_LOG"
+
+
+class InjectedFault(ConnectionError):
+    """A fault injected by the active plan (dropped control-plane message,
+    severed connection).  Subclasses ConnectionError so the production
+    retry/backoff paths treat it exactly like a real transport failure."""
+
+
+ACTIVE = False
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_counters: Dict[str, int] = {}
+_events: List[dict] = []
+_seq = 0
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    """Activate ``plan`` for this process (None deactivates)."""
+    global ACTIVE, _plan
+    with _lock:
+        _plan = plan
+        _counters.clear()
+        _events.clear()
+        _reset_seq()
+        ACTIVE = plan is not None
+
+
+def activate_from_env() -> Optional[FaultPlan]:
+    """(Re)load the plan from ``HOROVOD_FAULT_PLAN``; returns it."""
+    install_plan(FaultPlan.from_env())
+    return _plan
+
+
+def reset() -> None:
+    install_plan(None)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def _reset_seq() -> None:
+    global _seq
+    _seq = 0
+
+
+def _identity() -> tuple:
+    env = os.environ
+    rank = env.get("HOROVOD_RANK")
+    gen = env.get("HOROVOD_ELASTIC_GEN")
+    return (
+        int(rank) if rank is not None and rank.isdigit() else None,
+        env.get("HOROVOD_ELASTIC_WORKER_ID"),
+        int(gen) if gen is not None and gen.isdigit() else None,
+    )
+
+
+def record_event(site: str, hit: int, action: str, detail: str = "") -> dict:
+    """Append one deterministic event line (also used by the driver for
+    its own scheduled injections)."""
+    global _seq
+    with _lock:
+        _seq += 1
+        ev = {
+            "seq": _seq,
+            "site": site,
+            "hit": hit,
+            "action": action,
+            "detail": detail,
+        }
+        _events.append(ev)
+        path = os.environ.get(FAULT_EVENT_LOG_ENV, "")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(ev, sort_keys=True) + "\n")
+        except OSError:
+            pass
+    return ev
+
+
+def _execute(action: FaultAction, site: str, hit: int,
+             name: Optional[str]) -> Optional[str]:
+    detail = name or ""
+    if action.kind == "delay":
+        record_event(site, hit, "delay", detail)
+        time.sleep(action.seconds)
+        return None
+    if action.kind == "drop":
+        record_event(site, hit, "drop", detail)
+        raise InjectedFault(
+            f"injected fault: dropped {site} message"
+            + (f" ({name})" if name else "")
+        )
+    if action.kind == "duplicate":
+        record_event(site, hit, "duplicate", detail)
+        return "duplicate"
+    if action.kind == "preempt":
+        record_event(site, hit, "preempt", detail)
+        from . import preemption
+
+        preemption.request_preemption("fault plan: simulated maintenance")
+        return None
+    if action.kind == "kill":
+        record_event(site, hit, "kill", f"exit={action.exit_code}")
+        # Flush anything buffered — the event log write above already
+        # hit disk (opened in append mode per line).
+        try:
+            import sys
+
+            sys.stdout.flush()
+            sys.stderr.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        os._exit(action.exit_code)
+    return None
+
+
+def fault_point(site: str, name: Optional[str] = None) -> Optional[str]:
+    """Advance ``site``'s hit counter and execute any scheduled faults.
+
+    Returns a directive string for actions the call site must implement
+    itself (currently only ``"duplicate"``), else None.  Raises
+    :class:`InjectedFault` for dropped messages and never returns for
+    kills."""
+    plan = _plan
+    if plan is None:
+        return None
+    with _lock:
+        hit = _counters.get(site, 0) + 1
+        _counters[site] = hit
+    rank, worker, gen = _identity()
+    directive = None
+    for action in plan.actions:
+        if action.site != site:
+            continue
+        if not action.matches_process(rank, worker, gen):
+            continue
+        if not action.in_window(hit):
+            continue
+        if not plan.decide(action, rank):
+            continue
+        out = _execute(action, site, hit, name)
+        directive = out or directive
+    return directive
+
+
+def step(name: Optional[str] = None) -> None:
+    """Mark one training step (``State.commit`` calls this; non-elastic
+    loops may call it directly).  No-op without an active plan."""
+    if ACTIVE:
+        fault_point("step", name)
+
+
+# Load at import so worker processes spawned with HOROVOD_FAULT_PLAN in
+# their environment are armed without any code changes.
+if os.environ.get(FAULT_PLAN_ENV, "").strip():
+    try:
+        activate_from_env()
+    except Exception:  # noqa: BLE001 - a malformed plan must not
+        # take down production init; it is surfaced by the chaos tools.
+        import logging
+
+        logging.getLogger("horovod_tpu.fault").exception(
+            "could not load %s", FAULT_PLAN_ENV
+        )

@@ -1,0 +1,258 @@
+"""Deterministic, seeded fault plans.
+
+A fault plan is a JSON document (inline in ``HOROVOD_FAULT_PLAN`` or a path
+to a file) describing *exactly which failures to inject where*:
+
+.. code-block:: json
+
+    {
+      "seed": 1234,
+      "faults": [
+        {"kind": "kill",      "rank": 2, "at_step": 3, "exit_code": 43},
+        {"kind": "delay",     "rank": 1, "site": "enqueue",
+         "seconds": 0.05, "after": 2, "count": 20},
+        {"kind": "drop",      "site": "kv",  "frac": 0.5,
+         "after": 5, "count": 8},
+        {"kind": "duplicate", "site": "rpc", "frac": 0.1},
+        {"kind": "preempt",   "worker": "localhost:1", "after_s": 2.0},
+        {"kind": "preempt",   "rank": 0, "at_step": 4}
+      ]
+    }
+
+Determinism is the whole point: probabilistic actions (``frac``) draw from
+a ``random.Random`` stream keyed by ``(seed, site, rank)``, so the n-th tap
+hit at a site makes the same drop/keep decision in every run with the same
+seed.  :meth:`FaultPlan.canonical_schedule` serializes the fully-resolved
+plan — including the first decisions of every probabilistic stream — to
+canonical bytes, which the elastic driver writes to its event log so two
+runs with the same seed can be diffed byte-for-byte.
+
+Action fields
+-------------
+
+``kind``
+    ``kill`` | ``delay`` | ``drop`` | ``duplicate`` | ``preempt``.
+``site``
+    Tap the action applies to: ``step`` (one training step, i.e. one
+    ``State.commit``), ``enqueue``/``response`` (runtime collective
+    submission/completion), ``rpc`` (launcher control-plane send),
+    ``kv`` (rendezvous KV request), ``spawn`` (driver worker spawn).
+    Defaults: kill/preempt → ``step``, delay → ``enqueue``,
+    drop/duplicate → ``rpc``.
+``rank`` / ``worker`` / ``gen``
+    Selectors; omitted means "any". ``rank`` matches ``HOROVOD_RANK``,
+    ``worker`` matches ``HOROVOD_ELASTIC_WORKER_ID``, ``gen`` matches
+    ``HOROVOD_ELASTIC_GEN`` (scoping a fault to the first world generation
+    is the standard way to keep a kill from re-firing after recovery).
+``at_step`` / ``after`` / ``count`` / ``frac``
+    Trigger window over the site's hit counter: ``at_step`` fires exactly
+    at that count (kill/preempt), ``after``+``count`` bound a window
+    (delay/drop/duplicate), ``frac`` makes the action probabilistic inside
+    its window.
+``seconds`` / ``exit_code`` / ``after_s``
+    Parameters: delay duration, kill exit status, and (driver-side
+    preempt) seconds after spawn at which the driver delivers the
+    simulated maintenance notice (SIGTERM) to the worker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+FAULT_PLAN_ENV = "HOROVOD_FAULT_PLAN"
+
+_KINDS = ("kill", "delay", "drop", "duplicate", "preempt")
+_SITES = ("step", "enqueue", "response", "rpc", "kv", "spawn")
+_DEFAULT_SITE = {
+    "kill": "step",
+    "preempt": "step",
+    "delay": "enqueue",
+    "drop": "rpc",
+    "duplicate": "rpc",
+}
+# How many leading decisions of each probabilistic stream the canonical
+# schedule materializes (enough to make drop bursts diffable without
+# unbounded output).
+_SCHEDULE_DECISIONS = 64
+
+
+@dataclass
+class FaultAction:
+    kind: str
+    site: str
+    rank: Optional[int] = None
+    worker: Optional[str] = None
+    gen: Optional[int] = None
+    at_step: Optional[int] = None
+    after: int = 0
+    count: Optional[int] = None
+    frac: float = 1.0
+    seconds: float = 0.0
+    exit_code: int = 43
+    after_s: Optional[float] = None
+    index: int = 0  # position in the plan; part of the stream key
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any], index: int) -> "FaultAction":
+        kind = str(d.get("kind", "")).lower()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"fault plan action {index}: unknown kind {kind!r} "
+                f"(expected one of {_KINDS})"
+            )
+        site = str(d.get("site", _DEFAULT_SITE[kind])).lower()
+        if site not in _SITES:
+            raise ValueError(
+                f"fault plan action {index}: unknown site {site!r} "
+                f"(expected one of {_SITES})"
+            )
+        return FaultAction(
+            kind=kind,
+            site=site,
+            rank=None if d.get("rank") is None else int(d["rank"]),
+            worker=d.get("worker"),
+            gen=None if d.get("gen") is None else int(d["gen"]),
+            at_step=(
+                None if d.get("at_step") is None else int(d["at_step"])
+            ),
+            after=int(d.get("after", 0)),
+            count=None if d.get("count") is None else int(d["count"]),
+            frac=float(d.get("frac", 1.0)),
+            seconds=float(d.get("seconds", 0.0)),
+            exit_code=int(d.get("exit_code", 43)),
+            after_s=(
+                None if d.get("after_s") is None else float(d["after_s"])
+            ),
+            index=index,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"kind": self.kind, "site": self.site}
+        for k in ("rank", "worker", "gen", "at_step", "count", "after_s"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        if self.after:
+            out["after"] = self.after
+        if self.frac != 1.0:
+            out["frac"] = self.frac
+        if self.seconds:
+            out["seconds"] = self.seconds
+        if self.kind == "kill":
+            out["exit_code"] = self.exit_code
+        return out
+
+    def matches_process(self, rank: Optional[int], worker: Optional[str],
+                        gen: Optional[int]) -> bool:
+        if self.rank is not None and self.rank != rank:
+            return False
+        if self.worker is not None and self.worker != worker:
+            return False
+        if self.gen is not None and gen is not None and self.gen != gen:
+            return False
+        return True
+
+    def in_window(self, hit: int) -> bool:
+        """Window test over the site's 1-based hit counter."""
+        if self.at_step is not None:
+            return hit == self.at_step
+        if hit <= self.after:
+            return False
+        if self.count is not None and hit > self.after + self.count:
+            return False
+        return True
+
+
+class FaultPlan:
+    """A parsed plan plus its per-action deterministic decision streams."""
+
+    def __init__(self, seed: int, actions: List[FaultAction]):
+        self.seed = int(seed)
+        self.actions = actions
+        self._streams: Dict[tuple, random.Random] = {}
+
+    # ------------------------------------------------------------- parse
+    @staticmethod
+    def from_json(text: str) -> "FaultPlan":
+        doc = json.loads(text)
+        if not isinstance(doc, dict):
+            raise ValueError("fault plan must be a JSON object")
+        actions = [
+            FaultAction.from_dict(a, i)
+            for i, a in enumerate(doc.get("faults", []))
+        ]
+        return FaultPlan(int(doc.get("seed", 0)), actions)
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> Optional["FaultPlan"]:
+        """Load the plan named by ``HOROVOD_FAULT_PLAN`` (inline JSON when
+        the value starts with ``{``, otherwise a file path). Returns None
+        when the variable is unset/empty."""
+        raw = (env or os.environ).get(FAULT_PLAN_ENV, "").strip()
+        if not raw:
+            return None
+        if raw.startswith("{"):
+            return FaultPlan.from_json(raw)
+        with open(raw, "r") as f:
+            return FaultPlan.from_json(f.read())
+
+    # -------------------------------------------------------- decisions
+    def _stream(self, action: FaultAction, rank: Optional[int]) -> random.Random:
+        key = (action.index, action.site, rank if rank is not None else -1)
+        rng = self._streams.get(key)
+        if rng is None:
+            rng = random.Random(
+                f"{self.seed}:{action.index}:{action.site}:{key[2]}"
+            )
+            self._streams[key] = rng
+        return rng
+
+    def decide(self, action: FaultAction, rank: Optional[int]) -> bool:
+        """Deterministic probabilistic decision for one in-window hit."""
+        if action.frac >= 1.0:
+            return True
+        return self._stream(action, rank).random() < action.frac
+
+    def decision_trace(self, action: FaultAction, rank: Optional[int],
+                       n: int) -> List[bool]:
+        """First ``n`` decisions of an action's stream for ``rank`` —
+        computed on a FRESH stream so the trace is a pure function of
+        (seed, action, rank), independent of how often ``decide`` ran."""
+        rng = random.Random(
+            f"{self.seed}:{action.index}:{action.site}:"
+            f"{rank if rank is not None else -1}"
+        )
+        if action.frac >= 1.0:
+            return [True] * n
+        return [rng.random() < action.frac for _ in range(n)]
+
+    # --------------------------------------------------------- schedule
+    def canonical_schedule(self) -> str:
+        """Fully-resolved schedule as canonical JSON text: the actions in
+        plan order plus, for each probabilistic action, the first
+        decisions of its stream for the ranks it can select. Byte-for-byte
+        reproducible for a given plan — the driver writes these bytes to
+        its event log, which is what the chaos suite diffs across runs."""
+        resolved = []
+        for a in self.actions:
+            entry: Dict[str, Any] = a.to_dict()
+            if a.frac < 1.0:
+                ranks = [a.rank] if a.rank is not None else [None]
+                entry["decisions"] = {
+                    str(r if r is not None else "*"): [
+                        1 if d else 0
+                        for d in self.decision_trace(
+                            a, r, _SCHEDULE_DECISIONS
+                        )
+                    ]
+                    for r in ranks
+                }
+            resolved.append(entry)
+        return json.dumps(
+            {"seed": self.seed, "schedule": resolved},
+            sort_keys=True, separators=(",", ":"),
+        )

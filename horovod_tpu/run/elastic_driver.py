@@ -33,7 +33,10 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+import signal
+
 from . import launcher, safe_shell_exec
+from ..fault import injector as _fault
 from .http_server import KVStoreServer
 from .launcher import SlotInfo, _free_port, _is_local
 
@@ -81,6 +84,7 @@ class _Worker:
     proc: safe_shell_exec.ManagedProcess
     outfiles: Tuple
     done: bool = False
+    spawned_at: float = 0.0
 
 
 def _run_discovery_script(script: str) -> List[Tuple[str, int]]:
@@ -121,6 +125,7 @@ class ElasticDriver:
         elastic_timeout: float = 600.0,
         nic_pinned: bool = False,
         probed_hostset: Optional[List[str]] = None,
+        blacklist_cooldown: Optional[float] = None,
     ) -> None:
         if not hosts and not discovery_script:
             raise ValueError(
@@ -204,10 +209,46 @@ class ElasticDriver:
         self._removal_grace = 15.0
         self._current_ids: List[str] = []
         self._failures: Dict[str, int] = {}
-        self._blacklist: set = set()
+        self._last_failure: Dict[str, float] = {}
+        # Quarantine ledger (upstream's blacklist never forgives; here a
+        # host that recovers is re-admitted): host -> readmit deadline
+        # (None = permanent, when cooldown == 0). Each re-blacklisting of
+        # the same host doubles its quarantine.
+        self._blacklist: Dict[str, Optional[float]] = {}
+        self._quarantine_strikes: Dict[str, int] = {}
+        if blacklist_cooldown is None:
+            try:
+                blacklist_cooldown = float(
+                    self._env.get("HOROVOD_BLACKLIST_COOLDOWN_S", "") or 300.0
+                )
+            except ValueError:
+                blacklist_cooldown = 300.0
+        self._blacklist_cooldown = blacklist_cooldown
         self._finishing = False
         # Respawn mode: a world restart is queued behind the drain pool.
         self._restart_pending = False
+        # One-shot ledger for fault-plan preemption notices.
+        self._preempts_fired: set = set()
+        # Deterministic fault injection (docs/fault_tolerance.md): the
+        # injector armed itself from HOROVOD_FAULT_PLAN at import. The
+        # driver owns the canonical artifacts: the resolved schedule
+        # (byte-for-byte reproducible for a seed) and its own event log.
+        # Neither path is exported to workers — self._env was snapshotted
+        # above, so worker processes log to their own files only if the
+        # user pointed them somewhere.
+        plan = _fault.active_plan()
+        if plan is not None and self._output_dir:
+            sched_path = os.path.join(self._output_dir, "fault_schedule.json")
+            try:
+                with open(sched_path, "w") as f:
+                    f.write(plan.canonical_schedule())
+            except OSError:
+                pass
+            os.environ.setdefault(
+                _fault.FAULT_EVENT_LOG_ENV,
+                os.path.join(self._output_dir, "fault_events.driver.jsonl"),
+            )
+            self._log(f"fault plan armed (seed {plan.seed}): {sched_path}")
         self._log(f"rejoin mode: {self._rejoin_mode}")
 
     # ------------------------------------------------------------ pieces
@@ -245,7 +286,51 @@ class ElasticDriver:
                 )
             self._stop_discovery.wait(self._interval)
 
+    def _expire_blacklist(self) -> None:
+        """Re-admit hosts whose quarantine elapsed. The failure count is
+        cleared — the host earned a fresh chance — but its strike count
+        persists, so a relapse quarantines it for twice as long."""
+        now = time.monotonic()
+        for host, deadline in list(self._blacklist.items()):
+            if deadline is not None and now >= deadline:
+                del self._blacklist[host]
+                self._failures.pop(host, None)
+                self._last_failure.pop(host, None)
+                self._log(
+                    f"re-admitting host {host} after quarantine "
+                    f"(strike {self._quarantine_strikes.get(host, 1)})"
+                )
+
+    def _record_failure(self, host: str) -> int:
+        """Count one worker failure against ``host``, with decay: a count
+        that has been quiet for a full cooldown window is forgiven before
+        the new failure lands (old flakiness must not compound with a
+        fresh, unrelated incident months later)."""
+        now = time.monotonic()
+        last = self._last_failure.get(host)
+        if (last is not None and self._blacklist_cooldown > 0
+                and now - last > self._blacklist_cooldown):
+            self._failures[host] = 0
+        self._failures[host] = self._failures.get(host, 0) + 1
+        self._last_failure[host] = now
+        return self._failures[host]
+
+    def _blacklist_host(self, host: str) -> None:
+        strikes = self._quarantine_strikes.get(host, 0) + 1
+        self._quarantine_strikes[host] = strikes
+        if self._blacklist_cooldown > 0:
+            quarantine = self._blacklist_cooldown * (2 ** (strikes - 1))
+            self._blacklist[host] = time.monotonic() + quarantine
+            self._log(
+                f"blacklisted host {host} (strike {strikes}; quarantined "
+                f"for {quarantine:g}s)"
+            )
+        else:
+            self._blacklist[host] = None
+            self._log(f"blacklisted host {host} (permanently)")
+
     def _discover(self) -> List[Tuple[str, int]]:
+        self._expire_blacklist()
         hosts = (
             self._last_hosts if self._script
             else list(self._static_hosts or [])
@@ -344,6 +429,42 @@ class ElasticDriver:
         except Exception as exc:  # noqa: BLE001 - probe is best-effort
             self._log(f"NIC probe failed ({exc}); continuing without")
         self._probed_hostset = hostnames
+
+    def _maybe_fire_preemptions(self) -> None:
+        """Deliver scheduled simulated maintenance notices: a fault-plan
+        ``preempt`` action with ``after_s`` SIGTERMs the selected worker
+        that long after its spawn — the platform's preemption notice,
+        which the worker's graceful drain path turns into commit → drain
+        → rejoin. One-shot per (action, worker incarnation)."""
+        plan = _fault.active_plan()
+        if plan is None:
+            return
+        now = time.monotonic()
+        for action in plan.actions:
+            if action.kind != "preempt" or action.after_s is None:
+                continue
+            if action.gen is not None and action.gen != self._gen:
+                continue
+            for wid, w in list(self._workers.items()):
+                if action.worker is not None and action.worker != wid:
+                    continue
+                key = (action.index, wid, w.spawned_at)
+                if key in self._preempts_fired:
+                    continue
+                if now - w.spawned_at < action.after_s:
+                    continue
+                self._preempts_fired.add(key)
+                _fault.record_event(
+                    "driver", self._gen, "preempt-notice", wid
+                )
+                self._log(
+                    f"delivering simulated preemption notice (SIGTERM) "
+                    f"to {wid}"
+                )
+                try:
+                    os.kill(w.proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, OSError):
+                    pass
 
     def _retire_services(self, keep: int) -> None:
         """Shut down all but the newest service and ``keep`` prior
@@ -488,6 +609,11 @@ class ElasticDriver:
             outfiles = (stdout, stderr)
         if self._verbose:
             self._log(f"spawn {wid} rank {slot.rank}: {cmd}")
+        if _fault.ACTIVE:
+            # Chaos tap: scheduled spawn delays (slow scheduler / image
+            # pull); a 'preempt' action with after_s is handled by the
+            # supervision loop via _maybe_fire_preemptions.
+            _fault.fault_point("spawn", wid)
         # A fresh incarnation must earn its own joined-confirmation: a
         # stale key from a crashed predecessor under the same worker id
         # would otherwise mark this never-synced respawn as a valid
@@ -501,6 +627,7 @@ class ElasticDriver:
                 cmd, env=rank_env, stdout=stdout, stderr=stderr
             ),
             outfiles,
+            spawned_at=time.monotonic(),
         )
 
     def _reconcile(self, force: bool = False) -> bool:
@@ -624,6 +751,8 @@ class ElasticDriver:
             # elapsed since the last publish (a cascade can outrun the
             # publish-time retirement's time guard).
             self._retire_services(keep=2)
+            if _fault.ACTIVE:
+                self._maybe_fire_preemptions()
             if self._restart_pending and not self._removing:
                 # Respawn-mode restart: the old generation has fully
                 # drained; re-form even if no other event fires.
@@ -659,12 +788,10 @@ class ElasticDriver:
                         # count as a failure (not loop forever).
                         self._log(f"{wid} exited requesting respawn")
                     else:
-                        self._failures[w.host] = (
-                            self._failures.get(w.host, 0) + 1
-                        )
+                        count = self._record_failure(w.host)
                         self._log(
                             f"{wid} failed with exit code {rc} "
-                            f"(host failures: {self._failures[w.host]})"
+                            f"(host failures: {count})"
                         )
                     if self._finishing:
                         # A straggler crashing while the job winds down is
@@ -672,10 +799,9 @@ class ElasticDriver:
                         # re-form it into.
                         return 1
                     if (not requested_respawn
-                            and self._failures[w.host]
+                            and self._failures.get(w.host, 0)
                             >= self._failure_threshold):
-                        self._blacklist.add(w.host)
-                        self._log(f"blacklisted host {w.host}")
+                        self._blacklist_host(w.host)
                     del self._workers[wid]
                     for f in w.outfiles:
                         f.close()

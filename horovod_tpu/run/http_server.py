@@ -102,6 +102,11 @@ class KVStoreServer:
             }
 
 
+class KVHTTPError(Exception):
+    """Non-200 KV answer (e.g. 404 for a missing key). Not an OSError on
+    purpose — the retry path must not spin on a definitive answer."""
+
+
 class KVStoreClient:
     """Plain-TCP HTTP KV client built on ``http.client.HTTPConnection``.
 
@@ -115,20 +120,47 @@ class KVStoreClient:
     def __init__(self, addr: str, port: int):
         self._addr = addr
         self._port = port
+        # Bounded retry with exponential backoff (HOROVOD_RPC_* knobs):
+        # the KV store is the elastic control plane — a dropped GET during
+        # a re-rendezvous must cost one backoff, not the generation.
+        from ..fault.backoff import Backoff
+
+        self._backoff = Backoff.from_env()
 
     def _request(self, method: str, path: str, body=None) -> bytes:
         import http.client
 
-        conn = http.client.HTTPConnection(self._addr, self._port, timeout=30)
-        try:
-            conn.request(method, path, body=body)
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status != 200:
-                raise OSError(f"KV {method} {path}: HTTP {resp.status}")
-            return data
-        finally:
-            conn.close()
+        from ..fault import injector as _fault
+        from ..fault.backoff import retry_call
+
+        def once() -> bytes:
+            if _fault.ACTIVE:
+                # Chaos tap: 'drop' raises a ConnectionError before the
+                # request leaves, exercising this very retry loop.
+                _fault.fault_point("kv", f"{method} {path}")
+            conn = http.client.HTTPConnection(
+                self._addr, self._port, timeout=30
+            )
+            try:
+                conn.request(method, path, body=body)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status != 200:
+                    # Deliberately NOT an OSError: a 404 is an answer
+                    # (missing key), not a transport failure to retry.
+                    raise KVHTTPError(
+                        f"KV {method} {path}: HTTP {resp.status}"
+                    )
+                return data
+            finally:
+                conn.close()
+
+        return retry_call(
+            once,
+            retryable=(OSError, EOFError),
+            backoff=self._backoff,
+            describe=f"KV {method} {path}",
+        )
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         self._request("PUT", f"/{scope}/{key}", body=value)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import concurrent.futures
 import hashlib
 import hmac
+import logging
 import os
 import pickle
 import socket
@@ -35,10 +36,25 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..fault import injector as _fault
+from ..fault.backoff import Backoff, retry_call
+
+logger = logging.getLogger("horovod_tpu.run")
+
 SECRET_LENGTH = 32
 DIGEST_LENGTH = 32
 SECRET_ENV = "HOROVOD_SECRET_KEY"
 MAX_MESSAGE_BYTES = 64 * 1024 * 1024
+# Server-side wait window for rendezvous phases that block on a peer
+# (replaces the old hardcoded 60 s); see common/env.py.
+COORD_WAIT_TIMEOUT_ENV = "HOROVOD_COORD_WAIT_TIMEOUT_S"
+
+
+def coord_wait_timeout(default: float = 60.0) -> float:
+    try:
+        return float(os.environ.get(COORD_WAIT_TIMEOUT_ENV, "") or default)
+    except ValueError:
+        return default
 
 
 def make_secret_key() -> bytes:
@@ -168,8 +184,26 @@ class CommandExitCodeResponse:
     exit_code: Optional[int]
 
 
+@dataclass
+class ErrorResponse:
+    """Structured server-side failure: the handler's error travels back to
+    the client instead of dying as a silent EOF (the client would
+    otherwise fail over to other addresses and eventually report the
+    wrong thing)."""
+
+    message: str
+    kind: str = "error"  # "error" | "timeout"
+
+
 class NoValidAddressesFound(Exception):
     pass
+
+
+class RemoteTimeoutError(RuntimeError):
+    """A rendezvous phase timed out ON THE SERVER (e.g. a peer task never
+    registered). Deliberately not an OSError/TimeoutError: the server
+    already waited out the configured window, so the client-side retry
+    budget must NOT spin on it."""
 
 
 # --- interface enumeration ------------------------------------------------
@@ -232,9 +266,15 @@ class BasicService:
             def handle(self):
                 try:
                     req = service._wire.read(self.rfile)
-                    resp = service._handle(req, self.client_address)
-                    if resp is None:
-                        raise RuntimeError("handler returned no response")
+                    try:
+                        resp = service._handle(req, self.client_address)
+                        if resp is None:
+                            raise RuntimeError("handler returned no response")
+                    except TimeoutError as exc:
+                        # A phase timeout is an ANSWER, not a dropped
+                        # connection: ship it back so the client can name
+                        # the phase and the missing peers.
+                        resp = ErrorResponse(str(exc), kind="timeout")
                     service._wire.write(resp, self.wfile)
                 except (EOFError, WireError):
                     pass  # unauthenticated / truncated client; drop quietly
@@ -302,6 +342,9 @@ class BasicClient:
         self._service_name = service_name
         self._wire = Wire(key)
         self._timeout = timeout
+        # Control-plane RPC retry budget (HOROVOD_RPC_* knobs): a dropped
+        # or delayed message costs one backoff, not the job.
+        self._backoff = Backoff.from_env()
         self._addresses = self._probe(addresses, match_intf, retries)
         if not self._addresses:
             raise NoValidAddressesFound(
@@ -366,31 +409,62 @@ class BasicClient:
     def _request(self, req: Any, addr: Tuple[str, int],
                  timeout: Optional[float] = None,
                  connect_timeout: Optional[float] = None) -> Any:
-        with socket.create_connection(
-            addr,
-            timeout=connect_timeout if connect_timeout is not None
-            else self._timeout,
-        ) as sock:
-            # A request the server intentionally blocks on (e.g. the
-            # driver's wait-for-peer-registration) needs a read window
-            # longer than the connect default.
-            sock.settimeout(timeout if timeout is not None else self._timeout)
-            rfile = sock.makefile("rb")
-            wfile = sock.makefile("wb")
-            self._wire.write(req, wfile)
-            return self._wire.read(rfile)
+        if _fault.ACTIVE:
+            # Chaos tap: a 'drop' here raises before the socket opens (a
+            # lost request); retries re-enter the tap with a fresh hit
+            # count, so bounded drop bursts are survivable by design.
+            directive = _fault.fault_point("rpc", type(req).__name__)
+        else:
+            directive = None
+        repeats = 2 if directive == "duplicate" else 1
+        for _ in range(repeats):
+            with socket.create_connection(
+                addr,
+                timeout=connect_timeout if connect_timeout is not None
+                else self._timeout,
+            ) as sock:
+                # A request the server intentionally blocks on (e.g. the
+                # driver's wait-for-peer-registration) needs a read window
+                # longer than the connect default.
+                sock.settimeout(timeout if timeout is not None else self._timeout)
+                rfile = sock.makefile("rb")
+                wfile = sock.makefile("wb")
+                self._wire.write(req, wfile)
+                resp = self._wire.read(rfile)
+        if isinstance(resp, ErrorResponse):
+            if resp.kind == "timeout":
+                raise RemoteTimeoutError(resp.message)
+            raise RuntimeError(resp.message)
+        return resp
 
     def send(self, req: Any, timeout: Optional[float] = None) -> Any:
-        last_err: Optional[Exception] = None
-        for addrs in self._addresses.values():
-            for addr in addrs:
-                try:
-                    return self._request(req, addr, timeout=timeout)
-                except (OSError, EOFError, WireError) as e:
-                    # EOF = server handler raised and closed without a
-                    # response; try the remaining advertised addresses.
-                    last_err = e
-        raise last_err or NoValidAddressesFound(self._service_name)
+        """One authenticated request/response, sweeping every verified
+        address, with bounded exponential-backoff retries around the whole
+        sweep (``HOROVOD_RPC_RETRIES`` / ``HOROVOD_RPC_BACKOFF_*``)."""
+
+        def sweep() -> Any:
+            last_err: Optional[Exception] = None
+            for addrs in self._addresses.values():
+                for addr in addrs:
+                    try:
+                        return self._request(req, addr, timeout=timeout)
+                    except (OSError, EOFError, WireError) as e:
+                        # EOF = server handler raised and closed without a
+                        # response; try the remaining advertised addresses.
+                        last_err = e
+            raise last_err or NoValidAddressesFound(self._service_name)
+
+        return retry_call(
+            sweep,
+            retryable=(OSError, EOFError, WireError),
+            backoff=self._backoff,
+            describe=f"{self._service_name}: {type(req).__name__}",
+            on_retry=lambda attempt, exc, delay: logger.warning(
+                "%s: %s failed (%s); retry %d in %.2fs",
+                self._service_name, type(req).__name__, exc,
+                attempt + 1, delay,
+            ),
+        )
 
 
 class DriverService(BasicService):
@@ -400,9 +474,15 @@ class DriverService(BasicService):
 
     NAME = "horovod_tpu driver service"
 
-    def __init__(self, num_tasks: int, key: bytes, nic: Optional[str] = None):
+    def __init__(self, num_tasks: int, key: bytes, nic: Optional[str] = None,
+                 wait_timeout: Optional[float] = None):
         super().__init__(self.NAME, key, nic)
         self._num_tasks = num_tasks
+        # Honors HOROVOD_COORD_WAIT_TIMEOUT_S (or the launcher-plumbed
+        # value) instead of the old hardcoded 60 s.
+        self._wait_timeout = (
+            coord_wait_timeout() if wait_timeout is None else wait_timeout
+        )
         self._task_addrs: Dict[int, Dict[str, List[Tuple[str, int]]]] = {}
         self._task_to_task_addrs: Dict[int, Dict[str, List[Tuple[str, int]]]] = {}
         self._host_hashes: Dict[int, str] = {}
@@ -417,12 +497,21 @@ class DriverService(BasicService):
             return AckResponse()
         if isinstance(req, AllTaskAddressesRequest):
             with self._cond:
-                while req.index not in self._task_addrs:
-                    if not self._cond.wait(timeout=60):
-                        break
+                ok = self._cond.wait_for(
+                    lambda: req.index in self._task_addrs,
+                    timeout=self._wait_timeout,
+                )
                 addrs = self._task_addrs.get(req.index)
-            if addrs is None:
-                raise RuntimeError(f"task {req.index} never registered")
+                registered = sorted(self._task_addrs)
+            if not ok or addrs is None:
+                # Travels back to the asking task as an ErrorResponse —
+                # it raises RemoteTimeoutError naming the phase and the
+                # missing peer instead of silently proceeding.
+                raise TimeoutError(
+                    "rendezvous phase 'all-task-addresses' timed out "
+                    f"after {self._wait_timeout:g}s: task {req.index} "
+                    f"never registered (registered tasks: {registered})"
+                )
             return AllTaskAddressesResponse(addrs)
         if isinstance(req, RegisterTaskToTaskAddressesRequest):
             with self._cond:
@@ -431,25 +520,37 @@ class DriverService(BasicService):
             return AckResponse()
         return super()._handle(req, client_address)
 
-    def wait_for_initial_registration(self, timeout: float = 60.0) -> None:
+    def wait_for_initial_registration(self, timeout: Optional[float] = None) -> None:
+        timeout = self._wait_timeout if timeout is None else timeout
         with self._cond:
             ok = self._cond.wait_for(
                 lambda: len(self._task_addrs) >= self._num_tasks, timeout=timeout
             )
-        if not ok:
             missing = sorted(
                 set(range(self._num_tasks)) - set(self._task_addrs)
             )
-            raise TimeoutError(f"tasks never registered: {missing}")
+        if not ok:
+            raise TimeoutError(
+                "rendezvous phase 'initial-registration' timed out after "
+                f"{timeout:g}s; tasks never registered: {missing}"
+            )
 
-    def wait_for_task_to_task_addresses(self, timeout: float = 60.0) -> None:
+    def wait_for_task_to_task_addresses(self, timeout: Optional[float] = None) -> None:
+        timeout = self._wait_timeout if timeout is None else timeout
         with self._cond:
             ok = self._cond.wait_for(
                 lambda: len(self._task_to_task_addrs) >= self._num_tasks,
                 timeout=timeout,
             )
+            missing = sorted(
+                set(range(self._num_tasks)) - set(self._task_to_task_addrs)
+            )
         if not ok:
-            raise TimeoutError("ring address checks did not complete")
+            raise TimeoutError(
+                "rendezvous phase 'ring-address-check' timed out after "
+                f"{timeout:g}s; tasks that never reported verified "
+                f"addresses: {missing}"
+            )
 
     def task_addresses_for(self, index: int):
         with self._cond:
@@ -546,9 +647,12 @@ class DriverClient(BasicClient):
         self.send(RegisterTaskRequest(index, addresses, host_hash))
 
     def all_task_addresses(self, index):
-        # The driver blocks up to 60s waiting for the peer to register
-        # (slow ssh spawn); the read window must outlast that wait.
-        return self.send(AllTaskAddressesRequest(index), timeout=65.0).addresses
+        # The driver blocks up to its configured wait window for the peer
+        # to register (slow ssh spawn); the read window must outlast it.
+        return self.send(
+            AllTaskAddressesRequest(index),
+            timeout=coord_wait_timeout() + 5.0,
+        ).addresses
 
     def register_task_to_task_addresses(self, index, addresses) -> None:
         self.send(RegisterTaskToTaskAddressesRequest(index, addresses))
@@ -633,7 +737,7 @@ def discover_common_interfaces(
     import sys
 
     key = key or make_secret_key()
-    driver = DriverService(len(hosts), key)
+    driver = DriverService(len(hosts), key, wait_timeout=timeout)
     procs = []
     try:
         addrs = driver.addresses()

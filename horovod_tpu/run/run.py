@@ -158,6 +158,11 @@ def parse_args(argv: Optional[List[str]] = None):
     parser.add_argument("--blacklist-threshold", type=int, default=3,
                         help="Elastic: worker failures before a host is "
                              "blacklisted.")
+    parser.add_argument("--blacklist-cooldown", type=float, default=None,
+                        help="Elastic: seconds a blacklisted host stays "
+                             "quarantined before being re-admitted "
+                             "(doubles per relapse; 0 = forever; default "
+                             "HOROVOD_BLACKLIST_COOLDOWN_S or 300).")
     parser.add_argument("--elastic-timeout", type=float, default=600.0,
                         help="Elastic: seconds a worker waits for a usable "
                              "world generation before giving up.")
@@ -301,6 +306,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             elastic_timeout=args.elastic_timeout,
             nic_pinned=bool(args.network_interfaces),
             probed_hostset=probed_hostset,
+            blacklist_cooldown=args.blacklist_cooldown,
         ).run()
 
     if args.tpu_pod:

@@ -71,6 +71,12 @@ def run_elastic_job(hvdrun_args, script_text=None, script_path=None,
             with open(script_path, "w") as f:
                 f.write(script_text)
         env["ELASTIC_TD"] = td
+        # Chaos runs: all injections land in one shared event file (no-op
+        # for jobs without a fault plan — the injector only writes when a
+        # fault actually fires).
+        env.setdefault(
+            "HOROVOD_FAULT_EVENT_LOG", os.path.join(td, "fault_events.jsonl")
+        )
         proc = subprocess.run(
             [sys.executable, "-m", "horovod_tpu.run", *hvdrun_args,
              "--output-dir", td, sys.executable, script_path],
@@ -86,6 +92,7 @@ def run_elastic_job(hvdrun_args, script_text=None, script_path=None,
                 outs[fn[len("worker."):]] = open(
                     os.path.join(td, fn)
                 ).read()
-            if fn == "driver.log":
+            if fn in ("driver.log", "fault_schedule.json",
+                      "fault_events.jsonl"):
                 outs[fn] = open(os.path.join(td, fn)).read()
     return proc, outs

@@ -1,0 +1,133 @@
+"""Termination semantics of run/safe_shell_exec (ISSUE 2 satellite):
+whole-process-group kill (no orphaned grandchildren), exit-code
+propagation, and signal forwarding in execute()."""
+
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from horovod_tpu.run import safe_shell_exec
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    return True
+
+
+def _wait_gone(pid: int, timeout: float = 10.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not _pid_alive(pid):
+            return True
+        time.sleep(0.05)
+    return not _pid_alive(pid)
+
+
+def test_exit_code_propagation():
+    mp = safe_shell_exec.ManagedProcess(
+        [sys.executable, "-c", "import sys; sys.exit(7)"]
+    )
+    assert mp.wait(timeout=30) == 7
+    assert mp.poll() == 7
+
+
+def test_execute_returns_exit_code():
+    assert safe_shell_exec.execute(
+        [sys.executable, "-c", "import sys; sys.exit(5)"]
+    ) == 5
+    assert safe_shell_exec.execute(
+        [sys.executable, "-c", "pass"]
+    ) == 0
+
+
+def test_terminate_kills_whole_process_group(tmp_path):
+    """terminate() must take down the grandchild too: the worker script
+    spawns its own subprocesses (data loaders, compilers), and an
+    orphaned one would keep ports/files pinned across elastic
+    generations."""
+    pid_file = tmp_path / "grandchild.pid"
+    child = (
+        "import subprocess, sys, time\n"
+        "p = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(300)'])\n"
+        f"open({str(pid_file)!r}, 'w').write(str(p.pid))\n"
+        "time.sleep(300)\n"
+    )
+    mp = safe_shell_exec.ManagedProcess([sys.executable, "-c", child])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not pid_file.exists():
+        time.sleep(0.05)
+    assert pid_file.exists(), "child never spawned its grandchild"
+    grandchild = int(pid_file.read_text())
+    assert _pid_alive(mp.pid) and _pid_alive(grandchild)
+    # The grandchild shares the child's (new) process group.
+    assert os.getpgid(grandchild) == os.getpgid(mp.pid)
+    assert os.getpgid(mp.pid) != os.getpgid(os.getpid())
+    mp.terminate()
+    assert _wait_gone(mp.pid), "child survived terminate()"
+    assert _wait_gone(grandchild), "grandchild orphaned by terminate()"
+
+
+def test_terminate_sigkills_sigterm_ignorer(tmp_path):
+    """A worker that traps SIGTERM (the graceful-preemption handler does)
+    must still die: terminate() escalates to SIGKILL on the group after
+    the grace period."""
+    ready = tmp_path / "ready"
+    stubborn = (
+        "import signal, time\n"
+        "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+        f"open({str(ready)!r}, 'w').close()\n"
+        "time.sleep(300)\n"
+    )
+    mp = safe_shell_exec.ManagedProcess([sys.executable, "-c", stubborn])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and not ready.exists():
+        time.sleep(0.05)
+    assert ready.exists()
+    t0 = time.monotonic()
+    mp.terminate()
+    # Reap (terminate() does not wait after the SIGKILL escalation) and
+    # confirm it took the SIGKILL, after the grace window — not the
+    # ignored SIGTERM.
+    assert mp.wait(timeout=10) == -signal.SIGKILL
+    assert time.monotonic() - t0 >= (
+        safe_shell_exec.GRACEFUL_TERMINATION_TIME_S - 0.5
+    )
+
+
+def test_terminate_after_exit_is_noop():
+    mp = safe_shell_exec.ManagedProcess([sys.executable, "-c", "pass"])
+    assert mp.wait(timeout=30) == 0
+    mp.terminate()  # must not raise on a reaped process
+    assert mp.poll() == 0
+
+
+def test_execute_forwards_sigterm(tmp_path):
+    """execute() in a subprocess: SIGTERM to the supervisor terminates the
+    whole tree and execute() returns the child's (signal) status."""
+    import subprocess
+
+    script = tmp_path / "sup.py"
+    script.write_text(
+        "import sys\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "from horovod_tpu.run import safe_shell_exec\n"
+        "rc = safe_shell_exec.execute("
+        "[sys.executable, '-c', 'import time; time.sleep(300)'])\n"
+        "sys.exit(0 if rc != 0 else 1)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, str(script), repo],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    time.sleep(2.0)  # let the supervisor install its handlers
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=30)
+    assert rc == 0, proc.stderr.read().decode()

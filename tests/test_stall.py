@@ -52,6 +52,52 @@ def test_stall_cleared_tensor_does_not_warn(caplog):
     assert not [r for r in caplog.records if "Stalled ops" in r.getMessage()]
 
 
+def test_stall_rewarns_on_interval(caplog):
+    """Escalation rung 1 (ISSUE 2 satellite): the old one-shot `_warned`
+    set silenced a tensor forever; a stall is a live incident and must
+    re-warn on the configured interval."""
+    cfg = _cfg(warn=0.02)
+    cfg.stall_rewarn_seconds = 0.05
+    insp = StallInspector(cfg)
+    insp.record(["t0"])
+    time.sleep(0.04)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        insp.check()   # first warning
+        insp.check()   # within the re-warn window: silent
+        time.sleep(0.07)
+        insp.check()   # past the window: warns again
+    warns = [r for r in caplog.records if "Stalled ops" in r.getMessage()]
+    assert len(warns) == 2
+
+
+def test_stall_warning_includes_missing_ranks(caplog):
+    insp = StallInspector(_cfg(warn=0.02))
+    insp.record(["grad.w"])
+    time.sleep(0.04)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        insp.check(missing_ranks={"grad.w": [2, 5]})
+    text = "\n".join(r.getMessage() for r in caplog.records)
+    assert "grad.w <- [2, 5]" in text
+
+
+def test_stall_abort_report():
+    """Escalation rung 2: past the abort window the inspector reports the
+    tensor so the runtime can hand its waiters a named Status.Aborted."""
+    cfg = _cfg(warn=0.01)
+    cfg.stall_abort_time_seconds = 0.04
+    insp = StallInspector(cfg)
+    insp.record(["t.stuck"])
+    report = insp.check()
+    assert report.aborted == []
+    time.sleep(0.06)
+    report = insp.check()
+    assert report.aborted == ["t.stuck"]
+    assert not report.shutdown
+    # The runtime clears aborted tensors; a later check stays quiet.
+    insp.clear(["t.stuck"])
+    assert insp.check().aborted == []
+
+
 def test_stall_shutdown_flag():
     """HOROVOD_STALL_SHUTDOWN_TIME_SECONDS behavior
     (reference stall_inspector.h:72-80)."""

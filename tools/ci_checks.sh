@@ -1,10 +1,15 @@
 #!/usr/bin/env bash
-# Collective-safety static analysis gate (make lint-collectives).
+# CI gates: collective-safety static analysis + chaos smoke.
 #
-# Runs tools/collective_lint.py over the example train steps (Pass 1) and
-# the runtime sources' lock discipline (Pass 2). Exits nonzero on any
-# finding. Budget: must stay under 60s on CPU — the example steps are
-# traced (make_jaxpr), never compiled or executed.
+# Stage 1 (make lint-collectives): tools/collective_lint.py over the
+# example train steps (Pass 1) and the runtime sources' lock discipline
+# (Pass 2). Exits nonzero on any finding. Budget: under 60s on CPU — the
+# example steps are traced (make_jaxpr), never compiled or executed.
+#
+# Stage 2 (make chaos-smoke; skip with HVD_CI_SKIP_CHAOS=1): the seeded
+# fault-injection smoke — one worker kill, one slow rank, one dropped
+# control-plane burst from a fixed seed — asserting end-to-end recovery
+# and a byte-reproducible schedule log. Budget: under 120s on CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,7 +17,12 @@ export JAX_PLATFORMS=cpu
 
 start=$(date +%s)
 python tools/collective_lint.py all "$@"
-rc=$?
 elapsed=$(( $(date +%s) - start ))
 echo "ci_checks: collective lint clean in ${elapsed}s"
-exit $rc
+
+if [ "${HVD_CI_SKIP_CHAOS:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/chaos_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: chaos smoke recovered in ${elapsed}s"
+fi
