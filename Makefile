@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -13,11 +13,16 @@ test:
 # Collective-safety static analysis: Pass 1 over the example train steps
 # and Pass 2 over the runtime sources (docs/static_analysis.md).
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_smoke.py
 
-ci: lint-collectives chaos-smoke test
+# Metrics smoke (docs/metrics.md): 2-rank job with HOROVOD_METRICS=1,
+# GET /metrics scraped off the driver and validated, <60s CPU.
+metrics-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/metrics_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke test

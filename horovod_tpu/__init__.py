@@ -117,7 +117,33 @@ def init(config: Optional[Config] = None) -> None:
                 try:
                     _dist_init()
                 except RuntimeError as exc:
-                    if "already" not in str(exc).lower():
+                    msg = str(exc).lower()
+                    if "already" in msg:
+                        pass
+                    elif (_os.environ.get("HOROVOD_ELASTIC") == "1"
+                          and _rejoin_mode() == "respawn"
+                          and any(k in msg for k in (
+                              "bind", "address already in use",
+                              "address in use", "errno 98",
+                              "failed to listen"))):
+                        # The coordinator port was probed on the driver
+                        # host (or a remote probe fell back) and lost the
+                        # bind race here. Not this host's fault: exit
+                        # with the respawn status so the driver re-forms
+                        # the world with FRESH ports and records no
+                        # blacklist strike, instead of burning one of the
+                        # host's failure credits per collision.
+                        import logging as _logging
+
+                        _logging.getLogger("horovod_tpu").error(
+                            "jax coordination endpoint could not bind "
+                            "(%s); exiting for a respawn with fresh "
+                            "ports", exc,
+                        )
+                        from .elastic import REJOIN_EXIT_CODE
+
+                        _os._exit(REJOIN_EXIT_CODE)
+                    else:
                         raise
             from .core.xla_executor import XlaPlanExecutor
 
@@ -131,6 +157,7 @@ def init(config: Optional[Config] = None) -> None:
                     coord_addr=coord_addr, coord_port=coord_port,
                 )
                 _start_profiler(cfg)
+                _start_metrics_pusher(topo)
                 return
             except NotImplementedError:
                 raise
@@ -145,6 +172,7 @@ def init(config: Optional[Config] = None) -> None:
         _runtime = Runtime(cfg, topo)
         _runtime.start()
         _start_profiler(cfg)
+        _start_metrics_pusher(topo)
 
 
 def _start_profiler(cfg: Config) -> None:
@@ -170,14 +198,64 @@ def _start_profiler(cfg: Config) -> None:
 
 
 _profiler_active = False
+_metrics_pusher = None
+
+
+def _start_metrics_pusher(topo) -> None:
+    """Worker-side metrics publisher (docs/metrics.md): with
+    HOROVOD_METRICS set and an elastic KV rendezvous in the environment,
+    push this process's registry snapshot to the driver so its
+    ``GET /metrics`` aggregates every rank. No-op otherwise — the
+    in-process ``hvd.metrics()`` API needs no plumbing."""
+    global _metrics_pusher
+    from . import metrics as _metrics_mod
+
+    if not _metrics_mod.ACTIVE or _metrics_pusher is not None:
+        return
+    import os as _os
+
+    addr = _os.environ.get("HOROVOD_ELASTIC_KV_ADDR", "")
+    port = _os.environ.get("HOROVOD_ELASTIC_KV_PORT", "")
+    if not addr or not port:
+        return
+    from .metrics.export import MetricsPusher
+
+    try:
+        _metrics_pusher = MetricsPusher(addr, int(port), topo.rank)
+    except Exception as exc:  # noqa: BLE001 - metrics never block init
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "could not start the metrics pusher: %s", exc
+        )
+
+
+def metrics_snapshot() -> dict:
+    """Structured snapshot of this process's metrics registry — plain
+    dicts/lists/numbers only (counters, gauges, and fixed-bucket
+    histograms; see docs/metrics.md). Empty when ``HOROVOD_METRICS`` is
+    unset. ``hvd.metrics()`` returns the flattened one-value-per-series
+    view of the same data."""
+    from . import metrics as _metrics_mod
+
+    return _metrics_mod.snapshot()
 
 
 def shutdown() -> None:
     global _runtime, _mesh, _profiler_active, _ps_barrier_seq
+    global _metrics_pusher
     with _lock:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
+        if _metrics_pusher is not None:
+            # Stopped AFTER the runtime so the final push carries the
+            # teardown-time counter values.
+            try:
+                _metrics_pusher.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            _metrics_pusher = None
         _mesh = None
         # Process sets die with the runtime (a re-init starts clean, and
         # id assignment restarts so all ranks stay aligned).
@@ -1113,6 +1191,11 @@ __all__ = [
     "xla_enabled",
     "HorovodInternalError",
     "elastic",
+    "metrics",
+    "metrics_snapshot",
 ]
 
 from . import elastic  # noqa: E402  (hvd.elastic.run / State / ObjectState)
+# hvd.metrics is the metrics subpackage, made callable so hvd.metrics()
+# returns the flat snapshot dict (see metrics/__init__.py).
+from . import metrics  # noqa: E402, F401

@@ -74,6 +74,16 @@ HOROVOD_PREEMPTION_GRACEFUL = "HOROVOD_PREEMPTION_GRACEFUL"
 HOROVOD_FAULT_PLAN = "HOROVOD_FAULT_PLAN"
 HOROVOD_FAULT_EVENT_LOG = "HOROVOD_FAULT_EVENT_LOG"
 HOROVOD_FAULT_SEED = "HOROVOD_FAULT_SEED"
+# Runtime metrics (docs/metrics.md; horovod_tpu/metrics reads these
+# directly, like the fault knobs — launcher-side processes never build a
+# Config): enable the tap, pin the driver's /metrics (KV) port, and set
+# the worker snapshot push cadence.
+HOROVOD_METRICS = "HOROVOD_METRICS"
+HOROVOD_METRICS_PORT = "HOROVOD_METRICS_PORT"
+HOROVOD_METRICS_PUSH_INTERVAL_S = "HOROVOD_METRICS_PUSH_INTERVAL_S"
+# Respawn-mode data-loss guard: fail (instead of loudly warning) when a
+# restart generation > 1 finds no restored snapshot on any rank.
+HOROVOD_ELASTIC_REQUIRE_SNAPSHOT = "HOROVOD_ELASTIC_REQUIRE_SNAPSHOT"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64

@@ -24,6 +24,7 @@ from ..common.basics import NativeCore, _CoreError
 from ..common.env import Config
 from ..common.topology import Topology
 from ..fault import injector as _fault
+from .. import metrics as _metrics
 from ..common.types import (
     DataType,
     ReduceOp,
@@ -39,6 +40,13 @@ logger = logging.getLogger("horovod_tpu")
 
 _PLAN_ERROR = 7  # ResponseType::kError
 _PLAN_JOIN = 3
+
+# Plan type → metrics op label (matches ResponseType ordering in the
+# native core and the Python runtime's timeline names).
+_PLAN_TYPE_NAMES = {
+    0: "ALLREDUCE", 1: "ALLGATHER", 2: "BROADCAST", 3: "JOIN",
+    4: "ALLTOALL", 5: "REDUCESCATTER", 6: "ADASUM", 7: "ERROR",
+}
 
 
 class PlanExecutor:
@@ -130,6 +138,13 @@ class NativeRuntime:
         self._sync_waiters = 0
         self._no_waiters = threading.Event()
         self._no_waiters.set()
+        # Set by an inline synchronize() that observes next_plan == -1
+        # (core down): the parked executor thread must run its
+        # orphaned-entry drain NOW, not after every waiter exits — a TF
+        # callback-consumer with no handle to fail would otherwise hang
+        # until the last handle-waiter left (advisor finding,
+        # native_runtime inline-sync drain deferral).
+        self._core_down = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._executor_loop, name="hvd_plan_executor", daemon=True
@@ -207,6 +222,13 @@ class NativeRuntime:
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
         )
+        if _metrics.ACTIVE:
+            # Metrics tap, same metric names as the pure-Python runtime
+            # so dashboards are core-agnostic (docs/metrics.md).
+            entry.context["metrics_enqueue_ts"] = time.monotonic()
+            _metrics.TAP.inc(
+                "hvd_ops_submitted_total", op=request_type.name
+            )
         with self._entries_lock:
             self._entries.setdefault(name, deque()).append(entry)
         dtype = int(dtype_from_array(tensor)) if tensor is not None else 0
@@ -313,11 +335,13 @@ class NativeRuntime:
     # --- executor loop ---
     def _executor_loop(self) -> None:
         try:
-            while not self._stop.is_set():
+            while not self._stop.is_set() and not self._core_down.is_set():
                 if self._sync_waiters > 0:
                     # A synchronize() caller is inline-draining; park so
                     # the hot thread keeps the consumer role. Bounded
-                    # wait: _stop has no channel into this Event.
+                    # wait: _stop has no channel into this Event (but an
+                    # inline waiter that sees the core die sets BOTH
+                    # _core_down and _no_waiters to break the park).
                     self._no_waiters.wait(timeout=0.05)
                     continue
                 with self._consumer_lock:
@@ -387,6 +411,19 @@ class NativeRuntime:
                 )
             entries.append(entry)
 
+        op_label = _PLAN_TYPE_NAMES.get(int(plan["type"]), str(plan["type"]))
+        if _metrics.ACTIVE:
+            now = time.monotonic()
+            for entry in entries:
+                ts = entry.context.pop("metrics_enqueue_ts", None)
+                if ts is not None:
+                    _metrics.TAP.observe(
+                        "hvd_op_negotiate_seconds", now - ts, op=op_label
+                    )
+            with self._entries_lock:
+                depth = sum(len(q) for q in self._entries.values())
+            _metrics.TAP.set("hvd_queue_depth", float(depth))
+
         status_code = 0
         error = ""
         outputs: Dict[str, Any] = {}
@@ -419,6 +456,16 @@ class NativeRuntime:
             if status_code == 0
             else Status(StatusType(status_code), error)
         )
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_plans_total", op=op_label)
+            _metrics.TAP.observe(
+                "hvd_op_execute_seconds", duration, op=op_label
+            )
+            nbytes = int(plan.get("total_bytes", 0) or 0)
+            if nbytes:
+                _metrics.TAP.observe("hvd_op_bytes", nbytes, op=op_label)
+            if status_code != 0:
+                _metrics.TAP.inc("hvd_op_errors_total", op=op_label)
         for entry in entries:
             out = outputs.get(entry.name)
             if entry.callback is not None:
@@ -509,7 +556,16 @@ class NativeRuntime:
                         if self._stop.is_set():
                             continue
                         plan = self.core.next_plan(timeout_ms=1)
-                        if plan not in (0, -1, -2):
+                        if plan == -1:
+                            # Core down. The executor thread owns the
+                            # orphaned-entry callback drain
+                            # (_drain_entry_callbacks); wake it out of
+                            # its waiters park so callback-consumers are
+                            # failed promptly instead of after every
+                            # synchronize() caller exits via FailAll.
+                            self._core_down.set()
+                            self._no_waiters.set()
+                        elif plan not in (0, -2):
                             self._execute_plan(plan)
                         continue
                     finally:

@@ -33,6 +33,7 @@ import numpy as np
 from ..common.env import Config
 from ..common.topology import Topology
 from ..fault import injector as _fault
+from .. import metrics as _metrics
 from ..common.types import (
     DUPLICATE_NAME_ERROR_FMT,
     ReduceOp,
@@ -642,6 +643,15 @@ class Runtime:
         if not status.ok():
             self.handle_manager.mark_done(handle, status, None)
             return handle
+        if _metrics.ACTIVE:
+            # Metrics tap (docs/metrics.md): negotiate latency is measured
+            # from here to the coordinator's fused response. Disabled →
+            # not reached (the ACTIVE check is the whole overhead), same
+            # discipline as the fault tap above.
+            entry.context["metrics_enqueue_ts"] = time.monotonic()
+            _metrics.TAP.inc(
+                "hvd_ops_submitted_total", op=request_type.name
+            )
         if self.timeline.initialized:
             self.timeline.negotiate_start(name, request_type.name)
         self._wake.set()
@@ -691,6 +701,7 @@ class Runtime:
         self.tensor_queue.drain(self._drain_status or SHUT_DOWN_ERROR)
 
     def _run_cycle_once(self) -> None:
+        cycle_t0 = time.perf_counter() if _metrics.ACTIVE else 0.0
         if self.timeline.initialized and self.config.timeline_mark_cycles:
             self.timeline.mark_cycle_start()
         requests = self.tensor_queue.pop_requests()
@@ -702,6 +713,23 @@ class Runtime:
             self._perform_operation(response)
         missing = self.coordinator.missing_ranks()
         report = self.stall_inspector.check(missing)
+        if _metrics.ACTIVE:
+            _metrics.TAP.set(
+                "hvd_queue_depth", float(self.tensor_queue.size())
+            )
+            _metrics.TAP.observe(
+                "hvd_cycle_seconds", time.perf_counter() - cycle_t0
+            )
+            if report.warned:
+                _metrics.TAP.inc(
+                    "hvd_stall_warnings_total", len(report.warned)
+                )
+            if report.aborted:
+                _metrics.TAP.inc(
+                    "hvd_stall_aborts_total", len(report.aborted)
+                )
+            if report.shutdown:
+                _metrics.TAP.inc("hvd_stall_shutdowns_total")
         for name in report.aborted:
             # Rung 2: abort the individual stalled tensor — hand its
             # waiter a named status instead of letting it hang — and keep
@@ -768,6 +796,16 @@ class Runtime:
             for e in entries:
                 self.timeline.negotiate_end(e.name, timeline_name.replace("XLA_", ""))
                 self.timeline.start(e.name, timeline_name)
+        op_label = timeline_name.replace("XLA_", "")
+        if _metrics.ACTIVE:
+            now = time.monotonic()
+            for e in entries:
+                ts = e.context.pop("metrics_enqueue_ts", None)
+                if ts is not None:
+                    _metrics.TAP.observe(
+                        "hvd_op_negotiate_seconds", now - ts, op=op_label
+                    )
+        exec_t0 = time.perf_counter() if _metrics.ACTIVE else 0.0
         if response.response_type == ResponseType.ERROR:
             status = Status.PreconditionError(response.error_message)
         else:
@@ -776,6 +814,18 @@ class Runtime:
             except Exception as exc:  # noqa: BLE001
                 logger.exception("data plane failure")
                 status = Status.UnknownError(str(exc))
+        if _metrics.ACTIVE:
+            _metrics.TAP.observe(
+                "hvd_op_execute_seconds", time.perf_counter() - exec_t0,
+                op=op_label,
+            )
+            nbytes = sum(
+                int(getattr(e.tensor, "nbytes", 0) or 0) for e in entries
+            )
+            if nbytes:
+                _metrics.TAP.observe("hvd_op_bytes", nbytes, op=op_label)
+            if not status.ok():
+                _metrics.TAP.inc("hvd_op_errors_total", op=op_label)
         if self.timeline.initialized:
             for e in entries:
                 self.timeline.end(e.name, timeline_name)

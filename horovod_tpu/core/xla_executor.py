@@ -32,10 +32,12 @@ from __future__ import annotations
 import logging
 import math
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import metrics as _metrics
 from ..common.topology import Topology
 from ..common.types import ReduceOp
 from .native_runtime import PlanExecutor
@@ -308,8 +310,18 @@ class XlaPlanExecutor(PlanExecutor):
         with self._lock:
             fn = self._fn_cache.get(key)
             if fn is None:
+                t0 = time.perf_counter() if _metrics.ACTIVE else 0.0
                 fn = builder()
                 self._fn_cache[key] = fn
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_xla_cache_misses_total",
+                                     op=str(key[0]))
+                    _metrics.TAP.observe(
+                        "hvd_xla_compile_seconds",
+                        time.perf_counter() - t0, op=str(key[0]),
+                    )
+            elif _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_xla_cache_hits_total", op=str(key[0]))
         return fn
 
     def _local_out(self, garr) -> np.ndarray:

@@ -44,6 +44,7 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import metrics as _metrics
 from ..fault import injector as _fault_injector
 from ..fault import preemption as _preemption
 from ..fault.preemption import PreemptionInterrupt  # noqa: F401 (re-export)
@@ -423,6 +424,39 @@ def _maybe_restore_persisted(state: "State") -> bool:
     return True
 
 
+def _warn_if_unrestored(restored_any: bool) -> None:
+    """Respawn-mode data-loss guard (advisor finding): a restart at
+    generation > 1 means a previous world made progress, so when NO rank
+    restored a snapshot the job is silently starting over from step 0.
+    Shout about it — or, with ``HOROVOD_ELASTIC_REQUIRE_SNAPSHOT`` set,
+    fail the worker instead of losing data quietly."""
+    if restored_any:
+        return
+    try:
+        gen = int(os.environ.get("HOROVOD_ELASTIC_GEN", "1") or 1)
+    except ValueError:
+        gen = 1
+    if gen <= 1:
+        return  # a genuine from-scratch start
+    msg = (
+        f"elastic: restart generation {gen} found no restored snapshot "
+        "on ANY rank — training resumes from step 0 and all progress "
+        "since the last commit is LOST. Check that "
+        "HOROVOD_ELASTIC_STATE_DIR survives respawns (shared or "
+        "host-local persistent storage)."
+    )
+    if os.environ.get(
+        "HOROVOD_ELASTIC_REQUIRE_SNAPSHOT", ""
+    ).strip().lower() in ("1", "true", "yes", "on"):
+        raise RuntimeError(
+            msg + " Failing because HOROVOD_ELASTIC_REQUIRE_SNAPSHOT is "
+            "set."
+        )
+    logger.error(msg)
+    if _metrics.ACTIVE:
+        _metrics.TAP.inc("hvd_elastic_unrestored_restarts_total")
+
+
 def _elect_restored_sync_root(ctx: _ElasticContext, restored: bool) -> None:
     """Respawn-mode guard against silent progress loss: the driver picks
     a sync_root before workers spawn, so it cannot know which slots will
@@ -435,8 +469,10 @@ def _elect_restored_sync_root(ctx: _ElasticContext, restored: bool) -> None:
     import horovod_tpu as hvd
 
     if hvd.size() <= 1:
+        _warn_if_unrestored(restored)
         return
     flags = hvd.allgather_object(bool(restored), name="hvd.elastic.snap")
+    _warn_if_unrestored(any(flags))
     if not flags[ctx.sync_root] and any(flags):
         new_root = flags.index(True)
         logger.info(
@@ -515,6 +551,8 @@ def _rejoin(ctx: _ElasticContext) -> None:
         try:
             hvd.init()
             ctx.gen = int(world["gen"])  # committed only on success
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_elastic_rejoins_total")
             return
         except Exception as exc:  # noqa: BLE001 - racing another bump
             logger.warning(
@@ -1036,6 +1074,8 @@ def run(func: Callable) -> Callable:
                     _clear_persisted()
                 return result
             except HostsUpdatedInterrupt:
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_elastic_host_interrupts_total")
                 logger.info(
                     "elastic: membership change; rejoining with current "
                     "state"
@@ -1046,6 +1086,8 @@ def run(func: Callable) -> Callable:
                 # in-flight collectives with the runtime teardown below
                 # (_persist_state_and_exit / _rejoin both shut the
                 # runtime down), and rejoin through the elastic path.
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_elastic_preemptions_total")
                 logger.warning(
                     "elastic: preemption notice (%s); draining and "
                     "rejoining with the just-committed state", exc,
@@ -1054,6 +1096,8 @@ def run(func: Callable) -> Callable:
             except Exception as exc:  # noqa: BLE001 - filtered below
                 if not _is_collective_failure(exc):
                     raise
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_elastic_rollbacks_total")
                 logger.warning(
                     "elastic: collective failure (%s); rolling back to the "
                     "last commit and rejoining", exc,

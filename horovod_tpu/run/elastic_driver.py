@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 import signal
 
 from . import launcher, safe_shell_exec
+from .. import metrics as _metrics
 from ..fault import injector as _fault
 from .http_server import KVStoreServer
 from .launcher import SlotInfo, _free_port, _is_local
@@ -46,6 +47,31 @@ from .launcher import SlotInfo, _free_port, _is_local
 # literal on both sides so this launcher never imports the jax-loading
 # package). Not a failure: it does not count toward host blacklisting.
 REJOIN_EXIT_CODE = 79
+
+
+def _respawn_drain_grace(env: Dict[str, str], base: float = 15.0) -> float:
+    """Drain grace for a respawn-mode world restart, scaled to the
+    failure-DETECTION window instead of a fixed constant: a survivor only
+    persists-and-exits once its collectives fail, which takes up to the
+    coordination heartbeat timeout (2x: one missed beat + the agent's
+    confirmation) or the stall abort/shutdown window when one is
+    configured — whichever is longest — plus a persistence margin.
+    A fixed 15 s grace under a 60 s stall window would SIGTERM survivors
+    mid-commit-persist and turn a clean restart into data loss."""
+
+    def _f(name: str, default: float) -> float:
+        try:
+            return float(env.get(name, "") or default)
+        except ValueError:
+            return default
+
+    detect = 2.0 * _f("HOROVOD_ELASTIC_HEARTBEAT_S", 10.0)
+    for knob in ("HOROVOD_STALL_ABORT_TIME_SECONDS",
+                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"):
+        v = _f(knob, 0.0)
+        if v > 0:
+            detect = max(detect, v)
+    return max(base, detect + 5.0)
 
 
 def _inprocess_rejoin_supported() -> bool:
@@ -183,7 +209,14 @@ class ElasticDriver:
                 tempfile.gettempdir(), f"hvd_elastic_state_{os.getpid()}"
             ),
         )
-        self._kv = KVStoreServer()
+        # The KV rendezvous server doubles as the metrics endpoint
+        # (GET /metrics, docs/metrics.md); HOROVOD_METRICS_PORT pins its
+        # port so scrapers have a stable target.
+        try:
+            kv_port = int(self._env.get("HOROVOD_METRICS_PORT", "") or 0)
+        except ValueError:
+            kv_port = 0
+        self._kv = KVStoreServer(port=kv_port)
         # --network-interfaces pin: never ring-probe, the user chose.
         self._nic_pinned = nic_pinned
         # Host set most recently ring-probed for NICs — seeded with the
@@ -207,6 +240,14 @@ class ElasticDriver:
         # the terminate-anyway deadline.
         self._removing: List[Tuple[_Worker, float]] = []
         self._removal_grace = 15.0
+        # Respawn-mode restarts wait for survivors to DETECT the failure
+        # (heartbeat / stall windows) before persisting and exiting, so
+        # their drain grace scales with those windows (see
+        # _respawn_drain_grace) rather than reusing the fixed scale-down
+        # grace above.
+        self._restart_grace = _respawn_drain_grace(
+            self._env, self._removal_grace
+        )
         self._current_ids: List[str] = []
         self._failures: Dict[str, int] = {}
         self._last_failure: Dict[str, float] = {}
@@ -296,6 +337,10 @@ class ElasticDriver:
                 del self._blacklist[host]
                 self._failures.pop(host, None)
                 self._last_failure.pop(host, None)
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc(
+                        "hvd_elastic_readmissions_total", host=host
+                    )
                 self._log(
                     f"re-admitting host {host} after quarantine "
                     f"(strike {self._quarantine_strikes.get(host, 1)})"
@@ -313,11 +358,17 @@ class ElasticDriver:
             self._failures[host] = 0
         self._failures[host] = self._failures.get(host, 0) + 1
         self._last_failure[host] = now
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc(
+                "hvd_elastic_worker_failures_total", host=host
+            )
         return self._failures[host]
 
     def _blacklist_host(self, host: str) -> None:
         strikes = self._quarantine_strikes.get(host, 0) + 1
         self._quarantine_strikes[host] = strikes
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_elastic_blacklists_total", host=host)
         if self._blacklist_cooldown > 0:
             quarantine = self._blacklist_cooldown * (2 ** (strikes - 1))
             self._blacklist[host] = time.monotonic() + quarantine
@@ -331,6 +382,10 @@ class ElasticDriver:
 
     def _discover(self) -> List[Tuple[str, int]]:
         self._expire_blacklist()
+        if _metrics.ACTIVE:
+            _metrics.TAP.set(
+                "hvd_elastic_blacklisted_hosts", float(len(self._blacklist))
+            )
         hosts = (
             self._last_hosts if self._script
             else list(self._static_hosts or [])
@@ -384,6 +439,40 @@ class ElasticDriver:
         addr = "127.0.0.1" if all_local else socket.gethostname()
         return f"{addr}:{port}"
 
+    def _probe_free_port(self, host: str) -> int:
+        """A free port ON THE HOST THAT WILL BIND IT. ``_free_port()``
+        probes the driver machine, which is wrong for a remote
+        controller/coordinator host (advisor finding: the respawn-mode
+        jax coordinator port was probed locally but bound on
+        ``controller_addr``). For a remote host, ask it over ssh;
+        degrade to the local probe — plus the worker-side
+        bind-failure-respawns-with-fresh-ports path — when the probe
+        itself fails."""
+        if _is_local(host):
+            return _free_port()
+        import subprocess
+
+        probe = ("import socket; s=socket.socket(); s.bind((\"\", 0)); "
+                 "print(s.getsockname()[1])")
+        cmd = launcher.ssh_base_cmd(
+            host, self._ssh_port, batch=True, connect_timeout=5
+        ) + [f"python3 -c '{probe}'"]
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=10,
+            )
+            port = int(out.stdout.strip().splitlines()[-1])
+            if 0 < port < 65536:
+                return port
+        except Exception as exc:  # noqa: BLE001 - probe is best-effort
+            self._log(
+                f"remote port probe on {host} failed ({exc}); falling "
+                "back to a locally-probed port (a bind collision exits "
+                "the worker with the respawn status and retries with "
+                "fresh ports)"
+            )
+        return _free_port()
+
     def _drain_world_for_restart(self) -> None:
         """Respawn-mode restart: move every remaining live worker into
         the draining pool (grace first — a survivor needs time to persist
@@ -395,7 +484,9 @@ class ElasticDriver:
         if not self._workers:
             self._restart_pending = True
             return
-        deadline = time.monotonic() + self._removal_grace
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_elastic_restarts_total")
+        deadline = time.monotonic() + self._restart_grace
         for wid in list(self._workers):
             w = self._workers.pop(wid)
             self._removing.append((w, deadline))
@@ -454,6 +545,8 @@ class ElasticDriver:
                 if now - w.spawned_at < action.after_s:
                     continue
                 self._preempts_fired.add(key)
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_elastic_preempt_notices_total")
                 _fault.record_event(
                     "driver", self._gen, "preempt-notice", wid
                 )
@@ -500,7 +593,9 @@ class ElasticDriver:
         controller_addr = (
             "127.0.0.1" if _is_local(slots[0].hostname) else slots[0].hostname
         )
-        controller_port = _free_port()
+        # Both ports are BOUND on rank 0's host, so probe them there
+        # (see _probe_free_port), not on the driver machine.
+        controller_port = self._probe_free_port(slots[0].hostname)
         if self._rejoin_mode == "respawn":
             # Respawn mode rides the PUBLIC jax.distributed.initialize,
             # whose process 0 hosts the coordination service itself. The
@@ -509,7 +604,9 @@ class ElasticDriver:
             # connects and each waits forever for a full house. Rank 0
             # owning the service is fine here — any death restarts the
             # whole generation on a fresh port anyway.
-            jax_coordinator = f"{controller_addr}:{_free_port()}"
+            jax_coordinator = (
+                f"{controller_addr}:{self._probe_free_port(slots[0].hostname)}"
+            )
         else:
             jax_coordinator = self._start_coordination_service(
                 len(slots), all(_is_local(s.hostname) for s in slots)
@@ -553,6 +650,10 @@ class ElasticDriver:
             },
         }
         self._kv.put("elastic", "world", json.dumps(world).encode())
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_elastic_generations_total")
+            _metrics.TAP.set("hvd_elastic_generation", float(self._gen))
+            _metrics.TAP.set("hvd_elastic_world_size", float(len(slots)))
         self._log(
             f"generation {self._gen}: size {len(slots)} over "
             f"{sorted({s.hostname for s in slots})}"
@@ -677,6 +778,11 @@ class ElasticDriver:
     # -------------------------------------------------------------- loop
     def run(self) -> int:
         self._kv.start()
+        if _metrics.ACTIVE:
+            self._log(
+                f"metrics: GET /metrics on port {self._kv.port} "
+                "(rendezvous KV server)"
+            )
         if self._script:
             # Seed synchronously (the first allocation needs hosts when
             # the script is the sole source), then poll on a thread.
@@ -786,6 +892,10 @@ class ElasticDriver:
                         # runtime never emits 79 in-process, so there an
                         # exit 79 is a user program's own status and must
                         # count as a failure (not loop forever).
+                        if _metrics.ACTIVE:
+                            _metrics.TAP.inc(
+                                "hvd_elastic_respawn_requests_total"
+                            )
                         self._log(f"{wid} exited requesting respawn")
                     else:
                         count = self._record_failure(w.host)

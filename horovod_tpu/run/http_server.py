@@ -13,6 +13,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlparse
 
+from .. import metrics as _metrics
+
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -22,6 +24,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802
         key = urlparse(self.path).path
+        if key == "/metrics":
+            self._serve_metrics()
+            return
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_kv_server_requests_total", method="GET")
         with self.server.kv_lock:
             value = self.server.kv.get(key)
         if value is None:
@@ -38,6 +45,8 @@ class _Handler(BaseHTTPRequestHandler):
         key = urlparse(self.path).path
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_kv_server_requests_total", method="PUT")
         with self.server.kv_lock:
             self.server.kv[key] = value
         self.send_response(200)
@@ -46,11 +55,36 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):  # noqa: N802
         key = urlparse(self.path).path
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_kv_server_requests_total", method="DELETE")
         with self.server.kv_lock:
             self.server.kv.pop(key, None)
         self.send_response(200)
         self.send_header("Content-Length", "0")
         self.end_headers()
+
+    def _serve_metrics(self) -> None:
+        """Prometheus text exposition (docs/metrics.md): the serving
+        process's own registry plus every worker snapshot pushed into the
+        KV ``metrics`` scope, each series stamped with its source's
+        identity labels (``role="driver"`` / ``rank="N"``)."""
+        from ..metrics import export as _export
+
+        prefix = f"/{_export.KV_SCOPE}/"
+        with self.server.kv_lock:
+            pushed = {
+                k[len(prefix):]: v
+                for k, v in self.server.kv.items()
+                if k.startswith(prefix)
+            }
+        body = _export.aggregate_kv_snapshots(
+            pushed, local_snapshot=_metrics.snapshot()
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", _export.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
 
 class KVStoreServer:
@@ -133,6 +167,9 @@ class KVStoreClient:
         from ..fault import injector as _fault
         from ..fault.backoff import retry_call
 
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_kv_requests_total", method=method)
+
         def once() -> bytes:
             if _fault.ACTIVE:
                 # Chaos tap: 'drop' raises a ConnectionError before the
@@ -160,6 +197,10 @@ class KVStoreClient:
             retryable=(OSError, EOFError),
             backoff=self._backoff,
             describe=f"KV {method} {path}",
+            on_retry=lambda attempt, exc, delay: (
+                _metrics.TAP.inc("hvd_kv_retries_total", method=method)
+                if _metrics.ACTIVE else None
+            ),
         )
 
     def put(self, scope: str, key: str, value: bytes) -> None:

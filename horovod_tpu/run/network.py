@@ -36,6 +36,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from .. import metrics as _metrics
 from ..fault import injector as _fault
 from ..fault.backoff import Backoff, retry_call
 
@@ -441,6 +442,9 @@ class BasicClient:
         """One authenticated request/response, sweeping every verified
         address, with bounded exponential-backoff retries around the whole
         sweep (``HOROVOD_RPC_RETRIES`` / ``HOROVOD_RPC_BACKOFF_*``)."""
+        req_name = type(req).__name__
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_rpc_requests_total", request=req_name)
 
         def sweep() -> Any:
             last_err: Optional[Exception] = None
@@ -454,17 +458,30 @@ class BasicClient:
                         last_err = e
             raise last_err or NoValidAddressesFound(self._service_name)
 
-        return retry_call(
-            sweep,
-            retryable=(OSError, EOFError, WireError),
-            backoff=self._backoff,
-            describe=f"{self._service_name}: {type(req).__name__}",
-            on_retry=lambda attempt, exc, delay: logger.warning(
+        def on_retry(attempt, exc, delay):
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_rpc_retries_total", request=req_name)
+            logger.warning(
                 "%s: %s failed (%s); retry %d in %.2fs",
-                self._service_name, type(req).__name__, exc,
-                attempt + 1, delay,
-            ),
-        )
+                self._service_name, req_name, exc, attempt + 1, delay,
+            )
+
+        try:
+            return retry_call(
+                sweep,
+                retryable=(OSError, EOFError, WireError),
+                backoff=self._backoff,
+                describe=f"{self._service_name}: {req_name}",
+                on_retry=on_retry,
+            )
+        except RemoteTimeoutError:
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_rpc_timeouts_total", request=req_name)
+            raise
+        except Exception:
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_rpc_failures_total", request=req_name)
+            raise
 
 
 class DriverService(BasicService):

@@ -10,6 +10,11 @@
 # fault-injection smoke — one worker kill, one slow rank, one dropped
 # control-plane burst from a fixed seed — asserting end-to-end recovery
 # and a byte-reproducible schedule log. Budget: under 120s on CPU.
+#
+# Stage 3 (make metrics-smoke; skip with HVD_CI_SKIP_METRICS=1): a 2-rank
+# job with HOROVOD_METRICS=1 whose driver /metrics exposition is scraped
+# mid-run and validated (per-op histograms from both ranks, RPC counter
+# families, elastic gauges). Budget: under 60s on CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -25,4 +30,11 @@ if [ "${HVD_CI_SKIP_CHAOS:-0}" != "1" ]; then
     python tools/chaos_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: chaos smoke recovered in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_METRICS:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/metrics_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: metrics smoke scraped in ${elapsed}s"
 fi
