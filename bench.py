@@ -202,12 +202,22 @@ def _parse_args(argv=None):
              "replicated path, ring reduce-scatter when composed with "
              "--zero1",
     )
+    parser.add_argument(
+        "--overlap", action="store_true",
+        help="streamed in-backward gradient reduction (docs/overlap.md): "
+             "per-layer-group bucket psums issued inside the backward so "
+             "XLA can overlap them with remaining backward compute; "
+             "incompatible with --quantized/--zero1 (both re-shape the "
+             "reduction post-hoc)",
+    )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.zero1 and args.model != "transformer":
         parser.error("--zero1 is implemented for --model transformer only")
     if args.quantized and args.model != "transformer":
         parser.error("--quantized applies to --model transformer only")
+    if args.overlap and (args.quantized or args.zero1):
+        parser.error("--overlap is incompatible with --quantized/--zero1")
     return args
 
 
@@ -497,10 +507,18 @@ def run_lm_benchmark(args) -> int:
         opt_state = tx.init(params)
 
         def step(p, s, tok, lab):
-            loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
-            grads = hvdj.allreduce_gradients(
-                grads, quantized=args.quantized
-            )
+            if args.overlap:
+                def streamed(p_, tok_, lab_):
+                    return loss_fn(
+                        hvdj.stream_param_groups(p_), tok_, lab_
+                    )
+
+                loss, grads = jax.value_and_grad(streamed)(p, tok, lab)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
+                grads = hvdj.allreduce_gradients(
+                    grads, quantized=args.quantized
+                )
             updates, s = tx.update(grads, s, p)
             p = optax.apply_updates(p, updates)
             return p, s, jax.lax.pmean(loss, "data")
@@ -822,12 +840,22 @@ def run_benchmark(args) -> int:
         return loss, new_bs
 
     def step(p, bs, s, x, y, it):
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            p, bs, x, y, it
-        )
-        # The whole reference DistributedOptimizer pipeline: fusion-bucketed
-        # allreduce of gradients over the data axis.
-        grads = hvdj.allreduce_gradients(grads)
+        if args.overlap:
+            def streamed(p_, bs_, x_, y_, it_):
+                return loss_fn(
+                    hvdj.stream_param_groups(p_), bs_, x_, y_, it_
+                )
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                streamed, has_aux=True
+            )(p, bs, x, y, it)
+        else:
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(p, bs, x, y, it)
+            # The whole reference DistributedOptimizer pipeline: fusion-
+            # bucketed allreduce of gradients over the data axis.
+            grads = hvdj.allreduce_gradients(grads)
         new_bs = jax.tree.map(lambda v: jax.lax.pmean(v, "data"), new_bs)
         updates, s = tx.update(grads, s, p)
         p = optax.apply_updates(p, updates)

@@ -55,6 +55,17 @@ def init(config: Optional[Config] = None) -> None:
         if _runtime is not None and _runtime.running:
             return
         cfg = config or Config.from_env()
+        # XLA perf-flag preset (docs/overlap.md): must land in XLA_FLAGS
+        # before the first backend touch below (jax.distributed /
+        # jax.devices); idempotent if horovod_tpu.jax already applied it.
+        from .common import env as _env_mod
+
+        try:
+            _env_mod.apply_xla_perf_preset(cfg.xla_perf_preset)
+        except ValueError:
+            raise
+        except Exception:  # noqa: BLE001 - never block init on flag plumbing
+            pass
         topo = _topology_mod.detect()
         import os as _os
 

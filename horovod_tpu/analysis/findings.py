@@ -26,6 +26,10 @@ RULE_PPERMUTE = "ppermute-non-bijective"
 RULE_GROUP_DTYPE = "group-dtype-mismatch"
 RULE_GROUP_BUDGET = "group-over-budget"
 RULE_FUSION_BUDGET = "fusion-over-budget"
+# DistributedOptimizer(overlap=True) around a model whose layers were never
+# (or only partially) registered for streamed reduction — the silent
+# fallback/unreduced-gradient hazard (docs/overlap.md).
+RULE_OVERLAP_STREAMING = "overlap-no-streaming"
 
 # --- rule ids (Pass 2: runtime thread-safety lint) ---
 RULE_UNGUARDED = "unguarded-shared-state"
@@ -39,6 +43,7 @@ ALL_RULES = (
     RULE_GROUP_DTYPE,
     RULE_GROUP_BUDGET,
     RULE_FUSION_BUDGET,
+    RULE_OVERLAP_STREAMING,
     RULE_UNGUARDED,
 )
 

@@ -108,6 +108,61 @@ def check_gradient_tree(
     _raise_or_log(findings)
 
 
+def check_overlap_streaming(
+    registrations: Dict[str, int], n_grad_leaves: int
+) -> List[Finding]:
+    """Lint for ``DistributedOptimizer(overlap=True)``: the wrapped model's
+    layers must have been registered for streamed reduction
+    (``reduce_in_backward`` / ``stream_param_groups``) during the loss
+    trace, or the overlap promise silently degrades. Returns warning
+    findings (the optimizer falls back to the post-hoc reduction when
+    NOTHING was registered; a partial registration leaves the unregistered
+    leaves unreduced — flagged the loudest)."""
+    from .findings import RULE_OVERLAP_STREAMING, SEVERITY_WARNING
+
+    findings: List[Finding] = []
+    calls = int(registrations.get("calls", 0))
+    leaves = int(registrations.get("leaves", 0))
+    if calls == 0:
+        findings.append(
+            Finding(
+                rule=RULE_OVERLAP_STREAMING,
+                severity=SEVERITY_WARNING,
+                message=(
+                    "DistributedOptimizer(overlap=True) but no parameter "
+                    "subtree was registered for streamed reduction — wrap "
+                    "the params the loss consumes with "
+                    "hvd.reduce_in_backward / hvd.stream_param_groups (or "
+                    "use make_train_step(overlap=True)); falling back to "
+                    "the post-hoc reduction: correct, but with ZERO "
+                    "backward overlap"
+                ),
+                location="preflight:DistributedOptimizer",
+                details={"registered_calls": 0,
+                         "grad_leaves": int(n_grad_leaves)},
+            )
+        )
+    elif leaves < int(n_grad_leaves):
+        findings.append(
+            Finding(
+                rule=RULE_OVERLAP_STREAMING,
+                severity=SEVERITY_WARNING,
+                message=(
+                    f"overlap=True with a PARTIAL streaming registration: "
+                    f"{leaves} of {n_grad_leaves} gradient leaves were "
+                    "registered — the unregistered leaves' gradients are "
+                    "NOT reduced across ranks; register every layer or "
+                    "drop overlap=True"
+                ),
+                location="preflight:DistributedOptimizer",
+                details={"registered_leaves": leaves,
+                         "grad_leaves": int(n_grad_leaves),
+                         "registered_calls": calls},
+            )
+        )
+    return findings
+
+
 # --- eager checks ---
 def check_grouped(
     tensors: Sequence[Any], threshold_bytes: Optional[int], name: str

@@ -48,6 +48,15 @@ HOROVOD_CPU_OPERATIONS = "HOROVOD_CPU_OPERATIONS"
 # TPU-native additions.
 HOROVOD_TPU_MESH_AXES = "HOROVOD_TPU_MESH_AXES"
 HOROVOD_TPU_EAGER_BACKEND = "HOROVOD_TPU_EAGER_BACKEND"
+# Streamed (overlap) gradient reduction: size of the FIRST bucket to reduce
+# in the backward pass (DDP idiom — small, so the wire starts early;
+# docs/overlap.md). The reference HOROVOD_FUSION_THRESHOLD above is honored
+# as the default for every later bucket.
+HOROVOD_FUSION_FIRST_BUCKET_BYTES = "HOROVOD_FUSION_FIRST_BUCKET_BYTES"
+# XLA performance-flag preset (docs/overlap.md): "auto" (default — the
+# overlap preset when a TPU platform is detected, off elsewhere),
+# "overlap" (async collectives + latency-hiding scheduler), or "off".
+HOROVOD_XLA_PERF_PRESET = "HOROVOD_XLA_PERF_PRESET"
 # Opt-in collective-safety pre-flight (docs/static_analysis.md).
 HOROVOD_TPU_STATIC_CHECKS = "HOROVOD_TPU_STATIC_CHECKS"
 # Fault tolerance (docs/fault_tolerance.md).
@@ -88,6 +97,109 @@ HOROVOD_ELASTIC_REQUIRE_SNAPSHOT = "HOROVOD_ELASTIC_REQUIRE_SNAPSHOT"
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64
 
+# --- XLA performance-flag presets (docs/overlap.md) ---
+# The flags the streamed-reduction path needs to turn N independent bucket
+# psums into async all-reduce-start/-done pairs hidden behind backward
+# compute. Applied to XLA_FLAGS before the backend initializes (flag
+# parsing happens at first backend/compiler touch) and usable as
+# compiler_options for AOT compiles (tools/tpu_profile_overlap.py).
+XLA_PERF_PRESETS = {
+    "off": {},
+    "overlap": {
+        "xla_tpu_enable_latency_hiding_scheduler": "true",
+        "xla_tpu_enable_async_collective_fusion": "true",
+        "xla_tpu_enable_async_collective_fusion_fuse_all_reduce": "true",
+        "xla_enable_async_all_reduce": "true",
+    },
+}
+
+# Record of the last apply_xla_perf_preset() call, for the timeline/metrics
+# to stamp: {"preset": name, "flags": {...}, "applied": [...], "late": bool}.
+_applied_perf_preset = None
+
+
+def _tpu_platform_hinted() -> bool:
+    """TPU detection WITHOUT initializing a jax backend: only an EXPLICIT
+    platform pin counts. A merely-importable libtpu wheel is not enough —
+    a CPU-platform process whose XLA flag registry doesn't know the
+    xla_tpu_* names dies with "Unknown flags in XLA_FLAGS" at first
+    backend touch, so guessing wrong is fatal, not just noisy. On a TPU VM
+    with an unpinned platform, set HOROVOD_XLA_PERF_PRESET=overlap."""
+    plats = (
+        os.environ.get("JAX_PLATFORMS", "")
+        or os.environ.get("JAX_PLATFORM_NAME", "")
+    ).lower()
+    return "tpu" in plats
+
+
+def resolve_perf_preset(preset: str | None = None) -> tuple:
+    """Resolve a preset name (None reads HOROVOD_XLA_PERF_PRESET, default
+    "auto") to (name, flags). "auto" means the overlap preset on TPU and
+    off elsewhere — the TPU-only xla_tpu_* flags would be noise on other
+    platforms."""
+    name = (preset or os.environ.get(HOROVOD_XLA_PERF_PRESET, "")
+            or "auto").strip().lower()
+    if name == "auto":
+        name = "overlap" if _tpu_platform_hinted() else "off"
+    if name not in XLA_PERF_PRESETS:
+        raise ValueError(
+            f"unknown {HOROVOD_XLA_PERF_PRESET} {name!r}; "
+            f"choose from {sorted(XLA_PERF_PRESETS)} or 'auto'"
+        )
+    return name, dict(XLA_PERF_PRESETS[name])
+
+
+def apply_xla_perf_preset(preset: str | None = None) -> dict:
+    """Append the resolved preset's flags to XLA_FLAGS (idempotent — a flag
+    already mentioned there is left alone, so user overrides win) and
+    record what happened for the timeline/metrics. Must run before the
+    first jax backend touch to take effect; when it runs late the record
+    says so instead of lying about the flags being live."""
+    global _applied_perf_preset
+    name, flags = resolve_perf_preset(preset)
+    applied = []
+    if flags:
+        current = os.environ.get("XLA_FLAGS", "")
+        extra = []
+        for k, v in flags.items():
+            if k in current:
+                continue
+            extra.append(f"--{k}={v}")
+            applied.append(k)
+        if extra:
+            os.environ["XLA_FLAGS"] = (current + " " + " ".join(extra)).strip()
+    # A flag appended after the first backend touch is parsed too late to
+    # take effect; record that rather than claiming the flags are live.
+    late = False
+    try:
+        import sys
+
+        if "jax" in sys.modules:
+            from jax._src import xla_bridge as _xb
+
+            late = bool(applied) and bool(getattr(_xb, "_backends", None))
+    except Exception:  # noqa: BLE001 - best-effort introspection only
+        pass
+    record = {"preset": name, "flags": flags, "applied": applied,
+              "late": late}
+    _applied_perf_preset = record
+    try:
+        from .. import metrics as _metrics
+
+        if _metrics.ACTIVE:
+            _metrics.TAP.set(
+                "hvd_xla_perf_preset_info", 1.0, preset=name,
+                flags=",".join(sorted(flags)) or "none",
+            )
+    except Exception:  # noqa: BLE001 - metrics must never block init
+        pass
+    return record
+
+
+def applied_perf_preset() -> dict | None:
+    """The record of the last preset application (None before any)."""
+    return _applied_perf_preset
+
 
 def _get_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
@@ -126,6 +238,10 @@ class Config:
     """
 
     fusion_threshold_bytes: int = 64 * 1024 * 1024
+    # Streamed (overlap) reduction: first-bucket cap (DDP idiom) and the
+    # XLA perf-flag preset name ("auto" resolves per platform).
+    fusion_first_bucket_bytes: int = 1024 * 1024
+    xla_perf_preset: str = "auto"
     cycle_time_ms: float = 5.0
     cache_capacity: int = 1024
     cache_enabled: bool = True
@@ -167,6 +283,12 @@ class Config:
         cfg = Config()
         cfg.fusion_threshold_bytes = _get_int(
             HOROVOD_FUSION_THRESHOLD, cfg.fusion_threshold_bytes
+        )
+        cfg.fusion_first_bucket_bytes = _get_int(
+            HOROVOD_FUSION_FIRST_BUCKET_BYTES, cfg.fusion_first_bucket_bytes
+        )
+        cfg.xla_perf_preset = (
+            os.environ.get(HOROVOD_XLA_PERF_PRESET, "") or cfg.xla_perf_preset
         )
         # Reference accepts cycle time in ms as float via HOROVOD_CYCLE_TIME.
         cfg.cycle_time_ms = _get_float(HOROVOD_CYCLE_TIME, cfg.cycle_time_ms)

@@ -542,6 +542,11 @@ class Runtime:
             return
         if self.config.timeline_filename:
             self.timeline.initialize(self.config.timeline_filename, self.topology.rank)
+            from ..common import env as _env_mod
+
+            preset = _env_mod.applied_perf_preset()
+            if preset is not None:
+                self.timeline.metadata("hvd_xla_perf_preset", preset)
         self._thread = threading.Thread(
             target=self._background_loop, name="hvd_background", daemon=True
         )

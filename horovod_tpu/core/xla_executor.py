@@ -100,6 +100,20 @@ class XlaPlanExecutor(PlanExecutor):
         import jax
         from jax.sharding import Mesh
 
+        from ..common import env as _env_mod
+
+        # Resolve + record the XLA perf-flag preset for this data plane
+        # (idempotent: hvd.init already applied it pre-backend; here the
+        # record lands in metrics even for direct executor construction,
+        # and a too-late application is marked `late` rather than lied
+        # about).
+        try:
+            self._perf_preset = _env_mod.apply_xla_perf_preset(
+                getattr(config, "xla_perf_preset", None)
+            )
+        except Exception:  # noqa: BLE001 - plumbing must not block the plane
+            self._perf_preset = None
+
         self._jax = jax
         devices = jax.devices()
         if len(devices) < topology.size:

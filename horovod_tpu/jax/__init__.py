@@ -22,6 +22,7 @@ Typical use::
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Optional
 
 import jax
@@ -41,6 +42,19 @@ from ..parallel.mesh import (
     build_hierarchical_mesh,
     build_mesh,
 )
+
+_logger = logging.getLogger("horovod_tpu")
+
+# Compiled-mode users reach collectives through jit, never through hvd.init;
+# the perf-preset flags must land in XLA_FLAGS before the first backend
+# touch, so the resolver runs at import (idempotent; "auto" is off-platform
+# safe — it only adds TPU flags when a TPU platform is hinted).
+from ..common import env as _env_mod  # noqa: E402
+
+try:
+    _env_mod.apply_xla_perf_preset()
+except Exception:  # noqa: BLE001 - preset application must never block import
+    pass
 
 def _shard_map(fn, mesh, *, in_specs, out_specs, check: bool = False):
     """shard_map with version compatibility (check_vma in jax>=0.7,
@@ -72,6 +86,13 @@ broadcast = _c.broadcast
 alltoall = _c.alltoall
 reducescatter = _c.reducescatter
 hierarchical_allreduce = _c.hierarchical_allreduce
+
+# Streamed (overlap) gradient reduction: register a parameter subtree (or a
+# scanned layer stack's body) so its gradients are bucket-allreduced INSIDE
+# the backward pass — see ops/fusion.py and docs/overlap.md.
+reduce_in_backward = _fusion.reduce_in_backward
+stream_scan_body = _fusion.stream_scan_body
+stream_param_groups = _fusion.stream_param_groups
 
 
 def _select_reduce_fn(op: ReduceOp, hierarchical: bool):
@@ -114,7 +135,7 @@ def allreduce_gradients(
     *,
     op: ReduceOp = Average,
     axis_name=DATA_AXIS,
-    fusion_threshold_bytes: int = 64 * 1024 * 1024,
+    fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
     hierarchical: bool = False,
     quantized: bool = False,
@@ -127,7 +148,12 @@ def allreduce_gradients(
     collective (see ops/fusion.py). ``quantized=True`` moves each bucket
     through the int8-wire ring allreduce (``ops/quantized.py``, ~1%
     gradient noise at 8 ranks) instead of a full-precision ``psum``.
+    ``fusion_threshold_bytes=None`` resolves HOROVOD_FUSION_THRESHOLD
+    (64 MB default, reference parity).
     """
+    fusion_threshold_bytes = _fusion.default_threshold_bytes(
+        fusion_threshold_bytes
+    )
     axis_name = _normalize_axis(axis_name, hierarchical)
     from ..analysis import preflight as _preflight
 
@@ -198,16 +224,33 @@ def allreduce_gradients(
     return reduced
 
 
+def _check_overlap_rejections(overlap: bool, quantized: bool, op: ReduceOp):
+    if not overlap:
+        return
+    if quantized:
+        raise ValueError(
+            "overlap=True streams full-precision bucket psums inside the "
+            "backward; the quantized int8 ring allreduce dithers per bucket "
+            "and runs post-hoc only — pick one"
+        )
+    if op not in _fusion._STREAMABLE_OPS:
+        raise ValueError(
+            f"overlap=True supports elementwise reduce ops "
+            f"{_fusion._STREAMABLE_OPS}; got {op}"
+        )
+
+
 def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimizer
     optimizer,
     *,
     op: ReduceOp = Average,
     axis_name: str = DATA_AXIS,
-    fusion_threshold_bytes: int = 64 * 1024 * 1024,
+    fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
     hierarchical: bool = False,
     quantized: bool = False,
     backward_passes_per_step: int = 1,
+    overlap: bool = False,
 ):
     """Wrap an optax ``GradientTransformation`` so its update first
     allreduces gradients across the data axis.
@@ -219,23 +262,56 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     locally (see ``GradientAccumulator``) — the divisor is folded in here, as
     the reference does in the framework layer
     (``horovod/torch/mpi_ops.py:101-124``).
+
+    ``overlap=True`` expects the model's layers to have been registered for
+    streamed reduction (``hvd.reduce_in_backward`` /
+    ``hvd.stream_param_groups`` applied to the params the loss consumes):
+    the gradients then arrive ALREADY reduced from inside the backward pass
+    and the post-hoc reduction here is skipped. If no layer was registered
+    this falls back to the post-hoc reduction with a loud warning (and an
+    ``overlap-no-streaming`` finding under HOROVOD_TPU_STATIC_CHECKS=1) —
+    see docs/overlap.md.
     """
     import optax
+
+    _check_overlap_rejections(overlap, quantized, op)
 
     def init_fn(params):
         return optimizer.init(params)
 
     def update_fn(grads, state, params=None, **extra):
         prescale = 1.0 / backward_passes_per_step if backward_passes_per_step > 1 else 1.0
-        reduced = allreduce_gradients(
-            grads,
-            op=op,
-            axis_name=axis_name,
-            fusion_threshold_bytes=fusion_threshold_bytes,
-            compression=compression,
-            hierarchical=hierarchical,
-            quantized=quantized,
-        )
+        do_reduce = True
+        if overlap:
+            reg = _fusion.take_stream_registrations()
+            from ..analysis import preflight as _preflight
+
+            findings = _preflight.check_overlap_streaming(
+                reg, len(jax.tree.leaves(grads))
+            )
+            # No registered layer at all → the backward reduced nothing;
+            # reduce post-hoc (correct, just without overlap). Partial
+            # registration keeps the streamed contract (re-reducing here
+            # would double-reduce the registered layers) — the finding
+            # above already warned.
+            do_reduce = reg["calls"] == 0
+            if _preflight.enabled():
+                _preflight._raise_or_log(findings)
+            else:
+                for f in findings:
+                    _logger.warning("%s", f.render())
+        if do_reduce:
+            reduced = allreduce_gradients(
+                grads,
+                op=op,
+                axis_name=axis_name,
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                compression=compression,
+                hierarchical=hierarchical,
+                quantized=quantized,
+            )
+        else:
+            reduced = grads
         if prescale != 1.0:
             reduced = jax.tree.map(lambda g: g * prescale, reduced)
         return optimizer.update(reduced, state, params, **extra)
@@ -267,12 +343,14 @@ def make_train_step(
     *,
     axis_name: str = DATA_AXIS,
     op: ReduceOp = Average,
-    fusion_threshold_bytes: int = 64 * 1024 * 1024,
+    fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
     hierarchical: bool = False,
     quantized: bool = False,
     donate: bool = True,
     has_aux: bool = False,
+    overlap: bool = False,
+    first_bucket_bytes: Optional[int] = None,
 ):
     """Build a jitted SPMD training step: per-shard grads → fused allreduce
     → optax update, with the batch sharded over ``axis_name`` and
@@ -284,27 +362,60 @@ def make_train_step(
     op/compression — the whole reference ``DistributedOptimizer`` pipeline
     as one XLA program. With ``hierarchical=True`` the mesh must have
     (cross, local) axes (see ``build_hierarchical_mesh``).
+
+    ``overlap=True`` switches from the post-hoc whole-tree reduction to the
+    streamed path (docs/overlap.md): the top-level children of ``params``
+    are packed into DDP-style reverse-order groups (a smaller first bucket,
+    ``first_bucket_bytes`` / HOROVOD_FUSION_FIRST_BUCKET_BYTES) and each
+    group's psums are issued INSIDE the backward pass as soon as that
+    group's gradients exist — independent collectives XLA can overlap with
+    the remaining backward compute. Numerically identical to
+    ``overlap=False`` (elementwise reductions commute with the split);
+    ``quantized=True`` is rejected.
     """
     import optax
 
+    _check_overlap_rejections(overlap, quantized, op)
     axis_name = _normalize_axis(axis_name, hierarchical)
 
     def step(params, opt_state, batch):
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        if overlap:
+            def streamed_loss(p, b):
+                p = _fusion.stream_param_groups(
+                    p,
+                    op=op,
+                    axis_name=axis_name,
+                    threshold_bytes=fusion_threshold_bytes,
+                    first_bucket_bytes=first_bucket_bytes,
+                    hierarchical=hierarchical,
+                    compression=compression,
+                )
+                return loss_fn(p, b)
+
+            grad_fn = jax.value_and_grad(streamed_loss, has_aux=has_aux)
+        else:
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
         if has_aux:
             (loss, aux), grads = grad_fn(params, batch)
         else:
             loss, grads = grad_fn(params, batch)
             aux = None
-        grads = allreduce_gradients(
-            grads,
-            op=op,
-            axis_name=axis_name,
-            fusion_threshold_bytes=fusion_threshold_bytes,
-            compression=compression,
-            hierarchical=hierarchical,
-            quantized=quantized,
-        )
+        if not overlap:
+            grads = allreduce_gradients(
+                grads,
+                op=op,
+                axis_name=axis_name,
+                fusion_threshold_bytes=fusion_threshold_bytes,
+                compression=compression,
+                hierarchical=hierarchical,
+                quantized=quantized,
+            )
+        else:
+            # Streamed: grads left value_and_grad already reduced (the
+            # custom_vjp backward rules issued the bucket psums); consume
+            # the registration ledger so a later overlap DistributedOptimizer
+            # trace doesn't credit THIS trace's registrations.
+            _fusion.take_stream_registrations()
         loss = lax.pmean(loss, axis_name)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)

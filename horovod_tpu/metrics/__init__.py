@@ -57,6 +57,13 @@ _CATALOG: Dict[str, str] = {
     "hvd_plans_total": "Fused plans executed by this rank",
     "hvd_queue_depth": "Pending tensors in the runtime queue",
     "hvd_cycle_seconds": "Background negotiation-cycle duration",
+    "hvd_fusion_buckets": "Fusion buckets planned for one reduction path "
+                          "(trace-time; labeled by path)",
+    "hvd_fusion_bucket_bytes": "Planned fusion-bucket payload sizes",
+    "hvd_overlap_groups": "Streamed-reduction layer groups registered by "
+                          "the overlap path (trace-time)",
+    "hvd_xla_perf_preset_info": "Resolved XLA perf-flag preset (value is "
+                                "always 1; preset/flags in labels)",
     "hvd_xla_cache_hits_total": "Compiled-collective cache hits",
     "hvd_xla_cache_misses_total": "Compiled-collective cache misses",
     "hvd_xla_compile_seconds": "Compiled-collective build time",

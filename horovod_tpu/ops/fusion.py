@@ -11,18 +11,56 @@ memcpy: the pack/unpack reshapes fuse into neighbouring ops.
 
 The same pack/unpack is reused by the eager executor when it materializes a
 fused Response from the cycle loop.
+
+The streamed (overlap) path lives here too: :func:`reduce_in_backward` is a
+``custom_vjp`` identity whose backward rule issues the bucket psums for a
+parameter subtree *inside* the backward pass, as soon as that subtree's
+cotangents exist. A post-hoc ``fused_allreduce`` over the whole gradient
+pytree data-depends on the complete backward pass, so XLA's latency-hiding
+scheduler has nothing to hide the collective behind; per-subtree streamed
+psums depend only on their own layer suffix and overlap with the remaining
+backward compute (docs/overlap.md).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+import logging
+import threading
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from .. import metrics as _metrics
+from ..common import env as _env
 from ..common.types import ReduceOp, dtype_size, dtype_from_array
 from ..parallel.mesh import DATA_AXIS
 from . import collectives
+
+logger = logging.getLogger("horovod_tpu")
+
+
+def default_threshold_bytes(threshold_bytes: Optional[int] = None) -> int:
+    """Resolve the fusion threshold: explicit value > HOROVOD_FUSION_THRESHOLD
+    env knob > the reference's 64 MB default (operations.cc:411-417)."""
+    if threshold_bytes is not None:
+        return int(threshold_bytes)
+    return _env._get_int(
+        _env.HOROVOD_FUSION_THRESHOLD, 64 * 1024 * 1024
+    )
+
+
+def default_first_bucket_bytes(first_bucket_bytes: Optional[int] = None) -> int:
+    """Resolve the streamed-mode first-bucket size: explicit value >
+    HOROVOD_FUSION_FIRST_BUCKET_BYTES > 1 MiB (the DDP idiom: a small first
+    bucket puts bytes on the wire as early in the backward as possible)."""
+    if first_bucket_bytes is not None:
+        return int(first_bucket_bytes)
+    return _env._get_int(
+        _env.HOROVOD_FUSION_FIRST_BUCKET_BYTES, 1024 * 1024
+    )
 
 
 def plan_buckets(
@@ -34,7 +72,11 @@ def plan_buckets(
     ``threshold_bytes`` per bucket (reference ``FuseResponses`` packs
     same-dtype/device responses up to the fusion threshold with lookahead,
     ``controller.cc:626-750``; order here is deterministic since the pytree
-    order is static).
+    order is static). An oversized leaf (a bucket of its own) closes its
+    dtype's active bucket: later same-dtype leaves keep fusing, but into a
+    FRESH bucket, so bucket emission order stays monotone in submission
+    order — a leaf never joins a bucket that sits earlier in the stream
+    than an already-emitted oversized one.
     """
     buckets: List[List[int]] = []
     # Active bucket per dtype: (bucket_index, bytes_used)
@@ -44,6 +86,7 @@ def plan_buckets(
         key = str(leaf.dtype)
         if nbytes >= threshold_bytes:
             buckets.append([i])
+            active.pop(key, None)
             continue
         if key in active:
             bidx, used = active[key]
@@ -84,21 +127,38 @@ def fused_allreduce(
     *,
     op: ReduceOp = ReduceOp.AVERAGE,
     axis_name: str = DATA_AXIS,
-    threshold_bytes: int = 64 * 1024 * 1024,
+    threshold_bytes: Optional[int] = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     reduce_fn: Callable[..., jax.Array] | None = None,
+    label: str = "posthoc",
 ) -> Any:
     """Allreduce every leaf of a pytree with bucket fusion.
 
     Must be called inside an axis-binding context (shard_map / pmap). This is
     the compiled-mode equivalent of wrapping every gradient in
     ``hvd.allreduce`` and letting the background loop fuse them.
+    ``threshold_bytes=None`` resolves the HOROVOD_FUSION_THRESHOLD knob.
     """
+    threshold_bytes = default_threshold_bytes(threshold_bytes)
     leaves, treedef = jax.tree.flatten(tree)
     if not leaves:
         return tree
     buckets = plan_buckets(leaves, threshold_bytes)
+    if _metrics.ACTIVE:
+        # Trace-time plan stats (one emission per compile, not per step).
+        _metrics.TAP.set(
+            "hvd_fusion_buckets", float(len(buckets)), path=label
+        )
+        for bucket in buckets:
+            _metrics.TAP.observe(
+                "hvd_fusion_bucket_bytes",
+                float(sum(
+                    leaves[i].size * dtype_size(dtype_from_array(leaves[i]))
+                    for i in bucket
+                )),
+                path=label,
+            )
     reduce_fn = reduce_fn or collectives.allreduce
     results: List[jax.Array | None] = [None] * len(leaves)
     for bucket in buckets:
@@ -124,3 +184,280 @@ def fused_allreduce(
         for i, r in zip(bucket, unpacked):
             results[i] = r
     return jax.tree.unflatten(treedef, results)
+
+
+# --- streamed (overlap) reduction -------------------------------------------
+#
+# The post-hoc fused_allreduce above reduces the WHOLE gradient pytree after
+# value_and_grad returns, so every psum data-depends on the full backward
+# pass and XLA cannot overlap the collective with any compute. The streamed
+# path wraps parameter subtrees in a custom_vjp identity whose backward rule
+# reduces that subtree's cotangents the moment they exist — the psum's
+# operand cone is one layer suffix of the backward, and everything deeper in
+# the model is free compute for the latency-hiding scheduler to run behind
+# the wire transfer.
+
+# Ops a streamed reduction may use: per-group reduction must equal the
+# whole-tree reduction, which holds exactly for elementwise reductions.
+# ADASUM normalizes per bucket (bucket plans differ between the paths) and
+# the quantized int8 ring dithers per bucket — both stay post-hoc-only.
+_STREAMABLE_OPS = (
+    ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
+)
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    """Hashable reduction spec closed over by the custom_vjp backward rule
+    (custom_vjp nondiff args must hash/compare for trace caching)."""
+
+    op: ReduceOp = ReduceOp.AVERAGE
+    axis_name: Any = DATA_AXIS  # str, or a (cross, local) tuple
+    threshold_bytes: int = 64 * 1024 * 1024
+    hierarchical: bool = False
+    compression: Any = None  # a common.compression.Compressor class or None
+    label: str = "stream"
+
+
+def _hier_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
+                    postscale_factor=1.0):
+    """Two-level reduce for the streamed path: reduce-scatter on ICI,
+    shard psum on DCN, all-gather back (ops/collectives.py)."""
+    cross_axis, local_axis = axis_name
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    out = collectives.hierarchical_allreduce(
+        x, op=op, local_axis=local_axis, cross_axis=cross_axis
+    )
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
+
+
+def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
+    """Reduce one registered subtree's cotangents (runs inside the backward
+    trace, under the same axis binding as the forward)."""
+    compression = cfg.compression
+    ctxs = None
+    if compression is not None:
+        leaves, treedef = jax.tree.flatten(ct)
+        compressed = [compression.compress(l) for l in leaves]
+        ct = jax.tree.unflatten(treedef, [c for c, _ in compressed])
+        ctxs = [c for _, c in compressed]
+    reduced = fused_allreduce(
+        ct,
+        op=cfg.op,
+        axis_name=cfg.axis_name,
+        threshold_bytes=cfg.threshold_bytes,
+        reduce_fn=_hier_reduce_fn if cfg.hierarchical else None,
+        label=cfg.label,
+    )
+    if compression is not None:
+        leaves, treedef = jax.tree.flatten(reduced)
+        leaves = [
+            compression.decompress(l, c) for l, c in zip(leaves, ctxs)
+        ]
+        reduced = jax.tree.unflatten(treedef, leaves)
+    return reduced
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _stream_identity(cfg: StreamConfig, tree: Any) -> Any:
+    return tree
+
+
+def _stream_fwd(cfg, tree):
+    return tree, None
+
+
+def _stream_bwd(cfg, _res, ct):
+    return (_reduce_stream_group(cfg, ct),)
+
+
+_stream_identity.defvjp(_stream_fwd, _stream_bwd)
+
+
+# Per-thread trace ledger: DistributedOptimizer(overlap=True) consumes it to
+# detect a model whose layers were never registered for streaming (the
+# silent-fallback hazard the analysis lint warns about).
+_stream_trace = threading.local()
+
+
+def _note_stream_registration(n_leaves: int) -> None:
+    d = getattr(_stream_trace, "d", None)
+    if d is None:
+        d = {"calls": 0, "leaves": 0}
+        _stream_trace.d = d
+    d["calls"] += 1
+    d["leaves"] += int(n_leaves)
+
+
+def take_stream_registrations() -> Dict[str, int]:
+    """Return and reset this thread's (calls, leaves) streamed-registration
+    counts since the last take — consumed once per optimizer trace."""
+    d = getattr(_stream_trace, "d", None) or {"calls": 0, "leaves": 0}
+    _stream_trace.d = {"calls": 0, "leaves": 0}
+    return dict(d)
+
+
+def reduce_in_backward(
+    tree: Any,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    axis_name: Any = DATA_AXIS,
+    threshold_bytes: Optional[int] = None,
+    hierarchical: bool = False,
+    compression: Any = None,
+    label: str = "stream",
+) -> Any:
+    """Register a parameter subtree for streamed gradient reduction.
+
+    Identity on the forward pass; the backward rule bucket-allreduces the
+    subtree's cotangents as soon as they exist, giving XLA a collective
+    whose operand cone is only this subtree's layer suffix — overlappable
+    with the rest of the backward. Apply it to each layer (or layer group)
+    of the params BEFORE the layer's forward computation consumes them;
+    ``make_train_step(overlap=True)`` does this automatically via
+    :func:`stream_param_groups`.
+    """
+    if op not in _STREAMABLE_OPS:
+        raise ValueError(
+            f"reduce_in_backward supports elementwise ops {_STREAMABLE_OPS};"
+            f" got {op} (ADASUM normalizes per bucket and must stay post-hoc)"
+        )
+    if compression is not None:
+        from ..common.compression import Compression
+
+        if compression is Compression.none:
+            compression = None
+    cfg = StreamConfig(
+        op=op,
+        axis_name=tuple(axis_name) if isinstance(axis_name, list)
+        else axis_name,
+        threshold_bytes=default_threshold_bytes(threshold_bytes),
+        hierarchical=hierarchical,
+        compression=compression,
+        label=label,
+    )
+    _note_stream_registration(len(jax.tree.leaves(tree)))
+    return _stream_identity(cfg, tree)
+
+
+def stream_scan_body(
+    body_fn: Callable[[Any, Any], Any], **reduce_kw
+) -> Callable[[Any, Any], Any]:
+    """Scan-body variant for scanned layer stacks: wrap a ``lax.scan`` body
+    so the per-layer params slice it consumes is registered for streamed
+    backward reduction. The scan's backward then issues one bucket psum per
+    layer iteration — the reduction streams across the stack instead of
+    waiting for the accumulated stacked gradient. Valid because the
+    streamed ops are elementwise: psum of the per-iteration cotangent
+    slices equals psum of the stacked gradient."""
+    reduce_kw.setdefault("label", "stream-scan")
+
+    def wrapped(carry, xs):
+        return body_fn(carry, reduce_in_backward(xs, **reduce_kw))
+
+    return wrapped
+
+
+def _top_level_children(tree: Any):
+    """Split a pytree into its top-level children (the layer granularity
+    streamed grouping works at). Returns (children, rebuild) or None when
+    the tree has no splittable top level."""
+    if isinstance(tree, dict) and tree:
+        keys = list(tree.keys())
+
+        def rebuild(vals, keys=keys, cls=type(tree)):
+            out = dict(zip(keys, vals))
+            try:
+                return cls(out)
+            except Exception:  # noqa: BLE001 - exotic Mapping subclass
+                return out
+
+        return [tree[k] for k in keys], rebuild
+    if isinstance(tree, (list, tuple)) and tree:
+        def rebuild(vals, cls=type(tree)):
+            return cls(vals)
+
+        return list(tree), rebuild
+    return None
+
+
+def _tree_bytes(tree: Any) -> int:
+    return sum(
+        l.size * dtype_size(dtype_from_array(l))
+        for l in jax.tree.leaves(tree)
+    )
+
+
+def plan_layer_groups(
+    layer_bytes: Sequence[int],
+    threshold_bytes: int,
+    first_bucket_bytes: int,
+) -> List[List[int]]:
+    """Pack layer indices into streamed-reduction groups, walking in
+    REVERSE forward order (the order their gradients materialize in the
+    backward pass, torch DDP's bucket assignment). The first group to
+    reduce is capped at ``first_bucket_bytes`` so the first collective
+    launches as early as possible; later groups fill to the fusion
+    threshold. Groups are returned in reduction order; each group's member
+    list is sorted in forward order."""
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cap = max(int(first_bucket_bytes), 1)
+    for i in reversed(range(len(layer_bytes))):
+        cur.append(i)
+        cur_bytes += int(layer_bytes[i])
+        if cur_bytes >= cap:
+            groups.append(sorted(cur))
+            cur, cur_bytes = [], 0
+            cap = max(int(threshold_bytes), 1)
+    if cur:
+        groups.append(sorted(cur))
+    return groups
+
+
+def stream_param_groups(
+    params: Any,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    axis_name: Any = DATA_AXIS,
+    threshold_bytes: Optional[int] = None,
+    first_bucket_bytes: Optional[int] = None,
+    hierarchical: bool = False,
+    compression: Any = None,
+) -> Any:
+    """Partition ``params`` by top-level child (for a flax params dict: one
+    child per module, in construction ≈ forward order), pack the children
+    into DDP-style reverse-order groups with a smaller first bucket, and
+    register every group for streamed backward reduction. A tree with no
+    splittable top level degrades to one group (still overlappable with the
+    optimizer/loss tail, but not intra-backward)."""
+    threshold = default_threshold_bytes(threshold_bytes)
+    first = default_first_bucket_bytes(first_bucket_bytes)
+    split = _top_level_children(params)
+    if split is None:
+        return reduce_in_backward(
+            params, op=op, axis_name=axis_name, threshold_bytes=threshold,
+            hierarchical=hierarchical, compression=compression,
+            label="stream:g0",
+        )
+    children, rebuild = split
+    groups = plan_layer_groups(
+        [_tree_bytes(c) for c in children], threshold, first
+    )
+    if _metrics.ACTIVE:
+        _metrics.TAP.set("hvd_overlap_groups", float(len(groups)))
+    wrapped = list(children)
+    for gi, group in enumerate(groups):
+        sub = {str(i): children[i] for i in group}
+        sub = reduce_in_backward(
+            sub, op=op, axis_name=axis_name, threshold_bytes=threshold,
+            hierarchical=hierarchical, compression=compression,
+            label=f"stream:g{gi}",
+        )
+        for i in group:
+            wrapped[i] = sub[str(i)]
+    return rebuild(wrapped)

@@ -145,6 +145,17 @@ class Timeline:
         if self._writer is not None:
             self._writer.enqueue(ev)
 
+    def metadata(self, name: str, args: dict) -> None:
+        """Emit a process-scoped metadata record (Chrome-trace "M" phase) —
+        run facts a trace reader needs to interpret timings, e.g. the XLA
+        perf-preset flags the run compiled under."""
+        with self._lock:
+            if self._writer is None:
+                return
+            self._emit(
+                {"name": name, "ph": "M", "pid": self._rank, "args": args}
+            )
+
     # --- public recording API ---
     def negotiate_start(self, tensor_name: str, op_name: str) -> None:
         self._dur_begin(tensor_name, NEGOTIATE_PREFIX + op_name)

@@ -15,6 +15,12 @@
 # job with HOROVOD_METRICS=1 whose driver /metrics exposition is scraped
 # mid-run and validated (per-op histograms from both ranks, RPC counter
 # families, elastic gauges). Budget: under 60s on CPU.
+#
+# Stage 4 (make overlap-smoke; skip with HVD_CI_SKIP_OVERLAP=1): the
+# structural overlap verifier — the MLP + transformer phase-B programs
+# compiled with overlap on/off on the virtual CPU mesh, asserting the
+# streamed build yields >=3 independent all-reduce groups interleaved
+# with compute by the scheduler (docs/overlap.md). Budget: under 60s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -37,4 +43,11 @@ if [ "${HVD_CI_SKIP_METRICS:-0}" != "1" ]; then
     python tools/metrics_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: metrics smoke scraped in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_OVERLAP:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/tpu_profile_overlap.py --structural --assert-overlap
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: overlap structure verified in ${elapsed}s"
 fi

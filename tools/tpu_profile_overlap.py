@@ -28,6 +28,31 @@ ICI bandwidth per link, 2 torus axes") rather than invented.
 Writes PROFILE_OVERLAP.json at the repo root plus the trace under
 profiles/overlap_trace/. `--platform cpu` runs the same flow on the
 virtual CPU mesh as a self-test (its numbers are not the deliverable).
+
+STRUCTURAL MODE (`--structural`, CPU, CI-grade): the overlap property the
+streamed-reduction path (docs/overlap.md) claims — N independent
+all-reduce ops whose operand cones are disjoint layer suffixes of the
+backward, interleaved with compute by the scheduler — is verifiable from
+HLO alone, no TPU needed. This mode builds the 3-layer-MLP and small-
+transformer phase-B programs with overlap on AND off on the virtual CPU
+mesh, parses the pre-optimization HLO into a def-use graph (the
+collective-combiner-free ground truth for independence) and the compiled
+HLO for schedule interleaving, and reports per program:
+
+ - independent_all_reduce_groups: gradient (non-scalar) all-reduces with
+   no other gradient all-reduce in their operand cone — the count of
+   collectives free to start as soon as their own layer suffix finishes;
+ - pairs_with_overlap: adjacent all-reduce pairs in the compiled
+   schedule with >=1 compute op (fusion/dot/convolution) between them —
+   the scheduler actually interleaving compute with the collectives;
+ - overlappable_compute_per_all_reduce: per gradient all-reduce, how
+   many compute ops are in NEITHER its operand nor its user cone (the
+   compute a latency-hiding scheduler may run during the transfer).
+
+Writes PROFILE_OVERLAP_PHASEB_default.json / _overlap.json; with
+`--assert-overlap` exits nonzero unless the overlap build of BOTH
+programs shows independent_all_reduce_groups >= 3 and
+pairs_with_overlap > 0 (the `make overlap-smoke` CI gate).
 """
 from __future__ import annotations
 
@@ -44,10 +69,12 @@ V5E_ICI_BYTES_PER_S = 4.5e10  # per link, unidirectional (scaling book)
 V5E_ICI_LINKS = 2             # one per torus axis usable by a 1D ring
 
 
-def _model_and_step(tx, fusion_bytes=None):
+def _model_and_step(tx, fusion_bytes=None, overlap=False):
     """The ONE model + loss + train-step definition both phases measure
     — factoring it is what guarantees phase A (timed on the chip) and
-    phase B (AOT schedule inspection) describe the same program."""
+    phase B (AOT schedule inspection) describe the same program.
+    ``overlap=True`` swaps the post-hoc fused psum for the streamed
+    in-backward bucket reduction (docs/overlap.md)."""
     import jax
     import optax
 
@@ -73,10 +100,21 @@ def _model_and_step(tx, fusion_bytes=None):
     )
 
     def full_step(p, bs, s, x, y):
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            p, bs, x, y
-        )
-        grads = hvdj.allreduce_gradients(grads, **ar_kw)
+        if overlap:
+            def streamed_loss(p_, bs_, x_, y_):
+                p_ = hvdj.stream_param_groups(
+                    p_, threshold_bytes=fusion_bytes
+                )
+                return loss_fn(p_, bs_, x_, y_)
+
+            (loss, new_bs), grads = jax.value_and_grad(
+                streamed_loss, has_aux=True
+            )(p, bs, x, y)
+        else:
+            (loss, new_bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(p, bs, x, y)
+            grads = hvdj.allreduce_gradients(grads, **ar_kw)
         new_bs = jax.tree.map(lambda v: jax.lax.pmean(v, "data"), new_bs)
         updates, s = tx.update(grads, s, p)
         p = optax.apply_updates(p, updates)
@@ -275,10 +313,19 @@ def phase_b(args):
                 ).mean()
 
             def full_step(p, s, tok, lab):
-                loss, grads = jax.value_and_grad(lm_loss)(p, tok, lab)
-                grads = hvdj.allreduce_gradients(
-                    grads, fusion_threshold_bytes=fusion_bytes
-                )
+                if args.overlap:
+                    def streamed(p_, tok_, lab_):
+                        p_ = hvdj.stream_param_groups(
+                            p_, threshold_bytes=fusion_bytes
+                        )
+                        return lm_loss(p_, tok_, lab_)
+
+                    loss, grads = jax.value_and_grad(streamed)(p, tok, lab)
+                else:
+                    loss, grads = jax.value_and_grad(lm_loss)(p, tok, lab)
+                    grads = hvdj.allreduce_gradients(
+                        grads, fusion_threshold_bytes=fusion_bytes
+                    )
                 updates, s = tx.update(grads, s, p)
                 p = optax.apply_updates(p, updates)
                 return p, s, jax.lax.pmean(loss, "data")
@@ -299,7 +346,7 @@ def phase_b(args):
         else:
             tx = optax.sgd(0.01, momentum=0.9)
             model, _, full_step = _model_and_step(
-                tx, fusion_bytes=fusion_bytes
+                tx, fusion_bytes=fusion_bytes, overlap=args.overlap
             )
             img_aval = jax.ShapeDtypeStruct(
                 (global_batch, args.image_size, args.image_size, 3),
@@ -328,6 +375,11 @@ def phase_b(args):
         opts = {}
         if args.latency_hiding:
             opts["xla_tpu_enable_latency_hiding_scheduler"] = "true"
+        if args.preset:
+            from horovod_tpu.common.env import resolve_perf_preset
+
+            _pname, _pflags = resolve_perf_preset(args.preset)
+            opts.update(_pflags)
         for kv in args.compiler_opt:
             k, _, v = kv.partition("=")
             opts[k] = v
@@ -343,6 +395,7 @@ def phase_b(args):
         "status": "ok",
         "model": args.model,
         "fusion_mb": args.fusion_mb,
+        "overlap": bool(args.overlap),
         "latency_hiding_flag": bool(args.latency_hiding),
         "compiler_opts": sorted(opts),
         **_schedule_overlap_stats(hlo),
@@ -391,6 +444,330 @@ def _schedule_overlap_stats(hlo: str) -> dict:
     }
 
 
+# --- structural overlap verification (CPU, CI) ------------------------------
+
+_AR_RE = None
+
+
+def _parse_hlo(text: str):
+    """Parse HLO text into {computation: [(name, rhs)]} — enough for a
+    def-use graph: instruction names are unique within a computation and
+    every operand reference reuses the defined name. Handles both printer
+    styles: bare pre-optimization (``region_0.25 {`` / ``all-reduce.171 =
+    ...``) and %-prefixed compiled (``%fused_computation (p: f32[..]) ->
+    ... {`` / ``%all-reduce.8 = ...``)."""
+    import re
+
+    comp_re = re.compile(r"^(?:ENTRY\s+)?(%?[A-Za-z_][\w.\-]*)")
+    inst_re = re.compile(r"^\s*(?:ROOT\s+)?(%?[A-Za-z_][\w.\-]*)\s*=\s*(.*)$")
+    comment_re = re.compile(r"/\*.*?\*/")
+    comps = {}
+    cur = None
+    for line in text.splitlines():
+        stripped = comment_re.sub("", line).strip()
+        if (
+            stripped.endswith("{")
+            and "=" not in stripped
+            and not stripped.startswith("HloModule")
+        ):
+            m = comp_re.match(stripped)
+            if m:
+                cur = m.group(1)
+                comps[cur] = []
+            continue
+        if stripped.startswith("}"):
+            cur = None
+            continue
+        if cur is None:
+            continue
+        m = inst_re.match(line)
+        if m:
+            comps[cur].append((m.group(1), m.group(2)))
+    return comps
+
+
+def _reach(start, edges):
+    """Transitive closure from one node over an adjacency dict."""
+    seen, stack = set(), [start]
+    while stack:
+        n = stack.pop()
+        for d in edges.get(n, ()):
+            if d not in seen:
+                seen.add(d)
+                stack.append(d)
+    return seen
+
+
+def _dependency_stats(pre_hlo: str) -> dict:
+    """Independence analysis on PRE-OPTIMIZATION HLO (before any collective
+    combiner / scheduler pass): which all-reduces depend only on their own
+    layer suffix, and how much compute is in neither their operand nor
+    their user cone."""
+    import re
+
+    token_re = re.compile(r"%?[A-Za-z_][\w.\-]*")
+    ar_re = re.compile(r"\ball-reduce(?:-start)?\(")
+    scalar_re = re.compile(r"^\(?\s*\w+\[\]")
+    compute_re = re.compile(r"=?\s*.*\b(dot|convolution|fusion)\(")
+
+    total = {
+        "all_reduce_count": 0,
+        "scalar_all_reduce_count": 0,
+        "independent_all_reduce_groups": 0,
+        "overlappable_compute_per_all_reduce": [],
+    }
+    for insts in _parse_hlo(pre_hlo).values():
+        defined = {name: rhs for name, rhs in insts}
+        deps = {}
+        for name, rhs in insts:
+            deps[name] = {
+                t for t in token_re.findall(rhs)
+                if t in defined and t != name
+            }
+        rdeps = {}
+        for name, ds in deps.items():
+            for d in ds:
+                rdeps.setdefault(d, set()).add(name)
+        ars = [n for n, r in insts if ar_re.search(r)]
+        if not ars:
+            continue
+        grad_ars = [n for n in ars if not scalar_re.match(defined[n])]
+        total["all_reduce_count"] += len(grad_ars)
+        total["scalar_all_reduce_count"] += len(ars) - len(grad_ars)
+        compute = {
+            n for n, r in insts
+            if compute_re.search(r) and not ar_re.search(r)
+        }
+        for ar in grad_ars:
+            anc = _reach(ar, deps)
+            if not any(o in anc for o in grad_ars if o != ar):
+                total["independent_all_reduce_groups"] += 1
+            desc = _reach(ar, rdeps)
+            total["overlappable_compute_per_all_reduce"].append(
+                len(compute - anc - desc)
+            )
+    return total
+
+
+def _interleave_stats(compiled_hlo: str) -> dict:
+    """Schedule interleaving from COMPILED HLO text (printed in schedule
+    order on the sequential CPU backend): compute ops the scheduler placed
+    between consecutive all-reduces."""
+    import re
+
+    ar_re = re.compile(r"=\s*.*\ball-reduce(?:-start)?\(")
+    compute_re = re.compile(r"=\s*.*\b(fusion|dot|convolution)\(")
+    best = {"compiled_all_reduce_count": 0, "pairs_with_overlap": 0,
+            "interleaved_compute_ops": 0}
+    for insts in _parse_hlo(compiled_hlo).values():
+        positions = []
+        compute_pos = []
+        for i, (_, rhs) in enumerate(insts):
+            if ar_re.search("= " + rhs):
+                positions.append(i)
+            elif compute_re.search("= " + rhs):
+                compute_pos.append(i)
+        if len(positions) < best["compiled_all_reduce_count"]:
+            continue
+        pairs = 0
+        inter = 0
+        for a, b in zip(positions, positions[1:]):
+            between = sum(1 for c in compute_pos if a < c < b)
+            inter += between
+            if between:
+                pairs += 1
+        best = {
+            "compiled_all_reduce_count": len(positions),
+            "pairs_with_overlap": pairs,
+            "interleaved_compute_ops": inter,
+        }
+    return best
+
+
+def _structural_stats(lowered) -> dict:
+    pre = lowered.compiler_ir(dialect="hlo").as_hlo_text()
+    compiled = lowered.compile().as_text()
+    out = _dependency_stats(pre)
+    out.update(_interleave_stats(compiled))
+    out["overlap_eligible_all_reduces"] = sum(
+        1 for c in out["overlappable_compute_per_all_reduce"] if c > 0
+    )
+    return out
+
+
+def _structural_mlp(overlap: bool):
+    """The 3-layer MLP phase-B program. The default build runs the
+    post-hoc path at the reference 64 MB fusion threshold — one bucket,
+    one barrier-like all-reduce depending on the whole backward ("vs 1
+    today"). The overlap build streams with a 64 KB first bucket and a
+    1 MB threshold so the 1 MB fp32 layers each become a streamed group."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    D = 512
+    mesh = build_mesh()
+    n = len(jax.devices())
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = x
+        for i in range(3):
+            h = jnp.tanh(h @ params[f"layer{i}"]["w"] + params[f"layer{i}"]["b"])
+        return jnp.mean((h - y) ** 2)
+
+    tx = optax.sgd(0.01)
+    kw = (
+        dict(fusion_threshold_bytes=1 << 20, first_bucket_bytes=1 << 16)
+        if overlap else {}
+    )
+    step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=overlap, **kw,
+    )
+    params_aval = {
+        f"layer{i}": {
+            "w": jax.ShapeDtypeStruct((D, D), jnp.float32),
+            "b": jax.ShapeDtypeStruct((D,), jnp.float32),
+        }
+        for i in range(3)
+    }
+    opt_aval = jax.eval_shape(tx.init, params_aval)
+    batch_aval = (
+        jax.ShapeDtypeStruct((2 * n, D), jnp.float32),
+        jax.ShapeDtypeStruct((2 * n, D), jnp.float32),
+    )
+    return step.lower(params_aval, opt_aval, batch_aval)
+
+
+def _structural_transformer(overlap: bool):
+    """A small fp32 TransformerLM phase-B program (dense attention — the
+    Pallas interpreter would bury the backward in while loops and hide the
+    compute from the structural counters)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    T = 64
+    n = len(jax.devices())
+    mesh = build_mesh()
+
+    def dense_attn(q, k, v):
+        B, S, H, D = q.shape
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / jnp.sqrt(
+            jnp.asarray(D, q.dtype)
+        )
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+    model = TransformerLM(
+        vocab_size=512, d_model=128, n_heads=4, n_layers=3, max_len=T,
+        dtype=jnp.float32, attn_fn=dense_attn,
+    )
+
+    def loss_fn(params, batch):
+        tokens, labels = batch
+        logits = model.apply({"params": params}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    tx = optax.sgd(0.01)
+    kw = (
+        dict(fusion_threshold_bytes=256 << 10, first_bucket_bytes=16 << 10)
+        if overlap else {}
+    )
+    step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=overlap, **kw,
+    )
+    params_aval = jax.eval_shape(
+        lambda r, t: model.init(r, t)["params"],
+        jax.ShapeDtypeStruct((2,), jnp.uint32),
+        jax.ShapeDtypeStruct((1, T), jnp.int32),
+    )
+    opt_aval = jax.eval_shape(tx.init, params_aval)
+    tok_aval = jax.ShapeDtypeStruct((2 * n, T), jnp.int32)
+    return step.lower(params_aval, opt_aval, (tok_aval, tok_aval))
+
+
+def structural_mode(args) -> int:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    results = {}
+    for mode, overlap in (("default", False), ("overlap", True)):
+        t0 = time.time()
+        per = {}
+        for prog, builder in (
+            ("mlp3", _structural_mlp),
+            ("transformer", _structural_transformer),
+        ):
+            per[prog] = _structural_stats(builder(overlap))
+            print(
+                f"[overlap] structural {mode}/{prog}: "
+                f"independent_groups={per[prog]['independent_all_reduce_groups']} "
+                f"pairs_with_overlap={per[prog]['pairs_with_overlap']}",
+                flush=True,
+            )
+        results[mode] = {
+            "captured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "phase_b": {
+                "status": "ok",
+                "kind": "cpu-structural",
+                "overlap": overlap,
+                "elapsed_s": round(time.time() - t0, 2),
+                **per,
+            },
+        }
+        path = os.path.join(REPO, f"PROFILE_OVERLAP_PHASEB_{mode}.json")
+        with open(path, "w") as f:
+            json.dump(results[mode], f, indent=1)
+        print(f"[overlap] wrote {path}")
+
+    if args.assert_overlap:
+        failed = []
+        for prog in ("mlp3", "transformer"):
+            st = results["overlap"]["phase_b"][prog]
+            if st["independent_all_reduce_groups"] < 3:
+                failed.append(
+                    f"{prog}: independent_all_reduce_groups="
+                    f"{st['independent_all_reduce_groups']} < 3"
+                )
+            if st["pairs_with_overlap"] < 1:
+                failed.append(f"{prog}: pairs_with_overlap=0")
+            base = results["default"]["phase_b"][prog]
+            if st["independent_all_reduce_groups"] <= base[
+                "independent_all_reduce_groups"
+            ]:
+                failed.append(
+                    f"{prog}: overlap groups not > default "
+                    f"({st['independent_all_reduce_groups']} vs "
+                    f"{base['independent_all_reduce_groups']})"
+                )
+        if failed:
+            print("[overlap] STRUCTURAL ASSERTIONS FAILED:", file=sys.stderr)
+            for f in failed:
+                print(f"  {f}", file=sys.stderr)
+            return 5
+        print("[overlap] structural assertions passed")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--platform", default="tpu", choices=["tpu", "cpu"])
@@ -409,18 +786,40 @@ def main() -> int:
     ap.add_argument("--latency-hiding", action="store_true",
                     help="compile phase B with the TPU latency-hiding "
                          "scheduler / async collectives enabled")
+    ap.add_argument("--preset", default=None,
+                    choices=["off", "overlap", "auto"],
+                    help="apply a HOROVOD_XLA_PERF_PRESET flag set as "
+                         "phase B compiler options (common/env.py)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="build the phase B step with overlap=True "
+                         "(streamed in-backward bucket reduction, "
+                         "docs/overlap.md) instead of the post-hoc path")
     ap.add_argument("--compiler-opt", action="append", default=[],
                     help="extra XLA option for phase B as key=value "
                          "(repeatable)")
     ap.add_argument("--dump-hlo", default=None,
                     help="write phase B's optimized HLO text here")
     ap.add_argument("--skip-phase-b", action="store_true")
+    ap.add_argument("--structural", action="store_true",
+                    help="CPU structural verification: compile the MLP + "
+                         "transformer phase-B programs with overlap "
+                         "on/off, analyze HLO dependence + schedule, "
+                         "write PROFILE_OVERLAP_PHASEB_{default,overlap}"
+                         ".json")
+    ap.add_argument("--assert-overlap", action="store_true",
+                    help="with --structural: exit nonzero unless the "
+                         "overlap build shows >=3 independent all-reduce "
+                         "groups and scheduler-interleaved pairs for both "
+                         "programs (the overlap-smoke CI gate)")
     ap.add_argument(
         "--phase-b-only", action="store_true",
         help="Topology AOT schedule inspection only — works with the "
              "tunnel DOWN (topology descriptions are served offline).",
     )
     args = ap.parse_args()
+
+    if args.structural:
+        return structural_mode(args)
 
     if args.phase_b_only:
         # Keep any stray concrete-array op off the axon backend (a dead
