@@ -1012,38 +1012,74 @@ ResponseList Core::Coordinate(std::vector<RequestList>& lists) {
         auto& neg = it->second;
         // Validation — reference ConstructResponse semantics: dtype, op
         // type, shape (exact for allreduce/broadcast, non-0 dims for
-        // allgather), root consistency.
+        // allgather), root + reduce-op consistency. Error messages name
+        // the tensor AND the conflicting ranks (the first announcer vs
+        // the contradicting one) so an abort is actionable without a
+        // debugger on every host (docs/fault_tolerance.md).
         const Request& first = neg.request;
         // (Cross-set same-name requests can never meet here: NegKey embeds
         // the process_set_id, so they negotiate as distinct tensors.)
+        auto shape_str = [](const Request& r) {
+          std::string s = "[";
+          for (size_t d = 0; d < r.shape.size(); ++d) {
+            if (d) s += ",";
+            s += std::to_string(r.shape[d]);
+          }
+          return s + "]";
+        };
+        auto ranks_str = [&](const std::string& what_first,
+                             const std::string& what_req) {
+          return " (rank " + std::to_string(first.rank) + " announced " +
+                 what_first + ", rank " + std::to_string(req.rank) +
+                 " announced " + what_req + ")";
+        };
         if (req.type != first.type) {
           neg.error = true;
           neg.error_msg = "Mismatched collective operations for tensor " +
-                          req.name;
+                          req.name +
+                          ranks_str(TypeName(first.type), TypeName(req.type));
         } else if (req.dtype != first.dtype) {
           neg.error = true;
-          neg.error_msg = "Mismatched data types for tensor " + req.name;
+          neg.error_msg =
+              "Mismatched data types for tensor " + req.name +
+              ranks_str("dtype " +
+                            std::to_string(static_cast<int>(first.dtype)),
+                        "dtype " +
+                            std::to_string(static_cast<int>(req.dtype)));
         } else if (req.type == RequestType::kBroadcast &&
                    req.root_rank != first.root_rank) {
           neg.error = true;
-          neg.error_msg = "Mismatched root ranks for broadcast " + req.name;
+          neg.error_msg =
+              "Mismatched root ranks for broadcast " + req.name +
+              ranks_str("root " + std::to_string(first.root_rank),
+                        "root " + std::to_string(req.root_rank));
+        } else if ((req.type == RequestType::kAllreduce ||
+                    req.type == RequestType::kAdasum) &&
+                   req.reduce_op != first.reduce_op) {
+          neg.error = true;
+          neg.error_msg =
+              "Mismatched reduce operations for tensor " + req.name +
+              ranks_str("op " + std::to_string(first.reduce_op),
+                        "op " + std::to_string(req.reduce_op));
         } else if (req.type == RequestType::kAllgather) {
           if (req.shape.size() != first.shape.size()) {
             neg.error = true;
-            neg.error_msg = "Mismatched ranks for allgather " + req.name;
+            neg.error_msg = "Mismatched ranks for allgather " + req.name +
+                            ranks_str(shape_str(first), shape_str(req));
           } else {
             for (size_t d = 1; d < req.shape.size(); ++d) {
               if (req.shape[d] != first.shape[d]) {
                 neg.error = true;
                 neg.error_msg =
                     "Mismatched non-first dimensions for allgather " +
-                    req.name;
+                    req.name + ranks_str(shape_str(first), shape_str(req));
               }
             }
           }
         } else if (req.shape != first.shape) {
           neg.error = true;
-          neg.error_msg = "Mismatched shapes for tensor " + req.name;
+          neg.error_msg = "Mismatched shapes for tensor " + req.name +
+                          ranks_str(shape_str(first), shape_str(req));
         }
         neg.ranks.insert(req.rank);
         if (req.type == RequestType::kAllgather) {

@@ -30,6 +30,11 @@ RULE_FUSION_BUDGET = "fusion-over-budget"
 # (or only partially) registered for streamed reduction — the silent
 # fallback/unreduced-gradient hazard (docs/overlap.md).
 RULE_OVERLAP_STREAMING = "overlap-no-streaming"
+# Streamed-overlap step traced under HOROVOD_GUARD_NONFINITE=skip without
+# the cross-rank skip-agreement collective (guard/nonfinite.agree_flag):
+# ranks could disagree about skipping a step and silently diverge
+# (docs/fault_tolerance.md "Data-plane integrity").
+RULE_GUARD_SKIP_AGREEMENT = "guard-skip-no-agreement"
 
 # --- rule ids (Pass 2: runtime thread-safety lint) ---
 RULE_UNGUARDED = "unguarded-shared-state"
@@ -44,6 +49,7 @@ ALL_RULES = (
     RULE_GROUP_BUDGET,
     RULE_FUSION_BUDGET,
     RULE_OVERLAP_STREAMING,
+    RULE_GUARD_SKIP_AGREEMENT,
     RULE_UNGUARDED,
 )
 

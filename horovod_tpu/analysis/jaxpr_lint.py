@@ -358,9 +358,22 @@ def lint_step(
 ) -> List[Finding]:
     """Trace ``fn(*args)`` to a jaxpr and lint it. A trace-time unbound
     axis (jax's own NameError) is converted into an ``unknown-axis``
-    finding instead of propagating, so the CLI reports it uniformly."""
+    finding instead of propagating, so the CLI reports it uniformly.
+
+    The trace also feeds the guard-skip-agreement rule: the streamed
+    registration and skip-agreement-seam ledgers are drained before and
+    consumed after, so a step using streamed overlap under
+    ``HOROVOD_GUARD_NONFINITE=skip`` without the agreement collective is
+    flagged (docs/fault_tolerance.md)."""
     import jax
 
+    from ..guard import nonfinite as _nf
+    from ..ops import fusion as _fusion
+    from .preflight import check_guard_skip_agreement
+
+    # Drain stale ledgers so this trace's counts are its own.
+    _fusion.take_stream_registrations()
+    _nf.take_seam_registrations()
     try:
         closed = jax.make_jaxpr(fn)(*args)
     except NameError as exc:
@@ -375,6 +388,12 @@ def lint_step(
                 details={"exception": str(exc)},
             )
         ]
-    return lint_jaxpr(
+    stream_calls = _fusion.take_stream_registrations()["calls"]
+    seam_calls = _nf.take_seam_registrations()
+    findings = lint_jaxpr(
         closed, mesh=mesh, fusion_threshold_bytes=fusion_threshold_bytes
     )
+    findings.extend(
+        check_guard_skip_agreement(stream_calls, seam_calls)
+    )
+    return findings

@@ -163,6 +163,45 @@ def check_overlap_streaming(
     return findings
 
 
+def check_guard_skip_agreement(
+    stream_calls: int, seam_calls: int, policy: Optional[str] = None
+) -> List[Finding]:
+    """Lint for streamed-overlap training under the non-finite ``skip``
+    policy: a step that registers subtrees for streamed reduction but
+    never emits the cross-rank skip-agreement collective
+    (``guard/nonfinite.agree_flag``) lets ranks disagree about whether a
+    step was skipped — the divergence the digest guard exists to catch,
+    manufactured by the guard itself. ``make_train_step`` and
+    ``DistributedOptimizer`` always emit the seam; the rule catches
+    hand-rolled steps using ``reduce_in_backward`` with their own update
+    logic. ``policy=None`` resolves ``HOROVOD_GUARD_NONFINITE``."""
+    from ..guard import resolve_policy
+    from .findings import RULE_GUARD_SKIP_AGREEMENT
+
+    if resolve_policy(policy) != "skip":
+        return []
+    if stream_calls <= 0 or seam_calls > 0:
+        return []
+    return [
+        Finding(
+            rule=RULE_GUARD_SKIP_AGREEMENT,
+            severity=SEVERITY_ERROR,
+            message=(
+                "HOROVOD_GUARD_NONFINITE=skip with streamed-overlap "
+                "reduction but NO cross-rank skip-agreement collective "
+                "was traced — ranks can disagree about skipping a step "
+                "and silently diverge; route the update through "
+                "hvd.DistributedOptimizer / hvd.make_train_step (which "
+                "emit the agreement seam), or call "
+                "guard.nonfinite.agree_flag on your skip flag"
+            ),
+            location="preflight:guard-skip",
+            details={"stream_calls": int(stream_calls),
+                     "seam_calls": int(seam_calls)},
+        )
+    ]
+
+
 # --- eager checks ---
 def check_grouped(
     tensors: Sequence[Any], threshold_bytes: Optional[int], name: str

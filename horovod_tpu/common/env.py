@@ -93,6 +93,14 @@ HOROVOD_METRICS_PUSH_INTERVAL_S = "HOROVOD_METRICS_PUSH_INTERVAL_S"
 # Respawn-mode data-loss guard: fail (instead of loudly warning) when a
 # restart generation > 1 finds no restored snapshot on any rank.
 HOROVOD_ELASTIC_REQUIRE_SNAPSHOT = "HOROVOD_ELASTIC_REQUIRE_SNAPSHOT"
+# Data-plane integrity guard (docs/fault_tolerance.md "Data-plane
+# integrity"; horovod_tpu/guard reads these directly, like the fault and
+# metrics knobs): non-finite gradient policy (off|warn|zero|skip|abort),
+# parameter-digest agreement cadence in commits (0 = off), and what a
+# digest mismatch without an agreeing majority does (rollback|root).
+HOROVOD_GUARD_NONFINITE = "HOROVOD_GUARD_NONFINITE"
+HOROVOD_GUARD_DIGEST_STEPS = "HOROVOD_GUARD_DIGEST_STEPS"
+HOROVOD_GUARD_NO_QUORUM = "HOROVOD_GUARD_NO_QUORUM"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64

@@ -24,6 +24,7 @@ from ..common.basics import NativeCore, _CoreError
 from ..common.env import Config
 from ..common.topology import Topology
 from ..fault import injector as _fault
+from .. import guard as _guard
 from .. import metrics as _metrics
 from ..common.types import (
     DataType,
@@ -213,6 +214,15 @@ class NativeRuntime:
             # Chaos tap, same site name as the pure-Python runtime so one
             # fault plan drives either core (docs/fault_tolerance.md).
             _fault.fault_point("enqueue", name)
+            # Payload tap: scheduled nan/corrupt mutates the tensor
+            # BEFORE the guard sentinel, exercising detection end-to-end.
+            tensor = _fault.payload_fault("payload", name, tensor)
+        if _guard.ACTIVE and request_type in (
+            RequestType.ALLREDUCE, RequestType.ADASUM
+        ):
+            # Non-finite sentinel, same semantics as the pure-Python
+            # runtime (docs/fault_tolerance.md "Data-plane integrity").
+            tensor = _guard.TAP.check_payload(name, tensor)
         entry = TensorTableEntry(
             name=name,
             tensor=tensor,
@@ -428,8 +438,16 @@ class NativeRuntime:
         error = ""
         outputs: Dict[str, Any] = {}
         if plan["type"] == _PLAN_ERROR:
-            status_code = int(StatusType.PRECONDITION_ERROR)
+            # Coordinator-detected conflict (mismatched metadata across
+            # ranks, poisoned group): a named ABORT — the same status
+            # class as the stall ladder — so waiters raise
+            # HorovodInternalError and the elastic layer resets through
+            # the usual drain instead of treating it as a local bug.
+            status_code = int(StatusType.ABORTED)
             error = plan.get("error", "coordinator reported an error")
+            logger.error("coordinator abort: %s", error)
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_guard_metadata_aborts_total")
         elif plan["type"] == _PLAN_JOIN:
             pass
         else:
@@ -450,6 +468,15 @@ class NativeRuntime:
                 logger.exception("plan execution failed")
                 status_code = int(StatusType.UNKNOWN_ERROR)
                 error = str(exc)
+        if _fault.ACTIVE and status_code == 0:
+            # Output payload tap: a scheduled corrupt bit-flips THIS
+            # rank's result only — the SDC model the parameter-digest
+            # guard detects and heals (docs/fault_tolerance.md).
+            for entry in entries:
+                if entry.name in outputs:
+                    outputs[entry.name] = _fault.payload_fault(
+                        "output", entry.name, outputs[entry.name]
+                    )
         duration = time.perf_counter() - t0
         status = (
             Status.OK()

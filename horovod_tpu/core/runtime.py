@@ -33,6 +33,7 @@ import numpy as np
 from ..common.env import Config
 from ..common.topology import Topology
 from ..fault import injector as _fault
+from .. import guard as _guard
 from .. import metrics as _metrics
 from ..common.types import (
     DUPLICATE_NAME_ERROR_FMT,
@@ -100,6 +101,114 @@ class Response:
     response_type: ResponseType
     tensor_names: List[str] = field(default_factory=list)
     error_message: str = ""
+
+
+def describe_request(req: "Request") -> str:
+    """Human-readable announcement signature for conflict messages."""
+    from ..common.types import DataType
+
+    try:
+        dtype = DataType(req.dtype).name.lower()
+    except ValueError:
+        dtype = str(req.dtype)
+    parts = [
+        req.request_type.name.lower(), f"dtype={dtype}",
+        f"shape={tuple(req.shape)}",
+    ]
+    if req.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM):
+        parts.append(f"op={ReduceOp(req.reduce_op).name}")
+    if req.request_type == RequestType.BROADCAST:
+        parts.append(f"root={req.root_rank}")
+    if req.process_set_id:
+        parts.append(f"process_set={req.process_set_id}")
+    return " ".join(parts)
+
+
+class NegotiationTable:
+    """Cross-rank metadata validation (the coordinator half of upstream's
+    ``Controller::ConstructResponse`` error checks, docs/fault_tolerance.md
+    "Data-plane integrity").
+
+    Each announcement of a tensor name is checked against the first one
+    seen: conflicting operation type, dtype, shape (exact for
+    allreduce/broadcast/alltoall, non-first dimensions for allgather),
+    broadcast root, reduce op, or process set returns an error message
+    NAMING THE TENSOR AND BOTH RANKS — the coordinator turns it into an
+    aborted response instead of fusing garbage or stalling until the
+    inspector's timeout. The native core performs the same checks on its
+    own coordinator thread (cpp/src/core.cc Coordinate); this table is
+    the pure-Python seam, also usable offline to validate simulated
+    per-rank submission sets."""
+
+    def __init__(self):
+        self._first: Dict[str, Request] = {}
+
+    def clear(self, names: Sequence[str]) -> None:
+        for n in names:
+            self._first.pop(n, None)
+
+    def observe(self, req: Request) -> Optional[str]:
+        """Record one announcement; returns a conflict message when it
+        contradicts an earlier announcement of the same tensor."""
+        if req.request_type == RequestType.JOIN:
+            return None
+        first = self._first.get(req.tensor_name)
+        if first is None:
+            self._first[req.tensor_name] = req
+            return None
+        if first.rank == req.rank:
+            # Same rank re-announcing (its previous incarnation completed
+            # and was cleared, or a legal per-cycle repeat): re-key so the
+            # freshest metadata is what later ranks validate against.
+            self._first[req.tensor_name] = req
+            return None
+
+        def conflict(kind: str) -> str:
+            return (
+                f"{kind} for tensor '{req.tensor_name}': rank "
+                f"{first.rank} announced [{describe_request(first)}] but "
+                f"rank {req.rank} announced [{describe_request(req)}]"
+            )
+
+        if req.process_set_id != first.process_set_id:
+            return conflict("Mismatched process sets")
+        if req.request_type != first.request_type:
+            return conflict("Mismatched collective operations")
+        if req.dtype != first.dtype:
+            return conflict("Mismatched data types")
+        if (req.request_type == RequestType.BROADCAST
+                and req.root_rank != first.root_rank):
+            return conflict("Mismatched root ranks")
+        if (req.request_type in (RequestType.ALLREDUCE, RequestType.ADASUM)
+                and req.reduce_op != first.reduce_op):
+            return conflict("Mismatched reduce operations")
+        if req.request_type == RequestType.ALLGATHER:
+            if (len(req.shape) != len(first.shape)
+                    or req.shape[1:] != first.shape[1:]):
+                return conflict("Mismatched allgather dimensions")
+        elif tuple(req.shape) != tuple(first.shape):
+            return conflict("Mismatched shapes")
+        return None
+
+    def validate(self, requests: Sequence[Request]) -> List[Response]:
+        """Observe a batch of announcements (possibly spanning ranks) and
+        emit one aborted-error Response per conflicting tensor."""
+        out: List[Response] = []
+        failed: set = set()
+        for req in requests:
+            if req.tensor_name in failed:
+                continue
+            msg = self.observe(req)
+            if msg is not None:
+                failed.add(req.tensor_name)
+                out.append(
+                    Response(
+                        ResponseType.ERROR, [req.tensor_name],
+                        error_message=msg,
+                    )
+                )
+                self._first.pop(req.tensor_name, None)
+        return out
 
 
 class TensorQueue:
@@ -503,6 +612,10 @@ class Runtime:
         self.handle_manager = HandleManager()
         self.timeline = Timeline()
         self.stall_inspector = StallInspector(config)
+        # Cross-rank metadata validation: announcements that contradict an
+        # earlier one (shape/dtype/op/root/reduce-op/process-set) abort
+        # with tensor + ranks named instead of fusing garbage or stalling.
+        self.negotiation = NegotiationTable()
         self.joined = False
         # Status used for the final queue drain; replaced with a named
         # abort when the stall ladder (not a user shutdown) kills the
@@ -610,6 +723,17 @@ class Runtime:
             # submissions (docs/fault_tolerance.md). Inactive → not
             # reached (the ACTIVE check is the whole overhead).
             _fault.fault_point("enqueue", name)
+            # Payload tap: a scheduled nan/corrupt mutates the tensor
+            # BEFORE the guard sentinel below, so the seeded chaos runs
+            # exercise detection end-to-end.
+            tensor = _fault.payload_fault("payload", name, tensor)
+        if _guard.ACTIVE and request_type in (
+            RequestType.ALLREDUCE, RequestType.ADASUM
+        ):
+            # Non-finite sentinel (docs/fault_tolerance.md): one rank's
+            # NaN/Inf would silently poison every replica through the
+            # reduce. Disabled → not reached, same discipline as above.
+            tensor = _guard.TAP.check_payload(name, tensor)
         handle = self.handle_manager.allocate(name)
 
         def _done(status: Status, output: Any) -> None:
@@ -711,6 +835,20 @@ class Runtime:
             self.timeline.mark_cycle_start()
         requests = self.tensor_queue.pop_requests()
         self.stall_inspector.record([r.tensor_name for r in requests])
+        # Metadata validation BEFORE negotiation: a conflicting
+        # announcement aborts its waiters now (naming tensor + ranks)
+        # rather than fusing garbage or stalling to the inspector's
+        # timeout. Failed requests never reach the coordinator.
+        error_responses = self.negotiation.validate(requests)
+        if error_responses:
+            failed = {
+                n for r in error_responses for n in r.tensor_names
+            }
+            requests = [
+                r for r in requests if r.tensor_name not in failed
+            ]
+            for response in error_responses:
+                self._perform_operation(response)
         responses = self.coordinator.compute_response_list(
             requests, self.tensor_queue, self.config
         )
@@ -791,6 +929,7 @@ class Runtime:
             # Chaos tap: delay/abort a fused response before execution.
             _fault.fault_point("response", entries[0].name)
         self.stall_inspector.clear([e.name for e in entries])
+        self.negotiation.clear([e.name for e in entries])
         timeline_name = _REQ_TO_TIMELINE.get(
             RequestType(int(response.response_type))
             if int(response.response_type) <= int(RequestType.ADASUM)
@@ -812,7 +951,14 @@ class Runtime:
                     )
         exec_t0 = time.perf_counter() if _metrics.ACTIVE else 0.0
         if response.response_type == ResponseType.ERROR:
-            status = Status.PreconditionError(response.error_message)
+            # Coordinator-detected metadata conflict (or negotiation
+            # failure): a named ABORT, same status class as the stall
+            # ladder, so waiters raise HorovodInternalError and the
+            # elastic layer can reset through the usual drain.
+            status = Status.Aborted(response.error_message)
+            logger.error("%s", response.error_message)
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_guard_metadata_aborts_total")
         else:
             try:
                 status = self.data_plane.execute(response, entries, self.topology)
@@ -834,6 +980,12 @@ class Runtime:
         if self.timeline.initialized:
             for e in entries:
                 self.timeline.end(e.name, timeline_name)
+        if _fault.ACTIVE and status.ok():
+            # Output payload tap: a scheduled corrupt bit-flips THIS
+            # rank's result only — the SDC model the parameter-digest
+            # guard detects and heals.
+            for e in entries:
+                e.output = _fault.payload_fault("output", e.name, e.output)
         for entry in entries:
             if entry.callback is not None:
                 entry.callback(status, entry.output if status.ok() else None)

@@ -35,6 +35,7 @@ and re-synced from the new rank 0.
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import functools
 import json
@@ -44,6 +45,7 @@ import sys
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .. import guard as _guard
 from .. import metrics as _metrics
 from ..fault import injector as _fault_injector
 from ..fault import preemption as _preemption
@@ -416,7 +418,25 @@ def _maybe_restore_persisted(state: "State") -> bool:
         with open(path, "rb") as f:
             payload = pickle.load(f)
     except Exception as exc:  # noqa: BLE001 - torn write, stale format
-        logger.warning("elastic: unreadable persisted state (%s)", exc)
+        # Quarantine the broken snapshot instead of warning and
+        # re-reading the same bytes every generation: renamed aside it
+        # can never be retried (or mistaken for live state by a later
+        # respawn), while staying on disk for post-mortem.
+        quarantined = f"{path}.corrupt"
+        try:
+            os.replace(path, quarantined)
+            logger.error(
+                "elastic: unreadable persisted state (%s); quarantined "
+                "to %s — this slot resumes from a peer's snapshot",
+                exc, quarantined,
+            )
+        except OSError as mv_exc:
+            logger.warning(
+                "elastic: unreadable persisted state (%s); could not "
+                "quarantine it either (%s)", exc, mv_exc,
+            )
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_elastic_snapshot_quarantined_total")
         return False
     _apply_payload(state, payload)
     state.restore()
@@ -567,13 +587,34 @@ def _rejoin(ctx: _ElasticContext) -> None:
             time.sleep(0.5)
 
 
+_sync_root_override: Optional[int] = None
+
+
 def _sync_root() -> int:
     """Rank whose state is authoritative for the current generation: a
     survivor of the previous world (published by the driver), so a fresh
     respawn that happened to land on rank 0 can never broadcast its
-    just-constructed state over everyone's progress."""
+    just-constructed state over everyone's progress. The digest guard's
+    heal path overrides it transiently (``_sync_root_as``) to
+    re-broadcast from the agreeing quorum's reference rank."""
+    if _sync_root_override is not None:
+        return _sync_root_override
     ctx = _ctx()
     return ctx.sync_root if ctx is not None else 0
+
+
+@contextlib.contextmanager
+def _sync_root_as(root: int):
+    """Temporarily force the sync root (digest-guard healing): every rank
+    enters this context with the SAME root, so the broadcasts stay
+    collective."""
+    global _sync_root_override
+    prev = _sync_root_override
+    _sync_root_override = int(root)
+    try:
+        yield
+    finally:
+        _sync_root_override = prev
 
 
 # ----------------------------------------------------------------- state
@@ -585,6 +626,9 @@ class State:
 
     def __init__(self) -> None:
         self._reset_callbacks: List[Callable[[], None]] = []
+        # Commit counter for the parameter-digest guard
+        # (HOROVOD_GUARD_DIGEST_STEPS; docs/fault_tolerance.md).
+        self._guard_commits = 0
 
     def register_reset_callbacks(
         self, callbacks: List[Callable[[], None]]
@@ -602,8 +646,79 @@ class State:
             # Chaos tap: one commit == one training step; kill/preempt
             # actions with at_step target this counter.
             _fault_injector.fault_point("step")
+        if _guard.ACTIVE:
+            # Digest agreement BEFORE save(): a silently diverged replica
+            # must never become the rollback point. Heals in place (the
+            # heal's sync() snapshots) or raises for the elastic rollback.
+            self._guard_check_digest()
         self.save()
         self.check_host_updates()
+
+    def _guard_check_digest(self) -> None:
+        """Periodic cross-rank parameter-digest agreement
+        (docs/fault_tolerance.md "Data-plane integrity"): every
+        ``HOROVOD_GUARD_DIGEST_STEPS`` commits, hash the tracked state,
+        allgather the digests (bytes, not payloads), and on mismatch
+        self-heal — re-broadcast from the agreeing quorum's reference
+        rank, or roll back to the last commit when no quorum exists."""
+        steps = _guard.digest_steps()
+        if steps <= 0:
+            return
+        self._guard_commits += 1
+        if self._guard_commits % steps:
+            return
+        import horovod_tpu as hvd
+
+        if not hvd.is_initialized() or hvd.size() <= 1:
+            return
+        from ..guard import digest as _digest
+
+        mine = _digest.state_digest(self)
+        digests = hvd.allgather_object(mine, name="hvd.guard.digest")
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_guard_digest_checks_total")
+        ok, ref, outliers = _digest.find_quorum(
+            digests,
+            no_quorum=_guard.no_quorum_action(),
+            sync_root=_sync_root(),
+        )
+        if ok:
+            return
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_guard_digest_mismatches_total")
+        rt = getattr(hvd, "_runtime", None)
+        tl = getattr(rt, "timeline", None)
+        if tl is not None and getattr(tl, "initialized", False):
+            tl.metadata(
+                "hvd_guard_digest_mismatch",
+                {"outliers": outliers, "reference": ref},
+            )
+        if ref is None:
+            _guard.record_guard_event(
+                "digest-rollback", f"outliers={outliers}"
+            )
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_guard_rollbacks_total")
+            raise hvd.HorovodInternalError(
+                "parameter digest mismatch across ranks "
+                f"{outliers} with no agreeing quorum "
+                "(HOROVOD_GUARD_DIGEST_STEPS guard); rolling back to the "
+                "last commit"
+            )
+        _guard.record_guard_event(
+            "digest-heal", f"ref={ref} outliers={outliers}"
+        )
+        logger.error(
+            "digest guard: ranks %s diverged from the quorum; healing by "
+            "re-broadcast from rank %d", outliers, ref,
+        )
+        # Heal: every rank (agreeing and diverged alike) re-syncs from
+        # the reference — the broadcasts are collective. sync() also
+        # save()s, so the healed state becomes the new rollback point.
+        with _sync_root_as(ref):
+            self.sync()
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_guard_heals_total")
 
     def check_host_updates(self) -> None:
         """Raise ``HostsUpdatedInterrupt`` on EVERY rank when any rank has

@@ -31,6 +31,7 @@ from .injector import (  # noqa: F401
     events,
     fault_point,
     install_plan,
+    payload_fault,
     record_event,
     reset,
     step,
@@ -59,6 +60,7 @@ __all__ = [
     "fault_point",
     "install_plan",
     "install_sigterm_handler",
+    "payload_fault",
     "preemption_requested",
     "record_event",
     "request_preemption",

@@ -23,7 +23,12 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from .plan import FAULT_PLAN_ENV, FaultAction, FaultPlan
+from .plan import (
+    FAULT_PLAN_ENV,
+    FaultAction,
+    FaultPlan,
+    PAYLOAD_KINDS,
+)
 
 FAULT_EVENT_LOG_ENV = "HOROVOD_FAULT_EVENT_LOG"
 
@@ -93,6 +98,7 @@ def record_event(site: str, hit: int, action: str, detail: str = "") -> dict:
     """Append one deterministic event line (also used by the driver for
     its own scheduled injections)."""
     global _seq
+    rank, _, _ = _identity()
     with _lock:
         _seq += 1
         ev = {
@@ -101,6 +107,10 @@ def record_event(site: str, hit: int, action: str, detail: str = "") -> dict:
             "hit": hit,
             "action": action,
             "detail": detail,
+            # Per-process identity: a shared event-log file interleaves
+            # ranks nondeterministically, but each rank's OWN (rank, seq)
+            # subsequence is deterministic — that's what chaos runs diff.
+            "rank": rank,
         }
         _events.append(ev)
         path = os.environ.get(FAULT_EVENT_LOG_ENV, "")
@@ -168,6 +178,8 @@ def fault_point(site: str, name: Optional[str] = None) -> Optional[str]:
     for action in plan.actions:
         if action.site != site:
             continue
+        if action.kind in PAYLOAD_KINDS:
+            continue  # payload faults run through payload_fault()
         if not action.matches_process(rank, worker, gen):
             continue
         if not action.in_window(hit):
@@ -177,6 +189,92 @@ def fault_point(site: str, name: Optional[str] = None) -> Optional[str]:
         out = _execute(action, site, hit, name)
         directive = out or directive
     return directive
+
+
+def _mutate_payload(plan: FaultPlan, action: FaultAction, site: str,
+                    hit: int, name: str, tensor, rank):
+    """Apply one corrupt/nan action to a tensor payload. Returns a
+    mutated COPY (numpy) — the original array is never written through."""
+    import numpy as np
+
+    arr = np.array(np.asarray(tensor), copy=True)
+    if arr.size == 0:
+        return tensor
+    rng = plan._stream(action, rank)
+    if action.kind == "nan":
+        if not np.issubdtype(arr.dtype, np.floating):
+            return tensor  # integer payloads have no NaN to inject
+        idx = (action.element if action.element is not None
+               else rng.randrange(arr.size)) % arr.size
+        arr.flat[idx] = np.nan
+        record_event(site, hit, "nan", f"{name}[{idx}]")
+        return arr
+    # corrupt: flip one bit of one element — the SDC model. Flips land in
+    # the element's raw bytes, so exponent/sign corruption is possible
+    # (exactly the silent-divergence class the digest guard exists for).
+    itemsize = arr.dtype.itemsize
+    idx = (action.element if action.element is not None
+           else rng.randrange(arr.size)) % arr.size
+    bit = (action.bit if action.bit is not None
+           else rng.randrange(8 * itemsize)) % (8 * itemsize)
+    view = arr.reshape(-1).view(np.uint8)
+    view[idx * itemsize + bit // 8] ^= np.uint8(1 << (bit % 8))
+    record_event(site, hit, "corrupt", f"{name}[{idx}] bit {bit}")
+    return arr
+
+
+def payload_fault(site: str, name: str, tensor):
+    """Advance the payload hit counters and apply any scheduled payload
+    mutations (``corrupt`` / ``nan``) to ``tensor``. Returns the tensor
+    (a mutated numpy copy when a fault fired, the original otherwise).
+    Call sites gate on :data:`ACTIVE`; sites: ``payload`` (collective
+    input at submission), ``output`` (this rank's collective result).
+
+    An action with a ``tensor`` name pattern is windowed over its OWN
+    (site, pattern) counter — it counts only matching payloads, so
+    internal collectives (digest agreement, elastic sync) passing the
+    same tap never shift the schedule. Patternless actions use the
+    site-global counter."""
+    import fnmatch
+
+    plan = _plan
+    if plan is None or tensor is None:
+        return tensor
+    patterns = sorted({
+        a.tensor for a in plan.actions
+        if a.kind in PAYLOAD_KINDS and a.site == site
+        and a.tensor is not None
+        and fnmatch.fnmatchcase(name, a.tensor)
+    })
+    with _lock:
+        hit = _counters.get(site, 0) + 1
+        _counters[site] = hit
+        pattern_hits = {}
+        for p in patterns:
+            key = f"{site}|{p}"
+            pattern_hits[p] = _counters.get(key, 0) + 1
+            _counters[key] = pattern_hits[p]
+    rank, worker, gen = _identity()
+    out = tensor
+    for action in plan.actions:
+        if action.site != site or action.kind not in PAYLOAD_KINDS:
+            continue
+        if action.tensor is not None:
+            if action.tensor not in pattern_hits:
+                continue
+            window_hit = pattern_hits[action.tensor]
+        else:
+            window_hit = hit
+        if not action.matches_process(rank, worker, gen):
+            continue
+        if not action.in_window(window_hit):
+            continue
+        if not plan.decide(action, rank):
+            continue
+        out = _mutate_payload(
+            plan, action, site, window_hit, name, out, rank
+        )
+    return out
 
 
 def step(name: Optional[str] = None) -> None:

@@ -31,14 +31,22 @@ Action fields
 -------------
 
 ``kind``
-    ``kill`` | ``delay`` | ``drop`` | ``duplicate`` | ``preempt``.
+    ``kill`` | ``delay`` | ``drop`` | ``duplicate`` | ``preempt`` |
+    ``corrupt`` | ``nan``. The last two are *payload* faults exercising
+    the data-plane integrity guard (docs/fault_tolerance.md): ``corrupt``
+    bit-flips one element of a tensor payload (silent data corruption),
+    ``nan`` poisons one element of a floating-point gradient.
 ``site``
     Tap the action applies to: ``step`` (one training step, i.e. one
     ``State.commit``), ``enqueue``/``response`` (runtime collective
     submission/completion), ``rpc`` (launcher control-plane send),
-    ``kv`` (rendezvous KV request), ``spawn`` (driver worker spawn).
+    ``kv`` (rendezvous KV request), ``spawn`` (driver worker spawn),
+    ``payload`` (a collective's INPUT tensor at submission — where a
+    ``nan`` models a diverged kernel) and ``output`` (a collective's
+    result on THIS rank only — where a ``corrupt`` models SDC that makes
+    replicas silently diverge).
     Defaults: kill/preempt → ``step``, delay → ``enqueue``,
-    drop/duplicate → ``rpc``.
+    drop/duplicate → ``rpc``, nan → ``payload``, corrupt → ``output``.
 ``rank`` / ``worker`` / ``gen``
     Selectors; omitted means "any". ``rank`` matches ``HOROVOD_RANK``,
     ``worker`` matches ``HOROVOD_ELASTIC_WORKER_ID``, ``gen`` matches
@@ -53,6 +61,16 @@ Action fields
     Parameters: delay duration, kill exit status, and (driver-side
     preempt) seconds after spawn at which the driver delivers the
     simulated maintenance notice (SIGTERM) to the worker.
+``element`` / ``bit`` / ``tensor``
+    Payload-fault targeting: the flat element index to poison, (for
+    ``corrupt``) which bit of that element to flip, and a tensor-name
+    pattern (``fnmatch`` syntax, e.g. ``"grad"`` or ``"grad.*"``).
+    ``element``/``bit`` omitted → drawn from the action's seeded decision
+    stream, deterministic per (seed, action, rank) without hand-pinning.
+    With ``tensor`` set, the trigger window counts only MATCHING payloads
+    at the site (its own counter), so ``at_step`` means "the K-th time
+    THIS tensor passes the tap" — internal collectives (digest
+    agreement, elastic sync) don't perturb the schedule.
 """
 
 from __future__ import annotations
@@ -65,14 +83,20 @@ from typing import Any, Dict, List, Optional
 
 FAULT_PLAN_ENV = "HOROVOD_FAULT_PLAN"
 
-_KINDS = ("kill", "delay", "drop", "duplicate", "preempt")
-_SITES = ("step", "enqueue", "response", "rpc", "kv", "spawn")
+_KINDS = ("kill", "delay", "drop", "duplicate", "preempt", "corrupt", "nan")
+_SITES = ("step", "enqueue", "response", "rpc", "kv", "spawn",
+          "payload", "output")
+# Payload faults mutate tensors; only these sites carry one.
+PAYLOAD_KINDS = ("corrupt", "nan")
+PAYLOAD_SITES = ("payload", "output")
 _DEFAULT_SITE = {
     "kill": "step",
     "preempt": "step",
     "delay": "enqueue",
     "drop": "rpc",
     "duplicate": "rpc",
+    "corrupt": "output",
+    "nan": "payload",
 }
 # How many leading decisions of each probabilistic stream the canonical
 # schedule materializes (enough to make drop bursts diffable without
@@ -94,6 +118,9 @@ class FaultAction:
     seconds: float = 0.0
     exit_code: int = 43
     after_s: Optional[float] = None
+    element: Optional[int] = None  # payload faults: flat index to poison
+    bit: Optional[int] = None      # corrupt: bit of that element to flip
+    tensor: Optional[str] = None   # payload faults: name pattern (fnmatch)
     index: int = 0  # position in the plan; part of the stream key
 
     @staticmethod
@@ -127,12 +154,18 @@ class FaultAction:
             after_s=(
                 None if d.get("after_s") is None else float(d["after_s"])
             ),
+            element=(
+                None if d.get("element") is None else int(d["element"])
+            ),
+            bit=None if d.get("bit") is None else int(d["bit"]),
+            tensor=d.get("tensor"),
             index=index,
         )
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"kind": self.kind, "site": self.site}
-        for k in ("rank", "worker", "gen", "at_step", "count", "after_s"):
+        for k in ("rank", "worker", "gen", "at_step", "count", "after_s",
+                  "element", "bit", "tensor"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v

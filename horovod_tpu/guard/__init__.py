@@ -1,0 +1,241 @@
+"""Data-plane integrity guard (docs/fault_tolerance.md, "Data-plane
+integrity").
+
+PRs 2-4 hardened the control plane; this package defends the *data* plane:
+
+- **Non-finite sentinels** around the gradient reduction
+  (``HOROVOD_GUARD_NONFINITE=off|warn|zero|skip|abort``): a NaN/Inf
+  produced on one rank is detected before (or as) it poisons every
+  replica through the allreduce. ``zero`` sanitizes the bad entries
+  locally before the wire; ``skip`` reaches cross-rank agreement on a
+  skip-step flag so no rank applies a step another rank skipped;
+  ``abort`` surfaces a named error the elastic layer can roll back from.
+- **Periodic parameter-digest agreement**
+  (``HOROVOD_GUARD_DIGEST_STEPS=N``): every N commits each rank hashes
+  its tracked state, the digests are compared across ranks, and a
+  mismatch self-heals — re-broadcast from the agreeing quorum's
+  reference rank, or rollback to the last elastic commit when no quorum
+  exists (``HOROVOD_GUARD_NO_QUORUM=rollback|root``).
+
+Tap discipline — identical to ``fault/injector.py`` and ``metrics``:
+with no guard knob set (the production default) the module-level
+:data:`ACTIVE` flag is False, :data:`TAP` IS the shared no-op singleton
+:data:`NULL_TAP`, and instrumented call sites skip the tap entirely
+(``if _guard.ACTIVE: ...`` is the whole overhead).
+
+Detections are counted as ``hvd_guard_*`` metrics (when the metrics tap
+is live) and appended to the deterministic fault event log (site
+``guard``), so seeded chaos runs can assert guard behavior
+byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu.guard")
+
+GUARD_NONFINITE_ENV = "HOROVOD_GUARD_NONFINITE"
+GUARD_DIGEST_STEPS_ENV = "HOROVOD_GUARD_DIGEST_STEPS"
+GUARD_NO_QUORUM_ENV = "HOROVOD_GUARD_NO_QUORUM"
+
+NONFINITE_POLICIES = ("off", "warn", "zero", "skip", "abort")
+NO_QUORUM_ACTIONS = ("rollback", "root")
+
+
+def resolve_policy(explicit: Optional[str] = None) -> str:
+    """Resolve the non-finite policy: explicit argument >
+    ``HOROVOD_GUARD_NONFINITE`` > ``off``. Raises on unknown values —
+    a typoed policy silently meaning "off" would be a disabled guard
+    that looks enabled."""
+    name = (explicit or os.environ.get(GUARD_NONFINITE_ENV, "")
+            or "off").strip().lower()
+    if name not in NONFINITE_POLICIES:
+        raise ValueError(
+            f"unknown {GUARD_NONFINITE_ENV} policy {name!r}; choose from "
+            f"{NONFINITE_POLICIES}"
+        )
+    return name
+
+
+def digest_steps() -> int:
+    """Digest-agreement cadence in commits (0 = disabled)."""
+    v = os.environ.get(GUARD_DIGEST_STEPS_ENV, "").strip()
+    if not v:
+        return 0
+    try:
+        return max(int(v), 0)
+    except ValueError:
+        logger.warning(
+            "%s=%r is not an integer; digest agreement disabled",
+            GUARD_DIGEST_STEPS_ENV, v,
+        )
+        return 0
+
+
+def no_quorum_action() -> str:
+    """What a digest mismatch with no agreeing majority does:
+    ``rollback`` (default — restore the last elastic commit) or ``root``
+    (trust the current sync root's replica and re-broadcast from it —
+    the only heal available at 2 ranks, where one corruption can never
+    be outvoted)."""
+    name = (os.environ.get(GUARD_NO_QUORUM_ENV, "")
+            or "rollback").strip().lower()
+    if name not in NO_QUORUM_ACTIONS:
+        logger.warning(
+            "unknown %s %r; using 'rollback'", GUARD_NO_QUORUM_ENV, name
+        )
+        return "rollback"
+    return name
+
+
+def _count(name: str, value: float = 1.0, **labels) -> None:
+    """Increment an hvd_guard_* metric when the metrics tap is live."""
+    from .. import metrics as _metrics
+
+    if _metrics.ACTIVE:
+        _metrics.TAP.inc(name, value, **labels)
+
+
+def record_guard_event(action: str, detail: str = "") -> None:
+    """Append one guard detection to the deterministic fault event log
+    (site ``guard``) — seeded chaos runs diff these across runs. Only
+    recorded while a fault plan or event-log file is active: a long
+    production run with a chatty policy must not grow the in-memory
+    event list without bound."""
+    from ..fault import injector as _injector
+
+    if not (_injector.ACTIVE
+            or os.environ.get(_injector.FAULT_EVENT_LOG_ENV, "")):
+        return
+    global _guard_event_hits
+    with _event_lock:
+        _guard_event_hits += 1
+        hit = _guard_event_hits
+    _injector.record_event("guard", hit, action, detail)
+
+
+_event_lock = threading.Lock()
+_guard_event_hits = 0
+
+
+class GuardTap:
+    """The live tap: eager payload sentinel + counters. Installed only
+    while a guard knob is set; call sites gate on :data:`ACTIVE`."""
+
+    def __init__(self, policy: str):
+        self.policy = policy
+
+    # --- eager non-finite sentinel (numpy-level, pre-wire) ---
+    def check_payload(self, name: str, tensor: Any) -> Any:
+        """Apply the non-finite policy to one eager reduction payload
+        before it is enqueued. Returns the (possibly sanitized) tensor;
+        raises ``HorovodInternalError`` under ``abort``. Non-float
+        payloads pass through untouched."""
+        if self.policy == "off" or tensor is None:
+            return tensor
+        dtype = getattr(tensor, "dtype", None)
+        if dtype is None or not np.issubdtype(np.dtype(dtype), np.floating):
+            return tensor
+        arr = np.asarray(tensor)
+        finite = np.isfinite(arr)
+        if finite.all():
+            return tensor
+        n_bad = int(arr.size - int(finite.sum()))
+        _count("hvd_guard_nonfinite_total", n_bad,
+               policy=self.policy, path="eager")
+        record_guard_event(
+            f"nonfinite-{self.policy}", f"{name} n={n_bad}"
+        )
+        if self.policy == "abort":
+            from .. import HorovodInternalError
+
+            raise HorovodInternalError(
+                f"non-finite gradient guard (policy abort): tensor "
+                f"'{name}' contains {n_bad} non-finite value(s); refusing "
+                "to submit it to the collective"
+            )
+        if self.policy == "warn":
+            logger.warning(
+                "non-finite guard: tensor '%s' contains %d non-finite "
+                "value(s); submitting anyway (policy warn)", name, n_bad,
+            )
+            return tensor
+        # zero — and skip, which degrades to zero on the eager path: a
+        # per-submission skip would strand peer ranks inside the
+        # collective, and the step-level agreement the compiled path
+        # uses has no eager analogue at enqueue granularity.
+        if self.policy == "skip":
+            logger.warning(
+                "non-finite guard: policy 'skip' applies step-level "
+                "agreement in the compiled path only; eager tensor '%s' "
+                "is sanitized (zeroed) instead", name,
+            )
+        out = np.array(arr, copy=True)
+        out[~finite] = 0
+        return out
+
+
+class _NullGuardTap:
+    """Shared no-op tap installed while the guard is disabled."""
+
+    policy = "off"
+
+    def check_payload(self, name: str, tensor: Any) -> Any:
+        return tensor
+
+
+NULL_TAP = _NullGuardTap()
+
+ACTIVE = False
+TAP: Any = NULL_TAP
+
+_lock = threading.Lock()
+
+
+def install(policy: Optional[str] = None,
+            digest: Optional[int] = None) -> None:
+    """(De)activate the guard for this process. With both the policy
+    ``off`` and the digest cadence 0 the no-op singleton is installed."""
+    global ACTIVE, TAP
+    pol = resolve_policy(policy)
+    steps = digest_steps() if digest is None else max(int(digest), 0)
+    with _lock:
+        if pol == "off" and steps <= 0:
+            TAP = NULL_TAP
+            ACTIVE = False
+        else:
+            TAP = GuardTap(pol)
+            ACTIVE = True
+
+
+def activate_from_env() -> bool:
+    """(Re)load the guard configuration from the environment."""
+    install()
+    return ACTIVE
+
+
+def reset() -> None:
+    global ACTIVE, TAP, _guard_event_hits
+    with _lock:
+        TAP = NULL_TAP
+        ACTIVE = False
+    with _event_lock:
+        _guard_event_hits = 0
+
+
+# Arm at import (mirrors fault/injector.py and metrics): worker processes
+# spawned with guard knobs in their environment are protected without any
+# code changes.
+if (os.environ.get(GUARD_NONFINITE_ENV, "").strip()
+        or os.environ.get(GUARD_DIGEST_STEPS_ENV, "").strip()):
+    try:
+        activate_from_env()
+    except Exception:  # noqa: BLE001 - a malformed knob must not take
+        # down production init; surfaced by the guard tools/tests.
+        logger.exception("could not arm the data-plane guard from env")

@@ -1,0 +1,106 @@
+"""Parameter-digest agreement: detect silently diverged replicas.
+
+Replicated data-parallel state must be bit-identical across ranks between
+collectives; SDC (a flipped bit in HBM/host memory), nondeterministic
+kernels, or a bad rejoin silently break that invariant and the divergence
+compounds every step. The guard hashes each rank's tracked state every
+``HOROVOD_GUARD_DIGEST_STEPS`` commits, allgathers the digests (a few
+bytes — the payload never moves), and on mismatch:
+
+- an agreeing STRICT MAJORITY exists → the outlier ranks are healed by
+  re-broadcasting from the quorum's reference rank (its lowest member);
+- no quorum (e.g. a 1-v-1 tie at 2 ranks) → ``HOROVOD_GUARD_NO_QUORUM``
+  decides: ``rollback`` (default) raises so the elastic layer restores
+  the last commit, ``root`` trusts the current sync root's replica.
+
+The digest is SHA-256 over every array leaf's dtype/shape header and raw
+bytes plus a canonical pickle of non-array attributes — a pure function
+of the state, identical across ranks exactly when the state is.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def tree_digest(tree: Any, _h=None) -> str:
+    """SHA-256 hex digest of an array-leaf pytree (dtype + shape + raw
+    bytes per leaf, in pytree order)."""
+    import numpy as np
+
+    import jax
+
+    h = _h or hashlib.sha256()
+    leaves = jax.tree.leaves(tree)
+    host = jax.device_get(leaves)
+    for leaf in host:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    if _h is None:
+        return h.hexdigest()
+    return ""
+
+
+def state_digest(state: Any, tracked: Optional[Sequence[str]] = None) -> str:
+    """Digest an elastic ``State``'s tracked attributes: array-leaf
+    pytrees hash by raw bytes, everything else by pickle (deterministic
+    for the plain counters/containers states track)."""
+    import jax
+
+    keys = list(tracked if tracked is not None
+                else getattr(state, "_tracked", []))
+    h = hashlib.sha256()
+    for k in sorted(keys):
+        v = getattr(state, k, None)
+        h.update(k.encode())
+        leaves = jax.tree.leaves(v)
+        if leaves and all(hasattr(l, "shape") and hasattr(l, "dtype")
+                          for l in leaves):
+            tree_digest(v, _h=h)
+        else:
+            try:
+                h.update(pickle.dumps(v, protocol=4))
+            except Exception:  # noqa: BLE001 - unpicklable attr: hash repr
+                h.update(repr(v).encode())
+    return h.hexdigest()
+
+
+def find_quorum(
+    digests: Sequence[str], *, no_quorum: str = "rollback",
+    sync_root: int = 0,
+) -> Tuple[bool, Optional[int], List[int]]:
+    """Decide what a set of per-rank digests means.
+
+    Returns ``(ok, reference_rank, outlier_ranks)``:
+
+    - all digests equal → ``(True, None, [])``;
+    - a strict-majority group exists → ``(False, ref, outliers)`` where
+      ``ref`` is the lowest rank of the majority and ``outliers`` every
+      rank outside it;
+    - no strict majority → with ``no_quorum='root'``,
+      ``(False, sync_root, ranks disagreeing with sync_root)``; with
+      ``'rollback'`` (default), ``(False, None, all ranks)`` — the
+      caller must roll back, there is nothing trustworthy to heal from.
+    """
+    groups: Dict[str, List[int]] = {}
+    for r, d in enumerate(digests):
+        groups.setdefault(d, []).append(r)
+    if len(groups) == 1:
+        return True, None, []
+    n = len(digests)
+    majority = max(groups.values(), key=len)
+    if len(majority) * 2 > n:
+        ref = min(majority)
+        outliers = sorted(set(range(n)) - set(majority))
+        return False, ref, outliers
+    if no_quorum == "root" and 0 <= sync_root < n:
+        ref_digest = digests[sync_root]
+        outliers = sorted(
+            r for r in range(n) if digests[r] != ref_digest
+        )
+        return False, sync_root, outliers
+    return False, None, list(range(n))

@@ -32,6 +32,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..common.compression import Compression
 from ..common.types import Adasum, Average, ReduceOp, Sum
+from ..guard import nonfinite as _nf
+from ..guard import resolve_policy as _resolve_nonfinite
 from ..ops import collectives as _c
 from ..ops import fusion as _fusion
 from ..ops.adasum import adasum_reduce_fn
@@ -139,6 +141,7 @@ def allreduce_gradients(
     compression=Compression.none,
     hierarchical: bool = False,
     quantized: bool = False,
+    nonfinite: Optional[str] = None,
 ) -> Any:
     """Fusion-bucketed allreduce of a gradient pytree (in-jit).
 
@@ -150,11 +153,21 @@ def allreduce_gradients(
     gradient noise at 8 ranks) instead of a full-precision ``psum``.
     ``fusion_threshold_bytes=None`` resolves HOROVOD_FUSION_THRESHOLD
     (64 MB default, reference parity).
+
+    ``nonfinite`` (None reads ``HOROVOD_GUARD_NONFINITE``) applies the
+    non-finite sentinel around the reduce: ``zero`` sanitizes the local
+    gradients BEFORE the wire (a poisoned rank's NaN never reaches its
+    peers), ``warn`` detects on the reduced result and logs. The
+    step-level policies (``skip``/``abort``) are applied by
+    ``DistributedOptimizer`` / ``make_train_step``, not here.
     """
     fusion_threshold_bytes = _fusion.default_threshold_bytes(
         fusion_threshold_bytes
     )
     axis_name = _normalize_axis(axis_name, hierarchical)
+    nonfinite_policy = _resolve_nonfinite(nonfinite)
+    if nonfinite_policy == "zero":
+        grads = _nf.sanitize(grads)
     from ..analysis import preflight as _preflight
 
     if _preflight.enabled():
@@ -221,6 +234,10 @@ def allreduce_gradients(
         leaves, treedef = jax.tree.flatten(reduced)
         leaves = [compression.decompress(l, ctx) for l, ctx in zip(leaves, ctxs)]
         reduced = jax.tree.unflatten(treedef, leaves)
+    if nonfinite_policy == "warn":
+        # Post-reduce detection: a NaN from ANY rank propagates through
+        # SUM/AVERAGE, so every rank observes (and logs) the same event.
+        _nf.note_detection("warn", "reduce")(_nf.local_flag(reduced))
     return reduced
 
 
@@ -251,6 +268,7 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     quantized: bool = False,
     backward_passes_per_step: int = 1,
     overlap: bool = False,
+    nonfinite: Optional[str] = None,
 ):
     """Wrap an optax ``GradientTransformation`` so its update first
     allreduces gradients across the data axis.
@@ -271,10 +289,22 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     this falls back to the post-hoc reduction with a loud warning (and an
     ``overlap-no-streaming`` finding under HOROVOD_TPU_STATIC_CHECKS=1) —
     see docs/overlap.md.
+
+    ``nonfinite`` (None reads ``HOROVOD_GUARD_NONFINITE``, resolved when
+    the wrapper is built) applies the non-finite gradient guard: ``zero``
+    sanitizes before the wire, ``warn`` logs, ``skip`` reaches cross-rank
+    agreement on a skip flag and applies NO update on ANY rank for that
+    step, ``abort`` behaves like ``skip`` here (an optax transformation
+    cannot raise usefully from inside a trace) and is surfaced as a
+    raised ``HorovodInternalError`` by ``make_train_step`` — see
+    docs/fault_tolerance.md "Data-plane integrity".
     """
+    import jax.numpy as jnp
     import optax
 
     _check_overlap_rejections(overlap, quantized, op)
+    nonfinite_policy = _resolve_nonfinite(nonfinite)
+    norm_axis = _normalize_axis(axis_name, hierarchical)
 
     def init_fn(params):
         return optimizer.init(params)
@@ -300,6 +330,11 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             else:
                 for f in findings:
                     _logger.warning("%s", f.render())
+        flag = None
+        if nonfinite_policy in ("skip", "abort"):
+            # Pre-reduce local detection: catches a bad local gradient
+            # even under MIN/MAX reductions, where NaN may not propagate.
+            flag = _nf.local_flag(grads)
         if do_reduce:
             reduced = allreduce_gradients(
                 grads,
@@ -309,12 +344,37 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
                 compression=compression,
                 hierarchical=hierarchical,
                 quantized=quantized,
+                nonfinite=nonfinite_policy,
             )
         else:
             reduced = grads
+            if nonfinite_policy == "zero":
+                # Streamed groups sanitize pre-reduce when registered
+                # with the policy; sanitizing the already-reduced grads
+                # again is a harmless belt for manual registrations.
+                reduced = _nf.sanitize(reduced)
+            elif nonfinite_policy == "warn":
+                _nf.note_detection("warn", "overlap")(
+                    _nf.local_flag(reduced)
+                )
+        if flag is not None:
+            # Agreement seam: psum of the flag — no rank applies a step
+            # another rank skipped (same agreement shape the preemption
+            # commit check uses). Post-reduce detection is OR-ed in so an
+            # overflow created BY the summation is also caught.
+            flag = jnp.maximum(flag, _nf.local_flag(reduced))
+            flag = _nf.agree_flag(flag, norm_axis)
+            _nf.note_detection(nonfinite_policy, "optimizer")(flag)
         if prescale != 1.0:
             reduced = jax.tree.map(lambda g: g * prescale, reduced)
-        return optimizer.update(reduced, state, params, **extra)
+        updates, new_state = optimizer.update(reduced, state, params, **extra)
+        if flag is not None:
+            # Skipped step: zero updates, optimizer state held.
+            updates = _nf.select_on_flag(
+                flag, jax.tree.map(jnp.zeros_like, updates), updates
+            )
+            new_state = _nf.select_on_flag(flag, state, new_state)
+        return updates, new_state
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -351,6 +411,7 @@ def make_train_step(
     has_aux: bool = False,
     overlap: bool = False,
     first_bucket_bytes: Optional[int] = None,
+    nonfinite: Optional[str] = None,
 ):
     """Build a jitted SPMD training step: per-shard grads → fused allreduce
     → optax update, with the batch sharded over ``axis_name`` and
@@ -372,11 +433,23 @@ def make_train_step(
     the remaining backward compute. Numerically identical to
     ``overlap=False`` (elementwise reductions commute with the split);
     ``quantized=True`` is rejected.
+
+    ``nonfinite`` (None reads ``HOROVOD_GUARD_NONFINITE``, resolved when
+    the step is built) applies the non-finite gradient guard around the
+    reduce: ``zero`` sanitizes before the wire (per streamed group under
+    ``overlap=True``), ``warn`` logs detections, ``skip`` cross-rank
+    agrees on a skip flag and leaves params/opt-state UNCHANGED on every
+    rank for that step, ``abort`` additionally raises
+    ``HorovodInternalError`` from the returned step function so the
+    elastic layer rolls back — docs/fault_tolerance.md "Data-plane
+    integrity".
     """
+    import jax.numpy as jnp
     import optax
 
     _check_overlap_rejections(overlap, quantized, op)
     axis_name = _normalize_axis(axis_name, hierarchical)
+    nonfinite_policy = _resolve_nonfinite(nonfinite)
 
     def step(params, opt_state, batch):
         if overlap:
@@ -389,6 +462,7 @@ def make_train_step(
                     first_bucket_bytes=first_bucket_bytes,
                     hierarchical=hierarchical,
                     compression=compression,
+                    nonfinite=nonfinite_policy,
                 )
                 return loss_fn(p, b)
 
@@ -400,7 +474,12 @@ def make_train_step(
         else:
             loss, grads = grad_fn(params, batch)
             aux = None
+        flag = None
         if not overlap:
+            if nonfinite_policy in ("skip", "abort"):
+                # Pre-reduce local detection (robust under MIN/MAX, where
+                # NaN may not propagate through the reduction).
+                flag = _nf.local_flag(grads)
             grads = allreduce_gradients(
                 grads,
                 op=op,
@@ -409,6 +488,7 @@ def make_train_step(
                 compression=compression,
                 hierarchical=hierarchical,
                 quantized=quantized,
+                nonfinite=nonfinite_policy,
             )
         else:
             # Streamed: grads left value_and_grad already reduced (the
@@ -416,20 +496,63 @@ def make_train_step(
             # the registration ledger so a later overlap DistributedOptimizer
             # trace doesn't credit THIS trace's registrations.
             _fusion.take_stream_registrations()
+            if nonfinite_policy == "warn":
+                _nf.note_detection("warn", "overlap")(
+                    _nf.local_flag(grads)
+                )
+        if nonfinite_policy in ("skip", "abort"):
+            # Agreement seam (psum of the flag): no rank applies a step
+            # another rank skipped. Post-reduce detection is OR-ed in so
+            # an overflow created BY the summation is also caught; under
+            # overlap it is the only detection point (the flag cannot be
+            # carried out of the custom_vjp backward rules).
+            post = _nf.local_flag(grads)
+            flag = post if flag is None else jnp.maximum(flag, post)
+            flag = _nf.agree_flag(flag, axis_name)
+            _nf.note_detection(nonfinite_policy, "train_step")(flag)
         loss = lax.pmean(loss, axis_name)
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        if flag is not None:
+            # Skipped step: params and optimizer state held on EVERY rank.
+            new_params = _nf.select_on_flag(flag, params, new_params)
+            new_opt_state = _nf.select_on_flag(
+                flag, opt_state, new_opt_state
+            )
+        outs = [new_params, new_opt_state, loss]
         if has_aux:
             aux = jax.tree.map(lambda a: lax.pmean(a, axis_name), aux)
-            return new_params, new_opt_state, loss, aux
-        return new_params, new_opt_state, loss
+            outs.append(aux)
+        if nonfinite_policy == "abort":
+            outs.append(flag)
+        return tuple(outs)
 
     # Params/opt-state replicated; batch sharded on the data axis; every
     # output replicated. PartitionSpecs act as pytree prefixes.
     fn = _shard_map(
         step, mesh, in_specs=(P(), P(), P(axis_name)), out_specs=P()
     )
-    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+    jitted = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+    if nonfinite_policy != "abort":
+        return jitted
+
+    def aborting_step(params, opt_state, batch):
+        import numpy as np
+
+        out = jitted(params, opt_state, batch)
+        flag = out[-1]
+        if float(np.asarray(flag)) > 0:
+            from .. import HorovodInternalError
+
+            raise HorovodInternalError(
+                "non-finite gradient guard (policy abort): a rank "
+                "produced NaN/Inf gradients this step; the update was "
+                "not applied on any rank (cross-rank agreed) — rolling "
+                "back via the elastic layer if one is active"
+            )
+        return out[:-1]
+
+    return aborting_step
 
 
 class GradientAccumulator:

@@ -90,6 +90,21 @@ _CATALOG: Dict[str, str] = {
     "hvd_elastic_restarts_total": "Respawn-mode world restarts",
     "hvd_elastic_rollbacks_total": "State rollbacks after collective "
                                    "failure (worker)",
+    "hvd_elastic_snapshot_quarantined_total":
+        "Unreadable persisted snapshots quarantined to *.corrupt",
+    # Data-plane integrity guard (docs/fault_tolerance.md).
+    "hvd_guard_nonfinite_total": "Non-finite gradient detections "
+                                 "(labeled by policy and path)",
+    "hvd_guard_skipped_steps_total": "Optimizer steps skipped by "
+                                     "cross-rank agreement (policy skip)",
+    "hvd_guard_metadata_aborts_total": "Collectives aborted by cross-rank "
+                                       "metadata validation",
+    "hvd_guard_digest_checks_total": "Parameter-digest agreement rounds",
+    "hvd_guard_digest_mismatches_total": "Digest rounds that found "
+                                         "diverged replicas",
+    "hvd_guard_heals_total": "Digest mismatches healed by re-broadcast",
+    "hvd_guard_rollbacks_total": "Digest mismatches with no quorum "
+                                 "(elastic rollback raised)",
     "hvd_elastic_host_interrupts_total": "Membership-change interrupts "
                                          "(worker)",
     "hvd_elastic_preemptions_total": "Preemption interrupts (worker)",

@@ -217,6 +217,12 @@ class StreamConfig:
     hierarchical: bool = False
     compression: Any = None  # a common.compression.Compressor class or None
     label: str = "stream"
+    # Non-finite guard policy applied to this group's cotangents BEFORE
+    # the psum (docs/fault_tolerance.md "Data-plane integrity"): "zero"
+    # sanitizes locally so one rank's NaN never reaches the wire. Other
+    # policies act at the step level (jax/__init__.py) — the streamed
+    # group only sanitizes.
+    nonfinite: str = "off"
 
 
 def _hier_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
@@ -237,6 +243,12 @@ def _hier_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
 def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
     """Reduce one registered subtree's cotangents (runs inside the backward
     trace, under the same axis binding as the forward)."""
+    if cfg.nonfinite == "zero":
+        # Pre-wire sanitization: the healthy ranks' contributions to this
+        # group survive a poisoned peer (guard/nonfinite.py).
+        from ..guard import nonfinite as _nf
+
+        ct = _nf.sanitize(ct)
     compression = cfg.compression
     ctxs = None
     if compression is not None:
@@ -309,6 +321,7 @@ def reduce_in_backward(
     hierarchical: bool = False,
     compression: Any = None,
     label: str = "stream",
+    nonfinite: str = "off",
 ) -> Any:
     """Register a parameter subtree for streamed gradient reduction.
 
@@ -338,6 +351,7 @@ def reduce_in_backward(
         hierarchical=hierarchical,
         compression=compression,
         label=label,
+        nonfinite=str(nonfinite),
     )
     _note_stream_registration(len(jax.tree.leaves(tree)))
     return _stream_identity(cfg, tree)
@@ -428,6 +442,7 @@ def stream_param_groups(
     first_bucket_bytes: Optional[int] = None,
     hierarchical: bool = False,
     compression: Any = None,
+    nonfinite: str = "off",
 ) -> Any:
     """Partition ``params`` by top-level child (for a flax params dict: one
     child per module, in construction ≈ forward order), pack the children
@@ -442,7 +457,7 @@ def stream_param_groups(
         return reduce_in_backward(
             params, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
-            label="stream:g0",
+            label="stream:g0", nonfinite=nonfinite,
         )
     children, rebuild = split
     groups = plan_layer_groups(
@@ -456,7 +471,7 @@ def stream_param_groups(
         sub = reduce_in_backward(
             sub, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
-            label=f"stream:g{gi}",
+            label=f"stream:g{gi}", nonfinite=nonfinite,
         )
         for i in group:
             wrapped[i] = sub[str(i)]

@@ -24,10 +24,36 @@ def _flatten(tree: Any):
     return leaves, treedef
 
 
+def _atomic_write(final_path: str, write_fn) -> None:
+    """Write via a same-directory temp file + ``os.replace`` so a crash
+    mid-write can never leave a torn file under the final name: readers
+    see the complete old content or the complete new content, nothing in
+    between (POSIX rename atomicity)."""
+    tmp = f"{final_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final_path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def save_checkpoint(path: str, tree: Any, step: int = 0,
                     use_orbax: Optional[bool] = None) -> str:
     """Save a pytree. Call from rank 0 only (the reference convention:
-    'save only on rank 0')."""
+    'save only on rank 0').
+
+    Writes are ATOMIC (temp file + ``os.replace``, fsynced) for both the
+    npz payload and the ``latest.json`` pointer — a kill mid-save leaves
+    the previous checkpoint fully restorable instead of a torn "latest"
+    (the orbax path is already atomic via its own finalize rename). The
+    pointer is written LAST, after the payload it names is durable."""
     if use_orbax is None:
         try:
             import orbax.checkpoint  # noqa: F401
@@ -43,15 +69,18 @@ def save_checkpoint(path: str, tree: Any, step: int = 0,
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(ckpt_dir, tree, force=True)
     else:
-        import jax
-
         leaves, _ = _flatten(tree)
-        np.savez(
+        payload = {
+            f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)
+        }
+        _atomic_write(
             os.path.join(path, f"step_{step}.npz"),
-            **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+            lambda f: np.savez(f, **payload),
         )
-    with open(os.path.join(path, "latest.json"), "w") as f:
-        json.dump({"step": step, "orbax": use_orbax}, f)
+    meta = json.dumps({"step": step, "orbax": use_orbax}).encode()
+    _atomic_write(
+        os.path.join(path, "latest.json"), lambda f: f.write(meta)
+    )
     return path
 
 

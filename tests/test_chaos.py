@@ -543,6 +543,94 @@ def test_preempt_fault_action_sets_notice():
     assert [e["action"] for e in _injector.events()] == ["preempt"]
 
 
+# ------------------------------------------ payload faults (corrupt/nan)
+def test_payload_plan_parse_defaults():
+    p = FaultPlan.from_json(
+        '{"faults": ['
+        '{"kind": "nan", "rank": 0, "at_step": 2, "element": 0},'
+        '{"kind": "corrupt", "rank": 1, "tensor": "grad", "at_step": 3,'
+        ' "element": 1, "bit": 30}]}'
+    )
+    assert [a.site for a in p.actions] == ["payload", "output"]
+    assert p.actions[1].tensor == "grad"
+    assert p.actions[1].element == 1 and p.actions[1].bit == 30
+    # Round-trips through the canonical schedule (and stays stable).
+    s = p.canonical_schedule()
+    assert '"tensor":"grad"' in s and '"bit":30' in s
+    assert s == FaultPlan.from_json(
+        json.dumps({"seed": 0, "faults": [a.to_dict() for a in p.actions]})
+    ).canonical_schedule()
+
+
+def test_payload_fault_nan_poisons_float_only():
+    _plan('{"faults": [{"kind": "nan", "site": "payload", '
+          '"element": 1}]}')
+    x = np.ones(4, np.float32)
+    out = _injector.payload_fault("payload", "grad", x)
+    assert np.isnan(out[1]) and np.isfinite(out[[0, 2, 3]]).all()
+    assert np.isfinite(x).all()  # original untouched (mutated copy)
+    ints = np.ones(4, np.int64)
+    assert _injector.payload_fault("payload", "sizes", ints) is ints
+
+
+def test_payload_fault_corrupt_flips_exactly_one_bit():
+    _plan('{"faults": [{"kind": "corrupt", "site": "output", '
+          '"element": 2, "bit": 0}]}')
+    x = np.zeros(4, np.float32)
+    out = _injector.payload_fault("output", "grad", x)
+    diff = out.view(np.uint32) ^ x.view(np.uint32)
+    assert diff[2] == 1 and diff[[0, 1, 3]].sum() == 0
+    ev = _injector.events()[0]
+    assert ev["action"] == "corrupt" and "grad[2] bit 0" in ev["detail"]
+
+
+def test_payload_fault_stream_choice_is_deterministic():
+    """Without pinned element/bit the targets come from the seeded
+    decision stream: two plans with the same seed mutate identically,
+    a different seed differs."""
+    text = ('{"seed": 99, "faults": [{"kind": "corrupt", '
+            '"site": "output", "count": 4}]}')
+
+    def run(t):
+        _plan(t)
+        outs = [
+            _injector.payload_fault(
+                "output", "g", np.zeros(64, np.float32)
+            ).tobytes()
+            for _ in range(4)
+        ]
+        evs = [
+            (e["action"], e["detail"], e["hit"])
+            for e in _injector.events()
+        ]
+        return outs, evs
+
+    o1, e1 = run(text)
+    o2, e2 = run(text)
+    assert o1 == o2 and e1 == e2
+    o3, _ = run(text.replace("99", "7"))
+    assert o3 != o1
+
+
+def test_payload_fault_tensor_pattern_has_own_window():
+    """A tensor-scoped action counts only MATCHING payloads: internal
+    collectives crossing the same tap never shift the schedule."""
+    _plan('{"faults": [{"kind": "nan", "site": "payload", '
+          '"tensor": "grad", "at_step": 2, "element": 0}]}')
+    # Interleave unrelated tensors: they advance only the global counter.
+    for name in ("hvd.guard.digest.size", "hvd.guard.digest.data"):
+        out = _injector.payload_fault(
+            "payload", name, np.ones(4, np.float32)
+        )
+        assert np.isfinite(out).all()
+    out = _injector.payload_fault("payload", "grad", np.ones(4, np.float32))
+    assert np.isfinite(out).all()  # grad hit 1: below the window
+    out = _injector.payload_fault("payload", "grad", np.ones(4, np.float32))
+    assert np.isnan(out[0])  # grad hit 2: fires
+    ev = [e for e in _injector.events() if e["action"] == "nan"]
+    assert len(ev) == 1 and ev[0]["hit"] == 2
+
+
 # --------------------------------------------------------- e2e (seeded)
 CHAOS_SEED = 20260804
 
@@ -654,6 +742,266 @@ def test_chaos_e2e_kill_slow_drop():
     schedule log is byte-for-byte reproducible from the seed."""
     proc, outs = run_chaos_job()
     assert_chaos_recovery(proc, outs)
+
+
+# ---------------------------------------- guard e2e (seeded corrupt+nan)
+GUARD_SEED = 604
+
+
+def guard_plan() -> dict:
+    """The canonical data-plane-guard schedule (also used by
+    tools/guard_smoke.py): NaN-poison rank 0's gradient at its 2nd step,
+    bit-flip rank 1's allreduce OUTPUT at its 3rd step — exercising the
+    non-finite sentinel and the parameter-digest heal end-to-end."""
+    return {
+        "seed": GUARD_SEED,
+        "faults": [
+            {"kind": "nan", "rank": 0, "site": "payload",
+             "tensor": "grad", "at_step": 2, "element": 0, "gen": 1},
+            {"kind": "corrupt", "rank": 1, "site": "output",
+             "tensor": "grad", "at_step": 3, "element": 1, "bit": 30,
+             "gen": 1},
+        ],
+    }
+
+
+GUARD_WORKER = """
+import os
+import numpy as np, jax
+jax.config.update('jax_platforms', 'cpu')
+import horovod_tpu as hvd
+import horovod_tpu.elastic as elastic
+hvd.init()
+import jax.numpy as jnp
+
+state = elastic.JaxState(w=np.zeros((8,), np.float32), step=0)
+while state.step < 6:
+    g = hvd.allreduce(jnp.ones((8,), jnp.float32) * float(hvd.rank() + 1),
+                      op=hvd.Average, name='grad')
+    state.w = np.asarray(g) + np.asarray(state.w)
+    state.step += 1
+    state.commit()
+print('FINAL', hvd.rank(), state.step,
+      ' '.join(f'{v:.4f}' for v in np.asarray(state.w)), flush=True)
+hvd.shutdown()
+"""
+
+
+def normalized_events(path: str):
+    """Per-rank deterministic view of a (multi-process, interleaved)
+    event log: lines sorted by (rank, seq). Two runs of the same seeded
+    plan must produce identical normalized sequences."""
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    return sorted(
+        [(e.get("rank"), e["seq"], e["site"], e["hit"], e["action"],
+          e["detail"]) for e in lines]
+    )
+
+
+def run_guard_job(np_: int = 2, extra_env=None, timeout=180):
+    """Run the seeded guard scenario on a plain (non-elastic) 2- or
+    4-rank launch; returns (rank outs, normalized events). Shared with
+    tools/guard_smoke.py."""
+    import tempfile
+
+    from test_multiprocess import _run_workers
+
+    with tempfile.TemporaryDirectory() as td:
+        log = os.path.join(td, "events.jsonl")
+        env = {
+            "HOROVOD_FAULT_PLAN": json.dumps(guard_plan()),
+            "HOROVOD_FAULT_EVENT_LOG": log,
+            "HOROVOD_GUARD_NONFINITE": "zero",
+            "HOROVOD_GUARD_DIGEST_STEPS": "1",
+        }
+        if np_ == 2:
+            # 1-v-1 digest tie has no majority: trust the sync root.
+            env["HOROVOD_GUARD_NO_QUORUM"] = "root"
+        env.update(extra_env or {})
+        outs = _run_workers(
+            GUARD_WORKER, np_=np_, timeout=timeout, extra_env=env
+        )
+        events = normalized_events(log) if os.path.exists(log) else []
+    return outs, events
+
+
+def assert_guard_recovery(outs, events, np_: int):
+    """Detection + autonomous recovery: every rank finishes all 6 steps
+    with IDENTICAL, finite state matching the analytic expectation, and
+    the event log shows the injection → detection → heal chain."""
+    n = np_
+    a = (n + 1) / 2.0  # clean per-step Average of ranks' gradients
+    expect = [a * 6] * 8
+    expect[0] = a * 5 + (a - 1.0 / n)  # rank 0's nan zeroed at step 2
+    finals = [l for o in outs for l in o.splitlines()
+              if l.startswith("FINAL")]
+    assert len(finals) == n, (finals, outs)
+    for line in finals:
+        parts = line.split()
+        assert parts[2] == "6", finals  # all steps completed
+        w = [float(v) for v in parts[3:]]
+        np.testing.assert_allclose(w, expect, rtol=1e-6), finals
+    actions = [e[4] for e in events]
+    assert "nan" in actions, events          # injected
+    assert "nonfinite-zero" in actions, events  # sentinel detected
+    assert "corrupt" in actions, events      # injected
+    assert "digest-heal" in actions, events  # digest guard healed
+    heal = [e for e in events if e[4] == "digest-heal"][0]
+    assert "outliers=[1]" in heal[5], events
+
+
+def test_guard_e2e_2rank_sentinel_and_digest_heal():
+    """Acceptance: the seeded corrupt+nan plan is detected by the
+    sentinel + digest guards and recovered without operator action at 2
+    ranks (no majority → sync-root heal)."""
+    outs, events = run_guard_job(np_=2)
+    assert_guard_recovery(outs, events, np_=2)
+    # The resolved schedule is a pure function of the plan (the same
+    # byte-reproducibility contract the chaos suite asserts end-to-end;
+    # tools/guard_smoke.py additionally diffs two live runs).
+    text = json.dumps(guard_plan())
+    assert (FaultPlan.from_json(text).canonical_schedule()
+            == FaultPlan.from_json(text).canonical_schedule())
+
+
+def test_guard_e2e_4rank_majority_heal():
+    """At 4 ranks the 3-v-1 digest mismatch has a strict majority: the
+    default (rollback-on-no-quorum) config heals by re-broadcast."""
+    outs, events = run_guard_job(
+        np_=4, extra_env={"HOROVOD_GUARD_NO_QUORUM": "rollback"}
+    )
+    assert_guard_recovery(outs, events, np_=4)
+
+
+def test_guard_e2e_2rank_digest_rollback():
+    """No quorum and no root-trust: the digest mismatch rolls back to
+    the last elastic commit and the job self-recovers by re-running the
+    corrupted step."""
+    from conftest import run_elastic_job
+
+    body = """
+        import os
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        import horovod_tpu.elastic as elastic
+        hvd.init()
+        import jax.numpy as jnp
+        td = os.environ['ELASTIC_TD']
+        state = elastic.JaxState(w=np.zeros((8,), np.float32), step=0)
+
+        @elastic.run
+        def train(state):
+            while state.step < 6:
+                g = hvd.allreduce(jnp.ones((8,), jnp.float32),
+                                  op=hvd.Average, name='grad')
+                state.w = np.asarray(g) + np.asarray(state.w)
+                state.step += 1
+                state.commit()
+            return state.step
+
+        train(state)
+        print('FINAL', hvd.rank(), hvd.size(), state.step,
+              float(np.asarray(state.w).sum()), flush=True)
+        hvd.shutdown()
+"""
+    plan = {
+        "seed": 11,
+        "faults": [
+            {"kind": "corrupt", "rank": 1, "site": "output",
+             "tensor": "grad", "at_step": 3, "element": 0, "bit": 30,
+             "gen": 1},
+        ],
+    }
+    proc, outs = run_elastic_job(
+        ["-np", "2", "--min-np", "2", "--max-np", "2"],
+        script_text=textwrap.dedent(body),
+        extra_env={
+            "HOROVOD_FAULT_PLAN": json.dumps(plan),
+            "HOROVOD_GUARD_DIGEST_STEPS": "1",
+        },
+        timeout=300,
+    )
+    stderr = proc.stderr.decode()
+    assert proc.returncode == 0, (stderr, outs)
+    finals = [l for o in outs.values() for l in o.splitlines()
+              if l.startswith("FINAL")]
+    assert len(finals) == 2, (finals, stderr)
+    for line in finals:
+        _, rank, size, step, wsum = line.split()
+        # Recovered WITHOUT the corruption: the rollback re-ran the
+        # poisoned step cleanly (6 steps x 8 elements x avg 1.0).
+        assert size == "2" and step == "6", finals
+        assert float(wsum) == 48.0, finals
+    fired = {
+        json.loads(l)["action"]
+        for l in outs.get("fault_events.jsonl", "").splitlines()
+    }
+    assert {"corrupt", "digest-rollback"} <= fired, fired
+    errs = "".join(v for k, v in outs.items() if k.endswith(".err"))
+    assert "digest mismatch" in errs, (errs, stderr)
+
+
+def test_metadata_mismatch_aborts_with_tensor_and_ranks():
+    """Acceptance: a tensor announced with conflicting shapes across
+    ranks ABORTS (naming tensor + both ranks) instead of hanging —
+    through the real native-core coordinator at 2 ranks."""
+    from test_multiprocess import _run_workers
+
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        n = 4 if hvd.rank() == 0 else 8
+        try:
+            hvd.allreduce(jnp.ones((n,), jnp.float32), op=hvd.Sum,
+                          name="mismatched.grad")
+            print("NOABORT")
+        except hvd.HorovodInternalError as e:
+            print("ABORTED", str(e))
+        hvd.shutdown()
+        """,
+        np_=2,
+    )
+    for out in outs:
+        assert "ABORTED" in out, outs
+        assert "Mismatched shapes for tensor mismatched.grad" in out, outs
+        assert "rank 0 announced [4]" in out, outs
+        assert "rank 1 announced [8]" in out, outs
+
+
+def test_metadata_mismatch_reduce_op_aborts():
+    """Conflicting reduce ops for the same tensor abort too (the new
+    coordinator check), naming both ranks."""
+    from test_multiprocess import _run_workers
+
+    outs = _run_workers(
+        """
+        import numpy as np, jax
+        jax.config.update('jax_platforms', 'cpu')
+        import horovod_tpu as hvd
+        hvd.init()
+        import jax.numpy as jnp
+        op = hvd.Sum if hvd.rank() == 0 else hvd.Average
+        try:
+            hvd.allreduce(jnp.ones((4,), jnp.float32), op=op,
+                          name="op.grad")
+            print("NOABORT")
+        except hvd.HorovodInternalError as e:
+            print("ABORTED", str(e))
+        hvd.shutdown()
+        """,
+        np_=2,
+    )
+    for out in outs:
+        assert "ABORTED" in out, outs
+        assert "Mismatched reduce operations for tensor op.grad" in out, (
+            outs
+        )
+        assert "rank 0" in out and "rank 1" in out, outs
 
 
 def test_preemption_e2e_graceful_drain():

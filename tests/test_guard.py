@@ -1,0 +1,628 @@
+"""Data-plane integrity guard (horovod_tpu/guard; docs/fault_tolerance.md
+"Data-plane integrity"): non-finite sentinel policies at 2 and 4 mesh
+ranks, cross-rank metadata validation, parameter-digest agreement
+(heal + rollback), atomic checkpoint writes, snapshot quarantine, and the
+zero-overhead tap discipline."""
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+import horovod_tpu.jax as hvdj
+from horovod_tpu import guard
+from horovod_tpu.guard import digest as gdigest
+from horovod_tpu.guard import nonfinite as gnf
+from horovod_tpu.jax import _shard_map
+from horovod_tpu.parallel.mesh import build_mesh
+
+GUARD_ENVS = (
+    guard.GUARD_NONFINITE_ENV,
+    guard.GUARD_DIGEST_STEPS_ENV,
+    guard.GUARD_NO_QUORUM_ENV,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_guard_state(monkeypatch):
+    """Every test starts and ends with the guard disarmed and the knobs
+    unset (monkeypatch undoes the env on exit)."""
+    for k in GUARD_ENVS:
+        monkeypatch.delenv(k, raising=False)
+    guard.reset()
+    yield
+    guard.reset()
+
+
+# ------------------------------------------------------ policy / tap
+def test_policy_resolution(monkeypatch):
+    assert guard.resolve_policy() == "off"
+    monkeypatch.setenv(guard.GUARD_NONFINITE_ENV, "zero")
+    assert guard.resolve_policy() == "zero"
+    assert guard.resolve_policy("skip") == "skip"  # explicit wins
+    with pytest.raises(ValueError):
+        guard.resolve_policy("meteor")
+
+
+def test_tap_is_null_singleton_when_off(monkeypatch):
+    """Zero-overhead discipline: no knob set → ACTIVE False and TAP IS
+    the shared no-op singleton (same contract as fault/metrics taps)."""
+    guard.activate_from_env()
+    assert not guard.ACTIVE
+    assert guard.TAP is guard.NULL_TAP
+    # The null tap passes payloads through untouched.
+    x = np.array([1.0, np.nan])
+    assert guard.NULL_TAP.check_payload("t", x) is x
+    # Arming any knob swaps in a live tap; disarming restores the
+    # singleton.
+    monkeypatch.setenv(guard.GUARD_DIGEST_STEPS_ENV, "4")
+    guard.activate_from_env()
+    assert guard.ACTIVE and guard.TAP is not guard.NULL_TAP
+    assert guard.digest_steps() == 4
+    monkeypatch.delenv(guard.GUARD_DIGEST_STEPS_ENV)
+    guard.activate_from_env()
+    assert guard.TAP is guard.NULL_TAP
+
+
+def test_no_quorum_action(monkeypatch):
+    assert guard.no_quorum_action() == "rollback"
+    monkeypatch.setenv(guard.GUARD_NO_QUORUM_ENV, "root")
+    assert guard.no_quorum_action() == "root"
+    monkeypatch.setenv(guard.GUARD_NO_QUORUM_ENV, "coinflip")
+    assert guard.no_quorum_action() == "rollback"  # unknown → safe default
+
+
+# ----------------------------------------------- eager payload sentinel
+def test_check_payload_zero_sanitizes():
+    guard.install("zero")
+    x = np.array([1.0, np.nan, -np.inf, 4.0], np.float32)
+    out = guard.TAP.check_payload("grad", x)
+    np.testing.assert_array_equal(out, [1.0, 0.0, 0.0, 4.0])
+    # Clean payloads pass through by identity (no copy).
+    clean = np.ones(3, np.float32)
+    assert guard.TAP.check_payload("grad", clean) is clean
+
+
+def test_check_payload_warn_passes_through():
+    guard.install("warn")
+    x = np.array([np.nan], np.float32)
+    assert np.isnan(guard.TAP.check_payload("grad", x)).all()
+
+
+def test_check_payload_abort_raises_named():
+    guard.install("abort")
+    with pytest.raises(hvd.HorovodInternalError) as e:
+        guard.TAP.check_payload("grad.conv1", np.array([np.inf]))
+    assert "grad.conv1" in str(e.value)
+    assert "abort" in str(e.value)
+
+
+def test_check_payload_skip_degrades_to_zero_eager():
+    guard.install("skip")
+    out = guard.TAP.check_payload("g", np.array([np.nan, 2.0]))
+    np.testing.assert_array_equal(out, [0.0, 2.0])
+
+
+def test_check_payload_ignores_non_float():
+    guard.install("abort")
+    x = np.array([1, 2, 3], np.int64)
+    assert guard.TAP.check_payload("sizes", x) is x
+
+
+# ---------------------------------------------------------- digest core
+def test_tree_digest_sensitivity():
+    t = {"a": np.arange(6, dtype=np.float32), "b": np.zeros(2)}
+    d1 = gdigest.tree_digest(t)
+    assert d1 == gdigest.tree_digest(
+        {"a": np.arange(6, dtype=np.float32), "b": np.zeros(2)}
+    )
+    t2 = {"a": np.arange(6, dtype=np.float32), "b": np.zeros(2)}
+    t2["a"][3] += 1e-3
+    assert gdigest.tree_digest(t2) != d1
+    # dtype and shape are part of the identity, not just the bytes.
+    assert gdigest.tree_digest(
+        {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+         "b": np.zeros(2)}
+    ) != d1
+
+
+def test_state_digest_covers_arrays_and_counters():
+    from horovod_tpu.elastic import ObjectState
+
+    s1 = ObjectState(w=np.ones(4, np.float32), step=3)
+    s2 = ObjectState(w=np.ones(4, np.float32), step=3)
+    assert gdigest.state_digest(s1) == gdigest.state_digest(s2)
+    s2.step = 4
+    assert gdigest.state_digest(s1) != gdigest.state_digest(s2)
+    s2.step = 3
+    s2.w[0] = 7.0
+    assert gdigest.state_digest(s1) != gdigest.state_digest(s2)
+
+
+def test_find_quorum_cases():
+    ok, ref, out = gdigest.find_quorum(["d", "d", "d"])
+    assert ok and ref is None and out == []
+    # Strict majority heals from its lowest member.
+    ok, ref, out = gdigest.find_quorum(["d", "x", "d", "d"])
+    assert not ok and ref == 0 and out == [1]
+    ok, ref, out = gdigest.find_quorum(["x", "d", "d"])
+    assert not ok and ref == 1 and out == [0]
+    # 1-v-1 tie: no quorum → rollback (nothing trustworthy).
+    ok, ref, out = gdigest.find_quorum(["a", "b"])
+    assert not ok and ref is None and out == [0, 1]
+    # ... unless the operator opted into trusting the sync root.
+    ok, ref, out = gdigest.find_quorum(
+        ["a", "b"], no_quorum="root", sync_root=0
+    )
+    assert not ok and ref == 0 and out == [1]
+    # Everyone differs at 4 ranks: still no majority.
+    ok, ref, out = gdigest.find_quorum(["a", "b", "c", "e"])
+    assert not ok and ref is None and out == [0, 1, 2, 3]
+
+
+# ------------------------------------- digest agreement (mocked world)
+def _mock_world(monkeypatch, size, gathered):
+    monkeypatch.setattr(hvd, "is_initialized", lambda: True)
+    monkeypatch.setattr(hvd, "size", lambda: size)
+    monkeypatch.setattr(
+        hvd, "allgather_object", lambda obj, name=None, **kw: gathered(obj)
+    )
+
+
+def test_digest_check_heals_from_quorum(monkeypatch):
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv(guard.GUARD_DIGEST_STEPS_ENV, "2")
+    guard.activate_from_env()
+    state = elastic.ObjectState(w=np.ones(4, np.float32), step=0)
+    mine = gdigest.state_digest(state)
+    # 4 ranks: this rank agrees with the majority; rank 3 diverged.
+    _mock_world(monkeypatch, 4, lambda d: [d, d, d, "corrupted"])
+    synced = []
+    monkeypatch.setattr(
+        state, "sync", lambda: synced.append(elastic._sync_root())
+    )
+    state._guard_check_digest()  # commit 1 of 2: below cadence, no check
+    assert synced == []
+    state._guard_check_digest()  # commit 2: digest round fires
+    # Healed by re-broadcast from the quorum's reference rank (0), via
+    # the transient sync-root override.
+    assert synced == [0]
+    assert elastic._sync_root_override is None  # restored
+    del mine
+
+
+def test_digest_check_rolls_back_without_quorum(monkeypatch):
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv(guard.GUARD_DIGEST_STEPS_ENV, "1")
+    guard.activate_from_env()
+    state = elastic.ObjectState(w=np.ones(4, np.float32), step=0)
+    _mock_world(monkeypatch, 2, lambda d: [d, "diverged"])
+    with pytest.raises(hvd.HorovodInternalError) as e:
+        state._guard_check_digest()
+    assert "digest mismatch" in str(e.value)
+    assert "no agreeing quorum" in str(e.value)
+
+
+def test_commit_checks_digest_before_save(monkeypatch):
+    """A diverged replica must never become the rollback point: the
+    digest check runs BEFORE save() inside commit()."""
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv(guard.GUARD_DIGEST_STEPS_ENV, "1")
+    guard.activate_from_env()
+    state = elastic.ObjectState(w=np.ones(4, np.float32), step=0)
+    _mock_world(monkeypatch, 2, lambda d: [d, "diverged"])
+    state.w[0] = 123.0  # uncommitted divergence
+    with pytest.raises(hvd.HorovodInternalError):
+        state.commit()
+    state.restore()
+    assert state.w[0] == 1.0  # the bad value was never snapshotted
+
+
+# --------------------------------------- cross-rank metadata validation
+def _req(rank, name="t", rtype=None, dtype=10, shape=(4,), **kw):
+    from horovod_tpu.common.types import RequestType
+    from horovod_tpu.core.runtime import Request
+
+    return Request(
+        rank=rank,
+        request_type=rtype or RequestType.ALLREDUCE,
+        tensor_name=name, dtype=dtype, shape=tuple(shape), **kw,
+    )
+
+
+def test_negotiation_table_conflicts_name_tensor_and_ranks():
+    from horovod_tpu.common.types import ReduceOp, RequestType
+    from horovod_tpu.core.runtime import NegotiationTable
+
+    nt = NegotiationTable()
+    assert nt.observe(_req(0)) is None
+    msg = nt.observe(_req(1, shape=(8,)))
+    assert "Mismatched shapes" in msg
+    assert "'t'" in msg and "rank 0" in msg and "rank 1" in msg
+    assert "(4,)" in msg and "(8,)" in msg
+
+    nt = NegotiationTable()
+    nt.observe(_req(0))
+    assert "Mismatched data types" in nt.observe(_req(2, dtype=11))
+    nt = NegotiationTable()
+    nt.observe(_req(0))
+    assert "Mismatched reduce operations" in nt.observe(
+        _req(1, reduce_op=int(ReduceOp.MIN))
+    )
+    nt = NegotiationTable()
+    nt.observe(_req(0))
+    assert "Mismatched collective operations" in nt.observe(
+        _req(1, rtype=RequestType.ALLGATHER)
+    )
+    nt = NegotiationTable()
+    nt.observe(_req(0))
+    assert "Mismatched process sets" in nt.observe(
+        _req(1, process_set_id=5)
+    )
+    nt = NegotiationTable()
+    nt.observe(_req(0, rtype=RequestType.BROADCAST, root_rank=0))
+    assert "Mismatched root ranks" in nt.observe(
+        _req(1, rtype=RequestType.BROADCAST, root_rank=1)
+    )
+    # Allgather: dim0 may differ (Allgatherv parity), later dims may not.
+    nt = NegotiationTable()
+    nt.observe(_req(0, rtype=RequestType.ALLGATHER, shape=(2, 3)))
+    assert nt.observe(
+        _req(1, rtype=RequestType.ALLGATHER, shape=(5, 3))
+    ) is None
+    assert "Mismatched allgather dimensions" in nt.observe(
+        _req(2, rtype=RequestType.ALLGATHER, shape=(5, 4))
+    )
+
+
+def test_negotiation_table_validate_and_clear():
+    from horovod_tpu.common.types import ResponseType
+    from horovod_tpu.core.runtime import NegotiationTable
+
+    nt = NegotiationTable()
+    responses = nt.validate(
+        [_req(0), _req(1), _req(0, name="u"), _req(1, name="u", shape=(9,))]
+    )
+    assert len(responses) == 1
+    assert responses[0].response_type == ResponseType.ERROR
+    assert responses[0].tensor_names == ["u"]
+    # A completed tensor's slot clears: the name is reusable with a
+    # different signature afterwards.
+    nt.clear(["t"])
+    assert nt.observe(_req(1, shape=(16,))) is None
+
+
+def test_runtime_error_response_raises_aborted():
+    """A coordinator ERROR response aborts its waiters with the message
+    (Status.Aborted → HorovodInternalError), instead of hanging."""
+    from horovod_tpu.common.env import Config
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.common.types import ResponseType
+    from horovod_tpu.core.runtime import Response, Runtime
+
+    class ConflictCoordinator:
+        def compute_response_list(self, requests, queue, config):
+            return [
+                Response(
+                    ResponseType.ERROR, [r.tensor_name],
+                    error_message=(
+                        f"Mismatched shapes for tensor '{r.tensor_name}': "
+                        "rank 0 announced [...] but rank 1 announced [...]"
+                    ),
+                )
+                for r in requests
+            ]
+
+        def missing_ranks(self):
+            return {}
+
+        def shutdown(self):
+            pass
+
+    cfg = Config()
+    cfg.cycle_time_ms = 1.0
+    topo = Topology(rank=0, size=1, local_rank=0, local_size=1,
+                    cross_rank=0, cross_size=1)
+    rt = Runtime(cfg, topo, coordinator=ConflictCoordinator())
+    rt.start()
+    try:
+        h = rt.enqueue_allreduce("bad.grad", np.ones(4, np.float32))
+        with pytest.raises(hvd.HorovodInternalError) as e:
+            rt.synchronize(h, timeout=10.0)
+        assert "Mismatched shapes" in str(e.value)
+        assert "bad.grad" in str(e.value)
+        assert rt.running  # one bad tensor does not kill the runtime
+    finally:
+        rt.shutdown()
+
+
+# -------------------------------- compiled-mode policies at 2 / 4 ranks
+D = 8
+
+
+def _loss(p, b):
+    return jnp.mean((b * p["w"]) ** 2)
+
+
+def _nan_batch(n_ranks):
+    """Batch sharded over the data axis whose FIRST shard carries a NaN —
+    rank 0 produces non-finite gradients, the others stay healthy."""
+    b = np.linspace(1.0, 2.0, n_ranks * D).astype(np.float32)
+    b = b.reshape(n_ranks, D)
+    b[0, 0] = np.nan
+    return jnp.asarray(b.reshape(-1))
+
+
+def _clean_batch(n_ranks):
+    b = np.linspace(1.0, 2.0, n_ranks * D).astype(np.float32)
+    return jnp.asarray(b)
+
+
+def _mk(n_ranks, **kw):
+    mesh = build_mesh({"data": n_ranks}, devices=jax.devices()[:n_ranks])
+    tx = optax.sgd(0.1)
+    step = hvdj.make_train_step(_loss, tx, mesh, donate=False, **kw)
+    params = {"w": jnp.ones((D,), jnp.float32)}
+    return step, params, tx.init(params)
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4])
+def test_policy_zero_keeps_params_finite(n_ranks):
+    step, params, opt = _mk(n_ranks, nonfinite="zero")
+    new_params, _, _ = step(params, opt, _nan_batch(n_ranks))
+    w = np.asarray(new_params["w"])
+    assert np.isfinite(w).all()
+    # The healthy ranks' contributions survived: the step moved.
+    assert not np.array_equal(w, np.asarray(params["w"]))
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4])
+def test_policy_warn_detects_but_proceeds(n_ranks):
+    step, params, opt = _mk(n_ranks, nonfinite="warn")
+    new_params, _, _ = step(params, opt, _nan_batch(n_ranks))
+    # warn only observes: the poison propagates (that is the point of
+    # the stronger policies).
+    assert not np.isfinite(np.asarray(new_params["w"])).all()
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4])
+def test_policy_skip_holds_params_and_opt_state(n_ranks):
+    tx = optax.sgd(0.1, momentum=0.9)
+    mesh = build_mesh({"data": n_ranks}, devices=jax.devices()[:n_ranks])
+    step = hvdj.make_train_step(
+        _loss, tx, mesh, donate=False, nonfinite="skip"
+    )
+    params = {"w": jnp.ones((D,), jnp.float32)}
+    opt = tx.init(params)
+    new_params, new_opt, _ = step(params, opt, _nan_batch(n_ranks))
+    np.testing.assert_array_equal(
+        np.asarray(new_params["w"]), np.asarray(params["w"])
+    )
+    for a, b in zip(jax.tree.leaves(new_opt), jax.tree.leaves(opt)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # A clean step still applies.
+    p2, _, _ = step(params, opt, _clean_batch(n_ranks))
+    assert not np.array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4])
+def test_policy_abort_raises_named_error(n_ranks):
+    step, params, opt = _mk(n_ranks, nonfinite="abort")
+    with pytest.raises(hvd.HorovodInternalError) as e:
+        step(params, opt, _nan_batch(n_ranks))
+    assert "non-finite gradient guard" in str(e.value)
+    # Clean batches run normally through the aborting wrapper.
+    out = step(params, opt, _clean_batch(n_ranks))
+    assert len(out) == 3 and np.isfinite(float(out[2]))
+
+
+def test_policy_zero_overlap_parity():
+    """overlap=True with policy zero sanitizes per streamed group BEFORE
+    each psum — bitwise identical to the non-overlap zero path."""
+    params = {
+        f"layer{i}": {"w": jnp.full((D,), 1.0 + i, jnp.float32)}
+        for i in range(3)
+    }
+
+    def loss(p, b):
+        h = b
+        for k in sorted(p):
+            h = h * p[k]["w"]
+        return jnp.mean(h ** 2)
+
+    mesh = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    tx = optax.sgd(0.05)
+    batch = np.linspace(0.5, 1.5, 2 * D).astype(np.float32)
+    batch[0] = np.nan
+    batch = jnp.asarray(batch)
+    outs = {}
+    for overlap in (False, True):
+        step = hvdj.make_train_step(
+            loss, tx, mesh, donate=False, overlap=overlap,
+            nonfinite="zero",
+        )
+        outs[overlap] = step(params, tx.init(params), batch)
+    for a, b in zip(jax.tree.leaves(outs[False][0]),
+                    jax.tree.leaves(outs[True][0])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.isfinite(np.asarray(a)).all()
+
+
+def test_distributed_optimizer_skip_two_ranks():
+    mesh = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    tx = hvdj.DistributedOptimizer(optax.sgd(0.1), nonfinite="skip")
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    state = tx.init(params)
+
+    def upd(grads, st, p):
+        return tx.update(grads, st, p)
+
+    fn = _shard_map(
+        upd, mesh, in_specs=(P("data"), P(), P()), out_specs=P()
+    )
+    bad = np.ones((2, 4), np.float32)
+    bad[0, 0] = np.nan  # rank 0's shard is poisoned
+    updates, new_state = jax.jit(fn)(jnp.asarray(bad), state, params)
+    for u in jax.tree.leaves(updates):
+        np.testing.assert_array_equal(np.asarray(u), np.zeros_like(u))
+    clean = np.ones((2, 4), np.float32)
+    updates2, _ = jax.jit(fn)(jnp.asarray(clean), state, params)
+    assert any(
+        np.abs(np.asarray(u)).sum() > 0 for u in jax.tree.leaves(updates2)
+    )
+
+
+# ------------------------------------------------- guard-skip lint rule
+def test_check_guard_skip_agreement_rule(monkeypatch):
+    from horovod_tpu.analysis.preflight import check_guard_skip_agreement
+
+    # Policy not skip → never fires.
+    assert check_guard_skip_agreement(3, 0, policy="zero") == []
+    # Skip + streamed registrations + no seam → error.
+    fs = check_guard_skip_agreement(3, 0, policy="skip")
+    assert len(fs) == 1
+    assert fs[0].rule == "guard-skip-no-agreement"
+    assert fs[0].severity == "error"
+    # Seam present, or no streaming at all → clean.
+    assert check_guard_skip_agreement(3, 1, policy="skip") == []
+    assert check_guard_skip_agreement(0, 0, policy="skip") == []
+    # policy=None resolves the env knob.
+    monkeypatch.setenv(guard.GUARD_NONFINITE_ENV, "skip")
+    assert len(check_guard_skip_agreement(1, 0)) == 1
+
+
+def test_lint_step_flags_streamed_skip_without_agreement(monkeypatch):
+    from horovod_tpu import analysis
+
+    monkeypatch.setenv(guard.GUARD_NONFINITE_ENV, "skip")
+    mesh = build_mesh({"data": 2}, devices=jax.devices()[:2])
+    params = {"w": jnp.ones((D,), jnp.float32)}
+
+    def naked_streamed_step(p, b):
+        def streamed_loss(q, bb):
+            q = hvdj.stream_param_groups(q, axis_name="data")
+            return _loss(q, bb)
+
+        _, grads = jax.value_and_grad(streamed_loss)(p, b)
+        # Hand-rolled update with NO skip agreement: the hazard.
+        return jax.tree.map(lambda x, g: x - 0.1 * g, p, grads)
+
+    fn = _shard_map(
+        naked_streamed_step, mesh, in_specs=(P(), P("data")),
+        out_specs=P(),
+    )
+    findings = analysis.lint_step(
+        fn, params, _clean_batch(2), mesh=mesh
+    )
+    assert any(f.rule == "guard-skip-no-agreement" for f in findings)
+
+    # make_train_step emits the agreement seam → clean.
+    tx = optax.sgd(0.1)
+    step = hvdj.make_train_step(
+        _loss, tx, mesh, donate=False, overlap=True, nonfinite="skip"
+    )
+    findings = analysis.lint_step(
+        step, params, tx.init(params), _clean_batch(2), mesh=mesh
+    )
+    assert not any(
+        f.rule == "guard-skip-no-agreement" for f in findings
+    )
+
+
+# ------------------------------------------------ checkpoint atomicity
+def test_checkpoint_atomic_write_survives_midwrite_kill(tmp_path):
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "ckpt")
+    tree = {"w": np.arange(6, dtype=np.float32)}
+    ckpt.save_checkpoint(path, tree, step=1, use_orbax=False)
+    assert ckpt.latest_step(path) == 1
+
+    # Kill mid-payload-write of step 2: np.savez dies after partial
+    # bytes have been written to the temp file.
+    orig_savez = np.savez
+
+    def dying_savez(f, **kw):
+        f.write(b"PK\x03\x04 torn")
+        raise KeyboardInterrupt("killed mid-save")
+
+    np.savez = dying_savez
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            ckpt.save_checkpoint(
+                path, {"w": np.zeros(6, np.float32)}, step=2,
+                use_orbax=False,
+            )
+    finally:
+        np.savez = orig_savez
+    # The prior checkpoint is fully intact: pointer, payload, restore.
+    assert ckpt.latest_step(path) == 1
+    assert not os.path.exists(str(tmp_path / "ckpt" / "step_2.npz"))
+    assert not [
+        f for f in os.listdir(path) if ".tmp." in f
+    ], "temp files must not survive a failed save"
+    restored = ckpt.restore_checkpoint(
+        path, {"w": np.zeros(6, np.float32)}, broadcast=False
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), tree["w"]
+    )
+
+
+def test_checkpoint_latest_pointer_written_after_payload(tmp_path):
+    """latest.json must name a payload that exists: the pointer write
+    happens last, so dying between the two leaves the OLD pointer."""
+    from horovod_tpu.utils import checkpoint as ckpt
+
+    path = str(tmp_path / "ckpt")
+    ckpt.save_checkpoint(
+        path, {"w": np.ones(2, np.float32)}, step=5, use_orbax=False
+    )
+    meta = json.load(open(os.path.join(path, "latest.json")))
+    assert meta["step"] == 5
+    assert os.path.exists(os.path.join(path, "step_5.npz"))
+
+
+# ------------------------------------------------- snapshot quarantine
+def test_unreadable_snapshot_is_quarantined(tmp_path, monkeypatch):
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_ELASTIC_WORKER_ID", "hostA:0")
+    path = elastic._persist_path()
+    with open(path, "wb") as f:
+        f.write(b"not a pickle \x00\x01")
+    state = elastic.ObjectState(w=np.ones(2, np.float32), step=0)
+    assert elastic._maybe_restore_persisted(state) is False
+    # Quarantined aside, never re-read.
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    # A second generation finds nothing to trip over.
+    assert elastic._maybe_restore_persisted(state) is False
+
+
+def test_readable_snapshot_still_restores(tmp_path, monkeypatch):
+    import horovod_tpu.elastic as elastic
+
+    monkeypatch.setenv("HOROVOD_ELASTIC_STATE_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_ELASTIC_WORKER_ID", "hostA:0")
+    donor = elastic.ObjectState(w=np.full(2, 7.0, np.float32), step=9)
+    donor.save()
+    path = elastic._persist_path()
+    with open(path, "wb") as f:
+        pickle.dump(elastic._persist_payload(donor), f)
+    state = elastic.ObjectState(w=np.zeros(2, np.float32), step=0)
+    assert elastic._maybe_restore_persisted(state) is True
+    assert state.step == 9
+    np.testing.assert_array_equal(state.w, donor.w)

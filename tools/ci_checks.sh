@@ -21,6 +21,12 @@
 # compiled with overlap on/off on the virtual CPU mesh, asserting the
 # streamed build yields >=3 independent all-reduce groups interleaved
 # with compute by the scheduler (docs/overlap.md). Budget: under 60s.
+#
+# Stage 5 (make guard-smoke; skip with HVD_CI_SKIP_GUARD=1): the
+# data-plane integrity smoke — a 2-rank seeded nan+corrupt plan with the
+# non-finite sentinel and the parameter-digest heal asserted end-to-end,
+# and the event log byte-identical across two runs
+# (docs/fault_tolerance.md "Data-plane integrity"). Budget: under 15s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -50,4 +56,11 @@ if [ "${HVD_CI_SKIP_OVERLAP:-0}" != "1" ]; then
     python tools/tpu_profile_overlap.py --structural --assert-overlap
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: overlap structure verified in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_GUARD:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/guard_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: guard smoke detected+healed in ${elapsed}s"
 fi
