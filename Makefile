@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -13,7 +13,7 @@ test:
 # Collective-safety static analysis: Pass 1 over the example train steps
 # and Pass 2 over the runtime sources (docs/static_analysis.md).
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -38,4 +38,10 @@ overlap-smoke:
 guard-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/guard_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke test
+# Control-plane HA smoke (docs/fault_tolerance.md): seeded driver kill
+# mid-training + journal resume (--resume) + in-place worker reattach,
+# two runs with byte-identical normalized event logs, <90s CPU.
+driver-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/driver_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke test

@@ -101,6 +101,17 @@ HOROVOD_ELASTIC_REQUIRE_SNAPSHOT = "HOROVOD_ELASTIC_REQUIRE_SNAPSHOT"
 HOROVOD_GUARD_NONFINITE = "HOROVOD_GUARD_NONFINITE"
 HOROVOD_GUARD_DIGEST_STEPS = "HOROVOD_GUARD_DIGEST_STEPS"
 HOROVOD_GUARD_NO_QUORUM = "HOROVOD_GUARD_NO_QUORUM"
+# Control-plane availability (docs/fault_tolerance.md "Control-plane
+# availability"; run/journal.py + run/elastic_driver.py + elastic read
+# these directly): explicit driver-journal path (default:
+# <output-dir>/driver_journal.json), consecutive failed commit-time
+# driver probes before a worker votes to park, the --auto-resume
+# supervisor's restart budget, and the KV blackout the restart_driver
+# fault holds before replaying the journal in-process.
+HOROVOD_DRIVER_JOURNAL = "HOROVOD_DRIVER_JOURNAL"
+HOROVOD_DRIVER_LOST_PROBES = "HOROVOD_DRIVER_LOST_PROBES"
+HOROVOD_DRIVER_MAX_RESTARTS = "HOROVOD_DRIVER_MAX_RESTARTS"
+HOROVOD_FAULT_DRIVER_BLACKOUT_S = "HOROVOD_FAULT_DRIVER_BLACKOUT_S"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64

@@ -81,6 +81,15 @@ class _ElasticContext:
 
         self.worker_id = os.environ["HOROVOD_ELASTIC_WORKER_ID"]
         self.gen = int(os.environ.get("HOROVOD_ELASTIC_GEN", "1"))
+        # Driver-epoch fencing baseline (docs/fault_tolerance.md
+        # "Control-plane availability"): the incarnation of the driver
+        # that spawned this worker. A resumed driver presents a HIGHER
+        # epoch (reattach); anything lower is a stale driver that lost a
+        # supervisor race and must be rejected.
+        try:
+            self.epoch = int(os.environ.get("HOROVOD_DRIVER_EPOCH", "0"))
+        except ValueError:
+            self.epoch = 0
         # Rank holding the authoritative state for the current generation
         # (a survivor after a re-formation; see ElasticDriver._publish).
         # From env at spawn (a respawned worker joins mid-job and never
@@ -95,12 +104,120 @@ class _ElasticContext:
         self.timeout = float(
             os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600")
         )
+        # Consecutive failed control-plane probes; at the threshold the
+        # driver is declared lost and this rank votes to park.
+        self._probe_failures = 0
+        try:
+            self.lost_threshold = max(1, int(os.environ.get(
+                "HOROVOD_DRIVER_LOST_PROBES", "3")))
+        except ValueError:
+            self.lost_threshold = 3
+        self._parks = 0
 
-    def fetch_world(self) -> Optional[Dict[str, Any]]:
-        raw = self._kv.get("elastic", "world")
+    def fetch_world(self, strict: bool = False) -> Optional[Dict[str, Any]]:
+        raw = self._kv.get("elastic", "world", strict=strict)
         if raw is None:
             return None
         return json.loads(raw.decode())
+
+    def fetch_driver(self, strict: bool = False) -> Optional[Dict[str, Any]]:
+        """The driver's identity doc on the KV plane: epoch (fencing
+        token), generation, liveness beat."""
+        raw = self._kv.get("elastic", "driver", strict=strict)
+        if raw is None:
+            return None
+        return json.loads(raw.decode())
+
+    def probe_driver(self):
+        """One strict probe of the control plane for the park loop:
+        (driver_doc, world_doc), or (None, None) while the driver is
+        unreachable."""
+        try:
+            return self.fetch_driver(strict=True), self.fetch_world(
+                strict=True
+            )
+        except Exception:  # noqa: BLE001 - endpoint down
+            return None, None
+
+    def commit_probe(self):
+        """Per-commit control-plane probe. Returns
+        ``(updated, driver_lost, new_epoch)``:
+
+        - ``updated`` — a newer world generation is published;
+        - ``driver_lost`` — ``lost_threshold`` consecutive probes failed
+          (dead driver), or the plane is served by a STALE driver epoch
+          (split brain — park and wait to be fenced through);
+        - ``new_epoch`` — the driver restarted (epoch advanced) while
+          publishing the SAME generation: the fleet never broke, so this
+          rank can reattach in place, no parking and no collective."""
+        try:
+            world = self.fetch_world(strict=True)
+            driver = self.fetch_driver(strict=True)
+        except Exception:  # noqa: BLE001 - endpoint down
+            self._probe_failures += 1
+            return False, self._probe_failures >= self.lost_threshold, None
+        self._probe_failures = 0
+        updated = bool(world) and int(world["gen"]) > self.gen
+        if driver is not None:
+            try:
+                epoch = int(driver.get("epoch", 0) or 0)
+            except (TypeError, ValueError):
+                epoch = 0
+            if epoch < self.epoch:
+                # A fenced driver's world/generation claims are not
+                # trustworthy either: treat as loss, the park loop keeps
+                # rejecting it until a current driver answers.
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_worker_driver_fenced_total")
+                return False, True, None
+            if (epoch > self.epoch and not updated
+                    and world is not None
+                    and int(world["gen"]) == self.gen):
+                return False, False, epoch
+        return updated, False, None
+
+    def reattach(self, epoch: int) -> None:
+        """Adopt the resumed driver: accept its (higher) epoch,
+        re-register under it, and carry on — same generation, same
+        process, no rollback."""
+        self.epoch = int(epoch)
+        self._probe_failures = 0
+        self.signal_attach()
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_worker_reattaches_total")
+        if _fault_injector.ACTIVE:
+            _fault_injector.record_event(
+                "driver", 1, "reattach",
+                f"gen={self.gen} epoch={self.epoch}",
+            )
+        logger.warning(
+            "elastic: reattached to resumed driver (generation %s, "
+            "epoch %s)", self.gen, self.epoch,
+        )
+
+    def signal_attach(self) -> None:
+        """Re-register with a resumed driver: the adoption machinery
+        (ElasticDriver._poll_adopted) matches the generation + epoch and
+        uses the pid for local liveness supervision."""
+        try:
+            self._kv.put(
+                "elastic", f"attach.{self.worker_id}",
+                f"{self.gen}:{self.epoch}:{os.getpid()}".encode(),
+            )
+        except Exception:  # noqa: BLE001 - advisory signal
+            pass
+
+    def signal_done(self) -> None:
+        """Tell the driver this worker's training function returned.
+        A resumed driver has no process handle on adopted workers, so a
+        clean exit would otherwise be invisible to it."""
+        try:
+            self._kv.put(
+                "elastic", f"done.{self.worker_id}",
+                str(self.gen).encode(),
+            )
+        except Exception:  # noqa: BLE001 - advisory signal
+            pass
 
     def confirm_joined(self) -> None:
         """Tell the driver this worker completed a state sync in its
@@ -163,6 +280,12 @@ class _ElasticContext:
             }
         )
         self.sync_root = int(world.get("sync_root", 0))
+        # The generation doc is epoch-stamped: joining it acknowledges
+        # its driver, raising this worker's fencing baseline.
+        try:
+            self.epoch = max(self.epoch, int(world.get("epoch", 0) or 0))
+        except (TypeError, ValueError):
+            pass
         return True
 
 
@@ -174,6 +297,137 @@ def _ctx() -> Optional[_ElasticContext]:
     if _context is None and os.environ.get("HOROVOD_ELASTIC") == "1":
         _context = _ElasticContext()
     return _context
+
+
+# ------------------------------------------- driver-loss park/reattach
+class DriverWatch:
+    """Pure classification core of the worker-side park/reconnect state
+    machine (unit-testable without a fleet): given what a parked rank
+    currently observes on the KV plane, decide its next move.
+
+    - ``wait``     — no driver answering (or no world yet): keep parking.
+    - ``fenced``   — a driver is answering but with an epoch LOWER than
+      one this worker has already acknowledged: a stale incarnation that
+      lost a supervisor race. Rejected; keep parking for the real one.
+    - ``reattach`` — a current-or-newer epoch republished the SAME
+      generation this rank is part of: the fleet never broke, resume in
+      place (``epoch_seen`` carries the epoch to adopt).
+    - ``rejoin``   — the returning driver published a DIFFERENT
+      generation: this rank's world is gone; degrade to the existing
+      membership-interrupt path (state kept, re-sync, or respawn)."""
+
+    def __init__(self, gen: int, epoch: int):
+        self.gen = int(gen)
+        self.epoch = int(epoch)
+        self.epoch_seen: Optional[int] = None
+        self.fenced = 0
+
+    def classify(self, driver_doc, world_doc) -> str:
+        if not isinstance(driver_doc, dict):
+            return "wait"
+        try:
+            epoch = int(driver_doc.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return "wait"
+        if epoch < self.epoch:
+            self.fenced += 1
+            return "fenced"
+        if not isinstance(world_doc, dict):
+            return "wait"
+        try:
+            gen = int(world_doc.get("gen", -1))
+        except (TypeError, ValueError):
+            return "wait"
+        if gen == self.gen:
+            self.epoch_seen = epoch
+            return "reattach"
+        return "rejoin"
+
+
+# Cross-rank outcome agreement codes, ordered by severity (the fleet
+# adopts the MAX so no rank resumes into a world a peer abandoned).
+PARK_OUTCOMES = {"reattach": 0, "rejoin": 1, "dead": 2}
+
+
+def _park_and_reattach(ctx: _ElasticContext, state=None) -> None:
+    """Driver-loss handling, entered at a commit boundary once the fleet
+    AGREED (via the host-check allreduce) that the driver is gone:
+    training state is held, collectives are quiesced, and every rank
+    polls the KV plane with the bounded-backoff machinery until a
+    current-epoch driver answers. Same generation back → reattach in
+    place; new generation → the existing rollback/rejoin path; no driver
+    within the elastic timeout → collective failure (rollback, and in
+    respawn mode persist-and-exit so a future driver finds the
+    snapshots)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    from ..fault.backoff import Backoff
+
+    ctx._parks += 1
+    if _metrics.ACTIVE:
+        _metrics.TAP.inc("hvd_worker_parks_total")
+    if _fault_injector.ACTIVE:
+        _fault_injector.record_event(
+            "driver", ctx._parks, "park", f"gen={ctx.gen}"
+        )
+    logger.warning(
+        "elastic: driver unreachable; parked at the commit boundary "
+        "(state held, collectives quiesced; gen %s, epoch %s)",
+        ctx.gen, ctx.epoch,
+    )
+    watch = DriverWatch(ctx.gen, ctx.epoch)
+    backoff = Backoff.from_env()
+    deadline = time.monotonic() + ctx.timeout
+    attempt = 0
+    fenced_logged = False
+    outcome = "dead"
+    while time.monotonic() <= deadline:
+        driver_doc, world_doc = ctx.probe_driver()
+        got = watch.classify(driver_doc, world_doc)
+        if got in ("reattach", "rejoin"):
+            outcome = got
+            break
+        if got == "fenced":
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_worker_driver_fenced_total")
+            if not fenced_logged:
+                fenced_logged = True
+                if _fault_injector.ACTIVE:
+                    _fault_injector.record_event(
+                        "driver", ctx._parks, "fenced",
+                        f"epoch={driver_doc.get('epoch')}<{ctx.epoch}",
+                    )
+                logger.error(
+                    "elastic: rejecting stale driver (epoch %s < "
+                    "acknowledged %s); waiting for a current one",
+                    driver_doc.get("epoch"), ctx.epoch,
+                )
+        time.sleep(backoff.delay(min(attempt, 5)))
+        attempt += 1
+    # Outcome agreement: a rank must not resume into a world a peer has
+    # abandoned (or vice versa) — adopt the most severe observation.
+    code = PARK_OUTCOMES[outcome]
+    if hvd.is_initialized() and hvd.size() > 1:
+        agreed = int(np.asarray(hvd.allreduce(
+            np.asarray([code], np.int32), op=hvd.Max,
+            name="hvd.elastic.parkagree",
+        ))[0])
+    else:
+        agreed = code
+    if agreed == PARK_OUTCOMES["reattach"]:
+        ctx.reattach(watch.epoch_seen if watch.epoch_seen is not None
+                     else ctx.epoch)
+        return
+    if agreed == PARK_OUTCOMES["rejoin"]:
+        raise HostsUpdatedInterrupt(
+            "driver resumed with a new world generation; rejoining"
+        )
+    raise hvd.HorovodInternalError(
+        f"elastic: no current driver within {ctx.timeout:g}s of parking "
+        f"(last known generation {ctx.gen}, epoch {ctx.epoch})"
+    )
 
 
 def _jax_distributed_initialize(coord: str, num: int, pid: int) -> None:
@@ -571,6 +825,11 @@ def _rejoin(ctx: _ElasticContext) -> None:
         try:
             hvd.init()
             ctx.gen = int(world["gen"])  # committed only on success
+            # A resumed driver supervising adopted workers has no
+            # process handle on this rank: the attach signal (stamped
+            # with the generation + acknowledged epoch) is how it learns
+            # the rejoin landed.
+            ctx.signal_attach()
             if _metrics.ACTIVE:
                 _metrics.TAP.inc("hvd_elastic_rejoins_total")
             return
@@ -720,13 +979,26 @@ class State:
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_guard_heals_total")
 
+    # Per-rank vote weights for the commit-time agreement allreduce:
+    # updated/preempted contribute at most 3 per rank, so the driver-lost
+    # bit rides a band no sum of the small votes can reach below 32k
+    # ranks — one flag allreduce carries all three signals.
+    _LOST_WEIGHT = 65536
+
     def check_host_updates(self) -> None:
         """Raise ``HostsUpdatedInterrupt`` on EVERY rank when any rank has
         seen a newer world generation — agreement by allreduce so no rank
         runs ahead into a collective its peers abandoned. A pending
         preemption notice rides the same agreement: the preempted rank
         raises ``PreemptionInterrupt`` (drain + rejoin with the state just
-        committed), its peers a plain membership interrupt."""
+        committed), its peers a plain membership interrupt.
+
+        The same probe doubles as the driver heartbeat/epoch check
+        (docs/fault_tolerance.md "Control-plane availability"): when any
+        rank has lost the driver, ALL ranks park at this commit boundary
+        (state held, collectives quiesced) and reconnect/reattach; a
+        driver that restarted without ever dropping off (epoch advanced,
+        same generation) is reattached in place — a purely local act."""
         ctx = _ctx()
         if ctx is None:
             return
@@ -735,8 +1007,12 @@ class State:
         import horovod_tpu as hvd
 
         preempted = _preemption.preemption_requested()
+        updated, lost, new_epoch = ctx.commit_probe()
+        if new_epoch is not None and not (lost or updated or preempted):
+            ctx.reattach(new_epoch)
         flag = np.asarray(
-            [(2 if preempted else 0) + (1 if ctx.poll_updated() else 0)],
+            [(self._LOST_WEIGHT if lost else 0)
+             + (2 if preempted else 0) + (1 if updated else 0)],
             np.int32,
         )
         if hvd.size() > 1:
@@ -748,6 +1024,9 @@ class State:
             raise PreemptionInterrupt(
                 _preemption.preemption_reason() or "preemption notice"
             )
+        if total >= self._LOST_WEIGHT:
+            _park_and_reattach(ctx, self)
+            return
         if total >= 2:
             raise HostsUpdatedInterrupt(
                 "a peer rank received a preemption notice; re-forming "
@@ -1183,6 +1462,9 @@ def run(func: Callable) -> Callable:
                 # future generation's sync source.
                 ctx.confirm_joined()
                 result = func(state, *args, **kwargs)
+                # A resumed (adopting) driver cannot see this process
+                # exit; the done signal is its completion record.
+                ctx.signal_done()
                 if mode == "respawn":
                     # Clean finish: a leftover snapshot must not
                     # resurrect into an unrelated later job on this slot.

@@ -24,6 +24,7 @@ import time
 from typing import Dict, List, Optional
 
 from .plan import (
+    DRIVER_KINDS,
     FAULT_PLAN_ENV,
     FaultAction,
     FaultPlan,
@@ -180,6 +181,8 @@ def fault_point(site: str, name: Optional[str] = None) -> Optional[str]:
             continue
         if action.kind in PAYLOAD_KINDS:
             continue  # payload faults run through payload_fault()
+        if action.kind in DRIVER_KINDS:
+            continue  # driver faults fire in the driver's own loop
         if not action.matches_process(rank, worker, gen):
             continue
         if not action.in_window(hit):

@@ -32,10 +32,18 @@ Action fields
 
 ``kind``
     ``kill`` | ``delay`` | ``drop`` | ``duplicate`` | ``preempt`` |
-    ``corrupt`` | ``nan``. The last two are *payload* faults exercising
-    the data-plane integrity guard (docs/fault_tolerance.md): ``corrupt``
-    bit-flips one element of a tensor payload (silent data corruption),
-    ``nan`` poisons one element of a floating-point gradient.
+    ``corrupt`` | ``nan`` | ``kill_driver`` | ``restart_driver``.
+    ``corrupt``/``nan`` are *payload* faults exercising the data-plane
+    integrity guard (docs/fault_tolerance.md): ``corrupt`` bit-flips one
+    element of a tensor payload (silent data corruption), ``nan``
+    poisons one element of a floating-point gradient.
+    ``kill_driver``/``restart_driver`` are *control-plane* faults
+    executed by the elastic driver itself ``after_s`` seconds into its
+    run: a hard ``os._exit`` of the driver process (resume with
+    ``horovodrun --resume``) and an in-process simulated crash-restart
+    (KV blackout → journal replay → epoch bump → port reclaim). Scoped
+    by ``epoch`` (default: first driver incarnation only), so a resumed
+    driver never re-executes its own death.
 ``site``
     Tap the action applies to: ``step`` (one training step, i.e. one
     ``State.commit``), ``enqueue``/``response`` (runtime collective
@@ -83,12 +91,24 @@ from typing import Any, Dict, List, Optional
 
 FAULT_PLAN_ENV = "HOROVOD_FAULT_PLAN"
 
-_KINDS = ("kill", "delay", "drop", "duplicate", "preempt", "corrupt", "nan")
+_KINDS = ("kill", "delay", "drop", "duplicate", "preempt", "corrupt", "nan",
+          "kill_driver", "restart_driver")
 _SITES = ("step", "enqueue", "response", "rpc", "kv", "spawn",
-          "payload", "output")
+          "payload", "output", "driver")
 # Payload faults mutate tensors; only these sites carry one.
 PAYLOAD_KINDS = ("corrupt", "nan")
 PAYLOAD_SITES = ("payload", "output")
+# Driver faults execute in the ELASTIC DRIVER's supervision loop (site
+# ``driver``), never at worker taps: ``kill_driver`` hard-kills the
+# driver process ``after_s`` seconds into its run (the control-plane
+# SPOF model — resume via ``horovodrun --resume`` or a supervisor);
+# ``restart_driver`` simulates the full crash-restart cycle in-process
+# (KV blackout → journal replay → epoch bump → port reclaim →
+# republish) so a single job exercises park/reattach. Both are scoped
+# by the ``epoch`` selector (default: the FIRST driver incarnation
+# only) so a resumed driver does not re-execute its own death.
+DRIVER_KINDS = ("kill_driver", "restart_driver")
+DRIVER_KILL_EXIT_CODE = 67
 _DEFAULT_SITE = {
     "kill": "step",
     "preempt": "step",
@@ -97,6 +117,8 @@ _DEFAULT_SITE = {
     "duplicate": "rpc",
     "corrupt": "output",
     "nan": "payload",
+    "kill_driver": "driver",
+    "restart_driver": "driver",
 }
 # How many leading decisions of each probabilistic stream the canonical
 # schedule materializes (enough to make drop bursts diffable without
@@ -121,6 +143,7 @@ class FaultAction:
     element: Optional[int] = None  # payload faults: flat index to poison
     bit: Optional[int] = None      # corrupt: bit of that element to flip
     tensor: Optional[str] = None   # payload faults: name pattern (fnmatch)
+    epoch: Optional[int] = None    # driver faults: driver incarnation
     index: int = 0  # position in the plan; part of the stream key
 
     @staticmethod
@@ -137,6 +160,13 @@ class FaultAction:
                 f"fault plan action {index}: unknown site {site!r} "
                 f"(expected one of {_SITES})"
             )
+        if (kind in DRIVER_KINDS) != (site == "driver"):
+            raise ValueError(
+                f"fault plan action {index}: kind {kind!r} and site "
+                f"{site!r} do not match — driver faults "
+                f"({'/'.join(DRIVER_KINDS)}) execute only at the "
+                "'driver' site (the elastic driver's supervision loop)"
+            )
         return FaultAction(
             kind=kind,
             site=site,
@@ -150,7 +180,10 @@ class FaultAction:
             count=None if d.get("count") is None else int(d["count"]),
             frac=float(d.get("frac", 1.0)),
             seconds=float(d.get("seconds", 0.0)),
-            exit_code=int(d.get("exit_code", 43)),
+            exit_code=int(d.get(
+                "exit_code",
+                DRIVER_KILL_EXIT_CODE if kind == "kill_driver" else 43,
+            )),
             after_s=(
                 None if d.get("after_s") is None else float(d["after_s"])
             ),
@@ -159,13 +192,14 @@ class FaultAction:
             ),
             bit=None if d.get("bit") is None else int(d["bit"]),
             tensor=d.get("tensor"),
+            epoch=None if d.get("epoch") is None else int(d["epoch"]),
             index=index,
         )
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"kind": self.kind, "site": self.site}
         for k in ("rank", "worker", "gen", "at_step", "count", "after_s",
-                  "element", "bit", "tensor"):
+                  "element", "bit", "tensor", "epoch"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v
@@ -178,6 +212,13 @@ class FaultAction:
         if self.kind == "kill":
             out["exit_code"] = self.exit_code
         return out
+
+    def matches_driver_epoch(self, epoch: int) -> bool:
+        """Driver-fault scoping: an action with no explicit ``epoch``
+        targets ONLY the first driver incarnation — otherwise a resumed
+        driver, armed with the same plan from its environment, would
+        faithfully re-execute the very crash it just recovered from."""
+        return epoch == (self.epoch if self.epoch is not None else 1)
 
     def matches_process(self, rank: Optional[int], worker: Optional[str],
                         gen: Optional[int]) -> bool:

@@ -35,9 +35,11 @@ from typing import Dict, List, Optional, Tuple
 
 import signal
 
+from . import journal as _journal_mod
 from . import launcher, safe_shell_exec
 from .. import metrics as _metrics
 from ..fault import injector as _fault
+from ..fault.plan import DRIVER_KINDS
 from .http_server import KVStoreServer
 from .launcher import SlotInfo, _free_port, _is_local
 
@@ -152,6 +154,7 @@ class ElasticDriver:
         nic_pinned: bool = False,
         probed_hostset: Optional[List[str]] = None,
         blacklist_cooldown: Optional[float] = None,
+        resume: bool = False,
     ) -> None:
         if not hosts and not discovery_script:
             raise ValueError(
@@ -196,6 +199,47 @@ class ElasticDriver:
                 "inprocess" if _inprocess_rejoin_supported() else "respawn"
             )
         self._env["HOROVOD_ELASTIC_REJOIN_MODE"] = self._rejoin_mode
+        # --- durable control-plane journal (docs/fault_tolerance.md
+        # "Control-plane availability"): generation, membership,
+        # blacklist, and the rendezvous-critical KV keys are
+        # write-ahead-logged so a crashed driver can be resumed
+        # (--resume) without losing the fleet. Opening the journal bumps
+        # the driver EPOCH — the fencing token workers use to reject a
+        # stale driver that lost a supervisor race.
+        self._resume = bool(resume)
+        self._resume_finished = False
+        self._resume_world: Optional[Dict] = None
+        jpath = _journal_mod.default_path(self._output_dir, self._env)
+        if self._resume and jpath is None:
+            raise ValueError(
+                "--resume needs --output-dir (or HOROVOD_DRIVER_JOURNAL) "
+                "to locate the driver journal"
+            )
+        self._journal = (
+            _journal_mod.DriverJournal.open(jpath) if jpath else None
+        )
+        self._epoch = self._journal.epoch if self._journal else 1
+        prior = self._journal.state if self._journal else {}
+        if self._resume:
+            if not prior.get("gen"):
+                raise ValueError(
+                    f"--resume: no resumable driver journal at {jpath}"
+                )
+            if prior.get("finished"):
+                # The job completed before the crash-restart raced in;
+                # nothing to resume — run() exits 0 without touching the
+                # (long gone) fleet.
+                self._resume_finished = True
+            self._gen = int(prior.get("gen", 0))
+            self._resume_world = prior.get("world")
+            sd = prior.get("state_dir")
+            if sd:
+                # The predecessor's snapshot dir, NOT a fresh pid-keyed
+                # one: a fallback respawn must find the fleet's last
+                # persisted commits.
+                self._env["HOROVOD_ELASTIC_STATE_DIR"] = sd
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_driver_journal_replays_total")
         # Per-host snapshot dir for respawn-mode resume (workers write
         # locally; a slot's respawn lands on the same host). The driver
         # pid keys the path so every generation of the job shares it.
@@ -209,14 +253,26 @@ class ElasticDriver:
                 tempfile.gettempdir(), f"hvd_elastic_state_{os.getpid()}"
             ),
         )
+        if self._resume:
+            # Ownership (and the cleanup duty that comes with it)
+            # transfers from the crashed predecessor.
+            self._state_dir_owned = bool(prior.get("state_dir_owned"))
         # The KV rendezvous server doubles as the metrics endpoint
         # (GET /metrics, docs/metrics.md); HOROVOD_METRICS_PORT pins its
-        # port so scrapers have a stable target.
+        # port so scrapers have a stable target. A resumed driver MUST
+        # reclaim the journal-recorded port — every surviving worker
+        # dialed it at spawn — so the bind waits out lingering TIME_WAIT
+        # state instead of failing (SO_REUSEADDR + bounded retry).
         try:
             kv_port = int(self._env.get("HOROVOD_METRICS_PORT", "") or 0)
         except ValueError:
             kv_port = 0
-        self._kv = KVStoreServer(port=kv_port)
+        if self._resume and prior.get("kv_port"):
+            kv_port = int(prior["kv_port"])
+        self._kv = KVStoreServer(
+            port=kv_port,
+            reclaim_wait_s=10.0 if (self._resume and kv_port) else 0.0,
+        )
         # --network-interfaces pin: never ring-probe, the user chose.
         self._nic_pinned = nic_pinned
         # Host set most recently ring-probed for NICs — seeded with the
@@ -233,8 +289,24 @@ class ElasticDriver:
         self._services: List[list] = []
         self._last_hosts: List[Tuple[str, int]] = list(hosts or [])
         self._stop_discovery = threading.Event()
-        self._gen = 0
+        if not self._resume:
+            self._gen = 0
         self._workers: Dict[str, _Worker] = {}
+        # Control-plane HA bookkeeping: the last published world doc (the
+        # journal's authoritative membership record), the driver-doc beat
+        # counter, and — after a resume — the adoption state machine for
+        # workers that outlived the previous driver (no process handles;
+        # supervised via KV attach/done signals and local pid probes).
+        self._last_world: Optional[Dict] = None
+        self._beat = 0
+        self._adopting = bool(self._resume_world) and not self._resume_finished
+        self._attached: Dict[str, int] = {}
+        self._adopt_deadline: Optional[float] = None
+        self._adopt_drain_pids: Optional[set] = None
+        self._adopt_drain_deadline = 0.0
+        self._driver_faults_fired: set = set()
+        self._last_journaled_kv: Optional[Dict[str, str]] = None
+        self._started_at = time.monotonic()
         # Workers dropped from the world, draining toward a voluntary
         # exit (they see the new generation and leave cleanly); value is
         # the terminate-anyway deadline.
@@ -265,6 +337,22 @@ class ElasticDriver:
             except ValueError:
                 blacklist_cooldown = 300.0
         self._blacklist_cooldown = blacklist_cooldown
+        if self._resume:
+            # Quarantines journaled as wall-clock deadlines + remaining
+            # budget come back onto THIS process's monotonic clock,
+            # skew-clamped (see journal.blacklist_from_journal): healthy
+            # hosts are not re-quarantined, active quarantines are not
+            # forgotten.
+            self._blacklist = _journal_mod.blacklist_from_journal(
+                prior.get("blacklist") or {}
+            )
+            self._quarantine_strikes = {
+                h: int(n) for h, n in (prior.get("strikes") or {}).items()
+            }
+            self._failures = {
+                h: int(n) for h, n in (prior.get("failures") or {}).items()
+            }
+            self._seed_kv(prior)
         self._finishing = False
         # Respawn mode: a world restart is queued behind the drain pool.
         self._restart_pending = False
@@ -290,6 +378,14 @@ class ElasticDriver:
                 os.path.join(self._output_dir, "fault_events.driver.jsonl"),
             )
             self._log(f"fault plan armed (seed {plan.seed}): {sched_path}")
+        if _metrics.ACTIVE:
+            _metrics.TAP.set("hvd_driver_epoch", float(self._epoch))
+        if self._journal is not None:
+            self._journal_sync(force=True)
+            self._log(
+                f"driver journal: {self._journal.path} "
+                f"(epoch {self._epoch})"
+            )
         self._log(f"rejoin mode: {self._rejoin_mode}")
 
     # ------------------------------------------------------------ pieces
@@ -308,6 +404,338 @@ class ElasticDriver:
                     f.write(time.strftime("%H:%M:%S ") + line + "\n")
             except OSError:
                 pass
+
+    # ------------------------------------------------ control-plane HA
+    def _journal_sync(self, force: bool = False) -> None:
+        """Write-ahead journal the full control-plane state (atomic
+        tmp+fsync+replace). Called with ``force`` at every driver-owned
+        transition (publish, blacklist change, resume) and periodically
+        from the supervision loop to pick up worker-written KV drift
+        (``joined.*``/``rejoin.*`` signals); the periodic path only
+        writes when the rendezvous scope actually changed."""
+        # getattr: unit tests build bare drivers (__new__) around the
+        # blacklist methods without the journal plumbing.
+        if getattr(self, "_journal", None) is None:
+            return
+        kv_snap = {
+            k: v.decode("utf-8", "replace")
+            for k, v in self._kv.snapshot("elastic").items()
+            # The driver doc's beat changes every second and is
+            # re-derived on resume anyway — journaling it would turn the
+            # change-detection below into an every-second rewrite.
+            if k != "driver"
+        }
+        if not force and kv_snap == self._last_journaled_kv:
+            return
+        self._journal.record(
+            epoch=self._epoch,
+            gen=self._gen,
+            kv_port=self._kv.port,
+            rejoin_mode=self._rejoin_mode,
+            state_dir=self._env["HOROVOD_ELASTIC_STATE_DIR"],
+            state_dir_owned=self._state_dir_owned,
+            world=self._last_world,
+            current_ids=list(self._current_ids),
+            kv=kv_snap,
+            blacklist=_journal_mod.blacklist_to_journal(self._blacklist),
+            strikes=dict(self._quarantine_strikes),
+            failures=dict(self._failures),
+        )
+        self._last_journaled_kv = kv_snap
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_driver_journal_writes_total")
+
+    def _seed_kv(self, prior: Dict) -> None:
+        """Reload the journal's rendezvous-critical keys into the fresh
+        KV store. ``attach.*`` signals are per-epoch (workers must
+        re-register under the NEW epoch) and the ``world``/``driver``
+        docs are re-stamped with it, so those are excluded/rewritten;
+        everything else (``joined.*`` sync-root eligibility, pending
+        ``rejoin.*``/``done.*`` signals) replays verbatim."""
+        for k, v in (prior.get("kv") or {}).items():
+            if k in ("world", "driver") or k.startswith("attach."):
+                continue
+            self._kv.put("elastic", k, v.encode())
+
+    def _publish_driver_doc(self) -> None:
+        """Advertise this driver's identity on the KV plane: the epoch
+        (fencing token — workers reject anything lower than they have
+        seen) plus the current generation and a liveness beat."""
+        self._beat += 1
+        self._kv.put(
+            "elastic", "driver",
+            json.dumps({
+                "epoch": self._epoch,
+                "gen": self._gen,
+                "beat": self._beat,
+            }).encode(),
+        )
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            pass  # e.g. EPERM: exists but not ours
+        return True
+
+    def _enter_adoption(self) -> None:
+        """Resume path: re-enter the elastic loop at the journaled
+        generation and ADOPT the surviving fleet instead of respawning
+        it. In respawn mode the coordination plane (rank 0's controller
+        + jax coordinator) outlived the old driver, so the recorded
+        world is republished AS IS — same generation, new epoch — and
+        workers parked at their commit boundaries reattach in place. In
+        in-process mode the old driver hosted the coordination service,
+        so its death already failed the workers' collectives: publish a
+        FRESH generation (new endpoints) and let the survivors rejoin
+        through the existing rollback path — reattach degrades to
+        rejoin, never to a respawn of live processes."""
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_driver_restarts_total")
+            _metrics.TAP.set("hvd_driver_epoch", float(self._epoch))
+        if self._rejoin_mode == "respawn":
+            world = dict(self._resume_world)
+            world["epoch"] = self._epoch
+            self._last_world = world
+            self._current_ids = list(world.get("assignments", {}))
+            self._journal_sync(force=True)  # WAL before workers can see it
+            self._kv.put("elastic", "world", json.dumps(world).encode())
+            if _metrics.ACTIVE:
+                _metrics.TAP.set(
+                    "hvd_elastic_generation", float(self._gen)
+                )
+                _metrics.TAP.set(
+                    "hvd_elastic_world_size",
+                    float(len(self._current_ids)),
+                )
+        else:
+            slots = self._slots_from_world(self._resume_world)
+            self._publish(slots)  # gen+1, fresh coordination service
+            self._current_ids = [self._worker_id(s) for s in slots]
+        self._publish_driver_doc()
+        self._adopt_deadline = time.monotonic() + max(
+            30.0, self._restart_grace
+        )
+        if _fault.ACTIVE:
+            _fault.record_event(
+                "driver", 1, "resume",
+                f"gen={self._gen} epoch={self._epoch}",
+            )
+        self._log(
+            f"resumed at generation {self._gen} (epoch {self._epoch}); "
+            f"awaiting reattach of {sorted(self._current_ids)}"
+        )
+
+    @staticmethod
+    def _slots_from_world(world: Dict) -> List[SlotInfo]:
+        """Rebuild the slot allocation from a journaled world doc (the
+        in-process resume path needs real slots to publish fresh
+        endpoints for)."""
+        slots = []
+        for wid, a in (world.get("assignments") or {}).items():
+            host = wid.rsplit(":", 1)[0]
+            slots.append(SlotInfo(
+                hostname=host,
+                rank=int(a["rank"]),
+                size=int(world.get("size", len(world["assignments"]))),
+                local_rank=int(a["local_rank"]),
+                local_size=int(a["local_size"]),
+                cross_rank=int(a["cross_rank"]),
+                cross_size=int(a["cross_size"]),
+            ))
+        slots.sort(key=lambda s: s.rank)
+        return slots
+
+    def _poll_adopted(self) -> Optional[int]:
+        """Supervise adopted workers (no process handles — the previous
+        driver owned those): reattach via ``attach.<wid>`` KV signals
+        stamped with this epoch, completion via ``done.<wid>``, failure
+        via ``rejoin.<wid>`` signals, local pid probes, and the
+        reattach grace deadline. Returns an exit code when the job is
+        finished, else None."""
+        snap = self._kv.snapshot("elastic")
+        gen_s = str(self._gen)
+        for wid in self._current_ids:
+            if wid in self._attached:
+                continue
+            raw = snap.get(f"attach.{wid}")
+            if not raw:
+                continue
+            try:
+                a_gen, a_epoch, a_pid = raw.decode().split(":")
+            except ValueError:
+                continue
+            if a_gen == gen_s and int(a_epoch) == self._epoch:
+                self._attached[wid] = int(a_pid)
+                if _metrics.ACTIVE:
+                    _metrics.TAP.inc("hvd_driver_worker_reattaches_total")
+                self._log(
+                    f"worker {wid} reattached "
+                    f"(pid {a_pid}, epoch {self._epoch})"
+                )
+        done = {
+            wid for wid in self._current_ids
+            if (snap.get(f"done.{wid}") or b"").decode() == gen_s
+        }
+        if self._current_ids and done >= set(self._current_ids):
+            self._log("all adopted workers completed; job finished")
+            return 0
+        if any(
+            k.startswith("rejoin.") and v.decode() == gen_s
+            for k, v in snap.items()
+        ):
+            self._abandon_adoption(
+                "a worker abandoned the adopted generation"
+            )
+            return None
+        dead = [
+            wid for wid, pid in self._attached.items()
+            if wid not in done and _is_local(wid.rsplit(":", 1)[0])
+            and not self._pid_alive(pid)
+        ]
+        if dead:
+            for wid in dead:
+                self._record_failure(wid.rsplit(":", 1)[0])
+                self._log(f"adopted worker {wid} died")
+            self._abandon_adoption(f"adopted workers died: {dead}")
+            return None
+        if (len(self._attached) < len(self._current_ids)
+                and self._adopt_deadline is not None
+                and time.monotonic() > self._adopt_deadline):
+            missing = sorted(
+                set(self._current_ids) - set(self._attached)
+            )
+            self._abandon_adoption(
+                f"workers never reattached within grace: {missing}"
+            )
+        return None
+
+    def _abandon_adoption(self, why: str) -> None:
+        """Adoption failed (a worker died while the driver was down, or
+        survivors never reattached): degrade to the existing
+        respawn-from-snapshots restart. Attached workers get a SIGTERM
+        (their graceful-preemption path persists the last commit) and a
+        drain window before the fresh generation is published, so their
+        snapshots land before the replacements read them."""
+        self._log(
+            f"adoption abandoned: {why}; restarting the world from "
+            "persisted snapshots"
+        )
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_elastic_restarts_total")
+        drain = set()
+        for wid, pid in self._attached.items():
+            if not _is_local(wid.rsplit(":", 1)[0]):
+                continue
+            if self._pid_alive(pid):
+                try:
+                    os.kill(pid, signal.SIGTERM)
+                    drain.add(pid)
+                except OSError:
+                    pass
+        self._adopting = False
+        self._attached = {}
+        self._current_ids = []
+        self._adopt_drain_pids = drain
+        self._adopt_drain_deadline = time.monotonic() + self._restart_grace
+        self._journal_sync(force=True)
+
+    def _maybe_fire_driver_faults(self) -> None:
+        """Scheduled control-plane faults (docs/fault_tolerance.md):
+        ``kill_driver`` hard-exits this process ``after_s`` seconds into
+        the run (resume via ``--resume``/supervisor); ``restart_driver``
+        runs the full crash-restart cycle in-process. Both fire once,
+        and only in the driver incarnation the action's ``epoch``
+        selector names (default: the first), so a resumed driver never
+        replays its own death."""
+        plan = _fault.active_plan()
+        if plan is None:
+            return
+        now = time.monotonic()
+        for action in plan.actions:
+            if action.kind not in DRIVER_KINDS or action.after_s is None:
+                continue
+            if not action.matches_driver_epoch(self._epoch):
+                continue
+            if action.gen is not None and action.gen != self._gen:
+                continue
+            if action.index in self._driver_faults_fired:
+                continue
+            if now - self._started_at < action.after_s:
+                continue
+            self._driver_faults_fired.add(action.index)
+            _fault.record_event(
+                "driver", 1, action.kind,
+                f"gen={self._gen} epoch={self._epoch}",
+            )
+            if action.kind == "kill_driver":
+                self._log(
+                    "fault plan: killing driver "
+                    f"(exit {action.exit_code})"
+                )
+                sys.stderr.flush()
+                os._exit(action.exit_code)
+            else:
+                self._simulated_restart()
+
+    def _simulated_restart(self) -> None:
+        """The ``restart_driver`` fault: a full crash-restart cycle
+        without process death — KV blackout (workers observe driver
+        loss and park), journal replay as a fresh driver would perform
+        it, epoch bump, rendezvous-port reclaim, republish. Exercises
+        every resume mechanism a real ``--resume`` uses, in one
+        process, deterministically."""
+        if self._journal is None:
+            self._log(
+                "restart_driver fault ignored: journaling disabled "
+                "(no --output-dir and no HOROVOD_DRIVER_JOURNAL)"
+            )
+            return
+        self._log("fault plan: simulating driver crash-restart")
+        port = self._kv.port
+        self._journal_sync(force=True)
+        self._kv.stop()
+        try:
+            blackout = float(self._env.get(
+                "HOROVOD_FAULT_DRIVER_BLACKOUT_S", "") or 3.0)
+        except ValueError:
+            blackout = 3.0
+        time.sleep(blackout)
+        self._journal = _journal_mod.DriverJournal.open(self._journal.path)
+        prior = self._journal.state
+        self._epoch = self._journal.epoch
+        self._gen = int(prior.get("gen", self._gen))
+        self._blacklist = _journal_mod.blacklist_from_journal(
+            prior.get("blacklist") or {}
+        )
+        self._quarantine_strikes = {
+            h: int(n) for h, n in (prior.get("strikes") or {}).items()
+        }
+        self._failures = {
+            h: int(n) for h, n in (prior.get("failures") or {}).items()
+        }
+        self._kv = KVStoreServer(port=port, reclaim_wait_s=10.0)
+        self._kv.start()
+        self._seed_kv(prior)
+        world = prior.get("world")
+        if world:
+            world = dict(world)
+            world["epoch"] = self._epoch
+            self._last_world = world
+            self._kv.put("elastic", "world", json.dumps(world).encode())
+        self._publish_driver_doc()
+        self._journal_sync(force=True)
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_driver_restarts_total")
+            _metrics.TAP.inc("hvd_driver_journal_replays_total")
+            _metrics.TAP.set("hvd_driver_epoch", float(self._epoch))
+        self._log(
+            f"driver resumed in-process at generation {self._gen} "
+            f"(epoch {self._epoch})"
+        )
 
     def _discovery_loop(self) -> None:
         """Background discovery poller (upstream ElasticDriver runs its
@@ -332,11 +760,13 @@ class ElasticDriver:
         cleared — the host earned a fresh chance — but its strike count
         persists, so a relapse quarantines it for twice as long."""
         now = time.monotonic()
+        changed = False
         for host, deadline in list(self._blacklist.items()):
             if deadline is not None and now >= deadline:
                 del self._blacklist[host]
                 self._failures.pop(host, None)
                 self._last_failure.pop(host, None)
+                changed = True
                 if _metrics.ACTIVE:
                     _metrics.TAP.inc(
                         "hvd_elastic_readmissions_total", host=host
@@ -345,6 +775,8 @@ class ElasticDriver:
                     f"re-admitting host {host} after quarantine "
                     f"(strike {self._quarantine_strikes.get(host, 1)})"
                 )
+        if changed:
+            self._journal_sync(force=True)
 
     def _record_failure(self, host: str) -> int:
         """Count one worker failure against ``host``, with decay: a count
@@ -362,6 +794,7 @@ class ElasticDriver:
             _metrics.TAP.inc(
                 "hvd_elastic_worker_failures_total", host=host
             )
+        self._journal_sync(force=True)
         return self._failures[host]
 
     def _blacklist_host(self, host: str) -> None:
@@ -379,6 +812,7 @@ class ElasticDriver:
         else:
             self._blacklist[host] = None
             self._log(f"blacklisted host {host} (permanently)")
+        self._journal_sync(force=True)
 
     def _discover(self) -> List[Tuple[str, int]]:
         self._expire_blacklist()
@@ -633,6 +1067,7 @@ class ElasticDriver:
                 break
         world = {
             "gen": self._gen,
+            "epoch": self._epoch,
             "size": len(slots),
             "sync_root": sync_root,
             "controller_addr": controller_addr,
@@ -649,7 +1084,13 @@ class ElasticDriver:
                 for s in slots
             },
         }
+        # Write-ahead: the journal records the generation BEFORE any
+        # worker can observe it — a crash between the two replays a
+        # state the fleet has not outrun.
+        self._last_world = world
+        self._journal_sync(force=True)
         self._kv.put("elastic", "world", json.dumps(world).encode())
+        self._publish_driver_doc()
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_elastic_generations_total")
             _metrics.TAP.set("hvd_elastic_generation", float(self._gen))
@@ -685,6 +1126,7 @@ class ElasticDriver:
                 "HOROVOD_ELASTIC": "1",
                 "HOROVOD_ELASTIC_WORKER_ID": wid,
                 "HOROVOD_ELASTIC_GEN": str(self._gen),
+                "HOROVOD_DRIVER_EPOCH": str(self._epoch),
                 "HOROVOD_ELASTIC_SYNC_ROOT": endpoints["sync_root"],
                 "HOROVOD_ELASTIC_KV_ADDR": kv_addr,
                 "HOROVOD_ELASTIC_KV_PORT": str(self._kv.port),
@@ -718,9 +1160,13 @@ class ElasticDriver:
         # A fresh incarnation must earn its own joined-confirmation: a
         # stale key from a crashed predecessor under the same worker id
         # would otherwise mark this never-synced respawn as a valid
-        # sync_root.
+        # sync_root. Same for the HA signals (attach/done are gen- and
+        # epoch-stamped, but a dangling value from a dead incarnation
+        # has no business outliving it).
         self._kv.delete("elastic", f"joined.{wid}")
         self._kv.delete("elastic", f"rejoin.{wid}")
+        self._kv.delete("elastic", f"attach.{wid}")
+        self._kv.delete("elastic", f"done.{wid}")
         self._workers[wid] = _Worker(
             wid,
             slot.hostname,
@@ -777,6 +1223,12 @@ class ElasticDriver:
 
     # -------------------------------------------------------------- loop
     def run(self) -> int:
+        if self._resume_finished:
+            self._log(
+                "journal records the job as finished; nothing to resume"
+            )
+            self._kv.close()
+            return 0
         self._kv.start()
         if _metrics.ACTIVE:
             self._log(
@@ -795,7 +1247,13 @@ class ElasticDriver:
                 name="hvd_elastic_discovery", daemon=True,
             ).start()
         try:
-            return self._run()
+            rc = self._run()
+            if self._journal is not None:
+                try:
+                    self._journal.record(finished=(rc == 0))
+                except OSError:
+                    pass
+            return rc
         finally:
             self._stop_discovery.set()
             for w in list(self._workers.values()) + [
@@ -820,12 +1278,24 @@ class ElasticDriver:
                 )
 
     def _run(self) -> int:
-        if not self._reconcile():
+        self._started_at = time.monotonic()
+        self._publish_driver_doc()
+        if self._adopting:
+            self._enter_adoption()
+        elif not self._reconcile():
             return 1
         last_discovery = time.monotonic()
+        last_beat = 0.0
         while True:
             time.sleep(0.1)
             changed = False
+            now = time.monotonic()
+            if now - last_beat >= 1.0:
+                last_beat = now
+                # Liveness beat for worker-side driver probes, plus the
+                # periodic journal refresh of worker-written KV signals.
+                self._publish_driver_doc()
+                self._journal_sync()
             # Reap draining removed workers (exit code irrelevant);
             # terminate stragglers past the grace window.
             still_removing = []
@@ -859,6 +1329,24 @@ class ElasticDriver:
             self._retire_services(keep=2)
             if _fault.ACTIVE:
                 self._maybe_fire_preemptions()
+                self._maybe_fire_driver_faults()
+            if self._adopting:
+                rc = self._poll_adopted()
+                if rc is not None:
+                    return rc
+                continue
+            if self._adopt_drain_pids is not None:
+                # Post-adoption drain: wait for SIGTERMed survivors to
+                # persist their commits and exit before the replacement
+                # generation is spawned over their snapshots.
+                alive = {
+                    p for p in self._adopt_drain_pids if self._pid_alive(p)
+                }
+                if alive and time.monotonic() <= self._adopt_drain_deadline:
+                    self._adopt_drain_pids = alive
+                    continue
+                self._adopt_drain_pids = None
+                self._restart_pending = True
             if self._restart_pending and not self._removing:
                 # Respawn-mode restart: the old generation has fully
                 # drained; re-form even if no other event fires.

@@ -87,11 +87,46 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
-class KVStoreServer:
-    """In-process threaded HTTP KV server."""
+class _KVServer(ThreadingHTTPServer):
+    # Explicit SO_REUSEADDR (http.server defaults to it, but a resumed
+    # driver's ability to reclaim its advertised rendezvous port is a
+    # correctness requirement here, not an inherited accident): lingering
+    # TIME_WAIT connections from the crashed driver's clients must not
+    # block the rebind.
+    allow_reuse_address = True
+    daemon_threads = True
 
-    def __init__(self, port: int = 0):
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+
+class KVStoreServer:
+    """In-process threaded HTTP KV server.
+
+    ``port=0`` picks a free port. A pinned port (``HOROVOD_METRICS_PORT``
+    at first launch, or the journal-recorded port on ``--resume``) is
+    bound with SO_REUSEADDR; ``reclaim_wait_s`` additionally retries a
+    failing bind for that long — a resumed driver racing the OS's
+    cleanup of its predecessor's socket reclaims the port instead of
+    dying in TIME_WAIT."""
+
+    def __init__(self, port: int = 0, reclaim_wait_s: float = 0.0):
+        import errno
+        import time as _time
+
+        deadline = _time.monotonic() + max(0.0, reclaim_wait_s)
+        while True:
+            try:
+                self._server = _KVServer(("0.0.0.0", port), _Handler)
+                break
+            except OSError as exc:
+                if (port == 0 or exc.errno != errno.EADDRINUSE
+                        or _time.monotonic() >= deadline):
+                    raise OSError(
+                        exc.errno,
+                        f"could not bind rendezvous KV port {port}: "
+                        f"{exc.strerror or exc} (pinned port still held; "
+                        "waited "
+                        f"{max(0.0, reclaim_wait_s):g}s for reclaim)",
+                    ) from exc
+                _time.sleep(0.1)
         self._server.kv = {}
         self._server.kv_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -112,6 +147,16 @@ class KVStoreServer:
         if self._thread:
             self._thread.join(timeout=5)
         self._server.server_close()
+
+    def close(self) -> None:
+        """Release the bound port WITHOUT the serve_forever handshake —
+        for a server that was constructed but never start()ed
+        (``stop()``'s shutdown() would block forever on the event only
+        serve_forever sets)."""
+        if self._thread is not None:
+            self.stop()
+        else:
+            self._server.server_close()
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         """In-process store (no HTTP round-trip) under the same lock the
@@ -140,6 +185,19 @@ class KVHTTPError(Exception):
     """Non-200 KV answer (e.g. 404 for a missing key). Not an OSError on
     purpose — the retry path must not spin on a definitive answer."""
 
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+class KVUnavailableError(ConnectionError):
+    """The KV endpoint could not be reached within the retry budget.
+    Subclasses ConnectionError so existing transport-failure handling
+    still matches, but the message names the endpoint, how long it has
+    been down across consecutive failures, and the retry budget spent —
+    a dead driver reads as "driver at host:port unreachable for 12.3s",
+    not a bare timeout with a phase name."""
+
 
 class KVStoreClient:
     """Plain-TCP HTTP KV client built on ``http.client.HTTPConnection``.
@@ -160,9 +218,26 @@ class KVStoreClient:
         from ..fault.backoff import Backoff
 
         self._backoff = Backoff.from_env()
+        # First monotonic instant of the CURRENT consecutive-failure
+        # streak (None = last request succeeded): errors against a dead
+        # driver report elapsed downtime, not just the final attempt.
+        self._down_since: Optional[float] = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self._addr}:{self._port}"
+
+    def downtime(self) -> float:
+        """Seconds this endpoint has been failing consecutively (0 when
+        the last request succeeded)."""
+        import time
+
+        return (0.0 if self._down_since is None
+                else time.monotonic() - self._down_since)
 
     def _request(self, method: str, path: str, body=None) -> bytes:
         import http.client
+        import time
 
         from ..fault import injector as _fault
         from ..fault.backoff import retry_call
@@ -186,30 +261,59 @@ class KVStoreClient:
                     # Deliberately NOT an OSError: a 404 is an answer
                     # (missing key), not a transport failure to retry.
                     raise KVHTTPError(
-                        f"KV {method} {path}: HTTP {resp.status}"
+                        f"KV {method} {path}: HTTP {resp.status}",
+                        status=resp.status,
                     )
                 return data
             finally:
                 conn.close()
 
-        return retry_call(
-            once,
-            retryable=(OSError, EOFError),
-            backoff=self._backoff,
-            describe=f"KV {method} {path}",
-            on_retry=lambda attempt, exc, delay: (
-                _metrics.TAP.inc("hvd_kv_retries_total", method=method)
-                if _metrics.ACTIVE else None
-            ),
-        )
+        try:
+            data = retry_call(
+                once,
+                retryable=(OSError, EOFError),
+                backoff=self._backoff,
+                describe=f"KV {method} {path} to {self.endpoint}",
+                on_retry=lambda attempt, exc, delay: (
+                    _metrics.TAP.inc("hvd_kv_retries_total", method=method)
+                    if _metrics.ACTIVE else None
+                ),
+            )
+        except KVHTTPError:
+            self._down_since = None  # the server answered; it is up
+            raise
+        except (OSError, EOFError) as exc:
+            now = time.monotonic()
+            if self._down_since is None:
+                self._down_since = now
+            raise KVUnavailableError(
+                f"KV endpoint {self.endpoint} unreachable for "
+                f"{now - self._down_since:.1f}s "
+                f"({method} {path}; retry budget spent: "
+                f"{self._backoff.retries + 1} attempts): {exc}"
+            ) from exc
+        self._down_since = None
+        return data
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         self._request("PUT", f"/{scope}/{key}", body=value)
 
-    def get(self, scope: str, key: str) -> Optional[bytes]:
+    def get(self, scope: str, key: str,
+            strict: bool = False) -> Optional[bytes]:
+        """Fetch a key. Default (lenient) mode folds EVERY failure into
+        None — callers that only care "is the value there yet" keep
+        their simple polling loops. ``strict=True`` distinguishes the
+        two reasons a value can be absent: a missing key (HTTP 404)
+        still returns None, but a transport failure (dead driver)
+        raises :class:`KVUnavailableError` so the caller can tell "the
+        driver says no such key" from "there is no driver"."""
         try:
             return self._request("GET", f"/{scope}/{key}")
+        except KVHTTPError:
+            return None
         except Exception:
+            if strict:
+                raise
             return None
 
     def wait(self, scope: str, key: str, timeout: float = 60.0) -> bytes:

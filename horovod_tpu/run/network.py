@@ -346,11 +346,23 @@ class BasicClient:
         # Control-plane RPC retry budget (HOROVOD_RPC_* knobs): a dropped
         # or delayed message costs one backoff, not the job.
         self._backoff = Backoff.from_env()
+        # First instant of the current consecutive-failure streak: a
+        # dead peer reads as "endpoint down for Ns", not a bare error.
+        self._down_since: Optional[float] = None
         self._addresses = self._probe(addresses, match_intf, retries)
         if not self._addresses:
             raise NoValidAddressesFound(
                 f"no usable address for {service_name!r} among {addresses}"
             )
+
+    def _endpoints(self) -> str:
+        """Compact 'host:port' list of the verified addresses, for error
+        messages (which endpoint was actually dialed and found dead)."""
+        flat = sorted({
+            f"{a}:{p}" for addrs in self._addresses.values()
+            for a, p in addrs
+        })
+        return ",".join(flat) or "<no-verified-address>"
 
     def addresses(self) -> Dict[str, List[Tuple[str, int]]]:
         return self._addresses
@@ -466,22 +478,41 @@ class BasicClient:
                 self._service_name, req_name, exc, attempt + 1, delay,
             )
 
+        import time as _time
+
         try:
-            return retry_call(
+            result = retry_call(
                 sweep,
                 retryable=(OSError, EOFError, WireError),
                 backoff=self._backoff,
-                describe=f"{self._service_name}: {req_name}",
+                describe=(
+                    f"{self._service_name} at {self._endpoints()}: "
+                    f"{req_name}"
+                ),
                 on_retry=on_retry,
             )
         except RemoteTimeoutError:
+            self._down_since = None  # the server answered; it is up
             if _metrics.ACTIVE:
                 _metrics.TAP.inc("hvd_rpc_timeouts_total", request=req_name)
             raise
+        except (OSError, EOFError, WireError) as exc:
+            now = _time.monotonic()
+            if self._down_since is None:
+                self._down_since = now
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_rpc_failures_total", request=req_name)
+            raise type(exc)(
+                f"{exc} [endpoint {self._endpoints()} failing for "
+                f"{now - self._down_since:.1f}s; retry budget "
+                f"{self._backoff.retries + 1} attempts spent]"
+            ) from exc
         except Exception:
             if _metrics.ACTIVE:
                 _metrics.TAP.inc("hvd_rpc_failures_total", request=req_name)
             raise
+        self._down_since = None
+        return result
 
 
 class DriverService(BasicService):

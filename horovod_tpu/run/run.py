@@ -166,6 +166,21 @@ def parse_args(argv: Optional[List[str]] = None):
     parser.add_argument("--elastic-timeout", type=float, default=600.0,
                         help="Elastic: seconds a worker waits for a usable "
                              "world generation before giving up.")
+    parser.add_argument("--resume", action="store_true",
+                        help="Elastic: resume a crashed driver from its "
+                             "journal (requires the original --output-dir "
+                             "or HOROVOD_DRIVER_JOURNAL): replay the "
+                             "recorded generation/blacklist/rendezvous "
+                             "state, reclaim the advertised port, and "
+                             "reattach the surviving workers instead of "
+                             "respawning them.")
+    parser.add_argument("--auto-resume", action="store_true",
+                        help="Elastic: supervise the driver in a child "
+                             "process and re-launch it with --resume when "
+                             "it dies abnormally (crash/kill), up to "
+                             "HOROVOD_DRIVER_MAX_RESTARTS (default 3) "
+                             "times. Requires --output-dir for the "
+                             "journal.")
     parser.add_argument("--network-interfaces", default=None,
                         help="Comma-separated NICs to use for the control "
                              "plane; skips the automatic ring probe.")
@@ -223,6 +238,52 @@ def _importable(mod: str) -> bool:
         return False
 
 
+def _supervise_driver(argv: List[str],
+                      call=None) -> int:
+    """``--auto-resume``: run the elastic driver as a child process and
+    re-launch it with ``--resume`` whenever it dies abnormally — the
+    minimal supervisor that turns the control-plane journal into
+    unattended crash recovery. "Abnormal" is any exit the driver does
+    not use for deliberate outcomes (0 success, 1 job failure, 2 usage,
+    3 config, 4 unreachable hosts); signals and injected/driver-crash
+    codes resume. ``HOROVOD_DRIVER_MAX_RESTARTS`` (default 3) bounds a
+    crash loop."""
+    import subprocess
+
+    call = call or (lambda a: subprocess.call(
+        [sys.executable, "-m", "horovod_tpu.run", *a]
+    ))
+    child_args = [a for a in argv if a != "--auto-resume"]
+    try:
+        max_restarts = int(
+            os.environ.get("HOROVOD_DRIVER_MAX_RESTARTS", "") or 3
+        )
+    except ValueError:
+        max_restarts = 3
+    deliberate = (0, 1, 2, 3, 4)
+    restarts = 0
+    while True:
+        rc = call(child_args)
+        if rc in deliberate:
+            return rc
+        if restarts >= max_restarts:
+            print(
+                f"[hvdrun supervisor] driver died abnormally (exit {rc}) "
+                f"and the restart budget ({max_restarts}) is spent",
+                file=sys.stderr,
+            )
+            return rc
+        restarts += 1
+        print(
+            f"[hvdrun supervisor] driver died abnormally (exit {rc}); "
+            f"resuming from the journal (restart {restarts}/"
+            f"{max_restarts})",
+            file=sys.stderr,
+        )
+        if "--resume" not in child_args:
+            child_args = child_args + ["--resume"]
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     if args.version:
@@ -252,7 +313,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
     # Elastic mode: any elastic flag routes supervision to ElasticDriver
     # (generation-based re-rendezvous) instead of the fixed fan-out.
-    if args.host_discovery_script or args.min_np or args.max_np:
+    if (args.host_discovery_script or args.min_np or args.max_np
+            or args.resume):
+        if args.auto_resume:
+            return _supervise_driver(argv if argv is not None
+                                     else sys.argv[1:])
         if args.hostfile:
             hosts = launcher.parse_hostfile(args.hostfile)
         elif args.hosts:
@@ -307,6 +372,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             nic_pinned=bool(args.network_interfaces),
             probed_hostset=probed_hostset,
             blacklist_cooldown=args.blacklist_cooldown,
+            resume=args.resume,
         ).run()
 
     if args.tpu_pod:

@@ -174,6 +174,98 @@ def test_event_log_file_lines_are_deterministic(tmp_path, monkeypatch):
 
 
 # --------------------------------------------------------------- backoff
+def test_backoff_jitter_bounds():
+    """Satellite (ISSUE 6): jitter adds AT MOST ``jitter`` fraction on
+    top of the deterministic exponential delay, never subtracts, and
+    zero jitter is exact — over many draws."""
+    b = Backoff(retries=8, base_s=0.1, max_s=1.0, multiplier=2.0,
+                jitter=0.25, seed=11)
+    for _ in range(50):
+        for i in range(8):
+            base = min(1.0, 0.1 * (2.0 ** i))
+            d = b.delay(i)
+            assert base <= d <= base * 1.25 + 1e-12, (i, d)
+    exact = Backoff(retries=4, base_s=0.1, max_s=1.0, multiplier=2.0,
+                    jitter=0.0)
+    assert [exact.delay(i) for i in range(4)] == [0.1, 0.2, 0.4, 0.8]
+
+
+def test_backoff_seed_from_env_controls_jitter_stream(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FAULT_SEED", "321")
+    monkeypatch.setenv("HOROVOD_RPC_BACKOFF_JITTER", "0.5")
+    seq1 = [Backoff.from_env().delay(i) for i in range(6)]
+    seq2 = [Backoff.from_env().delay(i) for i in range(6)]
+    assert seq1 == seq2  # pure function of (seed, knobs)
+    monkeypatch.setenv("HOROVOD_FAULT_SEED", "322")
+    assert [Backoff.from_env().delay(i) for i in range(6)] != seq1
+
+
+def test_fault_stream_contract_per_seed_action_rank():
+    """Satellite (ISSUE 6): the per-(seed, action, rank) decision-stream
+    contract — streams are independent across actions and ranks, pure in
+    the seed, and ``decide`` consumes exactly the stream the canonical
+    trace materializes."""
+    text = ('{"seed": 42, "faults": ['
+            '{"kind": "drop", "site": "kv", "frac": 0.5},'
+            '{"kind": "drop", "site": "kv", "frac": 0.5}]}')
+    p = FaultPlan.from_json(text)
+    a0, a1 = p.actions
+    t0r0 = p.decision_trace(a0, 0, 32)
+    t0r1 = p.decision_trace(a0, 1, 32)
+    t1r0 = p.decision_trace(a1, 0, 32)
+    # Identical frac, different action index / rank → different streams.
+    assert t0r0 != t0r1
+    assert t0r0 != t1r0
+    # Purity: a fresh plan object reproduces every stream byte-for-byte,
+    # and interleaved decide() calls cannot cross-contaminate streams.
+    p2 = FaultPlan.from_json(text)
+    live0, live1 = [], []
+    for _ in range(32):
+        live0.append(p2.decide(p2.actions[0], 0))
+        live1.append(p2.decide(p2.actions[1], 0))
+    assert live0 == t0r0
+    assert live1 == t1r0
+    # And the whole contract is seed-keyed.
+    assert FaultPlan.from_json(text.replace("42", "43")).decision_trace(
+        a0, 0, 32
+    ) != t0r0
+
+
+# --------------------------------------------- control-plane HA (worker)
+def test_stale_epoch_driver_is_fenced_by_worker(monkeypatch):
+    """Acceptance (ISSUE 6): a worker that has acknowledged driver epoch
+    N rejects a KV plane served by epoch < N — commit probes report the
+    driver as lost (park) rather than trusting the stale world, and the
+    park classifier refuses to reattach to it."""
+    from horovod_tpu.elastic import DriverWatch, _ElasticContext
+    from horovod_tpu.run.http_server import KVStoreServer
+
+    server = KVStoreServer()
+    port = server.start()
+    try:
+        monkeypatch.setenv("HOROVOD_ELASTIC_WORKER_ID", "localhost:0")
+        monkeypatch.setenv("HOROVOD_ELASTIC_GEN", "2")
+        monkeypatch.setenv("HOROVOD_DRIVER_EPOCH", "3")
+        monkeypatch.setenv("HOROVOD_ELASTIC_KV_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_ELASTIC_KV_PORT", str(port))
+        ctx = _ElasticContext()
+        world = {"gen": 2, "epoch": 1, "assignments": {}}
+        server.put("elastic", "world", json.dumps(world).encode())
+        server.put("elastic", "driver",
+                   json.dumps({"epoch": 1, "gen": 2, "beat": 9}).encode())
+        updated, lost, new_epoch = ctx.commit_probe()
+        assert lost and not updated and new_epoch is None
+        watch = DriverWatch(ctx.gen, ctx.epoch)
+        assert watch.classify(*ctx.probe_driver()) == "fenced"
+        # The REAL (resumed) driver comes back: fencing lifts, reattach.
+        server.put("elastic", "driver",
+                   json.dumps({"epoch": 4, "gen": 2, "beat": 1}).encode())
+        assert watch.classify(*ctx.probe_driver()) == "reattach"
+        assert watch.epoch_seen == 4
+    finally:
+        server.stop()
+
+
 def test_backoff_progression_and_determinism():
     b1 = Backoff(retries=4, base_s=0.1, max_s=0.5, multiplier=2.0,
                  jitter=0.2, seed=7)
@@ -1002,6 +1094,191 @@ def test_metadata_mismatch_reduce_op_aborts():
             outs
         )
         assert "rank 0" in out and "rank 1" in out, outs
+
+
+# ------------------------------------ control-plane HA e2e (driver kill)
+DRIVER_SEED = 20260806
+
+# 8 steps x avg(1.0) on every element: the analytic final state of the
+# uninterrupted run, asserted BITWISE against the recovered one.
+DRIVER_STEPS = 8
+DRIVER_FINAL_HEX = np.full(4, float(DRIVER_STEPS),
+                           np.float32).tobytes().hex()
+
+DRIVER_WORKER = """
+import os, sys, time
+import numpy as np, jax
+jax.config.update('jax_platforms', 'cpu')
+import horovod_tpu as hvd
+import horovod_tpu.elastic as elastic
+hvd.init()
+import jax.numpy as jnp
+print('START', hvd.rank(), os.getpid(), flush=True)
+state = elastic.JaxState(w=np.zeros((4,), np.float32), step=0)
+
+@elastic.run
+def train(state):
+    while state.step < %d:
+        g = hvd.allreduce(jnp.ones((4,), jnp.float32),
+                          op=hvd.Average, name='grad')
+        state.w = np.asarray(g) + np.asarray(state.w)
+        state.step += 1
+        time.sleep(0.4)
+        state.commit()
+    return state.step
+
+train(state)
+print('FINAL', hvd.rank(), hvd.size(), state.step,
+      np.asarray(state.w, np.float32).tobytes().hex(), flush=True)
+hvd.shutdown()
+""" % DRIVER_STEPS
+
+
+def driver_kill_plan() -> dict:
+    """The canonical driver-kill schedule (also used by
+    tools/driver_smoke.py): the elastic driver hard-exits 3 s into the
+    run — mid-training for the 0.4 s-per-step workers — leaving the
+    fleet orphaned until ``--resume`` brings a successor up."""
+    return {
+        "seed": DRIVER_SEED,
+        "faults": [
+            {"kind": "kill_driver", "after_s": 3.0},
+        ],
+    }
+
+
+def normalized_driver_events(text: str):
+    """Deterministic view of a driver-HA event log: (rank, seq, site,
+    hit, action, detail) sorted with the driver's rank-less events
+    first. Byte-identical across two runs of the same seeded plan."""
+    events = [json.loads(l) for l in text.splitlines() if l.strip()]
+    return sorted(
+        (e.get("rank") if e.get("rank") is not None else -1,
+         e["seq"], e["site"], e["hit"], e["action"], e["detail"])
+        for e in events
+    )
+
+
+def run_driver_kill_job(outage_s: float = 4.0, timeout: int = 180):
+    """Run the seeded driver-kill scenario: launch a 2-rank elastic job
+    whose driver is killed mid-training, hold the outage for
+    ``outage_s`` (so every rank observes the loss and parks), then
+    resume the driver from its journal with ``hvdrun --resume``.
+    Returns (first_rc, resume_rc, outs dict, normalized events).
+    Shared with tools/driver_smoke.py."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "HOROVOD_CYCLE_TIME": "1",
+        "PYTHONPATH": os.pathsep.join(
+            [repo, env.get("PYTHONPATH", "")]
+        ).rstrip(os.pathsep),
+        "HOROVOD_FAULT_PLAN": json.dumps(driver_kill_plan()),
+        "HOROVOD_FAULT_SEED": str(DRIVER_SEED),
+        "HOROVOD_RPC_BACKOFF_BASE_S": "0.02",
+        # Two consecutive failed commit probes (~1 s at 0.4 s steps)
+        # declare the driver lost: every rank parks well inside the
+        # outage window.
+        "HOROVOD_DRIVER_LOST_PROBES": "2",
+    })
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(DRIVER_WORKER)
+        env["HOROVOD_FAULT_EVENT_LOG"] = os.path.join(
+            td, "fault_events.jsonl"
+        )
+        args = [sys.executable, "-m", "horovod_tpu.run",
+                "-np", "2", "--min-np", "2", "--max-np", "2",
+                "--output-dir", td, sys.executable, script]
+        first = subprocess.run(args, env=env, cwd=repo,
+                               capture_output=True, timeout=timeout)
+        time.sleep(outage_s)
+        resume = subprocess.run(
+            args[:3] + ["--resume"] + args[3:], env=env, cwd=repo,
+            capture_output=True, timeout=timeout,
+        )
+        outs = {}
+        for fn in os.listdir(td):
+            if fn.startswith("worker.") and (fn.endswith(".out")
+                                             or fn.endswith(".err")):
+                outs[fn] = open(os.path.join(td, fn)).read()
+        for fn in ("driver.log", "fault_events.jsonl",
+                   "fault_schedule.json", "driver_journal.json"):
+            p = os.path.join(td, fn)
+            if os.path.exists(p):
+                outs[fn] = open(p).read()
+        events = normalized_driver_events(
+            outs.get("fault_events.jsonl", "")
+        )
+        # Journal replay idempotence, asserted on the real artifact:
+        # two replays of the same bytes are identical state.
+        from horovod_tpu.run.journal import DriverJournal
+
+        jpath = os.path.join(td, "driver_journal.json")
+        assert DriverJournal(jpath).replay() == \
+            DriverJournal(jpath).replay()
+    return first, resume, outs, events
+
+
+def assert_driver_kill_recovery(first, resume, outs, events):
+    from horovod_tpu.fault.plan import DRIVER_KILL_EXIT_CODE
+
+    first_err = first.stderr.decode()
+    resume_err = resume.stderr.decode()
+    # The injected kill took the driver down with its distinct status...
+    assert first.returncode == DRIVER_KILL_EXIT_CODE, (
+        first.returncode, first_err,
+    )
+    # ...and the resumed driver finished the job.
+    assert resume.returncode == 0, (resume_err, outs)
+    assert "resumed at generation 1 (epoch 2)" in resume_err, resume_err
+    # Reattach, not respawn: each rank started EXACTLY once across both
+    # driver incarnations, and the pid that reattached is the pid that
+    # started.
+    starts = {}
+    finals = {}
+    for text in outs.values():
+        for line in text.splitlines():
+            if line.startswith("START"):
+                _, rank, pid = line.split()
+                assert rank not in starts, (outs, "respawned worker")
+                starts[rank] = pid
+            if line.startswith("FINAL"):
+                finals[line.split()[1]] = line.split()
+    assert set(starts) == {"0", "1"}, outs
+    for rank in ("0", "1"):
+        assert rank in finals, (outs, resume_err)
+        _, _, size, step, whex = finals[rank]
+        assert size == "2" and step == str(DRIVER_STEPS), finals
+        # Bitwise equality with the uninterrupted run's final params.
+        assert whex == DRIVER_FINAL_HEX, (whex, DRIVER_FINAL_HEX)
+    assert "reattached (pid " in resume_err, resume_err
+    for rank, pid in starts.items():
+        assert f"(pid {pid}, epoch 2)" in resume_err, (
+            starts, resume_err,
+        )
+    # The full failure→recovery chain is on the event log: kill, one
+    # park and one reattach per rank, one resume.
+    actions = [e[4] for e in events]
+    assert actions.count("kill_driver") == 1, events
+    assert actions.count("resume") == 1, events
+    assert actions.count("park") == 2, events
+    assert actions.count("reattach") == 2, events
+
+
+def test_driver_kill_resume_reattach_e2e():
+    """Acceptance (ISSUE 6): kill the driver mid-training → resume from
+    the journal → workers reattach under the new epoch WITHOUT being
+    respawned → final params bitwise-equal to an uninterrupted run;
+    journal replay idempotent."""
+    first, resume, outs, events = run_driver_kill_job()
+    assert_driver_kill_recovery(first, resume, outs, events)
 
 
 def test_preemption_e2e_graceful_drain():

@@ -1,0 +1,434 @@
+"""Control-plane high-availability units (docs/fault_tolerance.md
+"Control-plane availability"): the durable driver journal (atomic
+writes, idempotent replay, epoch fencing, clock-skew-safe blacklist
+serialization), rendezvous-port reclaim, KV-client error naming, the
+worker-side park/reconnect state machine at 2 and 4 simulated ranks,
+and the driver-fault plan actions. The live driver-kill → resume →
+reattach path is exercised end-to-end in tests/test_chaos.py and by
+``make driver-smoke``."""
+
+import json
+import os
+import time
+
+import pytest
+
+from horovod_tpu.fault import injector as _injector
+from horovod_tpu.fault.plan import (
+    DRIVER_KILL_EXIT_CODE,
+    FaultPlan,
+)
+from horovod_tpu.run import journal as journal_mod
+from horovod_tpu.run.journal import (
+    DriverJournal,
+    blacklist_from_journal,
+    blacklist_to_journal,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    _injector.reset()
+    yield
+    _injector.reset()
+
+
+# ---------------------------------------------------------------- journal
+def test_journal_roundtrip_and_idempotent_replay(tmp_path):
+    path = str(tmp_path / "driver_journal.json")
+    j = DriverJournal.open(path)
+    assert j.epoch == 1  # fresh journal: first driver incarnation
+    world = {"gen": 3, "assignments": {"h:0": {"rank": 0}}}
+    j.record(gen=3, kv_port=1234, world=world,
+             kv={"joined.h:0": "3"}, strikes={"h": 2})
+    # Replay is a pure function of the journal bytes: two replays (and
+    # two independent readers) see identical state.
+    r1 = DriverJournal(path).replay()
+    r2 = DriverJournal(path).replay()
+    assert r1 == r2
+    assert r1["gen"] == 3 and r1["kv_port"] == 1234
+    assert r1["world"] == world
+    assert r1["kv"] == {"joined.h:0": "3"}
+    assert r1["strikes"] == {"h": 2}
+    # Atomic write discipline: no temp file survives a completed write.
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+
+
+def test_journal_epoch_monotonic_across_opens(tmp_path):
+    path = str(tmp_path / "driver_journal.json")
+    epochs = [DriverJournal.open(path).epoch for _ in range(3)]
+    # Every open — resume or fresh reuse of the directory — bumps the
+    # epoch, so no two driver incarnations can ever share one.
+    assert epochs == [1, 2, 3]
+    # Prior (non-epoch) state survives the bump.
+    j = DriverJournal.open(path)
+    j.record(gen=7)
+    j2 = DriverJournal.open(path)
+    assert j2.epoch == 5 and j2.state["gen"] == 7
+
+
+def test_journal_refuses_future_version(tmp_path):
+    path = str(tmp_path / "driver_journal.json")
+    with open(path, "w") as f:
+        json.dump({"version": 999, "epoch": 4, "gen": 1}, f)
+    with pytest.raises(RuntimeError, match="version"):
+        DriverJournal(path).replay()
+
+
+def test_journal_unreadable_degrades_to_fresh(tmp_path):
+    path = str(tmp_path / "driver_journal.json")
+    with open(path, "w") as f:
+        f.write("{torn garbage")
+    j = DriverJournal.open(path)
+    assert j.replay() is not None  # the open wrote a fresh valid doc
+    assert j.epoch == 1
+
+
+# --------------------------------------- blacklist clock-skew serialization
+def test_blacklist_serialization_roundtrip_same_clock():
+    now_mono, now_wall = 1000.0, 5_000_000.0
+    bl = {"hostA": now_mono + 120.0, "hostB": None}
+    doc = blacklist_to_journal(bl, now_mono=now_mono, now_wall=now_wall)
+    assert doc["hostA"]["remaining_s"] == pytest.approx(120.0)
+    assert doc["hostB"] == {"permanent": True}
+    restored = blacklist_from_journal(
+        doc, now_mono=50.0, now_wall=now_wall + 30.0
+    )
+    # 30 s of real downtime elapsed: 90 s of quarantine left, expressed
+    # on the NEW process's monotonic clock.
+    assert restored["hostA"] == pytest.approx(50.0 + 90.0)
+    assert restored["hostB"] is None
+
+
+def test_blacklist_resume_with_backwards_clock_skew_does_not_extend():
+    """Regression (ISSUE 6 satellite): the restore clamp. A wall clock
+    stepped BACKWARDS across the restart makes the absolute deadline
+    look far in the future; trusting it verbatim would re-quarantine the
+    host for longer than it ever had left."""
+    doc = blacklist_to_journal(
+        {"hostA": 1000.0 + 60.0}, now_mono=1000.0, now_wall=5000.0
+    )
+    restored = blacklist_from_journal(
+        doc, now_mono=0.0, now_wall=5000.0 - 3600.0  # clock fell back 1 h
+    )
+    # Clamped to the 60 s that remained at write time — never extended.
+    assert restored["hostA"] == pytest.approx(60.0)
+
+
+def test_blacklist_resume_with_forward_skew_or_downtime_expires():
+    doc = blacklist_to_journal(
+        {"hostA": 1000.0 + 60.0}, now_mono=1000.0, now_wall=5000.0
+    )
+    restored = blacklist_from_journal(
+        doc, now_mono=0.0, now_wall=5000.0 + 61.0  # quarantine served
+    )
+    # Expired during the outage: re-admitted, NOT re-quarantined.
+    assert "hostA" not in restored
+    # And an active quarantine is NOT forgotten.
+    restored2 = blacklist_from_journal(
+        doc, now_mono=0.0, now_wall=5000.0 + 10.0
+    )
+    assert restored2["hostA"] == pytest.approx(50.0)
+
+
+def test_blacklist_malformed_entry_is_dropped_not_fatal():
+    restored = blacklist_from_journal(
+        {"hostA": {"deadline_unix": "junk"}, "hostB": {"permanent": True}},
+        now_mono=0.0, now_wall=0.0,
+    )
+    assert restored == {"hostB": None}
+
+
+# ----------------------------------------------------- rendezvous port HA
+def test_kv_server_reclaims_pinned_port_after_stop():
+    from horovod_tpu.run.http_server import KVStoreServer, _KVServer
+
+    assert _KVServer.allow_reuse_address is True
+    s1 = KVStoreServer()
+    port = s1.start()
+    s1.put("elastic", "world", b"x")
+    s1.stop()
+    # Immediate rebind of the same advertised port (SO_REUSEADDR +
+    # bounded reclaim retry): the resumed-driver path.
+    s2 = KVStoreServer(port=port, reclaim_wait_s=5.0)
+    try:
+        assert s2.port == port
+        s2.start()
+    finally:
+        s2.stop()
+
+
+def test_kv_server_pinned_port_conflict_names_port():
+    from horovod_tpu.run.http_server import KVStoreServer
+
+    s1 = KVStoreServer()
+    s1.start()
+    try:
+        # A LIVE listener on the port (not TIME_WAIT): even with
+        # SO_REUSEADDR the bind fails, and the error must say which
+        # port and that the reclaim window was exhausted.
+        with pytest.raises(OSError, match=str(s1.port)):
+            KVStoreServer(port=s1.port, reclaim_wait_s=0.2)
+    finally:
+        s1.stop()
+
+
+# ------------------------------------------------- KV client error naming
+def test_kv_client_strict_error_names_endpoint_downtime_budget(monkeypatch):
+    from horovod_tpu.run.http_server import (
+        KVStoreClient,
+        KVStoreServer,
+        KVUnavailableError,
+    )
+
+    monkeypatch.setenv("HOROVOD_RPC_RETRIES", "2")
+    monkeypatch.setenv("HOROVOD_RPC_BACKOFF_BASE_S", "0.01")
+    server = KVStoreServer()
+    port = server.start()
+    server.stop()  # now a dead endpoint
+    client = KVStoreClient("127.0.0.1", port)
+    with pytest.raises(KVUnavailableError) as e:
+        client.get("elastic", "world", strict=True)
+    msg = str(e.value)
+    assert f"127.0.0.1:{port}" in msg          # the endpoint
+    assert "unreachable for" in msg            # elapsed downtime
+    assert "3 attempts" in msg                 # retry budget spent
+    assert client.downtime() > 0.0
+    # Lenient mode still folds the same failure into None (polling
+    # callers keep their simple loops).
+    assert client.get("elastic", "world") is None
+    # And a 404 is an ANSWER even in strict mode, never an outage.
+    server2 = KVStoreServer(port=port, reclaim_wait_s=5.0)
+    server2.start()
+    try:
+        assert client.get("elastic", "missing", strict=True) is None
+        assert client.downtime() == 0.0
+    finally:
+        server2.stop()
+
+
+# --------------------------------------- park/reconnect state machine units
+def _watch():
+    from horovod_tpu.elastic import DriverWatch
+
+    return DriverWatch(gen=2, epoch=3)
+
+
+def test_driver_watch_classification():
+    w = _watch()
+    assert w.classify(None, None) == "wait"              # driver down
+    assert w.classify({"epoch": 3}, None) == "wait"      # no world yet
+    assert w.classify({"epoch": 2}, {"gen": 2}) == "fenced"  # stale driver
+    assert w.fenced == 1
+    assert w.classify({"epoch": "x"}, {"gen": 2}) == "wait"  # malformed
+    assert w.classify({"epoch": 4}, {"gen": 2}) == "reattach"
+    assert w.epoch_seen == 4                             # epoch to adopt
+    assert w.classify({"epoch": 4}, {"gen": 3}) == "rejoin"
+    # Same-epoch republish (driver never died, e.g. worker-side false
+    # positive): still a valid reattach target.
+    assert w.classify({"epoch": 3}, {"gen": 2}) == "reattach"
+
+
+def _simulate_park(rank_observations):
+    """Drive one DriverWatch per rank through its (skewed) observation
+    sequence until every rank reaches a terminal outcome, then apply the
+    cross-rank MAX agreement — the exact rule _park_and_reattach uses."""
+    from horovod_tpu.elastic import PARK_OUTCOMES, DriverWatch
+
+    outcomes = []
+    for obs in rank_observations:
+        w = DriverWatch(gen=2, epoch=3)
+        outcome = "dead"
+        for driver_doc, world_doc in obs:
+            got = w.classify(driver_doc, world_doc)
+            if got in ("reattach", "rejoin"):
+                outcome = got
+                break
+        outcomes.append(outcome)
+    agreed = max(PARK_OUTCOMES[o] for o in outcomes)
+    return outcomes, agreed
+
+
+def test_park_agreement_2_ranks_skewed_observations():
+    from horovod_tpu.elastic import PARK_OUTCOMES
+
+    # Rank 0 sees the resumed driver one probe earlier than rank 1; a
+    # stale driver answers rank 1 in between. Both converge on reattach.
+    outcomes, agreed = _simulate_park([
+        [(None, None), ({"epoch": 4}, {"gen": 2})],
+        [(None, None), ({"epoch": 2}, {"gen": 2}),
+         ({"epoch": 4}, {"gen": 2})],
+    ])
+    assert outcomes == ["reattach", "reattach"]
+    assert agreed == PARK_OUTCOMES["reattach"]
+
+
+def test_park_agreement_4_ranks_mixed_outcome_degrades_to_rejoin():
+    from horovod_tpu.elastic import PARK_OUTCOMES
+
+    # Three ranks observe the same-generation republish, one rank races
+    # past it and sees the NEXT generation: the fleet must not split —
+    # the max rule sends everyone down the rejoin path.
+    outcomes, agreed = _simulate_park([
+        [({"epoch": 4}, {"gen": 2})],
+        [({"epoch": 4}, {"gen": 2})],
+        [({"epoch": 4}, {"gen": 2})],
+        [({"epoch": 4}, {"gen": 3})],
+    ])
+    assert outcomes == ["reattach", "reattach", "reattach", "rejoin"]
+    assert agreed == PARK_OUTCOMES["rejoin"]
+
+
+def test_park_never_accepts_stale_epoch_driver():
+    from horovod_tpu.elastic import PARK_OUTCOMES
+
+    # A stale driver is ALL four ranks ever see: nobody reattaches, the
+    # park times out, and the outcome is the (rollback-triggering) dead
+    # verdict — the fencing acceptance criterion.
+    outcomes, agreed = _simulate_park([
+        [({"epoch": 1}, {"gen": 2})] * 5 for _ in range(4)
+    ])
+    assert outcomes == ["dead"] * 4
+    assert agreed == PARK_OUTCOMES["dead"]
+
+
+# --------------------------------------------------- driver fault actions
+def test_driver_fault_actions_parse_and_schedule():
+    p = FaultPlan.from_json(
+        '{"seed": 3, "faults": ['
+        '{"kind": "kill_driver", "after_s": 2.0},'
+        '{"kind": "restart_driver", "after_s": 1.0, "epoch": 2}]}'
+    )
+    kill, restart = p.actions
+    assert kill.site == "driver" and restart.site == "driver"
+    assert kill.exit_code == DRIVER_KILL_EXIT_CODE
+    # Epoch scoping: default targets ONLY the first driver incarnation
+    # (a resumed driver must not replay its own death).
+    assert kill.matches_driver_epoch(1)
+    assert not kill.matches_driver_epoch(2)
+    assert restart.matches_driver_epoch(2)
+    assert not restart.matches_driver_epoch(1)
+    # Canonical schedule remains a pure function of the plan.
+    s = p.canonical_schedule()
+    assert '"kind":"kill_driver"' in s and '"epoch":2' in s
+    assert s == FaultPlan.from_json(
+        json.dumps({"seed": 3,
+                    "faults": [a.to_dict() for a in p.actions]})
+    ).canonical_schedule()
+    with pytest.raises(ValueError):
+        FaultPlan.from_json('{"faults": [{"kind": "kill_driver", '
+                            '"site": "step"}]}')
+    with pytest.raises(ValueError):
+        FaultPlan.from_json('{"faults": [{"kind": "delay", '
+                            '"site": "driver"}]}')
+
+
+def test_driver_fault_kinds_skipped_at_worker_taps():
+    p = FaultPlan.from_json(
+        '{"faults": [{"kind": "kill_driver", "after_s": 0.0}]}'
+    )
+    _injector.install_plan(p)
+    # A worker-side tap at the driver site must NOT execute (let alone
+    # exit): driver faults belong to the driver's supervision loop.
+    assert _injector.fault_point("driver") is None
+    assert _injector.events() == []
+
+
+def test_maybe_fire_driver_faults_kill_and_epoch_fence(monkeypatch):
+    from horovod_tpu.run.elastic_driver import ElasticDriver
+
+    killed = []
+    monkeypatch.setattr(os, "_exit", lambda code: killed.append(code))
+    _injector.install_plan(FaultPlan.from_json(
+        '{"faults": [{"kind": "kill_driver", "after_s": 0.0,'
+        ' "exit_code": 71}]}'
+    ))
+    drv = ElasticDriver.__new__(ElasticDriver)  # unit scope
+    drv._epoch = 2
+    drv._gen = 1
+    drv._started_at = time.monotonic() - 1.0
+    drv._driver_faults_fired = set()
+    drv._output_dir = None
+    drv._verbose = False
+    # Epoch 2 (a resumed driver): the default-scoped kill is fenced off.
+    drv._maybe_fire_driver_faults()
+    assert killed == []
+    # Epoch 1 (the original driver): it fires, once.
+    drv._epoch = 1
+    drv._maybe_fire_driver_faults()
+    assert killed == [71]
+    drv._maybe_fire_driver_faults()
+    assert killed == [71]  # one-shot
+    assert [e["action"] for e in _injector.events()] == ["kill_driver"]
+
+
+# ------------------------------------------------------ resume plumbing
+def test_elastic_driver_resume_requires_journal(tmp_path):
+    from horovod_tpu.run.elastic_driver import ElasticDriver
+
+    with pytest.raises(ValueError, match="journal"):
+        ElasticDriver(
+            ["true"], min_np=1, max_np=1, hosts=[("localhost", 1)],
+            env={}, resume=True,
+        )
+    with pytest.raises(ValueError, match="resumable"):
+        ElasticDriver(
+            ["true"], min_np=1, max_np=1, hosts=[("localhost", 1)],
+            env={}, output_dir=str(tmp_path), resume=True,
+        )
+
+
+def test_elastic_driver_resume_finished_journal_exits_zero(tmp_path):
+    from horovod_tpu.run.elastic_driver import ElasticDriver
+
+    j = DriverJournal.open(str(tmp_path / journal_mod.JOURNAL_BASENAME))
+    j.record(gen=2, finished=True, world={"gen": 2, "assignments": {}})
+    drv = ElasticDriver(
+        ["true"], min_np=1, max_np=1, hosts=[("localhost", 1)],
+        env={}, output_dir=str(tmp_path), resume=True,
+    )
+    assert drv.run() == 0
+    # The epoch still advanced past the finished incarnation (fencing
+    # stays monotonic even across no-op resumes).
+    assert drv._epoch == 2
+
+
+# --------------------------------------------------------- auto-resume
+def test_supervise_driver_resumes_on_abnormal_exit():
+    from horovod_tpu.run.run import _supervise_driver
+
+    calls = []
+    codes = iter([67, 67, 0])
+
+    def fake_call(args):
+        calls.append(list(args))
+        return next(codes)
+
+    rc = _supervise_driver(
+        ["-np", "2", "--min-np", "2", "--auto-resume", "cmd"],
+        call=fake_call,
+    )
+    assert rc == 0
+    assert len(calls) == 3
+    # --auto-resume never reaches the child; --resume is appended once.
+    assert all("--auto-resume" not in c for c in calls)
+    assert "--resume" not in calls[0]
+    assert calls[1].count("--resume") == 1
+    assert calls[2].count("--resume") == 1
+
+
+def test_supervise_driver_deliberate_exit_and_budget(monkeypatch):
+    from horovod_tpu.run.run import _supervise_driver
+
+    # Deliberate exits (job failure) pass straight through.
+    assert _supervise_driver(["x"], call=lambda a: 1) == 1
+    # A crash loop is bounded by the restart budget.
+    monkeypatch.setenv("HOROVOD_DRIVER_MAX_RESTARTS", "2")
+    calls = []
+
+    def always_crash(args):
+        calls.append(1)
+        return 67
+
+    assert _supervise_driver(["x"], call=always_crash) == 67
+    assert len(calls) == 3  # initial + 2 restarts

@@ -27,6 +27,13 @@
 # non-finite sentinel and the parameter-digest heal asserted end-to-end,
 # and the event log byte-identical across two runs
 # (docs/fault_tolerance.md "Data-plane integrity"). Budget: under 15s.
+#
+# Stage 6 (make driver-smoke; skip with HVD_CI_SKIP_DRIVER=1): the
+# control-plane HA smoke — a seeded driver kill mid-training, journal
+# resume (hvdrun --resume), and in-place worker reattach, run twice with
+# byte-identical normalized event logs and the final params asserted
+# bitwise against the uninterrupted run (docs/fault_tolerance.md
+# "Control-plane availability"). Budget: under 90s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -63,4 +70,11 @@ if [ "${HVD_CI_SKIP_GUARD:-0}" != "1" ]; then
     python tools/guard_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: guard smoke detected+healed in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_DRIVER:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/driver_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: driver smoke killed+resumed+reattached in ${elapsed}s"
 fi
