@@ -979,11 +979,15 @@ class State:
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_guard_heals_total")
 
-    # Per-rank vote weights for the commit-time agreement allreduce:
-    # updated/preempted contribute at most 3 per rank, so the driver-lost
-    # bit rides a band no sum of the small votes can reach below 32k
-    # ranks — one flag allreduce carries all three signals.
-    _LOST_WEIGHT = 65536
+    # Per-rank vote bits for the commit-time agreement allreduce: each
+    # rank ORs its local observations into one int32 and the fleet
+    # agrees with op=Max (same idiom as the park-outcome agreement).
+    # The decision ladder only acts on the strongest signal present, so
+    # Max losing weaker bits is harmless — and unlike a weighted Sum the
+    # scheme is rank-count independent (no overflow band to outgrow).
+    _UPDATED_BIT = 1
+    _PREEMPT_BIT = 2
+    _LOST_BIT = 4
 
     def check_host_updates(self) -> None:
         """Raise ``HostsUpdatedInterrupt`` on EVERY rank when any rank has
@@ -1011,28 +1015,29 @@ class State:
         if new_epoch is not None and not (lost or updated or preempted):
             ctx.reattach(new_epoch)
         flag = np.asarray(
-            [(self._LOST_WEIGHT if lost else 0)
-             + (2 if preempted else 0) + (1 if updated else 0)],
+            [(self._LOST_BIT if lost else 0)
+             | (self._PREEMPT_BIT if preempted else 0)
+             | (self._UPDATED_BIT if updated else 0)],
             np.int32,
         )
         if hvd.size() > 1:
             flag = np.asarray(
-                hvd.allreduce(flag, op=hvd.Sum, name="hvd.elastic.hostcheck")
+                hvd.allreduce(flag, op=hvd.Max, name="hvd.elastic.hostcheck")
             )
-        total = int(flag[0])
+        agreed = int(flag[0])
         if preempted:
             raise PreemptionInterrupt(
                 _preemption.preemption_reason() or "preemption notice"
             )
-        if total >= self._LOST_WEIGHT:
+        if agreed >= self._LOST_BIT:
             _park_and_reattach(ctx, self)
             return
-        if total >= 2:
+        if agreed >= self._PREEMPT_BIT:
             raise HostsUpdatedInterrupt(
                 "a peer rank received a preemption notice; re-forming "
                 "the world"
             )
-        if total > 0:
+        if agreed > 0:
             raise HostsUpdatedInterrupt(
                 "host membership changed; re-forming the world"
             )

@@ -427,7 +427,16 @@ class ElasticDriver:
         }
         if not force and kv_snap == self._last_journaled_kv:
             return
+        # DriverJournal.open carries prior state — including a completed
+        # predecessor's finished=True — forward; every live sync must
+        # overwrite it or a fresh job reusing the output dir would look
+        # "finished" to --resume after a crash (and --auto-resume would
+        # report success over an abandoned fleet). The one exception is
+        # the finished-journal resume short-circuit, which must stay
+        # finished so repeat resumes keep exiting 0 without touching the
+        # (long gone) fleet. getattr: bare __new__ test drivers again.
         self._journal.record(
+            finished=bool(getattr(self, "_resume_finished", False)),
             epoch=self._epoch,
             gen=self._gen,
             kv_port=self._kv.port,

@@ -306,11 +306,20 @@ class KVStoreClient:
         two reasons a value can be absent: a missing key (HTTP 404)
         still returns None, but a transport failure (dead driver)
         raises :class:`KVUnavailableError` so the caller can tell "the
-        driver says no such key" from "there is no driver"."""
+        driver says no such key" from "there is no driver". Only a 404
+        means "missing key": any other HTTP status (a listening but
+        erroring driver — handler exception, wedged state) is a control
+        plane failure, and in strict mode it must count toward the
+        driver-lost threshold exactly like a dead endpoint."""
         try:
             return self._request("GET", f"/{scope}/{key}")
-        except KVHTTPError:
-            return None
+        except KVHTTPError as exc:
+            if exc.status == 404 or not strict:
+                return None
+            raise KVUnavailableError(
+                f"KV endpoint {self.endpoint} answering but failing: "
+                f"HTTP {exc.status} for GET /{scope}/{key}"
+            ) from exc
         except Exception:
             if strict:
                 raise

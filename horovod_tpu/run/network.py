@@ -200,6 +200,17 @@ class NoValidAddressesFound(Exception):
     pass
 
 
+class RPCUnavailableError(ConnectionError):
+    """A control-plane RPC endpoint could not be reached within the retry
+    budget. Subclasses ConnectionError so existing transport-failure
+    handling still matches, while the message names the endpoints, how
+    long they have been failing across consecutive sends, and the retry
+    budget spent. Raised ``from`` the final transport error instead of
+    rebuilding it — reconstructing an OSError subclass from a bare
+    string loses ``errno`` (and would TypeError on exception types
+    without a one-string constructor)."""
+
+
 class RemoteTimeoutError(RuntimeError):
     """A rendezvous phase timed out ON THE SERVER (e.g. a peer task never
     registered). Deliberately not an OSError/TimeoutError: the server
@@ -502,7 +513,7 @@ class BasicClient:
                 self._down_since = now
             if _metrics.ACTIVE:
                 _metrics.TAP.inc("hvd_rpc_failures_total", request=req_name)
-            raise type(exc)(
+            raise RPCUnavailableError(
                 f"{exc} [endpoint {self._endpoints()} failing for "
                 f"{now - self._down_since:.1f}s; retry budget "
                 f"{self._backoff.retries + 1} attempts spent]"

@@ -238,6 +238,14 @@ def _importable(mod: str) -> bool:
         return False
 
 
+# Exit code for an UNHANDLED exception in the elastic driver (sysexits
+# EX_SOFTWARE). Without it the most common software-crash mode — a
+# Python traceback — would exit 1, indistinguishable from the driver's
+# deliberate "job failed" verdict, and --auto-resume would refuse to
+# resume exactly the crash the journal exists to recover from.
+DRIVER_CRASH_RC = 70
+
+
 def _supervise_driver(argv: List[str],
                       call=None) -> int:
     """``--auto-resume``: run the elastic driver as a child process and
@@ -245,9 +253,10 @@ def _supervise_driver(argv: List[str],
     minimal supervisor that turns the control-plane journal into
     unattended crash recovery. "Abnormal" is any exit the driver does
     not use for deliberate outcomes (0 success, 1 job failure, 2 usage,
-    3 config, 4 unreachable hosts); signals and injected/driver-crash
-    codes resume. ``HOROVOD_DRIVER_MAX_RESTARTS`` (default 3) bounds a
-    crash loop."""
+    3 config, 4 unreachable hosts); signals, unhandled driver
+    exceptions (``DRIVER_CRASH_RC``), and injected/driver-crash codes
+    resume. ``HOROVOD_DRIVER_MAX_RESTARTS`` (default 3) bounds a crash
+    loop."""
     import subprocess
 
     call = call or (lambda a: subprocess.call(
@@ -356,7 +365,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
         from .elastic_driver import ElasticDriver
 
-        return ElasticDriver(
+        driver = ElasticDriver(
             command,
             min_np=args.min_np or args.num_proc or 1,
             max_np=args.max_np or args.num_proc or (1 << 30),
@@ -373,7 +382,16 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             probed_hostset=probed_hostset,
             blacklist_cooldown=args.blacklist_cooldown,
             resume=args.resume,
-        ).run()
+        )
+        try:
+            return driver.run()
+        except SystemExit:
+            raise
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return DRIVER_CRASH_RC
 
     if args.tpu_pod:
         slots = launcher.tpu_pod_allocation()

@@ -207,6 +207,73 @@ def test_kv_client_strict_error_names_endpoint_downtime_budget(monkeypatch):
         server2.stop()
 
 
+def test_kv_client_strict_get_non_404_is_an_outage():
+    """Regression (REVIEW): only a 404 means "missing key". A listening
+    but erroring driver (handler exception → 500) must read as a
+    control-plane failure in strict mode — not as "key absent, driver
+    up", which would reset the commit-probe failure streak and keep
+    workers from ever parking against a wedged driver."""
+    import http.server
+    import threading
+
+    from horovod_tpu.run.http_server import (
+        KVStoreClient,
+        KVUnavailableError,
+    )
+
+    class _Erroring(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(500)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Erroring)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = KVStoreClient("127.0.0.1", srv.server_port)
+        with pytest.raises(KVUnavailableError) as e:
+            client.get("elastic", "world", strict=True)
+        msg = str(e.value)
+        assert "HTTP 500" in msg
+        assert f"127.0.0.1:{srv.server_port}" in msg
+        # Lenient mode still folds the failure into None (polling
+        # callers keep their simple loops).
+        assert client.get("elastic", "world") is None
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------- RPC client error naming
+def test_rpc_client_dead_endpoint_wrapper_preserves_cause(monkeypatch):
+    """Regression (REVIEW): the endpoint-stamped re-raise used to
+    rebuild ``type(exc)`` from a bare string, losing ``errno`` on
+    OSError subclasses. The dedicated ConnectionError wrapper keeps the
+    original as ``__cause__`` and still matches transport-failure
+    handlers."""
+    from horovod_tpu.run import network as net
+
+    monkeypatch.setenv("HOROVOD_RPC_BACKOFF_BASE_S", "0.01")
+    key = net.make_secret_key()
+    svc = net.BasicService("svc", key)
+    svc.start()
+    client = net.BasicClient(
+        "svc", {"lo": [("127.0.0.1", svc.port)]}, key, retries=1
+    )
+    svc.shutdown()  # now a dead endpoint
+    with pytest.raises(net.RPCUnavailableError) as e:
+        client.send(net.PingRequest())
+    assert isinstance(e.value, ConnectionError)
+    cause = e.value.__cause__
+    assert isinstance(cause, (OSError, EOFError, net.WireError))
+    if isinstance(cause, OSError) and cause.errno is not None:
+        assert cause.errno != 0  # the original errno survived
+    msg = str(e.value)
+    assert "failing for" in msg          # elapsed downtime
+    assert "attempts spent" in msg       # retry budget
+
+
 # --------------------------------------- park/reconnect state machine units
 def _watch():
     from horovod_tpu.elastic import DriverWatch
@@ -277,6 +344,31 @@ def test_park_agreement_4_ranks_mixed_outcome_degrades_to_rejoin():
     ])
     assert outcomes == ["reattach", "reattach", "reattach", "rejoin"]
     assert agreed == PARK_OUTCOMES["rejoin"]
+
+
+def test_hostcheck_vote_bits_rank_count_independent():
+    """Regression (REVIEW): the commit-time agreement used a weighted
+    Sum (driver-lost at 65536 in an int32) that breaks past ~21k ranks.
+    The bitmask + Max scheme has no overflow band: the agreed value is
+    one rank's OR'd mask, whatever the fleet size, and the decision
+    ladder reads the strongest signal from it."""
+    from horovod_tpu.elastic import State
+
+    lost, pre, upd = State._LOST_BIT, State._PREEMPT_BIT, State._UPDATED_BIT
+    assert lost > pre > upd > 0
+
+    def agree(votes):
+        return max(votes)  # op=Max agreement, rank-count independent
+
+    # 32k (or any number of) ranks voting the small signals can never
+    # reach the lost band...
+    assert agree([pre | upd] * 32768) < lost
+    # ...one lost vote parks the fleet regardless of what rides along...
+    assert agree([upd] * 32767 + [lost | pre]) >= lost
+    # ...and a preempted peer outranks a plain membership update.
+    assert pre <= agree([upd, pre | upd]) < lost
+    # Every mask stays comfortably inside int32.
+    assert (lost | pre | upd) < 2 ** 31 - 1
 
 
 def test_park_never_accepts_stale_epoch_driver():
@@ -393,6 +485,41 @@ def test_elastic_driver_resume_finished_journal_exits_zero(tmp_path):
     assert drv._epoch == 2
 
 
+def test_fresh_driver_reusing_dir_clears_finished_flag(tmp_path):
+    """Regression (REVIEW): DriverJournal.open carries prior state —
+    including a completed predecessor's finished=True — forward, and
+    nothing cleared it, so a fresh job reusing the output dir looked
+    "finished" to --resume after a crash (abandoning a live fleet while
+    --auto-resume reported success)."""
+    from horovod_tpu.run.elastic_driver import ElasticDriver
+
+    j = DriverJournal.open(str(tmp_path / journal_mod.JOURNAL_BASENAME))
+    j.record(gen=2, finished=True, world={"gen": 2, "assignments": {}})
+    # A fresh (non --resume) job reusing the directory: its very first
+    # journal sync must overwrite the stale finished flag.
+    drv = ElasticDriver(
+        ["true"], min_np=1, max_np=1, hosts=[("localhost", 1)],
+        env={}, output_dir=str(tmp_path),
+    )
+    assert drv._journal.state.get("finished") is False
+    assert DriverJournal(drv._journal.path).replay()["finished"] is False
+    # Simulate the fresh job making progress, then crashing: --resume
+    # must resume it, not short-circuit on the predecessor's flag.
+    drv._gen = 3
+    drv._journal_sync(force=True)
+    drv._kv.close()  # release the port for the resumed driver's reclaim
+    drv2 = ElasticDriver(
+        ["true"], min_np=1, max_np=1, hosts=[("localhost", 1)],
+        env={}, output_dir=str(tmp_path), resume=True,
+    )
+    assert drv2._resume_finished is False
+    assert drv2._gen == 3
+    drv2._kv.close()
+    # The finished-journal short-circuit itself stays intact: a resume
+    # that DID see finished=True keeps it, so repeat resumes still exit
+    # 0 (test_elastic_driver_resume_finished_journal_exits_zero).
+
+
 # --------------------------------------------------------- auto-resume
 def test_supervise_driver_resumes_on_abnormal_exit():
     from horovod_tpu.run.run import _supervise_driver
@@ -415,6 +542,26 @@ def test_supervise_driver_resumes_on_abnormal_exit():
     assert "--resume" not in calls[0]
     assert calls[1].count("--resume") == 1
     assert calls[2].count("--resume") == 1
+
+
+def test_supervise_driver_resumes_on_unhandled_exception_rc():
+    """Regression (REVIEW): an unhandled Python exception in the driver
+    used to exit 1 — read as a deliberate job failure, the one crash
+    mode --auto-resume refused to recover. The driver now converts it
+    to the reserved crash code, which resumes."""
+    from horovod_tpu.run.run import DRIVER_CRASH_RC, _supervise_driver
+
+    assert DRIVER_CRASH_RC not in (0, 1, 2, 3, 4)
+    calls = []
+    codes = iter([DRIVER_CRASH_RC, 0])
+
+    def fake_call(args):
+        calls.append(list(args))
+        return next(codes)
+
+    assert _supervise_driver(["x"], call=fake_call) == 0
+    assert len(calls) == 2
+    assert calls[1].count("--resume") == 1
 
 
 def test_supervise_driver_deliberate_exit_and_budget(monkeypatch):
