@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -13,7 +13,7 @@ test:
 # Collective-safety static analysis: Pass 1 over the example train steps
 # and Pass 2 over the runtime sources (docs/static_analysis.md).
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -44,4 +44,10 @@ guard-smoke:
 driver-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/driver_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke test
+# Topology-compositor smoke (docs/topology.md): plan dumps for 1/2/4-slice
+# (and a three-level) synthetic topologies, byte-identical across two
+# runs, hierarchical DCN bytes < flat, <10s CPU, no backend.
+topo-smoke:
+	$(PY) tools/topo_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke test

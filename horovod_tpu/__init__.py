@@ -328,6 +328,36 @@ def is_homogeneous() -> bool:
     return _rt().topology.is_homogeneous
 
 
+def collective_plan(
+    collective: str = "allreduce",
+    nbytes: int = 4 * 1024 * 1024,
+    op: Optional[ReduceOp] = None,
+) -> dict:
+    """The topology compositor's selected lowering plan for one
+    collective at one payload size on THIS deployment's interconnect
+    model (docs/topology.md): algorithm (flat / ring / recursive-halving
+    / two-level / split), per-hop bytes-on-wire, per-stage schedule, and
+    the analytic cost estimate. Uses the initialized runtime's topology
+    when available, else fresh detection; honors the
+    ``HOROVOD_TOPOLOGY_MODEL`` override. Pure cost-model output — no
+    backend is touched, so this also works pre-init (the offline twin is
+    ``tools/topo_plan.py``)."""
+    from .topo import resolve_model, select_plan
+
+    topo = (
+        _runtime.topology if _runtime is not None
+        else _topology_mod.detect()
+    )
+    model = resolve_model(topo)
+    plan = select_plan(
+        model, collective, int(nbytes),
+        op=op if op is not None else ReduceOp.SUM,
+    )
+    out = plan.to_dict()
+    out["model"] = model.to_dict()
+    return out
+
+
 # Build-capability probes (reference horovod_*_built/enabled,
 # operations.cc:683-769). MPI/Gloo/NCCL/DDL/MLSL do not exist in the TPU
 # build; XLA is the sole data plane.

@@ -112,6 +112,16 @@ HOROVOD_DRIVER_JOURNAL = "HOROVOD_DRIVER_JOURNAL"
 HOROVOD_DRIVER_LOST_PROBES = "HOROVOD_DRIVER_LOST_PROBES"
 HOROVOD_DRIVER_MAX_RESTARTS = "HOROVOD_DRIVER_MAX_RESTARTS"
 HOROVOD_FAULT_DRIVER_BLACKOUT_S = "HOROVOD_FAULT_DRIVER_BLACKOUT_S"
+# Topology-aware collective compositor (docs/topology.md; horovod_tpu/topo
+# reads these directly). HOROVOD_TOPOLOGY_MODEL is a JSON file path or
+# inline JSON overriding the detected interconnect model (per-hop
+# bandwidth/latency, or a full hop list). HOROVOD_TOPOLOGY_PLAN="auto"
+# lets the eager executor enable hierarchical lowerings whenever the
+# compositor's cost model selects a non-flat plan (the legacy
+# HOROVOD_HIERARCHICAL_* booleans force them unconditionally); "off"
+# (default) keeps plan selection advisory (metrics/introspection only).
+HOROVOD_TOPOLOGY_MODEL = "HOROVOD_TOPOLOGY_MODEL"
+HOROVOD_TOPOLOGY_PLAN = "HOROVOD_TOPOLOGY_PLAN"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64
@@ -266,6 +276,10 @@ class Config:
     cache_enabled: bool = True
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    # "auto" = the eager executor goes hierarchical whenever the topology
+    # compositor's cost model selects a non-flat plan; "off" = planner is
+    # advisory only (docs/topology.md).
+    topology_plan: str = "off"
     autotune: bool = False
     autotune_log_file: str = ""
     autotune_warmup_samples: int = 3
@@ -315,6 +329,9 @@ class Config:
         cfg.cache_enabled = cfg.cache_capacity > 0
         cfg.hierarchical_allreduce = _get_bool(HOROVOD_HIERARCHICAL_ALLREDUCE)
         cfg.hierarchical_allgather = _get_bool(HOROVOD_HIERARCHICAL_ALLGATHER)
+        cfg.topology_plan = (
+            os.environ.get(HOROVOD_TOPOLOGY_PLAN, "") or cfg.topology_plan
+        ).strip().lower()
         cfg.autotune = _get_bool(HOROVOD_AUTOTUNE)
         cfg.autotune_log_file = os.environ.get(HOROVOD_AUTOTUNE_LOG, "")
         cfg.autotune_warmup_samples = _get_int(

@@ -660,6 +660,17 @@ class Runtime:
             preset = _env_mod.applied_perf_preset()
             if preset is not None:
                 self.timeline.metadata("hvd_xla_perf_preset", preset)
+            try:
+                from ..topo import resolve_model
+
+                # Run fact a trace reader needs to interpret collective
+                # timings: the interconnect model plans were priced on.
+                self.timeline.metadata(
+                    "hvd_topo_model",
+                    resolve_model(self.topology).to_dict(),
+                )
+            except Exception:  # noqa: BLE001 - metadata must not block start
+                pass
         self._thread = threading.Thread(
             target=self._background_loop, name="hvd_background", daemon=True
         )

@@ -153,6 +153,23 @@ class XlaPlanExecutor(PlanExecutor):
                 ),
                 (_CROSS_AXIS, _LOCAL_AXIS),
             )
+        # Interconnect model for the topology compositor: the eager path
+        # consults the same planner the streamed/compiled paths use
+        # (docs/topology.md). Built once — selection per plan is pure
+        # python. topology_plan="auto" lets the planner ENABLE the
+        # hierarchical lowerings; otherwise it is advisory (it still
+        # picks two-level vs split under the legacy force-knobs and
+        # records every verdict in metrics).
+        try:
+            from ..topo.model import apply_override, model_from_topology
+
+            self._topo_model = apply_override(model_from_topology(topology))
+        except Exception:  # noqa: BLE001 - planner must not block the plane
+            self._topo_model = None
+        self._topo_auto = (
+            getattr(config, "topology_plan", "off") == "auto"
+            if config else False
+        )
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self._sharding = NamedSharding(self._mesh, P(_RANK_AXIS))
@@ -392,7 +409,34 @@ class XlaPlanExecutor(PlanExecutor):
             offset += n
         return outputs
 
-    def _reduce_flat(self, v, *, op, adasum, hier, pre, post, participants):
+    def _consult_planner(self, collective: str, nbytes: int, op=None):
+        """Select (and metrics-record) the compositor's plan for one
+        eager collective — None when no model is available."""
+        if self._topo_model is None:
+            return None
+        try:
+            from ..topo import compositor as _compositor
+
+            return _compositor.record_plan(
+                _compositor.select_plan(
+                    self._topo_model, collective, nbytes,
+                    op=op if op is not None else ReduceOp.SUM,
+                ),
+                where="eager",
+            )
+        except Exception:  # noqa: BLE001 - advisory only
+            return None
+
+    @staticmethod
+    def _entry_bytes(entries) -> int:
+        return int(sum(
+            int(np.prod(e.tensor.shape)) * np.dtype(str(e.tensor.dtype)).itemsize
+            if len(e.tensor.shape) else np.dtype(str(e.tensor.dtype)).itemsize
+            for e in entries
+        ))
+
+    def _reduce_flat(self, v, *, op, adasum, hier, pre, post, participants,
+                     algorithm="two-level", split_fraction=None):
         """Collective math on one flat per-rank vector; traced inside the
         compiled plan executable by both the host and device paths."""
         from jax import lax
@@ -416,10 +460,14 @@ class XlaPlanExecutor(PlanExecutor):
             else:
                 r = adasum_allreduce(v, axis_name=_RANK_AXIS)
         elif hier:
-            from ..ops.collectives import hierarchical_allreduce
+            from ..topo import compositor as _compositor
 
-            r = hierarchical_allreduce(
-                v, local_axis=_LOCAL_AXIS, cross_axis=_CROSS_AXIS
+            # The planner's verdict picks the hierarchical flavor:
+            # two-level (the NCCLHierarchicalAllreduce shape) or the
+            # FlexLink split that drives ICI and DCN concurrently.
+            r = _compositor.lower_allreduce(
+                v, (_CROSS_AXIS, _LOCAL_AXIS), op=ReduceOp.SUM,
+                algorithm=algorithm, split_fraction=split_fraction,
             )
             if op == ReduceOp.AVERAGE:
                 r = (r / participants).astype(r.dtype)
@@ -453,6 +501,18 @@ class XlaPlanExecutor(PlanExecutor):
         # exists. MIN/MAX stay flat (reference hierarchy covers sums only).
         # Process-set collectives always run flat on the sub-mesh (a set
         # has no (cross, local) factorization of its own).
+        # The compositor's verdict for this payload (recorded in
+        # hvd_topo_plan_info either way; authoritative only under
+        # HOROVOD_TOPOLOGY_PLAN=auto).
+        tplan = None
+        if (
+            ctx is None and self._mesh2 is not None and not adasum
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+            and (self._topo_auto or _metrics.ACTIVE)
+        ):
+            tplan = self._consult_planner(
+                "allreduce", self._entry_bytes(entries), op
+            )
         hier = (
             ctx is None
             and self._mesh2 is not None
@@ -463,10 +523,21 @@ class XlaPlanExecutor(PlanExecutor):
                 # Adasum on a multi-level grid is always hierarchical, like
                 # the reference's CUDA variant (adasum_cuda_operations.cc).
                 or adasum
+                # Planner-driven: the cost model turned hierarchy on.
+                or (self._topo_auto and tplan is not None
+                    and tplan.algorithm in ("two-level", "split"))
             )
         )
+        algorithm, split_fraction = "two-level", None
+        if (
+            hier and not adasum and tplan is not None
+            and tplan.algorithm == "split" and tplan.nbytes
+        ):
+            algorithm = "split"
+            split_fraction = tplan.split_bytes[0] / tplan.nbytes
         kw = dict(op=op, adasum=adasum, hier=hier, pre=pre, post=post,
-                  participants=participants, ctx=ctx)
+                  participants=participants, ctx=ctx,
+                  algorithm=algorithm, split_fraction=split_fraction)
         if (
             all(self._device_resident(e.tensor) for e in entries)
             and len({str(e.tensor.dtype) for e in entries}) == 1
@@ -475,10 +546,12 @@ class XlaPlanExecutor(PlanExecutor):
         return self._allreduce_host(entries, **kw)
 
     def _allreduce_host(self, entries, *, op, adasum, hier, pre, post,
-                        participants, ctx=None) -> Dict[str, Any]:
+                        participants, ctx=None, algorithm="two-level",
+                        split_fraction=None) -> Dict[str, Any]:
         buf, shapes, dtype = self._pack(entries)
         key = ("ar", dtype, buf.size, int(op), adasum, pre, post,
-               participants, hier, ("ps", ctx.id if ctx else 0))
+               participants, hier, algorithm, split_fraction,
+               ("ps", ctx.id if ctx else 0))
 
         def build():
             def body(x):
@@ -486,7 +559,8 @@ class XlaPlanExecutor(PlanExecutor):
                 v = x[0] if not hier else x[0, 0]
                 return self._reduce_flat(
                     v, op=op, adasum=adasum, hier=hier, pre=pre, post=post,
-                    participants=participants,
+                    participants=participants, algorithm=algorithm,
+                    split_fraction=split_fraction,
                 )
 
             # The carrier is executor-owned: donate it so XLA aliases the
@@ -504,7 +578,8 @@ class XlaPlanExecutor(PlanExecutor):
         return self._unpack(res, entries, shapes)
 
     def _allreduce_device(self, entries, *, op, adasum, hier, pre, post,
-                          participants, ctx=None) -> Dict[str, Any]:
+                          participants, ctx=None, algorithm="two-level",
+                          split_fraction=None) -> Dict[str, Any]:
         """Zero-host-copy path: entries are device-resident jax arrays, so
         pack + collective + unpack trace into one executable and outputs
         stay on device. The flat fusion buffer is an XLA temporary — the
@@ -514,7 +589,8 @@ class XlaPlanExecutor(PlanExecutor):
         shapes = tuple(tuple(int(d) for d in e.tensor.shape) for e in entries)
         dtype = str(entries[0].tensor.dtype)
         key = ("ar_dev", dtype, shapes, int(op), adasum, pre, post,
-               participants, hier, ("ps", ctx.id if ctx else 0))
+               participants, hier, algorithm, split_fraction,
+               ("ps", ctx.id if ctx else 0))
 
         def build():
             def body(*xs):
@@ -523,7 +599,8 @@ class XlaPlanExecutor(PlanExecutor):
                 v = vs[0] if len(vs) == 1 else jnp.concatenate(vs)
                 r = self._reduce_flat(
                     v, op=op, adasum=adasum, hier=hier, pre=pre, post=post,
-                    participants=participants,
+                    participants=participants, algorithm=algorithm,
+                    split_fraction=split_fraction,
                 )
                 if len(shapes) == 1:
                     return r.reshape(shapes[0])
@@ -577,10 +654,22 @@ class XlaPlanExecutor(PlanExecutor):
         # and compact on the host (XLA needs static shapes).
         rank_sizes = [int(s) for s in plan.get("rank_sizes", [])]
         uneven = bool(rank_sizes) and len(set(rank_sizes)) > 1
+        tplan = None
+        if (
+            ctx is None and self._mesh2 is not None
+            and (self._topo_auto or _metrics.ACTIVE)
+        ):
+            tplan = self._consult_planner(
+                "allgather", self._entry_bytes(entries)
+            )
         hier = (
             ctx is None
             and self._mesh2 is not None
-            and self._plan_knob(plan, "hierarchical_allgather", 2)
+            and (
+                self._plan_knob(plan, "hierarchical_allgather", 2)
+                or (self._topo_auto and tplan is not None
+                    and tplan.algorithm == "two-level")
+            )
         )
         n_ranks = ctx.size if ctx is not None else self._topo.size
 
@@ -636,6 +725,11 @@ class XlaPlanExecutor(PlanExecutor):
         from ..jax import _shard_map
         from ..ops.collectives import broadcast as bcast_op
 
+        if _metrics.ACTIVE and ctx is None:
+            # Advisory verdict only (the eager broadcast body runs on the
+            # flat rank mesh); surfaces what a hierarchical lowering
+            # would save in hvd_topo_bytes_per_hop.
+            self._consult_planner("broadcast", self._entry_bytes(entries))
         # root_rank travels as a GLOBAL rank (reference process-set API
         # semantics); on a sub-mesh the lowering wants the member position.
         root = int(plan.get("root", 0))
@@ -691,6 +785,10 @@ class XlaPlanExecutor(PlanExecutor):
         from ..jax import _shard_map
         from ..ops.collectives import reducescatter as rs_lowering
 
+        if _metrics.ACTIVE and ctx is None:
+            self._consult_planner(
+                "reducescatter", self._entry_bytes(entries)
+            )
         outputs: Dict[str, Any] = {}
         n = ctx.size if ctx is not None else self._topo.size
         my = ctx.index if ctx is not None else self._topo.rank
@@ -774,6 +872,8 @@ class XlaPlanExecutor(PlanExecutor):
         from jax.sharding import PartitionSpec as P
         from ..jax import _shard_map
 
+        if _metrics.ACTIVE and ctx is None:
+            self._consult_planner("alltoall", self._entry_bytes(entries))
         outputs: Dict[str, Any] = {}
         n = ctx.size if ctx is not None else self._topo.size
         for e in entries:

@@ -41,8 +41,11 @@ from ..parallel.mesh import (
     CROSS_AXIS,
     DATA_AXIS,
     LOCAL_AXIS,
+    POD_AXIS,
     build_hierarchical_mesh,
     build_mesh,
+    build_three_level_mesh,
+    hierarchy_axes,
 )
 
 _logger = logging.getLogger("horovod_tpu")
@@ -88,6 +91,10 @@ broadcast = _c.broadcast
 alltoall = _c.alltoall
 reducescatter = _c.reducescatter
 hierarchical_allreduce = _c.hierarchical_allreduce
+hierarchical_allgather = _c.hierarchical_allgather
+hierarchical_reducescatter = _c.hierarchical_reducescatter
+hierarchical_broadcast = _c.hierarchical_broadcast
+hierarchical_alltoall = _c.hierarchical_alltoall
 
 # Streamed (overlap) gradient reduction: register a parameter subtree (or a
 # scanned layer stack's body) so its gradients are bucket-allreduced INSIDE
@@ -97,9 +104,54 @@ stream_scan_body = _fusion.stream_scan_body
 stream_param_groups = _fusion.stream_param_groups
 
 
-def _select_reduce_fn(op: ReduceOp, hierarchical: bool):
+def collective_plan(collective: str = "allreduce",
+                    nbytes: int = 4 * 1024 * 1024,
+                    op: Optional[ReduceOp] = None) -> dict:
+    """Compiled-mode alias of :func:`horovod_tpu.collective_plan` —
+    the topology compositor's selected plan for one collective at one
+    payload size (docs/topology.md)."""
+    from .. import collective_plan as _cp
+
+    return _cp(collective, nbytes, op)
+
+
+def _resolve_hierarchical(hierarchical, mesh: Optional[Mesh] = None):
+    """Resolve the tri-state ``hierarchical`` knob (docs/topology.md):
+
+    - ``False`` / ``True`` pass through (True = the forced two-level
+      lowering, reference parity).
+    - ``"auto"`` consults the topology compositor: with a mesh, the
+      hierarchy axes the caller built decide (a deliberate (pod,) cross,
+      local grid -> per-bucket plan selection; a flat data mesh -> flat);
+      without one, the detected process topology's homogeneity-gated
+      model decides.
+
+    Returns ``(mode, axes)`` where mode is False / True / "planned" and
+    axes is the hierarchy axis tuple for planned mode (None otherwise).
+    """
+    if hierarchical == "auto":
+        if mesh is not None:
+            axes = hierarchy_axes(mesh)
+            if axes:
+                return "planned", axes
+            return False, None
+        from ..topo import resolve_model
+
+        if resolve_model().eligible:
+            return "planned", (CROSS_AXIS, LOCAL_AXIS)
+        return False, None
+    if hierarchical == "planned":
+        return "planned", None
+    return bool(hierarchical), None
+
+
+def _select_reduce_fn(op: ReduceOp, hierarchical):
     if op == ReduceOp.ADASUM:
         return adasum_reduce_fn
+    if hierarchical == "planned":
+        from ..topo import compositor as _compositor
+
+        return _compositor.auto_reduce_fn()
     if hierarchical:
         # axis_name must be the (cross, local) tuple: reduce-scatter rides
         # ICI (local), the shard psum rides DCN (cross).
@@ -118,10 +170,11 @@ def _select_reduce_fn(op: ReduceOp, hierarchical: bool):
     return _c.allreduce
 
 
-def _normalize_axis(axis_name, hierarchical: bool):
-    """hierarchical=True defaults the axis to the (cross, local) pair of a
-    hierarchical mesh; a plain psum uses the tuple directly (XLA reduces
-    over both axes), while the hierarchical reduce path splits it."""
+def _normalize_axis(axis_name, hierarchical):
+    """hierarchical=True (or "planned") defaults the axis to the
+    (cross, local) pair of a hierarchical mesh; a plain psum uses the
+    tuple directly (XLA reduces over both axes), while the hierarchical
+    reduce path splits it."""
     if hierarchical and isinstance(axis_name, str):
         if axis_name != DATA_AXIS:
             raise ValueError(
@@ -139,7 +192,7 @@ def allreduce_gradients(
     axis_name=DATA_AXIS,
     fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
-    hierarchical: bool = False,
+    hierarchical: Any = False,
     quantized: bool = False,
     nonfinite: Optional[str] = None,
 ) -> Any:
@@ -164,6 +217,8 @@ def allreduce_gradients(
     fusion_threshold_bytes = _fusion.default_threshold_bytes(
         fusion_threshold_bytes
     )
+    if hierarchical == "auto":
+        hierarchical, _ = _resolve_hierarchical(hierarchical)
     axis_name = _normalize_axis(axis_name, hierarchical)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
     if nonfinite_policy == "zero":
@@ -264,7 +319,7 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     axis_name: str = DATA_AXIS,
     fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
-    hierarchical: bool = False,
+    hierarchical: Any = False,
     quantized: bool = False,
     backward_passes_per_step: int = 1,
     overlap: bool = False,
@@ -304,6 +359,10 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
 
     _check_overlap_rejections(overlap, quantized, op)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
+    # "auto" without a mesh in hand: the detected process topology's
+    # homogeneity-gated model decides (docs/topology.md); the mesh the
+    # caller traces under must then carry the (cross, local) axes.
+    hierarchical, _ = _resolve_hierarchical(hierarchical)
     norm_axis = _normalize_axis(axis_name, hierarchical)
 
     def init_fn(params):
@@ -405,7 +464,7 @@ def make_train_step(
     op: ReduceOp = Average,
     fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
-    hierarchical: bool = False,
+    hierarchical: Any = False,
     quantized: bool = False,
     donate: bool = True,
     has_aux: bool = False,
@@ -448,6 +507,14 @@ def make_train_step(
     import optax
 
     _check_overlap_rejections(overlap, quantized, op)
+    # "auto": the mesh decides — a (pod,) cross, local hierarchy engages
+    # per-bucket compositor plan selection (flat/two-level/split by
+    # payload bytes, docs/topology.md); a flat data mesh stays flat. This
+    # is what makes make_train_step(overlap=True) go hierarchical
+    # automatically on multi-slice topologies.
+    hierarchical, hier_axes = _resolve_hierarchical(hierarchical, mesh)
+    if hierarchical == "planned" and hier_axes and axis_name == DATA_AXIS:
+        axis_name = hier_axes
     axis_name = _normalize_axis(axis_name, hierarchical)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
 

@@ -109,6 +109,14 @@ _CATALOG: Dict[str, str] = {
                                          "(worker)",
     "hvd_elastic_preemptions_total": "Preemption interrupts (worker)",
     "hvd_elastic_rejoins_total": "World rejoins completed (worker)",
+    # Topology-aware collective compositor (docs/topology.md).
+    "hvd_topo_plan_info": "Selected compositor lowering plan (value is "
+                          "always 1; collective/algorithm/op/where in "
+                          "labels)",
+    "hvd_topo_bytes_per_hop": "Planned per-rank bytes-on-wire per "
+                              "interconnect hop for the selected plan",
+    "hvd_mesh_fallback_total": "build_mesh degraded to a bare device "
+                               "reshape (ICI adjacency lost)",
 }
 
 _BUCKET_OVERRIDES = {

@@ -138,6 +138,13 @@ def broadcast(
     ``psum``, which paid a full ring allreduce (O(size x bytes) ICI
     traffic) to move one rank's tensor."""
     n = _axis_size(axis_name)
+    if not 0 <= int(root_rank) < n:
+        # The virtual-rank modulo below would silently wrap an
+        # out-of-range root onto the wrong rank.
+        raise ValueError(
+            f"broadcast root_rank {root_rank} out of range for axis "
+            f"{axis_name!r} of size {n}"
+        )
     if n == 1:
         return x
     # Virtual rank: root is 0; holders after round t are vr < 2^(t+1).
@@ -201,20 +208,86 @@ def hierarchical_allreduce(
     Direct TPU re-expression of ``NCCLHierarchicalAllreduce``
     (``nccl_operations.cc:151-346``): ncclReduceScatter → cross-node
     MPI_Allreduce → ncclAllGather, with the D2H/H2D hops deleted because XLA
-    moves shards over DCN directly.
+    moves shards over DCN directly. MIN/MAX lower as per-hop reduction
+    chains (regrouping commutes bitwise); PRODUCT/ADASUM raise — the
+    reduce-scatter regrouping has no product form here and Adasum's
+    hierarchical schedule lives in ``ops/adasum.py``. Lowering delegated
+    to the topology compositor (``topo/compositor.py``), which holds the
+    general k-level form.
     """
-    flat = x.reshape(-1)
-    n = flat.shape[0]
-    local_size = _axis_size(local_axis)
-    pad = (-n) % local_size
-    if pad:
-        flat = jnp.pad(flat, (0, pad))
-    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
-    shard = lax.psum(shard, cross_axis)
-    full = lax.all_gather(shard, local_axis, tiled=True)
-    if pad:
-        full = full[:n]
-    out = full.reshape(x.shape)
-    if op == ReduceOp.AVERAGE:
-        out = out / (_axis_size(local_axis) * _axis_size(cross_axis))
-    return out
+    from ..topo import compositor as _compositor
+
+    # Raises ValueError for unsupported ops (a silent SUM for MIN/MAX
+    # was the old failure mode).
+    return _compositor.lower_allreduce(
+        x, (cross_axis, local_axis), op=op, algorithm="two-level"
+    )
+
+
+def hierarchical_allgather(
+    x: jax.Array,
+    *,
+    local_axis: str = "local",
+    cross_axis: str = "cross",
+) -> jax.Array:
+    """Two-level allgather: gather over ICI, then gather the slice blocks
+    over DCN — the TPU re-expression of ``MPIHierarchicalAllgather``
+    (``mpi_operations.cc:168-321``); rank order ``cross*local_size+local``
+    keeps the concatenation identical to the flat op."""
+    from ..topo import compositor as _compositor
+
+    return _compositor.lower_allgather(
+        x, (cross_axis, local_axis), algorithm="two-level"
+    )
+
+
+def hierarchical_reducescatter(
+    x: jax.Array,
+    *,
+    op: ReduceOp = ReduceOp.SUM,
+    local_axis: str = "local",
+    cross_axis: str = "cross",
+) -> jax.Array:
+    """Two-level reduce-scatter: a local block transpose (free relayout)
+    lets the ICI hop reduce-scatter FIRST, so only the 1/local_size shard
+    crosses DCN, while the emitted shard matches the flat op's rank
+    order."""
+    from ..topo import compositor as _compositor
+
+    return _compositor.lower_reducescatter(
+        x, (cross_axis, local_axis), op=op, algorithm="two-level"
+    )
+
+
+def hierarchical_broadcast(
+    x: jax.Array,
+    *,
+    root_rank: int = 0,
+    local_axis: str = "local",
+    cross_axis: str = "cross",
+) -> jax.Array:
+    """Two-level broadcast: binomial tree inside the root's slice (ICI),
+    then per-column trees across slices (DCN) — each stage stays on one
+    hop instead of the flat tree's rounds straddling DCN."""
+    from ..topo import compositor as _compositor
+
+    return _compositor.lower_broadcast(
+        x, (cross_axis, local_axis), root_rank=root_rank,
+        algorithm="two-level",
+    )
+
+
+def hierarchical_alltoall(
+    x: jax.Array,
+    *,
+    local_axis: str = "local",
+    cross_axis: str = "cross",
+) -> jax.Array:
+    """Two-level all-to-all: one cross-slice exchange (DCN) grouped by
+    destination slice, a local block transpose, then the intra-slice
+    exchange (ICI) — flat-equal output in source-rank order."""
+    from ..topo import compositor as _compositor
+
+    return _compositor.lower_alltoall(
+        x, (cross_axis, local_axis), algorithm="two-level"
+    )

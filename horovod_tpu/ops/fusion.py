@@ -215,6 +215,12 @@ class StreamConfig:
     axis_name: Any = DATA_AXIS  # str, or a (cross, local) tuple
     threshold_bytes: int = 64 * 1024 * 1024
     hierarchical: bool = False
+    # Per-bucket plan selection via the topology compositor
+    # (docs/topology.md): each streamed bucket's payload is priced on the
+    # interconnect model of the bound axes and lowered with the selected
+    # algorithm (flat / two-level / split). Set by hierarchical="auto" /
+    # "planned" in the public entry points.
+    planned: bool = False
     compression: Any = None  # a common.compression.Compressor class or None
     label: str = "stream"
     # Non-finite guard policy applied to this group's cotangents BEFORE
@@ -256,12 +262,24 @@ def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
         compressed = [compression.compress(l) for l in leaves]
         ct = jax.tree.unflatten(treedef, [c for c, _ in compressed])
         ctxs = [c for _, c in compressed]
+    if cfg.planned:
+        from ..topo import compositor as _compositor
+
+        # Built inside the backward trace: axis sizes come from the live
+        # bindings, so each bucket is priced on the mesh it runs over.
+        reduce_fn = _compositor.planned_reduce_fn(
+            _compositor.model_for_axes(cfg.axis_name), cfg.axis_name
+        )
+    elif cfg.hierarchical:
+        reduce_fn = _hier_reduce_fn
+    else:
+        reduce_fn = None
     reduced = fused_allreduce(
         ct,
         op=cfg.op,
         axis_name=cfg.axis_name,
         threshold_bytes=cfg.threshold_bytes,
-        reduce_fn=_hier_reduce_fn if cfg.hierarchical else None,
+        reduce_fn=reduce_fn,
         label=cfg.label,
     )
     if compression is not None:
@@ -318,7 +336,7 @@ def reduce_in_backward(
     op: ReduceOp = ReduceOp.AVERAGE,
     axis_name: Any = DATA_AXIS,
     threshold_bytes: Optional[int] = None,
-    hierarchical: bool = False,
+    hierarchical: Any = False,
     compression: Any = None,
     label: str = "stream",
     nonfinite: str = "off",
@@ -343,12 +361,17 @@ def reduce_in_backward(
 
         if compression is Compression.none:
             compression = None
+    # "planned" = per-bucket compositor plan selection over the axis
+    # tuple (hierarchical="auto" at the make_train_step level resolves
+    # to this when the mesh carries a (pod, cross, local) hierarchy).
+    planned = hierarchical == "planned"
     cfg = StreamConfig(
         op=op,
         axis_name=tuple(axis_name) if isinstance(axis_name, list)
         else axis_name,
         threshold_bytes=default_threshold_bytes(threshold_bytes),
-        hierarchical=hierarchical,
+        hierarchical=bool(hierarchical) and not planned,
+        planned=planned,
         compression=compression,
         label=label,
         nonfinite=str(nonfinite),
@@ -440,7 +463,7 @@ def stream_param_groups(
     axis_name: Any = DATA_AXIS,
     threshold_bytes: Optional[int] = None,
     first_bucket_bytes: Optional[int] = None,
-    hierarchical: bool = False,
+    hierarchical: Any = False,
     compression: Any = None,
     nonfinite: str = "off",
 ) -> Any:

@@ -16,6 +16,7 @@ Conventions:
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -23,9 +24,14 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from .. import metrics as _metrics
+
+logger = logging.getLogger("horovod_tpu")
+
 DATA_AXIS = "data"
 LOCAL_AXIS = "local"
 CROSS_AXIS = "cross"
+POD_AXIS = "pod"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
@@ -94,7 +100,20 @@ def build_mesh(
         dev_array = mesh_utils.create_device_mesh(
             shape, devices=devices, allow_split_physical_axes=True
         )
-    except Exception:
+    except Exception as exc:  # noqa: BLE001 - degrade, but LOUDLY
+        # The naive reshape keeps every collective correct but loses the
+        # physical ICI adjacency create_device_mesh preserves — on a real
+        # pod that silently turns "local" hops into cross-chip traffic,
+        # so this fallback must never pass unnoticed.
+        logger.warning(
+            "mesh_utils.create_device_mesh failed for shape %s (%s: %s); "
+            "falling back to a bare device reshape — ICI adjacency is NOT "
+            "preserved and hierarchical lowerings may ride the wrong links",
+            dict(zip(names, shape)), type(exc).__name__, exc,
+        )
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_mesh_fallback_total",
+                             error=type(exc).__name__)
         dev_array = np.array(devices).reshape(shape)
     return Mesh(dev_array, names)
 
@@ -116,6 +135,42 @@ def build_hierarchical_mesh(
     return build_mesh(
         {CROSS_AXIS: ndev // local_size, LOCAL_AXIS: local_size}, devices
     )
+
+
+def build_three_level_mesh(
+    pod_size: int,
+    cross_size: int,
+    local_size: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Three-level ``(pod, cross, local)`` mesh: ``local`` rides ICI
+    within a slice, ``cross`` rides DCN between slices of one pod, and
+    ``pod`` rides the (slower) inter-pod DCN — the hierarchy the
+    compositor's three-level plans lower over (docs/topology.md). Rank
+    layout is ``rank = pod*(cross*local) + cross*local + local``, the
+    outer-major order every hierarchical lowering assumes."""
+    devices = list(devices if devices is not None else jax.devices())
+    ndev = len(devices)
+    if ndev != pod_size * cross_size * local_size:
+        raise ValueError(
+            f"{ndev} devices != pod {pod_size} x cross {cross_size} x "
+            f"local {local_size}"
+        )
+    return build_mesh(
+        {POD_AXIS: pod_size, CROSS_AXIS: cross_size, LOCAL_AXIS: local_size},
+        devices,
+    )
+
+
+def hierarchy_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """The mesh's hierarchy axis tuple, outermost first — () when the
+    mesh has no (cross, local) grid to compose over."""
+    if LOCAL_AXIS not in mesh.axis_names or CROSS_AXIS not in mesh.axis_names:
+        return ()
+    axes = [CROSS_AXIS, LOCAL_AXIS]
+    if POD_AXIS in mesh.axis_names:
+        axes.insert(0, POD_AXIS)
+    return tuple(axes)
 
 
 def data_axis_size(mesh: Mesh) -> int:

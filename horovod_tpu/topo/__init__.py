@@ -1,0 +1,43 @@
+"""Topology-aware collective compositor (docs/topology.md).
+
+``topo.model`` — the machine-readable interconnect hierarchy (hops with
+per-generation bandwidth/latency defaults, ``HOROVOD_TOPOLOGY_MODEL``
+override, homogeneity-gated eligibility).
+
+``topo.compositor`` — hierarchical lowering plans for every collective
+(allreduce / allgather / reduce-scatter / broadcast / alltoall), an
+analytic cost model selecting ring vs. recursive-halving vs. two-level
+vs. FlexLink-style split per (topology, payload bytes, op), and the
+``shard_map`` lowerings that execute the selected plan.
+
+Planning is backend-free (``tools/topo_plan.py`` dumps plans with no
+accelerator); lowering runs inside jitted traces.
+"""
+
+from .model import (  # noqa: F401
+    GENERATION_DEFAULTS,
+    Hop,
+    InterconnectModel,
+    apply_override,
+    detect_generation,
+    model_from_mesh_shape,
+    model_from_topology,
+    resolve_model,
+    synthetic_model,
+)
+from .compositor import (  # noqa: F401
+    COLLECTIVES,
+    Plan,
+    Stage,
+    auto_reduce_fn,
+    model_for_axes,
+    lower_allgather,
+    lower_allreduce,
+    lower_alltoall,
+    lower_broadcast,
+    lower_reducescatter,
+    planned_reduce_fn,
+    record_plan,
+    select_plan,
+    split_fractions,
+)

@@ -1,0 +1,336 @@
+"""Machine-readable interconnect model.
+
+The reference hardcodes its hierarchy into backend op choices
+(``NCCLHierarchicalAllreduce``: NCCL inside the node, MPI across); this
+module makes the hierarchy *data*: an ordered list of :class:`Hop` entries
+— outermost (slowest, DCN) first, innermost (fastest, ICI) last — each
+carrying the mesh axis it rides, the rank count along it, and an
+alpha-beta cost entry (per-hop latency + bandwidth). The collective
+compositor (``topo/compositor.py``) lowers every collective into primitive
+schedules over these hops and costs candidate algorithms against this
+table, following HiCCL (PAPERS.md, arXiv:2408.05962): compose collectives
+from multicast/reduce/fence primitives mapped onto an explicit
+interconnect hierarchy.
+
+Construction sources, in priority order:
+
+1. ``HOROVOD_TOPOLOGY_MODEL`` — a JSON file path or inline JSON object.
+   A full ``{"hops": [...]}`` document replaces the detected model;
+   a ``{"<hop-name>": {"bandwidth_gbps": ...}}`` partial overrides cost
+   entries on the detected hops (docs/topology.md has the schema).
+2. The detected process topology (``common/topology.py``): LOCAL maps to
+   one ICI hop, CROSS to one DCN hop. ``Topology.is_homogeneous`` is the
+   "safe to go hierarchical" gate — a ragged or interleaved layout yields
+   a flat (single-hop) model so no lowering ever puts a "local" stage on
+   DCN.
+3. Per-generation bandwidth/latency defaults (``GENERATION_DEFAULTS``) —
+   deliberately coarse public numbers; they rank hops against each other
+   (the only thing plan selection needs), they are not a benchmark.
+
+Everything here is backend-free: building a model and selecting plans
+never touches jax, so ``tools/topo_plan.py`` runs on any box.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common import env as _env
+from ..common.topology import Topology
+
+# Canonical hop names (free-form in overrides, but the defaults and the
+# mesh wiring use these).
+ICI = "ici"
+DCN = "dcn"
+POD_DCN = "dcn-pod"
+
+# Mesh axis each canonical hop rides (parallel/mesh.py axis names).
+_HOP_AXES = {ICI: "local", DCN: "cross", POD_DCN: "pod"}
+
+# Per-TPU-generation alpha-beta defaults: {hop: (bandwidth_gbps,
+# latency_us)}. Bandwidths are coarse per-chip aggregates from public
+# specs (ICI) and a per-chip share of a 200 Gbps host NIC (DCN); the
+# inter-pod hop assumes WAN-ish DCN. Override any of them via
+# HOROVOD_TOPOLOGY_MODEL — selection only needs the *ordering* and rough
+# ratios to be right.
+GENERATION_DEFAULTS: Dict[str, Dict[str, Tuple[float, float]]] = {
+    "v3": {ICI: (70.0, 1.0), DCN: (12.5, 50.0), POD_DCN: (6.25, 200.0)},
+    "v4": {ICI: (300.0, 1.0), DCN: (12.5, 50.0), POD_DCN: (6.25, 200.0)},
+    "v5e": {ICI: (200.0, 1.0), DCN: (12.5, 50.0), POD_DCN: (6.25, 200.0)},
+    "v5p": {ICI: (600.0, 1.0), DCN: (25.0, 50.0), POD_DCN: (6.25, 200.0)},
+    "v6e": {ICI: (448.0, 1.0), DCN: (25.0, 50.0), POD_DCN: (6.25, 200.0)},
+    # CPU test clusters / unknown hardware: keep the ICI >> DCN ordering
+    # so plan *shapes* match what a real pod would select.
+    "generic": {ICI: (50.0, 2.0), DCN: (5.0, 100.0), POD_DCN: (2.5, 400.0)},
+}
+
+
+@dataclass(frozen=True)
+class Hop:
+    """One interconnect level: ``size`` ranks reachable over this hop,
+    riding mesh axis ``axis``, at ``bandwidth_gbps`` gigaBYTES/s per rank
+    with ``latency_us`` per communication round."""
+
+    name: str
+    axis: str
+    size: int
+    bandwidth_gbps: float
+    latency_us: float
+
+    def __post_init__(self):
+        if self.size < 1:
+            raise ValueError(f"hop {self.name!r}: size must be >= 1")
+        if self.bandwidth_gbps <= 0 or self.latency_us < 0:
+            raise ValueError(
+                f"hop {self.name!r}: bandwidth must be > 0 and latency >= 0"
+            )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "axis": self.axis,
+            "size": self.size,
+            "bandwidth_gbps": self.bandwidth_gbps,
+            "latency_us": self.latency_us,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Hop":
+        return Hop(
+            name=str(d["name"]),
+            axis=str(d.get("axis", d["name"])),
+            size=int(d["size"]),
+            bandwidth_gbps=float(d["bandwidth_gbps"]),
+            latency_us=float(d["latency_us"]),
+        )
+
+
+@dataclass(frozen=True)
+class InterconnectModel:
+    """Ordered hop list, outermost (slowest) first. A single hop means a
+    flat topology — the compositor then only considers single-level
+    algorithms. ``eligible`` is the hierarchical-safety gate
+    (``Topology.is_homogeneous`` + a genuine >1x>1 grid)."""
+
+    hops: Tuple[Hop, ...]
+    generation: str = "generic"
+    eligible: bool = False
+    source: str = "synthetic"
+
+    def __post_init__(self):
+        if not self.hops:
+            raise ValueError("an interconnect model needs at least one hop")
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for h in self.hops:
+            n *= h.size
+        return n
+
+    @property
+    def levels(self) -> int:
+        return len(self.hops)
+
+    @property
+    def inner(self) -> Hop:
+        return self.hops[-1]
+
+    @property
+    def axes(self) -> Tuple[str, ...]:
+        """Mesh axis names, outermost first — the axis tuple the
+        compositor lowerings take."""
+        return tuple(h.axis for h in self.hops)
+
+    def hop(self, name: str) -> Hop:
+        for h in self.hops:
+            if h.name == name:
+                return h
+        raise KeyError(f"no hop named {name!r} in {self.axes}")
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "eligible": self.eligible,
+            "source": self.source,
+            "size": self.size,
+            "hops": [h.to_dict() for h in self.hops],
+        }
+
+    def to_json(self) -> str:
+        """Stable serialization (sorted keys, no timestamps) — the CI
+        smoke diffs two dumps byte-for-byte."""
+        return json.dumps(self.to_dict(), sort_keys=True, indent=1)
+
+    @staticmethod
+    def from_dict(d: dict) -> "InterconnectModel":
+        return InterconnectModel(
+            hops=tuple(Hop.from_dict(h) for h in d["hops"]),
+            generation=str(d.get("generation", "generic")),
+            eligible=bool(d.get("eligible", len(d["hops"]) > 1)),
+            source=str(d.get("source", "json")),
+        )
+
+
+def detect_generation() -> str:
+    """TPU generation from the deployment env (TPU_ACCELERATOR_TYPE, e.g.
+    "v5litepod-16"/"v4-32"), without touching a jax backend. Unknown or
+    absent hardware maps to "generic"."""
+    raw = (
+        os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        or os.environ.get("TPU_TYPE", "")
+    ).strip().lower()
+    for gen in ("v6e", "v5p", "v5e", "v5lite", "v4", "v3"):
+        if raw.startswith(gen):
+            return "v5e" if gen == "v5lite" else gen
+    return "generic"
+
+
+def _costs(generation: str) -> Dict[str, Tuple[float, float]]:
+    return GENERATION_DEFAULTS.get(generation, GENERATION_DEFAULTS["generic"])
+
+
+def _mk_hop(name: str, size: int, generation: str,
+            axis: Optional[str] = None) -> Hop:
+    bw, lat = _costs(generation).get(
+        name, _costs(generation).get(DCN, (5.0, 100.0))
+    )
+    return Hop(
+        name=name, axis=axis or _HOP_AXES.get(name, name), size=size,
+        bandwidth_gbps=bw, latency_us=lat,
+    )
+
+
+def synthetic_model(
+    local: int,
+    cross: int = 1,
+    pod: int = 1,
+    generation: str = "generic",
+    eligible: Optional[bool] = None,
+) -> InterconnectModel:
+    """Hand-built model for tools and tests: (pod, cross, local) sizes
+    with per-generation default costs. Degenerate (=1) outer levels are
+    dropped, so ``synthetic_model(8)`` is a flat single-slice pod."""
+    hops: List[Hop] = []
+    if pod > 1:
+        hops.append(_mk_hop(POD_DCN, pod, generation))
+    if cross > 1:
+        hops.append(_mk_hop(DCN, cross, generation))
+    hops.append(_mk_hop(ICI, max(int(local), 1), generation))
+    if eligible is None:
+        eligible = len(hops) > 1
+    return InterconnectModel(
+        hops=tuple(hops), generation=generation, eligible=eligible,
+        source="synthetic",
+    )
+
+
+def model_from_topology(
+    topology: Topology, generation: Optional[str] = None
+) -> InterconnectModel:
+    """The detected-deployment model: LOCAL -> one ICI hop, CROSS -> one
+    DCN hop. Non-homogeneous layouts (ragged or interleaved slices — see
+    ``topology_from_slice_metadata``) and degenerate grids collapse to a
+    flat ineligible model: the executor's (cross, local) mesh assumes the
+    block rank layout, so "hierarchical" over a violated layout would
+    silently put local stages on DCN."""
+    generation = generation or detect_generation()
+    grid = (
+        topology.is_homogeneous
+        and topology.local_size > 1
+        and topology.cross_size > 1
+        and topology.local_size * topology.cross_size == topology.size
+    )
+    if grid:
+        return InterconnectModel(
+            hops=(
+                _mk_hop(DCN, topology.cross_size, generation),
+                _mk_hop(ICI, topology.local_size, generation),
+            ),
+            generation=generation, eligible=True, source="topology",
+        )
+    return InterconnectModel(
+        hops=(_mk_hop(ICI, max(topology.size, 1), generation),),
+        generation=generation, eligible=False, source="topology",
+    )
+
+
+def model_from_mesh_shape(
+    axis_sizes: Dict[str, int], generation: Optional[str] = None
+) -> InterconnectModel:
+    """Model for an explicitly-built hierarchical mesh ({axis: size} from
+    ``Mesh.shape``): the caller constructed (pod, cross, local) axes on
+    purpose, so eligibility follows from the axes existing — the
+    homogeneity gate applies to *detected* process topologies, not to a
+    deliberate mesh."""
+    generation = generation or detect_generation()
+    hops: List[Hop] = []
+    pod = int(axis_sizes.get("pod", 1))
+    cross = int(axis_sizes.get("cross", 1))
+    local = int(axis_sizes.get("local", 1))
+    if pod > 1:
+        hops.append(_mk_hop(POD_DCN, pod, generation))
+    if cross > 1:
+        hops.append(_mk_hop(DCN, cross, generation))
+    hops.append(_mk_hop(ICI, local, generation))
+    return InterconnectModel(
+        hops=tuple(hops), generation=generation,
+        eligible=len(hops) > 1 and local > 1, source="mesh",
+    )
+
+
+def _load_override() -> Optional[dict]:
+    raw = os.environ.get(_env.HOROVOD_TOPOLOGY_MODEL, "").strip()
+    if not raw:
+        return None
+    if raw.startswith("{"):
+        return json.loads(raw)
+    with open(raw) as f:
+        return json.load(f)
+
+
+def apply_override(model: InterconnectModel) -> InterconnectModel:
+    """Apply the HOROVOD_TOPOLOGY_MODEL knob: a document with a "hops"
+    list replaces the model wholesale; otherwise each top-level key names
+    a hop and its dict patches that hop's cost fields (unknown hop names
+    raise — a typo'd override silently doing nothing is worse)."""
+    doc = _load_override()
+    if doc is None:
+        return model
+    if "hops" in doc:
+        return InterconnectModel.from_dict(doc)
+    names = {h.name for h in model.hops}
+    patched = []
+    unknown = [k for k in doc if k not in names]
+    if unknown:
+        raise ValueError(
+            f"{_env.HOROVOD_TOPOLOGY_MODEL} overrides unknown hop(s) "
+            f"{unknown}; this model has {sorted(names)}"
+        )
+    for h in model.hops:
+        patch = doc.get(h.name, {})
+        patched.append(Hop(
+            name=h.name,
+            axis=str(patch.get("axis", h.axis)),
+            size=int(patch.get("size", h.size)),
+            bandwidth_gbps=float(patch.get("bandwidth_gbps",
+                                           h.bandwidth_gbps)),
+            latency_us=float(patch.get("latency_us", h.latency_us)),
+        ))
+    return InterconnectModel(
+        hops=tuple(patched), generation=model.generation,
+        eligible=model.eligible, source=model.source + "+override",
+    )
+
+
+def resolve_model(topology: Optional[Topology] = None) -> InterconnectModel:
+    """The model the runtime uses: detected topology (or the given one)
+    with the env override applied."""
+    if topology is None:
+        from ..common import topology as _topo_mod
+
+        topology = _topo_mod.detect()
+    return apply_override(model_from_topology(topology))

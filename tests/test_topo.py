@@ -1,0 +1,671 @@
+"""Topology-aware collective compositor (docs/topology.md).
+
+Three layers under test:
+
+1. the interconnect MODEL — per-generation defaults, the
+   ``HOROVOD_TOPOLOGY_MODEL`` override, the homogeneity eligibility gate,
+   stable JSON;
+2. PLAN SELECTION — the analytic cost model picking ring vs.
+   recursive-halving vs. two-level vs. FlexLink split per (topology,
+   payload bytes, op), deterministically;
+3. the LOWERINGS — every compositor lowering (allreduce / allgather /
+   reduce-scatter / broadcast / alltoall) numerically equal to the flat
+   lowering at 2, 4, and 8 simulated ranks, including a three-level
+   (pod, cross, local) case and the ICI+DCN concurrent-split allreduce;
+   bitwise where the regrouping commutes (MIN/MAX, integer SUM, pure
+   data movement), tolerance-checked for float SUM.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvdj
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.jax import _shard_map
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.parallel.mesh import (
+    build_hierarchical_mesh,
+    build_mesh,
+    build_three_level_mesh,
+    hierarchy_axes,
+)
+from horovod_tpu.topo import (
+    GENERATION_DEFAULTS,
+    InterconnectModel,
+    apply_override,
+    model_from_topology,
+    select_plan,
+    synthetic_model,
+)
+from horovod_tpu.topo import compositor as K
+
+
+# --- helpers -----------------------------------------------------------------
+
+
+def _grid_mesh(cross, local, pod=None):
+    n = cross * local * (pod or 1)
+    if pod:
+        return build_three_level_mesh(pod, cross, local,
+                                      jax.devices()[:n]), n
+    return build_hierarchical_mesh(local, jax.devices()[:n]), n
+
+
+def _run(mesh, fn, x, axes):
+    spec = P(tuple(axes))
+    return jax.jit(
+        _shard_map(fn, mesh, in_specs=(spec,), out_specs=spec)
+    )(x)
+
+
+GRIDS = [
+    pytest.param((2, 1, None), id="2ranks-2x1"),
+    pytest.param((2, 2, None), id="4ranks-2x2"),
+    pytest.param((2, 4, None), id="8ranks-2x4"),
+    pytest.param((2, 2, 2), id="8ranks-2x2x2-threelevel"),
+]
+
+
+def _axes(pod):
+    return (("pod",) if pod else ()) + ("cross", "local")
+
+
+# --- model -------------------------------------------------------------------
+
+
+def test_synthetic_model_shapes():
+    m = synthetic_model(local=4, cross=2, generation="v5e")
+    assert [h.name for h in m.hops] == ["dcn", "ici"]
+    assert m.size == 8 and m.levels == 2 and m.eligible
+    assert m.axes == ("cross", "local")
+    m3 = synthetic_model(local=2, cross=2, pod=2)
+    assert [h.name for h in m3.hops] == ["dcn-pod", "dcn", "ici"]
+    assert m3.size == 8
+    flat = synthetic_model(local=8)
+    assert flat.levels == 1 and not flat.eligible
+
+
+def test_generation_defaults_order():
+    """The defaults only have to rank hops correctly: ICI strictly faster
+    than DCN, DCN strictly faster than inter-pod DCN, per generation."""
+    for gen, hops in GENERATION_DEFAULTS.items():
+        assert hops["ici"][0] > hops["dcn"][0] >= hops["dcn-pod"][0], gen
+
+
+def test_model_json_stable_and_roundtrips():
+    m = synthetic_model(local=4, cross=2, generation="v4")
+    assert m.to_json() == m.to_json()
+    back = InterconnectModel.from_dict(json.loads(m.to_json()))
+    assert back.hops == m.hops
+
+
+def test_model_override_inline_json(monkeypatch):
+    m = synthetic_model(local=4, cross=2, generation="v5e")
+    monkeypatch.setenv(
+        "HOROVOD_TOPOLOGY_MODEL",
+        '{"dcn": {"bandwidth_gbps": 99.0, "latency_us": 7.0}}',
+    )
+    out = apply_override(m)
+    assert out.hop("dcn").bandwidth_gbps == 99.0
+    assert out.hop("dcn").latency_us == 7.0
+    assert out.hop("ici") == m.hop("ici")
+    assert out.source.endswith("+override")
+
+
+def test_model_override_full_document(tmp_path, monkeypatch):
+    doc = {
+        "generation": "custom",
+        "hops": [
+            {"name": "dcn", "axis": "cross", "size": 2,
+             "bandwidth_gbps": 10.0, "latency_us": 80.0},
+            {"name": "ici", "axis": "local", "size": 4,
+             "bandwidth_gbps": 400.0, "latency_us": 0.5},
+        ],
+    }
+    path = tmp_path / "model.json"
+    path.write_text(json.dumps(doc))
+    monkeypatch.setenv("HOROVOD_TOPOLOGY_MODEL", str(path))
+    out = apply_override(synthetic_model(local=8))
+    assert out.generation == "custom"
+    assert out.hop("ici").bandwidth_gbps == 400.0
+    assert out.eligible  # >1 hop in the replacement document
+
+
+def test_model_override_unknown_hop_raises(monkeypatch):
+    monkeypatch.setenv(
+        "HOROVOD_TOPOLOGY_MODEL", '{"icl": {"bandwidth_gbps": 1.0}}'
+    )
+    with pytest.raises(ValueError, match="icl"):
+        apply_override(synthetic_model(local=4, cross=2))
+
+
+def test_model_from_topology_homogeneity_gate():
+    from horovod_tpu.common.topology import Topology
+
+    good = Topology(rank=0, size=8, local_rank=0, local_size=4,
+                    cross_rank=0, cross_size=2, is_homogeneous=True)
+    m = model_from_topology(good)
+    assert m.eligible and m.levels == 2
+    ragged = Topology(rank=0, size=8, local_rank=0, local_size=4,
+                      cross_rank=0, cross_size=2, is_homogeneous=False)
+    m = model_from_topology(ragged)
+    assert not m.eligible and m.levels == 1
+    single = Topology(rank=0, size=8, local_rank=0, local_size=8,
+                      cross_rank=0, cross_size=1, is_homogeneous=True)
+    assert not model_from_topology(single).eligible
+
+
+def test_detect_generation_env(monkeypatch):
+    from horovod_tpu.topo import detect_generation
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    assert detect_generation() == "v5e"
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+    assert detect_generation() == "v4"
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("TPU_TYPE", raising=False)
+    assert detect_generation() == "generic"
+
+
+# --- plan selection ----------------------------------------------------------
+
+
+def test_plan_selection_by_payload():
+    m = synthetic_model(local=4, cross=2, generation="v5e")
+    small = select_plan(m, "allreduce", 1024)
+    large = select_plan(m, "allreduce", 256 << 20)
+    assert small.algorithm == "two-level"
+    assert large.algorithm == "split"
+    assert large.split_bytes[0] + large.split_bytes[1] == 256 << 20
+    # Bandwidth-proportional split: the ICI-share bucket dominates.
+    assert large.split_bytes[0] > large.split_bytes[1]
+
+
+def test_plan_single_level_ring_vs_halving():
+    m = synthetic_model(local=8, generation="v5e")
+    assert select_plan(m, "allreduce", 64).algorithm == "recursive-halving"
+    # A non-power-of-two hop cannot run halving-doubling.
+    m6 = synthetic_model(local=6, generation="v5e")
+    assert select_plan(m6, "allreduce", 64).algorithm == "ring"
+
+
+def test_plan_hierarchical_dcn_bytes_below_flat():
+    m = synthetic_model(local=4, cross=2, generation="v5e")
+    for coll in ("allreduce", "allgather", "reducescatter", "alltoall",
+                 "broadcast"):
+        plan = select_plan(m, coll, 16 << 20)
+        assert plan.algorithm != "flat", coll
+        flat_cands = {
+            "allreduce": K._candidates_allreduce(m, 16 << 20, ReduceOp.SUM),
+            "allgather": K._candidates_allgather(m, 16 << 20),
+            "reducescatter": K._candidates_reducescatter(m, 16 << 20),
+            "alltoall": K._candidates_alltoall(m, 16 << 20),
+            "broadcast": K._candidates_broadcast(m, 16 << 20),
+        }[coll]["flat"]
+        flat_dcn = sum(s.bytes_on_wire for s in flat_cands
+                       if "dcn" in s.hop)
+        hier_dcn = sum(v for k, v in plan.bytes_per_hop.items()
+                       if "dcn" in k)
+        assert hier_dcn < flat_dcn, coll
+
+
+def test_plan_min_two_level_product_flat():
+    m = synthetic_model(local=4, cross=2)
+    assert select_plan(m, "allreduce", 1 << 20,
+                       op=ReduceOp.MIN).algorithm == "two-level"
+    assert select_plan(m, "allreduce", 1 << 20,
+                       op=ReduceOp.PRODUCT).algorithm == "flat"
+
+
+def test_plan_ineligible_model_stays_flat():
+    """The homogeneity gate collapses the hierarchy: no two-level/split
+    plan may come back — only single-level algorithms over the flattened
+    hop (whose ring/halving labels the production paths lower via the
+    native collective)."""
+    m = synthetic_model(local=4, cross=2, eligible=False)
+    plan = select_plan(m, "allreduce", 64 << 20)
+    assert plan.algorithm in ("flat", "ring", "recursive-halving")
+    assert plan.hop_sizes == (8,)
+    assert all(s.hop != "ici" or "dcn" not in s.hop for s in plan.stages)
+
+
+def test_plan_unknown_collective_raises():
+    with pytest.raises(ValueError, match="unknown collective"):
+        select_plan(synthetic_model(local=4), "scan", 1024)
+
+
+def test_collective_plan_api():
+    import horovod_tpu as hvd
+
+    out = hvd.collective_plan("allreduce", 1 << 20)
+    assert out["collective"] == "allreduce"
+    assert "model" in out and "stages" in out
+    # jax-binding alias returns the same verdict.
+    assert hvdj.collective_plan("allreduce", 1 << 20)["algorithm"] == (
+        out["algorithm"]
+    )
+
+
+# --- lowering equality vs flat at 2/4/8 ranks --------------------------------
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_allreduce_two_level_matches_flat(grid):
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(n, 13, 3), jnp.float32)
+    flat = _run(mesh, lambda t: jax.lax.psum(t[0], axes)[None], x, axes)
+    out = _run(mesh, lambda t: K.lower_allreduce(
+        t[0], axes, op=ReduceOp.SUM, algorithm="two-level")[None], x, axes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(flat),
+                               rtol=2e-5)
+    # AVERAGE folds the divisor in.
+    outa = _run(mesh, lambda t: K.lower_allreduce(
+        t[0], axes, op=ReduceOp.AVERAGE, algorithm="two-level")[None],
+        x, axes)
+    np.testing.assert_allclose(np.asarray(outa), np.asarray(flat) / n,
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_allreduce_int_sum_bitwise(grid):
+    """Integer SUM regroupings commute exactly: the hierarchical result
+    must be bit-identical to the flat psum."""
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    x = jnp.asarray(
+        np.random.RandomState(2).randint(-1000, 1000, (n, 17)), jnp.int32
+    )
+    flat = _run(mesh, lambda t: jax.lax.psum(t[0], axes)[None], x, axes)
+    out = _run(mesh, lambda t: K.lower_allreduce(
+        t[0], axes, op=ReduceOp.SUM, algorithm="two-level")[None], x, axes)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_allreduce_min_max_bitwise(grid):
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    x = jnp.asarray(np.random.RandomState(3).randn(n, 9), jnp.float32)
+    for op, ref in ((ReduceOp.MIN, jax.lax.pmin),
+                    (ReduceOp.MAX, jax.lax.pmax)):
+        flat = _run(mesh, lambda t, ref=ref: ref(t[0], axes)[None], x, axes)
+        out = _run(mesh, lambda t, op=op: K.lower_allreduce(
+            t[0], axes, op=op, algorithm="two-level")[None], x, axes)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+
+
+@pytest.mark.parametrize("nranks", [2, 4, 8])
+def test_allreduce_split_matches_flat(nranks):
+    """The FlexLink ICI+DCN concurrent-split mode: two pipelined
+    hierarchical buckets concatenate to the flat reduction."""
+    mesh, n = _grid_mesh(2, nranks // 2)
+    axes = ("cross", "local")
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(n, 31), jnp.float32)
+    flat = _run(mesh, lambda t: jax.lax.psum(t[0], axes)[None], x, axes)
+    frac = K.split_fractions(
+        synthetic_model(local=nranks // 2, cross=2, generation="v5e")
+    )[0]
+    out = _run(mesh, lambda t: K.lower_allreduce(
+        t[0], axes, op=ReduceOp.SUM, algorithm="split",
+        split_fraction=frac)[None], x, axes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(flat),
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("nranks", [2, 4, 8])
+@pytest.mark.parametrize("algorithm", ["ring", "recursive-halving"])
+def test_allreduce_explicit_schedules_match_flat(nranks, algorithm):
+    """The explicit single-hop ppermute schedules (ring reduce-scatter +
+    allgather; MPICH halving-doubling)."""
+    mesh = build_mesh({"data": nranks}, jax.devices()[:nranks])
+    axes = ("data",)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(nranks, 11), jnp.float32)
+    flat = _run(mesh, lambda t: jax.lax.psum(t[0], "data")[None], x, axes)
+    out = _run(mesh, lambda t: K.lower_allreduce(
+        t[0], axes, op=ReduceOp.SUM, algorithm=algorithm)[None], x, axes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(flat),
+                               rtol=2e-5)
+    # MIN rides the same schedules bitwise.
+    fmin = _run(mesh, lambda t: jax.lax.pmin(t[0], "data")[None], x, axes)
+    omin = _run(mesh, lambda t: K.lower_allreduce(
+        t[0], axes, op=ReduceOp.MIN, algorithm=algorithm)[None], x, axes)
+    np.testing.assert_array_equal(np.asarray(omin), np.asarray(fmin))
+
+
+def test_recursive_halving_rejects_non_power_of_two():
+    """The halving-doubling schedule needs power-of-two hops: the
+    lowering guards it at trace time and the planner never offers it."""
+    mesh = build_mesh({"data": 6}, jax.devices()[:6])
+    x = jnp.zeros((6, 4), jnp.float32)
+    with pytest.raises(ValueError, match="power-of-two"):
+        jax.jit(_shard_map(
+            lambda t: K.lower_allreduce(
+                t[0], ("data",), op=ReduceOp.SUM,
+                algorithm="recursive-halving")[None],
+            mesh, in_specs=(P("data"),), out_specs=P("data"),
+        ))(x)
+    assert select_plan(
+        synthetic_model(local=6), "allreduce", 64
+    ).algorithm == "ring"
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_allgather_matches_flat_bitwise(grid):
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    x = jnp.asarray(np.random.RandomState(6).randn(n * 2, 5), jnp.float32)
+    ref = _run(mesh, lambda t: K.lower_allgather(t, axes, algorithm="flat"),
+               x, axes)
+    out = _run(mesh, lambda t: K.lower_allgather(
+        t, axes, algorithm="two-level"), x, axes)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_reducescatter_matches_flat(grid):
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(n * n * 3, 2), jnp.float32)
+    ref = _run(mesh, lambda t: K.lower_reducescatter(
+        t, axes, op=ReduceOp.SUM, algorithm="flat"), x, axes)
+    out = _run(mesh, lambda t: K.lower_reducescatter(
+        t, axes, op=ReduceOp.SUM, algorithm="two-level"), x, axes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5)
+    # int32: regrouped integer sums are exact.
+    xi = jnp.asarray(rng.randint(-50, 50, (n * n, 3)), jnp.int32)
+    refi = _run(mesh, lambda t: K.lower_reducescatter(
+        t, axes, op=ReduceOp.SUM, algorithm="flat"), xi, axes)
+    outi = _run(mesh, lambda t: K.lower_reducescatter(
+        t, axes, op=ReduceOp.SUM, algorithm="two-level"), xi, axes)
+    np.testing.assert_array_equal(np.asarray(outi), np.asarray(refi))
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_broadcast_matches_flat_all_roots(grid):
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    xb = jnp.tile(jnp.arange(n, dtype=jnp.float32).reshape(n, 1), (1, 7))
+    for root in {0, n - 1, n // 2}:
+        expected = np.full((n, 7), root, np.float32)
+        for alg in ("two-level", "two-level-sa"):
+            out = _run(mesh, lambda t, r=root, a=alg: K.lower_broadcast(
+                t[0], axes, root_rank=r, algorithm=a)[None], xb, axes)
+            np.testing.assert_array_equal(
+                np.asarray(out).reshape(n, 7), expected
+            ), (root, alg)
+
+
+@pytest.mark.parametrize("grid", GRIDS)
+def test_alltoall_matches_flat_bitwise(grid):
+    cross, local, pod = grid
+    mesh, n = _grid_mesh(cross, local, pod)
+    axes = _axes(pod)
+    x = jnp.arange(n * n * 2 * 3, dtype=jnp.float32).reshape(n * n * 2, 3)
+    ref = _run(mesh, lambda t: K.lower_alltoall(t, axes, algorithm="flat"),
+               x, axes)
+    out = _run(mesh, lambda t: K.lower_alltoall(
+        t, axes, algorithm="two-level"), x, axes)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# --- satellite regressions ---------------------------------------------------
+
+
+def test_hierarchical_allreduce_rejects_unsupported_ops():
+    """Regression: op=PRODUCT used to silently return a SUM."""
+    mesh = build_hierarchical_mesh(local_size=4)
+    x = jnp.ones((8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="PRODUCT"):
+        jax.jit(_shard_map(
+            lambda t: C.hierarchical_allreduce(
+                t[0], op=ReduceOp.PRODUCT)[None],
+            mesh, in_specs=(P(("cross", "local")),),
+            out_specs=P(("cross", "local")),
+        ))(x)
+
+
+def test_hierarchical_allreduce_min_max_real():
+    """MIN/MAX used to silently SUM; now they lower per-hop, bitwise."""
+    mesh, n = _grid_mesh(2, 4)
+    axes = ("cross", "local")
+    x = jnp.asarray(np.random.RandomState(8).randn(n, 6), jnp.float32)
+    for op, ref in ((ReduceOp.MIN, jax.lax.pmin),
+                    (ReduceOp.MAX, jax.lax.pmax)):
+        flat = _run(mesh, lambda t, ref=ref: ref(t[0], axes)[None], x, axes)
+        out = _run(mesh, lambda t, op=op: C.hierarchical_allreduce(
+            t[0], op=op)[None], x, axes)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(flat))
+
+
+def test_broadcast_out_of_range_root_raises():
+    """Regression: the virtual-rank modulo silently wrapped
+    root_rank >= axis_size onto the wrong root."""
+    mesh = build_mesh({"data": 8})
+    x = jnp.zeros((8, 4), jnp.float32)
+    for bad in (8, -1, 100):
+        with pytest.raises(ValueError, match="size 8"):
+            jax.jit(_shard_map(
+                lambda t, b=bad: C.broadcast(
+                    t[0], root_rank=b, axis_name="data")[None],
+                mesh, in_specs=(P("data"),), out_specs=P("data"),
+            ))(x)
+
+
+def test_hierarchical_collective_variants_exposed():
+    """Every collective now has a compositor-backed hierarchical variant
+    reachable from the jax binding."""
+    mesh, n = _grid_mesh(2, 4)
+    axes = ("cross", "local")
+    x = jnp.asarray(np.random.RandomState(9).randn(n * 2, 3), jnp.float32)
+    ref = _run(mesh, lambda t: K.lower_allgather(t, axes, algorithm="flat"),
+               x, axes)
+    out = _run(mesh, lambda t: hvdj.hierarchical_allgather(t), x, axes)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    xr = jnp.asarray(np.random.RandomState(10).randn(n * n, 2), jnp.float32)
+    refr = _run(mesh, lambda t: K.lower_reducescatter(
+        t, axes, op=ReduceOp.SUM, algorithm="flat"), xr, axes)
+    outr = _run(mesh, lambda t: hvdj.hierarchical_reducescatter(t), xr, axes)
+    np.testing.assert_allclose(np.asarray(outr), np.asarray(refr),
+                               rtol=2e-5)
+    xa = jnp.arange(n * n, dtype=jnp.float32).reshape(n * n, 1)
+    refa = _run(mesh, lambda t: K.lower_alltoall(t, axes, algorithm="flat"),
+                xa, axes)
+    outa = _run(mesh, lambda t: hvdj.hierarchical_alltoall(t), xa, axes)
+    np.testing.assert_array_equal(np.asarray(outa), np.asarray(refa))
+    xb = jnp.tile(jnp.arange(n, dtype=jnp.float32).reshape(n, 1), (1, 4))
+    outb = _run(mesh, lambda t: hvdj.hierarchical_broadcast(
+        t[0], root_rank=5)[None], xb, axes)
+    np.testing.assert_array_equal(
+        np.asarray(outb).reshape(n, 4), np.full((n, 4), 5, np.float32)
+    )
+
+
+def test_mesh_fallback_warns_and_counts(monkeypatch, caplog):
+    """Satellite: the bare-reshape fallback must be loud — warning naming
+    the exception plus an hvd_mesh_fallback_total increment."""
+    import logging
+
+    from horovod_tpu import metrics
+    from jax.experimental import mesh_utils
+
+    def boom(*a, **k):
+        raise RuntimeError("no contiguous submesh")
+
+    monkeypatch.setattr(mesh_utils, "create_device_mesh", boom)
+    metrics.install(True)
+    try:
+        with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+            mesh = build_mesh({"data": 8})
+        assert mesh.shape["data"] == 8  # still works
+        msgs = [r.getMessage() for r in caplog.records]
+        assert any("create_device_mesh failed" in m
+                   and "RuntimeError" in m
+                   and "no contiguous submesh" in m
+                   and "ICI adjacency" in m for m in msgs), msgs
+        snap = metrics.snapshot()
+        series = snap["hvd_mesh_fallback_total"]["series"]
+        assert any(s["value"] >= 1 for s in series), series
+        assert any(
+            s["labels"].get("error") == "RuntimeError" for s in series
+        ), series
+    finally:
+        metrics.reset()
+
+
+# --- streamed / compiled wiring ----------------------------------------------
+
+
+def _mlp_loss(params, batch):
+    xb, yb = batch
+    h = jnp.tanh(xb @ params["l0"]["w"])
+    h = h @ params["l1"]["w"]
+    return jnp.mean((h - yb) ** 2)
+
+
+def _mlp_fixtures(n):
+    import optax
+
+    rng = np.random.RandomState(0)
+    params = {
+        "l0": {"w": jnp.asarray(rng.randn(16, 16), jnp.float32)},
+        "l1": {"w": jnp.asarray(rng.randn(16, 16), jnp.float32)},
+    }
+    tx = optax.sgd(0.01)
+    batch = (jnp.asarray(rng.randn(n, 16), jnp.float32),
+             jnp.asarray(rng.randn(n, 16), jnp.float32))
+    return params, tx, tx.init(params), batch
+
+
+def test_auto_hierarchical_overlap_step_matches_flat():
+    """make_train_step(overlap=True, hierarchical="auto") on a
+    multi-slice mesh goes hierarchical per bucket and stays numerically
+    equal to the flat step."""
+    params, tx, opt, batch = _mlp_fixtures(8)
+    flat_step = hvdj.make_train_step(_mlp_loss, tx, build_mesh(),
+                                     donate=False)
+    p1, _, l1 = flat_step(params, opt, batch)
+    mesh2 = build_hierarchical_mesh(local_size=4)
+    auto_step = hvdj.make_train_step(
+        _mlp_loss, tx, mesh2, donate=False, overlap=True,
+        hierarchical="auto",
+    )
+    p2, _, l2 = auto_step(params, opt, batch)
+    assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+    for k in ("l0", "l1"):
+        np.testing.assert_allclose(
+            np.asarray(p1[k]["w"]), np.asarray(p2[k]["w"]), rtol=2e-5
+        )
+
+
+def test_auto_hierarchical_three_level_step_matches_flat():
+    params, tx, opt, batch = _mlp_fixtures(8)
+    flat_step = hvdj.make_train_step(_mlp_loss, tx, build_mesh(),
+                                     donate=False)
+    p1, _, _ = flat_step(params, opt, batch)
+    mesh3 = build_three_level_mesh(2, 2, 2)
+    assert hierarchy_axes(mesh3) == ("pod", "cross", "local")
+    step3 = hvdj.make_train_step(_mlp_loss, tx, mesh3, donate=False,
+                                 hierarchical="auto")
+    p3, _, _ = step3(params, opt, batch)
+    np.testing.assert_allclose(
+        np.asarray(p1["l0"]["w"]), np.asarray(p3["l0"]["w"]), rtol=2e-5
+    )
+
+
+def test_auto_on_flat_mesh_stays_flat():
+    """hierarchical="auto" over a plain data mesh must not change the
+    program: the lowering stays a single all-reduce (no reduce-scatter
+    stage)."""
+    params, tx, opt, batch = _mlp_fixtures(8)
+    step = hvdj.make_train_step(_mlp_loss, tx, build_mesh(), donate=False,
+                                hierarchical="auto")
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, opt, batch),
+    )
+    text = step.lower(*avals).as_text()
+    assert "reduce-scatter" not in text and "reduce_scatter" not in text
+
+
+def test_auto_hierarchical_lowering_contains_reduce_scatter():
+    """The "auto" path on a hierarchical mesh must actually change the
+    program (not just relabel it)."""
+    params, tx, opt, batch = _mlp_fixtures(8)
+    mesh2 = build_hierarchical_mesh(local_size=4)
+    step = hvdj.make_train_step(_mlp_loss, tx, mesh2, donate=False,
+                                hierarchical="auto")
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, opt, batch),
+    )
+    text = step.lower(*avals).as_text()
+    assert "reduce_scatter" in text or "reduce-scatter" in text
+
+
+def test_streamed_planned_records_plan_metrics():
+    from horovod_tpu import metrics
+
+    params, tx, opt, batch = _mlp_fixtures(8)
+    mesh2 = build_hierarchical_mesh(local_size=4)
+    metrics.install(True)
+    try:
+        step = hvdj.make_train_step(
+            _mlp_loss, tx, mesh2, donate=False, overlap=True,
+            hierarchical="auto",
+        )
+        step(params, opt, batch)
+        snap = metrics.snapshot()
+        assert "hvd_topo_plan_info" in snap, sorted(snap)
+        info = snap["hvd_topo_plan_info"]["series"]
+        assert any(
+            s["labels"].get("collective") == "allreduce"
+            and s["labels"].get("where") == "stream"
+            for s in info
+        ), info
+        hops = snap["hvd_topo_bytes_per_hop"]["series"]
+        assert {s["labels"].get("hop") for s in hops} >= {"ici", "dcn"}, hops
+    finally:
+        metrics.reset()
+
+
+def test_distributed_optimizer_auto_without_mesh_is_safe():
+    """DistributedOptimizer(hierarchical="auto") with a single-process
+    (ineligible) detected topology must resolve to the flat path and
+    work over a plain data mesh."""
+    import optax
+
+    params, tx, opt, batch = _mlp_fixtures(8)
+    dtx = hvdj.DistributedOptimizer(tx, hierarchical="auto")
+    mesh = build_mesh()
+
+    def step(p, o, b):
+        loss, grads = jax.value_and_grad(_mlp_loss)(p, b)
+        updates, o2 = dtx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o2, loss
+
+    fn = jax.jit(_shard_map(
+        step, mesh, in_specs=(P(), P(), P("data")), out_specs=P(),
+    ))
+    p2, _, _ = fn(params, dtx.init(params), batch)
+    flat_step = hvdj.make_train_step(_mlp_loss, tx, mesh, donate=False)
+    p1, _, _ = flat_step(params, tx.init(params), batch)
+    np.testing.assert_allclose(
+        np.asarray(p1["l0"]["w"]), np.asarray(p2["l0"]["w"]), rtol=1e-6
+    )

@@ -65,6 +65,64 @@ def test_interleaved_process_indices_disable_grid():
     assert t.local_size == 2 and t.cross_size == 2
 
 
+def test_single_slice_pod_eight_procs_hierarchy_ineligible():
+    """A single-slice pod (everything on ICI) is homogeneous but has no
+    cross axis — the compositor's eligibility gate must come back False
+    so no lowering invents a DCN hop."""
+    from horovod_tpu.topo import model_from_topology
+
+    pairs = [(p, 0) for p in range(8)]
+    t = topology_from_slice_metadata(5, pairs)
+    assert t.is_homogeneous
+    assert (t.local_rank, t.local_size) == (5, 8)
+    assert (t.cross_rank, t.cross_size) == (0, 1)
+    m = model_from_topology(t)
+    assert not m.eligible and m.levels == 1
+
+
+def test_unequal_slice_sizes_gate_all_members():
+    """Ragged slices (3+1): EVERY process must see non-homogeneous, not
+    just those in the minority slice — one rank building the (cross,
+    local) grid while its peers stay flat would deadlock the collective."""
+    pairs = [(0, 0), (1, 0), (2, 0), (3, 1)]
+    for rank in range(4):
+        t = topology_from_slice_metadata(rank, pairs)
+        assert not t.is_homogeneous, rank
+    # Members of the big slice still get correct local coordinates.
+    t = topology_from_slice_metadata(1, pairs)
+    assert (t.local_rank, t.local_size) == (1, 3)
+    assert (t.cross_rank, t.cross_size) == (0, 2)
+
+
+def test_interleaved_layout_blocks_compositor_eligibility():
+    """Non-contiguous process-to-slice layouts (JAX assigns process
+    indices by coordinator registration order) violate the block rank
+    layout; the compositor model built from them must be flat."""
+    from horovod_tpu.topo import model_from_topology
+
+    pairs = [(0, 0), (1, 1), (2, 0), (3, 1)]
+    for rank in range(4):
+        t = topology_from_slice_metadata(rank, pairs)
+        assert not t.is_homogeneous, rank
+        m = model_from_topology(t)
+        assert not m.eligible and m.levels == 1, rank
+
+
+def test_contiguous_but_reversed_slice_ids_stay_homogeneous():
+    """Slice ids need not start at 0 or be dense — only the block layout
+    matters: slice k in slice-id ORDER owning the contiguous range
+    [k*local, (k+1)*local) keeps the grid valid."""
+    pairs = [(0, 7), (1, 7), (2, 9), (3, 9)]
+    t = topology_from_slice_metadata(2, pairs)
+    assert t.is_homogeneous
+    assert (t.cross_rank, t.cross_size) == (1, 2)
+    assert (t.local_rank, t.local_size) == (0, 2)
+    # ...but the same ids with swapped process blocks violate it.
+    swapped = [(0, 9), (1, 9), (2, 7), (3, 7)]
+    t2 = topology_from_slice_metadata(2, swapped)
+    assert not t2.is_homogeneous
+
+
 def test_megascale_env_detection(monkeypatch):
     """Multi-slice deployments (megascale env) map CROSS onto the DCN
     slice axis and LOCAL onto ICI workers with the block rank layout the

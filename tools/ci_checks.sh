@@ -34,6 +34,12 @@
 # byte-identical normalized event logs and the final params asserted
 # bitwise against the uninterrupted run (docs/fault_tolerance.md
 # "Control-plane availability"). Budget: under 90s.
+#
+# Stage 7 (make topo-smoke; skip with HVD_CI_SKIP_TOPO=1): the topology
+# compositor smoke — plan dumps for 1/2/4-slice (and one three-level)
+# synthetic topologies byte-identical across two runs, hierarchical DCN
+# bytes strictly below flat, homogeneity gate enforced
+# (docs/topology.md). Pure cost model, no backend. Budget: under 10s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -77,4 +83,11 @@ if [ "${HVD_CI_SKIP_DRIVER:-0}" != "1" ]; then
     python tools/driver_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: driver smoke killed+resumed+reattached in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_TOPO:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/topo_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: topo smoke plans stable in ${elapsed}s"
 fi

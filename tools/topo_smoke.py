@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""CI smoke for the topology compositor (docs/topology.md, <10s CPU).
+
+Asserts, for 1-slice / 2-slice / 4-slice (and one three-level) synthetic
+topologies:
+
+1. **Determinism** — the full plan dump is byte-identical across two
+   in-process runs AND across two ``tools/topo_plan.py`` CLI invocations
+   (stable JSON is the contract the offline tooling and any CI diffing
+   rely on).
+2. **Plan-shape sanity** — single-slice stays single-level; multi-slice
+   large-payload allreduce picks a hierarchical algorithm whose DCN
+   bytes-on-wire are strictly below the flat plan's; the homogeneity
+   gate forces ineligible models flat; MIN lowers two-level while
+   PRODUCT stays flat.
+
+No jax, no backend — pure cost-model execution.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from horovod_tpu.common.types import ReduceOp  # noqa: E402
+from horovod_tpu.topo import select_plan, synthetic_model  # noqa: E402
+from horovod_tpu.topo.compositor import (  # noqa: E402
+    _candidates_allreduce,
+    _plan_cost_us,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from topo_plan import DEFAULT_BYTES, build_dump  # noqa: E402
+
+TOPOLOGIES = (
+    ("1-slice", dict(local=8, cross=1)),
+    ("2-slice", dict(local=4, cross=2)),
+    ("4-slice", dict(local=2, cross=4)),
+    ("2-pod", dict(local=2, cross=2, pod=2)),
+)
+
+
+def fail(msg: str) -> None:
+    print(f"[topo-smoke] FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    t0 = time.time()
+    for name, sizes in TOPOLOGIES:
+        model = synthetic_model(generation="v5e", **sizes)
+        d1 = json.dumps(build_dump(
+            model, ["allreduce", "allgather", "reducescatter", "broadcast",
+                    "alltoall"], list(DEFAULT_BYTES), ReduceOp.SUM,
+        ), sort_keys=True, indent=1)
+        d2 = json.dumps(build_dump(
+            model, ["allreduce", "allgather", "reducescatter", "broadcast",
+                    "alltoall"], list(DEFAULT_BYTES), ReduceOp.SUM,
+        ), sort_keys=True, indent=1)
+        if d1 != d2:
+            fail(f"{name}: in-process dumps differ")
+        big = select_plan(model, "allreduce", 64 << 20)
+        if model.levels == 1:
+            if big.algorithm not in ("ring", "recursive-halving"):
+                fail(f"{name}: single-level allreduce chose {big.algorithm}")
+        else:
+            if big.algorithm not in ("two-level", "split"):
+                fail(f"{name}: 64MB allreduce stayed {big.algorithm}")
+            flat_stages = _candidates_allreduce(
+                model, 64 << 20, ReduceOp.SUM
+            )["flat"]
+            flat_dcn = sum(
+                s.bytes_on_wire for s in flat_stages if "dcn" in s.hop
+            )
+            hier_dcn = sum(
+                v for k, v in big.bytes_per_hop.items() if "dcn" in k
+            )
+            if not hier_dcn < flat_dcn:
+                fail(f"{name}: hierarchical DCN bytes {hier_dcn} not < "
+                     f"flat {flat_dcn}")
+            if select_plan(
+                model, "allreduce", 1 << 20, op=ReduceOp.MIN
+            ).algorithm != "two-level":
+                fail(f"{name}: MIN did not lower two-level")
+            if select_plan(
+                model, "allreduce", 1 << 20, op=ReduceOp.PRODUCT
+            ).algorithm != "flat":
+                fail(f"{name}: PRODUCT left the flat lowering")
+        # Homogeneity gate: same hops, ineligible -> flat only.
+        gated = synthetic_model(generation="v5e", eligible=False, **sizes)
+        if select_plan(gated, "allreduce", 64 << 20).algorithm not in (
+            "flat", "ring", "recursive-halving"
+        ):
+            fail(f"{name}: ineligible model still lowered hierarchically")
+        print(f"[topo-smoke] {name}: dump stable, "
+              f"64MB allreduce={big.algorithm}, "
+              f"bytes_per_hop={big.bytes_per_hop}")
+
+    # CLI determinism: two subprocess invocations, byte-identical stdout.
+    cmd = [sys.executable, os.path.join(REPO, "tools", "topo_plan.py"),
+           "--local", "4", "--cross", "2", "--generation", "v5e"]
+    env = {k: v for k, v in os.environ.items()
+           if k != "HOROVOD_TOPOLOGY_MODEL"}
+    o1 = subprocess.run(cmd, capture_output=True, env=env, check=True)
+    o2 = subprocess.run(cmd, capture_output=True, env=env, check=True)
+    if o1.stdout != o2.stdout:
+        fail("topo_plan.py CLI output differs across runs")
+    json.loads(o1.stdout)  # well-formed
+    print(f"[topo-smoke] CLI dump byte-identical "
+          f"({len(o1.stdout)} bytes)")
+    print(f"[topo-smoke] PASS in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

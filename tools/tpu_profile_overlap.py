@@ -584,6 +584,70 @@ def _interleave_stats(compiled_hlo: str) -> dict:
     return best
 
 
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "s32": 4,
+    "u64": 8, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+}
+
+
+def _topo_plan_report(pre_hlo: str) -> dict:
+    """Bytes-per-hop per collective from the compositor's chosen plans
+    (docs/topology.md): every gradient all-reduce in the program is
+    priced on a synthetic two-slice interconnect model (the bucket sizes
+    are the program's REAL fusion buckets, read off the pre-optimization
+    HLO), reporting what the selected hierarchical plans put on each hop
+    vs. the flat lowering's all-DCN ride."""
+    import re
+
+    from horovod_tpu.common.types import ReduceOp
+    from horovod_tpu.topo import select_plan, synthetic_model
+    from horovod_tpu.topo.compositor import _candidates_allreduce
+
+    shape_re = re.compile(r"^\(?\s*(\w+)\[([\d,]*)\]")
+    scalar_re = re.compile(r"^\(?\s*\w+\[\]")
+    ar_re = re.compile(r"\ball-reduce(?:-start)?\(")
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    buckets = []
+    for insts in _parse_hlo(pre_hlo).values():
+        for _, rhs in insts:
+            if not ar_re.search(rhs) or scalar_re.match(rhs):
+                continue
+            m = shape_re.match(rhs)
+            if not m:
+                continue
+            dsize = _HLO_DTYPE_BYTES.get(m.group(1), 4)
+            elems = 1
+            for d in m.group(2).split(","):
+                if d.strip():
+                    elems *= int(d)
+            buckets.append(elems * dsize)
+    per_bucket = []
+    totals: dict = {}
+    flat_dcn = 0
+    for nb in sorted(buckets, reverse=True):
+        plan = select_plan(model, "allreduce", nb, op=ReduceOp.SUM)
+        per_bucket.append({
+            "nbytes": nb,
+            "algorithm": plan.algorithm,
+            "bytes_per_hop": plan.bytes_per_hop,
+        })
+        for hop, v in plan.bytes_per_hop.items():
+            totals[hop] = totals.get(hop, 0) + v
+        flat = _candidates_allreduce(model, nb, ReduceOp.SUM)["flat"]
+        flat_dcn += sum(s.bytes_on_wire for s in flat)
+    return {
+        "model": {
+            "hop_sizes": [h.size for h in model.hops],
+            "generation": model.generation,
+        },
+        "collective": "allreduce",
+        "bucket_count": len(buckets),
+        "per_bucket": per_bucket,
+        "bytes_per_hop_total": dict(sorted(totals.items())),
+        "flat_dcn_bytes_total": flat_dcn,
+    }
+
+
 def _structural_stats(lowered) -> dict:
     pre = lowered.compiler_ir(dialect="hlo").as_hlo_text()
     compiled = lowered.compile().as_text()
@@ -592,6 +656,7 @@ def _structural_stats(lowered) -> dict:
     out["overlap_eligible_all_reduces"] = sum(
         1 for c in out["overlappable_compute_per_all_reduce"] if c > 0
     )
+    out["topo_plans"] = _topo_plan_report(pre)
     return out
 
 
@@ -720,6 +785,14 @@ def structural_mode(args) -> int:
                 f"[overlap] structural {mode}/{prog}: "
                 f"independent_groups={per[prog]['independent_all_reduce_groups']} "
                 f"pairs_with_overlap={per[prog]['pairs_with_overlap']}",
+                flush=True,
+            )
+            tp = per[prog]["topo_plans"]
+            print(
+                f"[overlap] topo plans {mode}/{prog}: "
+                f"{tp['bucket_count']} buckets, "
+                f"bytes_per_hop={tp['bytes_per_hop_total']} "
+                f"(flat would put {tp['flat_dcn_bytes_total']} on dcn)",
                 flush=True,
             )
         results[mode] = {
