@@ -10,8 +10,11 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-# Collective-safety static analysis: Pass 1 over the example train steps
-# and Pass 2 over the runtime sources (docs/static_analysis.md).
+# Collective-safety static analysis (docs/static_analysis.md): Pass 1
+# over the example train steps, Pass 2 over the runtime + fault/guard/
+# metrics/journal sources, Pass 3 over the full compositor plan grid,
+# Pass 4 over the shipped train-step variants, Pass 5 over the reference
+# sharding-rule table.
 lint-collectives:
 	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 bash tools/ci_checks.sh
 

@@ -1,6 +1,6 @@
 """horovod_tpu.analysis — collective-safety static analyzers.
 
-Two passes over two layers of the system:
+Five passes over three layers of the system:
 
  - **Pass 1 (collective lint)** inspects what a training step *will* do
    before it runs: trace a jitted fn to its jaxpr and check collective
@@ -11,12 +11,28 @@ Two passes over two layers of the system:
    (:mod:`.ordering`); validate grouped-collective dtype/budget
    composition (:mod:`.groups`).
  - **Pass 2 (runtime thread-safety lint)** checks the runtime's own
-   sources against its declared lock discipline (:mod:`.runtime_lint`).
+   sources — and, since PR 8, the fault/guard/metrics/journal packages —
+   against their declared lock discipline (:mod:`.runtime_lint`).
+ - **Pass 3 (symbolic plan verifier)** executes every compositor
+   lowering plan over an abstract per-rank chunk state and proves the
+   schedule realizes the collective's spec, with no jax import
+   (:mod:`.plan_verify`).
+ - **Pass 4 (rank-divergence analyzer)** taint-tracks ``axis_index``
+   through a jaxpr and flags collectives guarded by rank-divergent
+   ``cond``/``switch``/``while`` — the SPMD deadlock the Horovod paper's
+   coordinator exists to catch at runtime (:mod:`.divergence`).
+ - **Pass 5 (sharding-rule validator)** rejects regex->PartitionSpec
+   rule tables a mesh cannot satisfy before anything is traced
+   (:mod:`.sharding_rules`).
 
-``tools/collective_lint.py`` exposes both as a CLI (JSON + human output,
-nonzero exit on findings); ``HOROVOD_TPU_STATIC_CHECKS=1`` wires Pass 1
-into ``DistributedOptimizer`` / ``allreduce`` setup as a pre-flight
-(:mod:`.preflight`). See ``docs/static_analysis.md``.
+``tools/collective_lint.py`` exposes all passes as a CLI (versioned JSON
++ human output; exit 1 on findings, 2 on analyzer crash);
+``HOROVOD_TPU_STATIC_CHECKS=1`` wires the trace-time passes into
+``DistributedOptimizer`` / ``allreduce`` setup as a pre-flight
+(:mod:`.preflight`). Findings can be suppressed in-source
+(``# hvd-analysis: ignore[rule]``) or at the call site
+(:func:`suppressions` / the ``suppress=`` kwarg). See
+``docs/static_analysis.md``.
 """
 
 from __future__ import annotations
@@ -26,9 +42,11 @@ from .findings import (
     Finding,
     SEVERITY_ERROR,
     SEVERITY_WARNING,
+    apply_suppressions,
     errors,
     findings_to_json,
     sort_findings,
+    suppressions,
 )
 from .groups import check_fusion_plan, check_group
 from .jaxpr_lint import (
@@ -47,10 +65,14 @@ from .runtime_lint import (
     AttrRule,
     ClassRule,
     DEFAULT_DISCIPLINE,
+    MODULE,
     lint_file,
     lint_runtime,
     lint_source,
 )
+from .divergence import analyze_divergence, analyze_step
+from .plan_verify import verify_plan, verify_plan_grid
+from .sharding_rules import normalize_spec, validate_sharding_rules
 
 __all__ = [
     "AttrRule",
@@ -60,8 +82,12 @@ __all__ = [
     "CollectiveSite",
     "DEFAULT_DISCIPLINE",
     "Finding",
+    "MODULE",
     "SEVERITY_ERROR",
     "SEVERITY_WARNING",
+    "analyze_divergence",
+    "analyze_step",
+    "apply_suppressions",
     "check_cross_rank_order",
     "check_fusion_plan",
     "check_group",
@@ -73,7 +99,12 @@ __all__ = [
     "lint_runtime",
     "lint_source",
     "lint_step",
+    "normalize_spec",
     "record_rank_trace",
     "simulate_ranks",
     "sort_findings",
+    "suppressions",
+    "validate_sharding_rules",
+    "verify_plan",
+    "verify_plan_grid",
 ]

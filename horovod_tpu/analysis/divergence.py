@@ -1,0 +1,324 @@
+"""Pass 4 — SPMD rank-divergence analysis over jaxprs.
+
+The Horovod paper's coordination model (arXiv:1802.05799) exists because
+of one failure class: ranks disagreeing about *whether* to issue a
+collective. Under XLA the SPMD program is identical on every rank, so the
+only way ranks can diverge is data-dependent control flow on a
+rank-dependent value — a collective inside a ``lax.cond`` / ``switch`` /
+``while_loop`` whose predicate derives from ``axis_index``. One group
+member takes the collective branch, its peers take the other, and every
+rank deadlocks at scale (the stall inspector's ~60 s silence, caught here
+at trace time).
+
+The analysis is a taint-propagating abstract interpretation:
+
+ - **sources** — ``axis_index(axis)`` taints its output with ``{axis}``;
+ - **propagation** — any equation with a tainted operand taints its
+   outputs with the union of operand taints, through ``pjit`` / ``scan``
+   / ``shard_map`` / custom-vjp sub-jaxprs;
+ - **convergence (the sanctioned seam)** — ``psum`` / ``pmax`` / ``pmin``
+   / ``all_gather`` over an axis REMOVE that axis from the taint: after
+   the reduction every member of the axis group holds the same value.
+   This is exactly the guard package's skip-agreement pattern
+   (``guard/nonfinite.agree_flag`` — a psum over the reduction axes), so
+   guard-skip steps lint clean by construction;
+ - **sinks** — a ``cond``/``switch`` whose predicate is tainted over axis
+   A, or a ``while`` whose continuation predicate is, flags every
+   collective in its branches/body that communicates over A
+   (:data:`RULE_RANK_DIVERGENCE`). Divergence over a *disjoint* axis is
+   fine: all members of the collective's group share the predicate value.
+
+Wired into :func:`~horovod_tpu.analysis.jaxpr_lint.lint_step`, the CLI
+``examples``/``divergence`` targets, and the preflight. Suppress a
+sanctioned site with ``analysis.suppressions("rank-divergent-collective")``
+or the ``suppress=`` kwarg (``docs/static_analysis.md``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from .findings import (
+    Finding,
+    RULE_RANK_DIVERGENCE,
+    SEVERITY_ERROR,
+    apply_suppressions,
+)
+from .jaxpr_lint import COLLECTIVE_PRIMITIVES, _axis_names, _sub_jaxprs
+
+Taint = FrozenSet[str]
+_EMPTY: Taint = frozenset()
+
+# Collectives whose *output* is uniform across the reduced axes: the
+# convergence seam. ppermute/all_to_all/reduce_scatter outputs stay
+# rank-dependent (each rank receives different data).
+_CONVERGING = {"psum", "psum2", "pmax", "pmin", "all_gather"}
+
+
+def _jaxpr_of(obj: Any) -> Any:
+    return obj.jaxpr if hasattr(obj, "jaxpr") else obj
+
+
+class _TaintEnv:
+    """Var -> taint mapping keyed by object identity (jaxpr Vars are
+    unique per jaxpr; Literals are always clean)."""
+
+    def __init__(self) -> None:
+        self._m: Dict[int, Taint] = {}
+
+    def get(self, var: Any) -> Taint:
+        return self._m.get(id(var), _EMPTY)
+
+    def set(self, var: Any, taint: Taint) -> None:
+        if taint:
+            self._m[id(var)] = frozenset(taint)
+        else:
+            self._m.pop(id(var), None)
+
+
+def _collect_collectives_shallow(
+    jaxpr: Any, path: str
+) -> List[Tuple[str, Tuple[str, ...], str]]:
+    """Every collective (primitive, axes, path) inside ``jaxpr``,
+    recursively — used to report what a tainted guard would strand."""
+    jaxpr = _jaxpr_of(jaxpr)
+    out: List[Tuple[str, Tuple[str, ...], str]] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMITIVES and name != "axis_index":
+            out.append((name, _axis_names(eqn.params), path))
+        child = f"{path}/{name}" if path else name
+        for value in eqn.params.values():
+            for sub in _sub_jaxprs(value):
+                out.extend(_collect_collectives_shallow(sub, child))
+    return out
+
+
+class _Analyzer:
+    def __init__(self) -> None:
+        self.findings: List[Finding] = []
+
+    def _flag(self, guard: str, path: str, pred_taint: Taint,
+              collectives: Sequence[Tuple[str, Tuple[str, ...], str]],
+              ) -> None:
+        for prim, axes, cpath in collectives:
+            overlap = sorted(set(axes) & pred_taint)
+            if not overlap:
+                continue
+            self.findings.append(Finding(
+                rule=RULE_RANK_DIVERGENCE,
+                severity=SEVERITY_ERROR,
+                message=(
+                    f"{COLLECTIVE_PRIMITIVES[prim]} over axis "
+                    f"{overlap if len(overlap) > 1 else overlap[0]!r} is "
+                    f"guarded by a {guard} whose predicate derives from "
+                    f"axis_index over the same axis — group members can "
+                    f"take different branches and deadlock every rank; "
+                    f"converge the predicate first (psum it over the "
+                    f"axis, the guard skip-agreement pattern) or lift "
+                    f"the collective out of the branch"
+                ),
+                location=f"jaxpr:{cpath}/{prim}" if cpath
+                else f"jaxpr:{prim}",
+                details={
+                    "guard": guard,
+                    "guard_path": path,
+                    "tainted_axes": sorted(pred_taint),
+                    "collective_axes": list(axes),
+                },
+            ))
+
+    def _run_jaxpr(self, jaxpr: Any, in_taints: Sequence[Taint],
+                   path: str) -> List[Taint]:
+        """Propagate taints through one (open) jaxpr; returns the taints
+        of its outvars."""
+        jaxpr = _jaxpr_of(jaxpr)
+        env = _TaintEnv()
+        for var, t in zip(jaxpr.invars, in_taints):
+            env.set(var, t)
+        for eqn in jaxpr.eqns:
+            self._run_eqn(eqn, env, path)
+        return [env.get(v) for v in jaxpr.outvars]
+
+    def _invar_taints(self, eqn: Any, env: _TaintEnv) -> List[Taint]:
+        return [env.get(v) for v in eqn.invars]
+
+    def _run_eqn(self, eqn: Any, env: _TaintEnv, path: str) -> None:
+        name = eqn.primitive.name
+        ins = self._invar_taints(eqn, env)
+        joined: Taint = frozenset().union(*ins) if ins else _EMPTY
+
+        if name == "axis_index":
+            axes = _axis_names(eqn.params)
+            for v in eqn.outvars:
+                env.set(v, frozenset(axes))
+            return
+
+        if name in _CONVERGING:
+            axes = frozenset(_axis_names(eqn.params))
+            # axis_index_groups restrict the agreement to subgroups; stay
+            # conservative and keep the taint in that case.
+            if eqn.params.get("axis_index_groups") is None:
+                out_taint = joined - axes
+            else:
+                out_taint = joined
+            for v in eqn.outvars:
+                env.set(v, out_taint)
+            return
+
+        if name == "cond":
+            self._run_cond(eqn, env, ins, path)
+            return
+        if name == "while":
+            self._run_while(eqn, env, ins, path)
+            return
+        if name == "scan":
+            self._run_scan(eqn, env, ins, path)
+            return
+
+        # Generic sub-jaxpr call (pjit, shard_map, closed_call,
+        # custom_jvp/vjp, remat, ...): map operand taints through when
+        # arities line up, else degrade to the joined taint.
+        subs = [s for v in eqn.params.values() for s in _sub_jaxprs(v)]
+        if subs:
+            out_taints: Optional[List[Taint]] = None
+            for sub in subs:
+                sj = _jaxpr_of(sub)
+                n_in = len(sj.invars)
+                if n_in == len(ins):
+                    sub_ins = ins
+                elif n_in < len(ins):
+                    # Leading operands are consts/tokens for some
+                    # primitives; align from the right.
+                    sub_ins = ins[len(ins) - n_in:]
+                else:
+                    sub_ins = list(ins) + [_EMPTY] * (n_in - len(ins))
+                child = f"{path}/{name}" if path else name
+                outs = self._run_jaxpr(sub, sub_ins, child)
+                if out_taints is None:
+                    out_taints = outs
+                else:
+                    out_taints = [
+                        a | b for a, b in zip(out_taints, outs)
+                    ]
+            if out_taints is not None and len(out_taints) == len(
+                eqn.outvars
+            ):
+                for v, t in zip(eqn.outvars, out_taints):
+                    env.set(v, t)
+                return
+        for v in eqn.outvars:
+            env.set(v, joined)
+
+    def _run_cond(self, eqn: Any, env: _TaintEnv, ins: List[Taint],
+                  path: str) -> None:
+        branches = eqn.params.get("branches") or ()
+        pred_taint = ins[0] if ins else _EMPTY
+        child = f"{path}/cond" if path else "cond"
+        if pred_taint:
+            for br in branches:
+                self._flag(
+                    "cond/switch", child, pred_taint,
+                    _collect_collectives_shallow(br, child),
+                )
+        op_ins = ins[1:]
+        out_taints: Optional[List[Taint]] = None
+        for br in branches:
+            outs = self._run_jaxpr(br, op_ins, child)
+            if out_taints is None:
+                out_taints = outs
+            else:
+                out_taints = [a | b for a, b in zip(out_taints, outs)]
+        for v, t in zip(eqn.outvars, out_taints or []):
+            # Branch selection on a tainted predicate taints the result.
+            env.set(v, t | pred_taint)
+
+    def _run_while(self, eqn: Any, env: _TaintEnv, ins: List[Taint],
+                   path: str) -> None:
+        cond_j = eqn.params.get("cond_jaxpr")
+        body_j = eqn.params.get("body_jaxpr")
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        cond_consts = ins[:cn]
+        body_consts = ins[cn:cn + bn]
+        carry = list(ins[cn + bn:])
+        child = f"{path}/while" if path else "while"
+        # Fixpoint on the carry taint (the body may launder axis_index
+        # into the carry that feeds the next iteration's predicate).
+        for _ in range(len(carry) + 2):
+            outs = self._run_jaxpr(body_j, body_consts + carry, child)
+            new_carry = [a | b for a, b in zip(carry, outs)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        pred = self._run_jaxpr(cond_j, cond_consts + carry, child)
+        pred_taint: Taint = frozenset().union(*pred) if pred else _EMPTY
+        if pred_taint:
+            # Rank-dependent trip count: every collective in the body
+            # runs a different number of times per rank.
+            self._flag(
+                "while", child, pred_taint,
+                _collect_collectives_shallow(body_j, child),
+            )
+        for v, t in zip(eqn.outvars, carry):
+            env.set(v, t | pred_taint)
+
+    def _run_scan(self, eqn: Any, env: _TaintEnv, ins: List[Taint],
+                  path: str) -> None:
+        body = eqn.params.get("jaxpr")
+        n_consts = int(eqn.params.get("num_consts", 0))
+        n_carry = int(eqn.params.get("num_carry", 0))
+        consts = ins[:n_consts]
+        carry = list(ins[n_consts:n_consts + n_carry])
+        xs = ins[n_consts + n_carry:]
+        child = f"{path}/scan" if path else "scan"
+        for _ in range(len(carry) + 2):
+            outs = self._run_jaxpr(body, consts + carry + list(xs), child)
+            new_carry = [
+                a | b for a, b in zip(carry, outs[:n_carry])
+            ]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        outs = self._run_jaxpr(body, consts + carry + list(xs), child)
+        out_taints = list(outs[:n_carry]) + list(outs[n_carry:])
+        for v, t in zip(eqn.outvars, out_taints):
+            env.set(v, t)
+
+
+def analyze_divergence(
+    closed_jaxpr: Any,
+    *,
+    suppress: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Analyze an already-traced jaxpr (``jax.make_jaxpr`` output or any
+    Jaxpr/ClosedJaxpr) for collectives guarded by rank-divergent control
+    flow. Returns findings ([] when every collective is reached
+    uniformly)."""
+    analyzer = _Analyzer()
+    jaxpr = _jaxpr_of(closed_jaxpr)
+    analyzer._run_jaxpr(jaxpr, [_EMPTY] * len(jaxpr.invars), "")
+    seen = set()
+    unique: List[Finding] = []
+    for f in analyzer.findings:
+        key = (f.location, f.details.get("guard_path"), f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(f)
+    return apply_suppressions(unique, suppress)
+
+
+def analyze_step(
+    fn: Any,
+    *args: Any,
+    suppress: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Trace ``fn(*args)`` and run :func:`analyze_divergence` on the
+    result (the standalone entry the CLI ``divergence`` target uses;
+    ``lint_step`` already folds this pass in)."""
+    import jax
+
+    return analyze_divergence(
+        jax.make_jaxpr(fn)(*args), suppress=suppress
+    )

@@ -10,9 +10,12 @@ lines. Rule ids are the vocabulary of the suppression syntax
 
 from __future__ import annotations
 
+import contextlib
+import fnmatch
 import json
+import threading
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
@@ -39,6 +42,39 @@ RULE_GUARD_SKIP_AGREEMENT = "guard-skip-no-agreement"
 # --- rule ids (Pass 2: runtime thread-safety lint) ---
 RULE_UNGUARDED = "unguarded-shared-state"
 
+# --- rule ids (Pass 3: symbolic plan verifier) ---
+# A compositor Plan stage that is malformed: unknown primitive, a hop/axis
+# that does not exist on the model, an SPMD asymmetry (group members whose
+# abstract buffers disagree where the schedule requires agreement), or a
+# declared round count that does not match the stage's expanded schedule.
+RULE_PLAN_STAGE = "plan-bad-stage"
+# An expanded ppermute round of a ring/halving schedule is not a complete
+# bijection over its hop (the silent-hang class jaxpr lint catches for
+# traced ppermutes, applied to the *planned* schedule before any trace).
+RULE_PLAN_BIJECTION = "plan-non-bijective-permute"
+# A stage's declared bytes-on-wire deviates from the symbolically-derived
+# traffic beyond integer-rounding slack.
+RULE_PLAN_BYTES = "plan-bytes-mismatch"
+# The final abstract state does not satisfy the collective's spec
+# (allreduce: every rank holds the full reduction; allgather/
+# reduce-scatter/broadcast/alltoall likewise).
+RULE_PLAN_RESULT = "plan-wrong-result"
+
+# --- rule ids (Pass 4: SPMD rank-divergence analyzer) ---
+# A collective reached under control flow (cond/switch/while) whose
+# predicate derives from axis_index over an axis the collective reduces
+# over: ranks of one group can take different branches and deadlock
+# (the Horovod coordination model's classic SPMD killer).
+RULE_RANK_DIVERGENCE = "rank-divergent-collective"
+
+# --- rule ids (Pass 5: mesh/sharding-rule validator) ---
+RULE_SHARDING_UNKNOWN_AXIS = "sharding-unknown-axis"
+RULE_SHARDING_DUP_AXIS = "sharding-duplicate-axis"
+RULE_SHARDING_INDIVISIBLE = "sharding-non-divisible"
+RULE_SHARDING_UNMATCHED = "sharding-unmatched-param"
+RULE_SHARDING_SCALAR = "sharding-scalar-not-replicated"
+RULE_SHARDING_BAD_RULE = "sharding-bad-rule"
+
 ALL_RULES = (
     RULE_UNKNOWN_AXIS,
     RULE_ORDER_MISMATCH,
@@ -51,6 +87,17 @@ ALL_RULES = (
     RULE_OVERLAP_STREAMING,
     RULE_GUARD_SKIP_AGREEMENT,
     RULE_UNGUARDED,
+    RULE_PLAN_STAGE,
+    RULE_PLAN_BIJECTION,
+    RULE_PLAN_BYTES,
+    RULE_PLAN_RESULT,
+    RULE_RANK_DIVERGENCE,
+    RULE_SHARDING_UNKNOWN_AXIS,
+    RULE_SHARDING_DUP_AXIS,
+    RULE_SHARDING_INDIVISIBLE,
+    RULE_SHARDING_UNMATCHED,
+    RULE_SHARDING_SCALAR,
+    RULE_SHARDING_BAD_RULE,
 )
 
 
@@ -121,3 +168,61 @@ def findings_to_json(findings: Sequence[Finding], **extra: Any) -> str:
 
 def errors(findings: Sequence[Finding]) -> List[Finding]:
     return [f for f in findings if f.severity == SEVERITY_ERROR]
+
+
+# --- call-site suppressions -------------------------------------------------
+#
+# The AST pass suppresses with an in-source comment; jaxpr-level and
+# divergence findings have no source line to hang a comment on — their
+# "call site" is the lint/preflight call. A suppression spec is
+# ``"rule-id"`` (everywhere) or ``"rule-id@location-glob"`` (only where
+# the finding's location matches the fnmatch pattern), so one sanctioned
+# false positive never forces a global rule disable. Specs come in via
+# the ``suppress=`` kwarg on the analyzers or the :func:`suppressions`
+# context manager (thread-local, nestable) around a lint/preflight call.
+
+_suppress_local = threading.local()
+
+
+def _parse_spec(spec: str) -> Tuple[str, str]:
+    rule, _, loc = str(spec).partition("@")
+    return rule.strip(), (loc.strip() or "*")
+
+
+def _active_specs() -> List[Tuple[str, str]]:
+    return list(getattr(_suppress_local, "stack", ()))
+
+
+@contextlib.contextmanager
+def suppressions(*specs: str):
+    """Suppress matching findings from any analyzer run inside the block
+    (the call-site analogue of ``# hvd-analysis: ignore[rule]``)."""
+    parsed = [_parse_spec(s) for s in specs]
+    stack = getattr(_suppress_local, "stack", [])
+    _suppress_local.stack = stack + parsed
+    try:
+        yield
+    finally:
+        _suppress_local.stack = stack
+
+
+def _suppressed(finding: Finding, specs: Iterable[Tuple[str, str]]) -> bool:
+    for rule, loc in specs:
+        if rule and rule != finding.rule:
+            continue
+        if fnmatch.fnmatchcase(finding.location or "", loc):
+            return True
+    return False
+
+
+def apply_suppressions(
+    findings: Sequence[Finding],
+    suppress: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Filter ``findings`` through the explicit ``suppress`` specs plus
+    any :func:`suppressions` context active on this thread."""
+    specs = [_parse_spec(s) for s in (suppress or ())]
+    specs.extend(_active_specs())
+    if not specs:
+        return list(findings)
+    return [f for f in findings if not _suppressed(f, specs)]

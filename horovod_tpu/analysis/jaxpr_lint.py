@@ -37,6 +37,7 @@ from .findings import (
     RULE_UNKNOWN_AXIS,
     SEVERITY_ERROR,
     SEVERITY_WARNING,
+    apply_suppressions,
 )
 
 # Primitive-name vocabulary. jax names the replicated-tracing variants of
@@ -327,9 +328,13 @@ def lint_jaxpr(
     *,
     mesh: Any = None,
     fusion_threshold_bytes: Optional[int] = None,
+    divergence: bool = True,
+    suppress: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     """Lint an already-traced jaxpr (``jax.make_jaxpr(fn)(*args)`` output,
-    or any Jaxpr/ClosedJaxpr)."""
+    or any Jaxpr/ClosedJaxpr). ``divergence=True`` folds the Pass 4
+    rank-divergence analysis in; ``suppress`` takes call-site suppression
+    specs (``"rule"`` or ``"rule@location-glob"``)."""
     known = _mesh_axis_sizes(mesh)
     sites = collect_collectives(closed_jaxpr)
     findings: List[Finding] = []
@@ -347,7 +352,11 @@ def lint_jaxpr(
         if site.primitive == "ppermute":
             findings.extend(_check_ppermute(site, local_known))
         findings.extend(_check_fusion_budget(site, fusion_threshold_bytes))
-    return findings
+    if divergence:
+        from .divergence import analyze_divergence
+
+        findings.extend(analyze_divergence(closed_jaxpr))
+    return apply_suppressions(findings, suppress)
 
 
 def lint_step(
@@ -355,6 +364,8 @@ def lint_step(
     *args: Any,
     mesh: Any = None,
     fusion_threshold_bytes: Optional[int] = None,
+    divergence: bool = True,
+    suppress: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     """Trace ``fn(*args)`` to a jaxpr and lint it. A trace-time unbound
     axis (jax's own NameError) is converted into an ``unknown-axis``
@@ -364,7 +375,10 @@ def lint_step(
     registration and skip-agreement-seam ledgers are drained before and
     consumed after, so a step using streamed overlap under
     ``HOROVOD_GUARD_NONFINITE=skip`` without the agreement collective is
-    flagged (docs/fault_tolerance.md)."""
+    flagged (docs/fault_tolerance.md). The Pass 4 rank-divergence
+    analysis runs over the same trace (``divergence=False`` opts out);
+    ``suppress`` filters findings at this call site
+    (docs/static_analysis.md "Suppressions")."""
     import jax
 
     from ..guard import nonfinite as _nf
@@ -377,7 +391,7 @@ def lint_step(
     try:
         closed = jax.make_jaxpr(fn)(*args)
     except NameError as exc:
-        return [
+        return apply_suppressions([
             Finding(
                 rule=RULE_UNKNOWN_AXIS,
                 severity=SEVERITY_ERROR,
@@ -387,13 +401,14 @@ def lint_step(
                 location="trace",
                 details={"exception": str(exc)},
             )
-        ]
+        ], suppress)
     stream_calls = _fusion.take_stream_registrations()["calls"]
     seam_calls = _nf.take_seam_registrations()
     findings = lint_jaxpr(
-        closed, mesh=mesh, fusion_threshold_bytes=fusion_threshold_bytes
+        closed, mesh=mesh, fusion_threshold_bytes=fusion_threshold_bytes,
+        divergence=divergence,
     )
     findings.extend(
         check_guard_skip_agreement(stream_calls, seam_calls)
     )
-    return findings
+    return apply_suppressions(findings, suppress)

@@ -202,6 +202,27 @@ def check_guard_skip_agreement(
     ]
 
 
+def check_sharding_rules(
+    rules: Any,
+    mesh: Any,
+    params: Optional[Dict[str, Sequence[int]]] = None,
+    *,
+    suppress: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Pre-flight for the sharding-rules engine (ROADMAP item 4): reject
+    a regex->PartitionSpec rule table the mesh cannot satisfy BEFORE any
+    placement is traced. Error findings raise
+    :class:`CollectiveSafetyError`; warnings (a rule sharding a scalar)
+    are logged and returned."""
+    from .sharding_rules import validate_sharding_rules
+
+    findings = validate_sharding_rules(
+        rules, mesh, params, suppress=suppress
+    )
+    _raise_or_log(findings)
+    return findings
+
+
 # --- eager checks ---
 def check_grouped(
     tensors: Sequence[Any], threshold_bytes: Optional[int], name: str

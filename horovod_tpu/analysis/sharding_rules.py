@@ -1,0 +1,329 @@
+"""Pass 5 — mesh/sharding-rule validation.
+
+ROADMAP item 4's sharding-rules engine adopts the declarative
+regex -> PartitionSpec table pattern (SNIPPETS.md
+``match_partition_rules``): param names are matched against ordered
+``(pattern, spec)`` rules and the first hit decides the leaf's
+placement. A typo'd axis name, a doubled mesh axis, or a dim the mesh
+cannot divide only surfaces deep inside pjit today — this validator
+rejects the table *before* anything is traced, so the engine lands on a
+checked foundation ("rules validated against the mesh by the static
+analyzer").
+
+Everything is backend-free: a "spec" is any PartitionSpec-shaped value —
+``None`` (replicated), a string axis name, or a sequence whose entries
+are ``None`` / axis name / tuple of axis names (one entry per array
+dim). jax's actual ``PartitionSpec`` duck-types through unchanged, so
+the future engine and the tests can hand either in.
+
+Rules checked (docs/static_analysis.md has the table):
+
+ - :data:`RULE_SHARDING_BAD_RULE` — a rule's regex does not compile or
+   its spec is not PartitionSpec-shaped;
+ - :data:`RULE_SHARDING_UNKNOWN_AXIS` — a spec names an axis the mesh
+   does not have;
+ - :data:`RULE_SHARDING_DUP_AXIS` — one spec uses the same mesh axis for
+   two different dims (an axis can shard at most one dim of a leaf);
+ - :data:`RULE_SHARDING_INDIVISIBLE` — with a param table: a matched
+   dim's size is not divisible by the product of its axis sizes, or the
+   spec has more entries than the param has dims;
+ - :data:`RULE_SHARDING_UNMATCHED` — with a param table: a non-scalar
+   param no rule matches (the engine would have to raise mid-init);
+ - :data:`RULE_SHARDING_SCALAR` (warning) — a rule shards a scalar
+   param; the canonical engine silently replicates scalars, so the rule
+   is dead weight or a misunderstanding.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import (
+    Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union,
+)
+
+from .findings import (
+    Finding,
+    RULE_SHARDING_BAD_RULE,
+    RULE_SHARDING_DUP_AXIS,
+    RULE_SHARDING_INDIVISIBLE,
+    RULE_SHARDING_SCALAR,
+    RULE_SHARDING_UNKNOWN_AXIS,
+    RULE_SHARDING_UNMATCHED,
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    apply_suppressions,
+)
+
+SpecEntry = Union[None, str, Sequence[str]]
+Spec = Union[None, str, Sequence[SpecEntry]]
+Rule = Tuple[str, Spec]
+
+
+def normalize_spec(spec: Spec) -> Optional[Tuple[Tuple[str, ...], ...]]:
+    """Normalize a PartitionSpec-shaped value into one axis tuple per
+    dim; None when the value is not spec-shaped. ``None``/empty ->
+    ``()`` (replicated), ``"x"`` -> ``(("x",),)``."""
+    if spec is None:
+        return ()
+    if isinstance(spec, str):
+        return ((spec,),)
+    try:
+        entries = tuple(spec)
+    except TypeError:
+        return None
+    out: List[Tuple[str, ...]] = []
+    for e in entries:
+        if e is None:
+            out.append(())
+        elif isinstance(e, str):
+            out.append((e,))
+        else:
+            try:
+                axes = tuple(e)
+            except TypeError:
+                return None
+            if not all(isinstance(a, str) for a in axes):
+                return None
+            out.append(axes)
+    return tuple(out)
+
+
+def _mesh_axes(mesh: Any) -> Dict[str, int]:
+    """Name -> size for a mesh given as a dict, a jax ``Mesh`` (or
+    anything with a ``.shape`` mapping), or a sequence of (name, size)
+    pairs."""
+    from .jaxpr_lint import _mesh_axis_sizes
+
+    return _mesh_axis_sizes(mesh)
+
+
+def _spec_repr(spec: Spec) -> str:
+    norm = normalize_spec(spec)
+    if norm is None:
+        return repr(spec)
+    return "P(" + ", ".join(
+        "None" if not axes else (repr(axes[0]) if len(axes) == 1
+                                 else repr(tuple(axes)))
+        for axes in norm
+    ) + ")"
+
+
+def _is_scalar(shape: Sequence[int]) -> bool:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return len(shape) == 0 or n == 1
+
+
+def validate_sharding_rules(
+    rules: Sequence[Rule],
+    mesh: Any,
+    params: Optional[Mapping[str, Sequence[int]]] = None,
+    *,
+    suppress: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Validate a regex -> PartitionSpec rule table against ``mesh``
+    (name -> size), and — when ``params`` maps param names to shapes —
+    against the concrete tree the table is meant to place."""
+    axes = _mesh_axes(mesh)
+    findings: List[Finding] = []
+    compiled: List[Tuple[int, Any, Tuple[Tuple[str, ...], ...]]] = []
+
+    for idx, rule in enumerate(rules):
+        try:
+            pattern, spec = rule
+        except (TypeError, ValueError):
+            findings.append(Finding(
+                rule=RULE_SHARDING_BAD_RULE,
+                severity=SEVERITY_ERROR,
+                message=f"rule #{idx} is not a (pattern, spec) pair: "
+                        f"{rule!r}",
+                location=f"sharding:rule[{idx}]",
+                details={"rule_index": idx},
+            ))
+            continue
+        loc = f"sharding:rule[{idx}]:{pattern}"
+        try:
+            rx = re.compile(pattern)
+        except re.error as exc:
+            findings.append(Finding(
+                rule=RULE_SHARDING_BAD_RULE,
+                severity=SEVERITY_ERROR,
+                message=f"rule #{idx} pattern {pattern!r} does not "
+                        f"compile: {exc}",
+                location=loc,
+                details={"rule_index": idx, "pattern": str(pattern)},
+            ))
+            continue
+        norm = normalize_spec(spec)
+        if norm is None:
+            findings.append(Finding(
+                rule=RULE_SHARDING_BAD_RULE,
+                severity=SEVERITY_ERROR,
+                message=f"rule #{idx} spec {spec!r} is not "
+                        f"PartitionSpec-shaped",
+                location=loc,
+                details={"rule_index": idx},
+            ))
+            continue
+        used: Dict[str, int] = {}
+        for dim, dim_axes in enumerate(norm):
+            for a in dim_axes:
+                if a not in axes:
+                    findings.append(Finding(
+                        rule=RULE_SHARDING_UNKNOWN_AXIS,
+                        severity=SEVERITY_ERROR,
+                        message=(
+                            f"rule #{idx} ({pattern!r}) shards dim {dim} "
+                            f"over axis {a!r} which is not a mesh axis "
+                            f"(mesh: {sorted(axes) or 'empty'})"
+                        ),
+                        location=loc,
+                        details={"rule_index": idx, "axis": a,
+                                 "mesh_axes": sorted(axes)},
+                    ))
+                elif a in used and used[a] != dim:
+                    findings.append(Finding(
+                        rule=RULE_SHARDING_DUP_AXIS,
+                        severity=SEVERITY_ERROR,
+                        message=(
+                            f"rule #{idx} ({pattern!r}) uses mesh axis "
+                            f"{a!r} for both dim {used[a]} and dim "
+                            f"{dim} — an axis can shard at most one dim "
+                            f"of one leaf"
+                        ),
+                        location=loc,
+                        details={"rule_index": idx, "axis": a,
+                                 "dims": [used[a], dim]},
+                    ))
+                elif a in used:
+                    findings.append(Finding(
+                        rule=RULE_SHARDING_DUP_AXIS,
+                        severity=SEVERITY_ERROR,
+                        message=(
+                            f"rule #{idx} ({pattern!r}) repeats mesh "
+                            f"axis {a!r} within dim {dim}"
+                        ),
+                        location=loc,
+                        details={"rule_index": idx, "axis": a,
+                                 "dims": [dim]},
+                    ))
+                else:
+                    used[a] = dim
+        compiled.append((idx, rx, norm))
+
+    if params is not None:
+        for name in sorted(params):
+            shape = tuple(int(d) for d in params[name])
+            scalar = _is_scalar(shape)
+            match = None
+            for idx, rx, norm in compiled:
+                if rx.search(name) is not None:
+                    match = (idx, norm)
+                    break
+            if match is None:
+                if not scalar:
+                    findings.append(Finding(
+                        rule=RULE_SHARDING_UNMATCHED,
+                        severity=SEVERITY_ERROR,
+                        message=(
+                            f"param {name!r} (shape {shape}) matches no "
+                            f"rule — the engine would raise mid-init; "
+                            f"add a rule or a catch-all replicate"
+                        ),
+                        location=f"sharding:param:{name}",
+                        details={"param": name, "shape": list(shape)},
+                    ))
+                continue
+            idx, norm = match
+            loc = f"sharding:param:{name}"
+            if scalar:
+                if any(norm[d] for d in range(len(norm))):
+                    findings.append(Finding(
+                        rule=RULE_SHARDING_SCALAR,
+                        severity=SEVERITY_WARNING,
+                        message=(
+                            f"rule #{idx} shards scalar param {name!r}; "
+                            f"scalars are always replicated (the engine "
+                            f"ignores the spec)"
+                        ),
+                        location=loc,
+                        details={"param": name, "rule_index": idx},
+                    ))
+                continue
+            if len(norm) > len(shape):
+                findings.append(Finding(
+                    rule=RULE_SHARDING_INDIVISIBLE,
+                    severity=SEVERITY_ERROR,
+                    message=(
+                        f"rule #{idx} spec has {len(norm)} entries but "
+                        f"param {name!r} has {len(shape)} dims"
+                    ),
+                    location=loc,
+                    details={"param": name, "rule_index": idx,
+                             "shape": list(shape)},
+                ))
+                continue
+            for dim, dim_axes in enumerate(norm):
+                factor = 1
+                for a in dim_axes:
+                    factor *= int(axes.get(a, 1))
+                if factor > 1 and shape[dim] % factor:
+                    findings.append(Finding(
+                        rule=RULE_SHARDING_INDIVISIBLE,
+                        severity=SEVERITY_ERROR,
+                        message=(
+                            f"param {name!r} dim {dim} (size "
+                            f"{shape[dim]}) is not divisible by "
+                            f"{'x'.join(dim_axes)} = {factor} "
+                            f"(rule #{idx})"
+                        ),
+                        location=loc,
+                        details={"param": name, "dim": dim,
+                                 "size": shape[dim], "factor": factor,
+                                 "rule_index": idx},
+                    ))
+    return apply_suppressions(findings, suppress)
+
+
+# --- reference DP x TP table (the CLI `sharding` target + tests) -------------
+#
+# A GPT-class param tree on a {"data": D, "model": T} mesh: embeddings
+# and attention/MLP kernels shard their feature dim over "model",
+# norms/biases replicate, scalars replicate implicitly. This is the
+# shape item 4's engine will ship; the validator accepting it (and
+# rejecting its seeded corruptions) is the acceptance gate.
+
+EXAMPLE_GPT_MESH: Dict[str, int] = {"data": 4, "model": 2}
+
+EXAMPLE_GPT_RULES: Tuple[Rule, ...] = (
+    (r"embeddings/embedding$", (None, "model")),
+    (r"attention/(query|key|value)/kernel$", (None, "model")),
+    (r"attention/out/kernel$", ("model", None)),
+    (r"mlp/up/kernel$", (None, "model")),
+    (r"mlp/down/kernel$", ("model", None)),
+    (r"(ln|layernorm|norm)[^/]*/(scale|bias)$", None),
+    (r"bias$", None),
+    (r".*", None),  # catch-all: replicate
+)
+
+
+def example_gpt_params(
+    d_model: int = 128, d_ff: int = 512, vocab: int = 384
+) -> Dict[str, Tuple[int, ...]]:
+    """A representative GPT-class param-shape table (name -> shape) the
+    reference rule table must place cleanly."""
+    return {
+        "embeddings/embedding": (vocab, d_model),
+        "layer_0/attention/query/kernel": (d_model, d_model),
+        "layer_0/attention/key/kernel": (d_model, d_model),
+        "layer_0/attention/value/kernel": (d_model, d_model),
+        "layer_0/attention/out/kernel": (d_model, d_model),
+        "layer_0/attention/out/bias": (d_model,),
+        "layer_0/mlp/up/kernel": (d_model, d_ff),
+        "layer_0/mlp/down/kernel": (d_ff, d_model),
+        "layer_0/ln_1/scale": (d_model,),
+        "layer_0/ln_1/bias": (d_model,),
+        "final_norm/scale": (d_model,),
+        "step": (),
+    }

@@ -51,12 +51,12 @@ _seq = 0
 
 def install_plan(plan: Optional[FaultPlan]) -> None:
     """Activate ``plan`` for this process (None deactivates)."""
-    global ACTIVE, _plan
+    global ACTIVE, _plan, _seq
     with _lock:
         _plan = plan
         _counters.clear()
         _events.clear()
-        _reset_seq()
+        _seq = 0
         ACTIVE = plan is not None
 
 
@@ -77,11 +77,6 @@ def active_plan() -> Optional[FaultPlan]:
 def events() -> List[dict]:
     with _lock:
         return list(_events)
-
-
-def _reset_seq() -> None:
-    global _seq
-    _seq = 0
 
 
 def _identity() -> tuple:
@@ -115,12 +110,16 @@ def record_event(site: str, hit: int, action: str, detail: str = "") -> dict:
         }
         _events.append(ev)
         path = os.environ.get(FAULT_EVENT_LOG_ENV, "")
-    if path:
-        try:
-            with open(path, "a") as f:
-                f.write(json.dumps(ev, sort_keys=True) + "\n")
-        except OSError:
-            pass
+        # The file append stays under the lock: released first, a second
+        # thread could write its (higher-seq) line before this one, and
+        # this rank's (rank, seq) subsequence in the shared log — the
+        # thing chaos runs diff byte-for-byte — would invert.
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps(ev, sort_keys=True) + "\n")
+            except OSError:
+                pass
     return ev
 
 

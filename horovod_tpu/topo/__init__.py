@@ -30,14 +30,17 @@ from .compositor import (  # noqa: F401
     Plan,
     Stage,
     auto_reduce_fn,
+    candidate_plans,
     model_for_axes,
     lower_allgather,
     lower_allreduce,
     lower_alltoall,
     lower_broadcast,
     lower_reducescatter,
+    perm_rounds,
     planned_reduce_fn,
     record_plan,
     select_plan,
     split_fractions,
+    stage_kind,
 )

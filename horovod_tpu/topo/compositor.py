@@ -67,6 +67,64 @@ _HIER_REDUCE_OPS = (
     ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
 )
 
+# Stable stage metadata (consumed by analysis/plan_verify.py): the base
+# primitive kind behind each stage label. Suffixes encode the schedule
+# variant (``-ring`` / ``-halving`` / ``-doubling`` / ``-tree``) and, for
+# split mode, the bucket (``-b0`` / ``-b1``). ``local`` stages move no
+# bytes over any hop.
+STAGE_KINDS = {
+    "all_reduce": "allreduce",
+    "reduce_scatter": "reducescatter",
+    "all_gather": "allgather",
+    "broadcast": "broadcast",
+    "all_to_all": "alltoall",
+    "block_permute": "local",
+}
+
+
+def stage_kind(primitive: str) -> Tuple[str, str, Optional[int]]:
+    """Decompose a stage label into ``(kind, variant, bucket)``:
+    ``"reduce_scatter-ring-b1"`` -> ``("reducescatter", "ring", 1)``.
+    Unknown labels return kind ``"?"`` (the verifier rejects them)."""
+    name = primitive
+    bucket: Optional[int] = None
+    for b in (0, 1):
+        if name.endswith(f"-b{b}"):
+            name, bucket = name[: -3], b
+            break
+    variant = ""
+    for suffix in ("ring", "halving", "doubling", "tree"):
+        if name.endswith("-" + suffix):
+            name, variant = name[: -(len(suffix) + 1)], suffix
+            break
+    return STAGE_KINDS.get(name, "?"), variant, bucket
+
+
+def perm_rounds(primitive: str, size: int) -> Optional[List[List[Tuple[int, int]]]]:
+    """The explicit per-round ``ppermute`` schedule a ring/halving stage
+    stands for, as ``[[(src, dst), ...], ...]`` over ``range(size)`` —
+    the metadata the symbolic plan verifier checks for bijectivity and
+    round counts. Non-permute stages (XLA-native collectives, trees,
+    local relayouts) return None."""
+    _, variant, _ = stage_kind(primitive)
+    n = int(size)
+    if variant == "ring":
+        if n <= 1:
+            return []
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+        return [list(fwd) for _ in range(n - 1)]
+    if variant in ("halving", "doubling"):
+        if n <= 1:
+            return []
+        if n & (n - 1):
+            return [[(i, i) for i in range(n)]]  # caught as a bad round
+        k = n.bit_length() - 1
+        dists = [n >> (t + 1) for t in range(k)]
+        if variant == "doubling":
+            dists = list(reversed(dists))
+        return [[(i, i ^ d) for i in range(n)] for d in dists]
+    return None
+
 
 @dataclass(frozen=True)
 class Stage:
@@ -405,17 +463,34 @@ def _candidates_alltoall(model: InterconnectModel,
     return cands
 
 
-def select_plan(
+def _effective_model(model: InterconnectModel) -> InterconnectModel:
+    if model.eligible or model.levels <= 1:
+        return model
+    # Collapse to the flat view: hierarchy exists but is unsafe.
+    return InterconnectModel(
+        hops=(Hop(
+            name=_bottleneck(model).name,
+            axis="+".join(model.axes),
+            size=model.size,
+            bandwidth_gbps=_bottleneck(model).bandwidth_gbps,
+            latency_us=_bottleneck(model).latency_us,
+        ),),
+        generation=model.generation, eligible=False,
+        source=model.source,
+    )
+
+
+def candidate_plans(
     model: InterconnectModel,
     collective: str,
     nbytes: int,
     op: Any = ReduceOp.SUM,
-) -> Plan:
-    """Cost every candidate algorithm for ``collective`` at this payload
-    on this model and return the cheapest as a :class:`Plan`. An
-    ineligible model (ragged/interleaved layout, or a single hop) only
-    considers single-level algorithms — the "safe to go hierarchical"
-    gate from ``Topology.is_homogeneous``."""
+) -> Dict[str, Plan]:
+    """Every candidate lowering the compositor can emit for
+    ``collective`` at this payload on this model, as fully-formed costed
+    :class:`Plan` objects keyed by algorithm name. :func:`select_plan`
+    picks the cheapest of these; the symbolic plan verifier
+    (``analysis/plan_verify.py``) checks every one of them."""
     if collective not in COLLECTIVES:
         raise ValueError(
             f"unknown collective {collective!r}; one of {COLLECTIVES}"
@@ -426,20 +501,7 @@ def select_plan(
         op_enum = ReduceOp[op.upper()]
     if op_enum is None:
         op_enum = ReduceOp.SUM
-    eff = model
-    if not model.eligible and model.levels > 1:
-        # Collapse to the flat view: hierarchy exists but is unsafe.
-        eff = InterconnectModel(
-            hops=(Hop(
-                name=_bottleneck(model).name,
-                axis="+".join(model.axes),
-                size=model.size,
-                bandwidth_gbps=_bottleneck(model).bandwidth_gbps,
-                latency_us=_bottleneck(model).latency_us,
-            ),),
-            generation=model.generation, eligible=False,
-            source=model.source,
-        )
+    eff = _effective_model(model)
     if collective == "allreduce":
         cands = _candidates_allreduce(eff, nbytes, op_enum)
     elif collective == "allgather":
@@ -452,33 +514,53 @@ def select_plan(
         cands = _candidates_alltoall(eff, nbytes)
     if not cands:
         cands = {"flat": []}
-    best_name, best_stages, best_cost = None, None, None
-    for name in sorted(cands):  # deterministic tie-break
+    op_label = _op_name(
+        op_enum if collective in ("allreduce", "reducescatter") else None
+    )
+    plans: Dict[str, Plan] = {}
+    for name in sorted(cands):
         stages = cands[name]
         if name == "split":
             cost = _split_cost_us(eff, nbytes)
+            f0, _ = split_fractions(eff)
+            nb0 = int(nbytes * f0)
+            split_bytes: Tuple[int, ...] = (nb0, nbytes - nb0)
         else:
             cost = _plan_cost_us(
                 [s for s in stages if s.hop != "-"], eff
             )
-        if best_cost is None or cost < best_cost:
-            best_name, best_stages, best_cost = name, stages, cost
-    split_bytes: Tuple[int, ...] = ()
-    if best_name == "split":
-        f0, _ = split_fractions(eff)
-        nb0 = int(nbytes * f0)
-        split_bytes = (nb0, nbytes - nb0)
-    return Plan(
-        collective=collective,
-        op=_op_name(op_enum if collective in ("allreduce", "reducescatter")
-                    else None),
-        algorithm=best_name,
-        nbytes=nbytes,
-        hop_sizes=tuple(h.size for h in eff.hops),
-        stages=tuple(best_stages),
-        cost_us=float(best_cost),
-        split_bytes=split_bytes,
-    )
+            split_bytes = ()
+        plans[name] = Plan(
+            collective=collective,
+            op=op_label,
+            algorithm=name,
+            nbytes=nbytes,
+            hop_sizes=tuple(h.size for h in eff.hops),
+            stages=tuple(stages),
+            cost_us=float(cost),
+            split_bytes=split_bytes,
+        )
+    return plans
+
+
+def select_plan(
+    model: InterconnectModel,
+    collective: str,
+    nbytes: int,
+    op: Any = ReduceOp.SUM,
+) -> Plan:
+    """Cost every candidate algorithm for ``collective`` at this payload
+    on this model and return the cheapest as a :class:`Plan`. An
+    ineligible model (ragged/interleaved layout, or a single hop) only
+    considers single-level algorithms — the "safe to go hierarchical"
+    gate from ``Topology.is_homogeneous``."""
+    plans = candidate_plans(model, collective, nbytes, op)
+    best: Optional[Plan] = None
+    for name in sorted(plans):  # deterministic tie-break
+        plan = plans[name]
+        if best is None or plan.cost_us < best.cost_us:
+            best = plan
+    return best
 
 
 def _split_cost_us(model: InterconnectModel, nbytes: int) -> float:

@@ -407,9 +407,125 @@ def test_runtime_sources_are_clean():
 
 
 def test_runtime_discipline_covers_fixed_attributes():
-    rules = analysis.DEFAULT_DISCIPLINE["runtime.py"]["Runtime"]
+    rules = analysis.DEFAULT_DISCIPLINE["core/runtime.py"]["Runtime"]
     assert rules.attrs["_process_sets"].lock == "_state_lock"
     assert rules.attrs["joined"].lock == "_state_lock"
+
+
+def test_module_level_discipline_covers_new_packages():
+    """PR 8: the lock-discipline pass extends to the packages added
+    since PR 1 — module-global tap state and the metrics registry."""
+    disc = analysis.DEFAULT_DISCIPLINE
+    assert disc["fault/injector.py"][analysis.MODULE].attrs[
+        "_seq"].lock == "_lock"
+    assert disc["guard/__init__.py"][analysis.MODULE].attrs[
+        "TAP"].lock == "_lock"
+    assert disc["metrics/registry.py"]["Registry"].attrs[
+        "_metrics"].lock == "_lock"
+    assert "run/journal.py" in disc
+    # The topo planning layer is declared stateless (empty discipline).
+    assert disc["topo/compositor.py"] == {}
+
+
+def test_module_level_lint_flags_unguarded_global():
+    src = textwrap.dedent(
+        """
+        import threading
+        _lock = threading.Lock()
+        _table = {}
+        ACTIVE = False
+
+        def good(v):
+            global ACTIVE
+            with _lock:
+                _table["k"] = v
+                ACTIVE = True
+
+        def bad(v):
+            global ACTIVE
+            _table["k"] = v
+            ACTIVE = True
+
+        def local_shadow():
+            ACTIVE = True  # local binding, not the module global
+            return ACTIVE
+
+        def bad_mutator():
+            _table.clear()
+        """
+    )
+    rules = {analysis.MODULE: analysis.ClassRule(attrs={
+        "_table": analysis.AttrRule("_lock"),
+        "ACTIVE": analysis.AttrRule("_lock"),
+    })}
+    findings = analysis.lint_source(src, rules, "module_fixture.py")
+    flagged = {(f.details["method"], f.details["attribute"])
+               for f in findings}
+    assert flagged == {
+        ("bad", "_table"), ("bad", "ACTIVE"), ("bad_mutator", "_table"),
+    }
+
+
+def test_module_level_nested_def_does_not_inherit_lock():
+    src = textwrap.dedent(
+        """
+        def sneaky():
+            with _lock:
+                def later():
+                    _table.clear()
+                return later
+        """
+    )
+    rules = {analysis.MODULE: analysis.ClassRule(attrs={
+        "_table": analysis.AttrRule("_lock"),
+    })}
+    findings = analysis.lint_source(src, rules, "module_fixture.py")
+    assert [f.rule for f in findings] == [RULE_UNGUARDED]
+
+
+def test_fault_injector_event_log_order_under_contention(tmp_path):
+    """Regression for the race the extended pass surfaced: the event-log
+    file append used to run OUTSIDE the injector lock, so two threads
+    could invert this rank's (rank, seq) subsequence in the shared log —
+    the byte-determinism chaos runs diff. Hammer record_event from many
+    threads and assert the file's seq column is strictly increasing."""
+    import threading
+
+    from horovod_tpu.fault import injector
+    from horovod_tpu.fault.plan import FaultPlan
+
+    log = tmp_path / "events.jsonl"
+    injector.install_plan(FaultPlan(seed=1, actions=[]))
+    old = os.environ.get(injector.FAULT_EVENT_LOG_ENV)
+    os.environ[injector.FAULT_EVENT_LOG_ENV] = str(log)
+    try:
+        n_threads, n_events = 8, 40
+
+        def hammer(t):
+            for i in range(n_events):
+                injector.record_event("test-site", i + 1, "noop", f"t{t}")
+
+        threads = [
+            threading.Thread(target=hammer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        if old is None:
+            os.environ.pop(injector.FAULT_EVENT_LOG_ENV, None)
+        else:
+            os.environ[injector.FAULT_EVENT_LOG_ENV] = old
+        injector.reset()
+    seqs = [
+        json.loads(line)["seq"]
+        for line in log.read_text().splitlines() if line
+    ]
+    assert len(seqs) == n_threads * n_events
+    assert seqs == sorted(seqs), "event-log seq order inverted"
+    assert len(set(seqs)) == len(seqs)
 
 
 def _python_runtime():
@@ -502,8 +618,9 @@ def test_findings_json_is_stable():
 
 
 def test_cli_clean_on_shipped_code():
-    """Acceptance: zero findings on the shipped examples + runtime, exit
-    0, JSON shape stable, under the 60s CPU budget."""
+    """Acceptance: zero findings on the shipped examples + runtime +
+    plan grid + divergence variants + sharding table, exit 0, JSON shape
+    stable and versioned, under the 60s CPU budget."""
     start = time.monotonic()
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "collective_lint.py"),
@@ -516,7 +633,40 @@ def test_cli_clean_on_shipped_code():
     doc = json.loads(proc.stdout)
     assert doc["summary"]["total"] == 0
     assert doc["target"] == "all"
+    assert doc["schema_version"] == 2
+    assert doc["passes"] == [
+        "divergence", "examples", "plans", "runtime", "sharding"
+    ]
+    assert doc["plans_verified"] > 100
     assert elapsed < 60, f"lint took {elapsed:.1f}s (budget 60s)"
+
+
+def test_cli_json_stable_across_runs():
+    """The versioned JSON document is byte-identical across two runs of
+    the pure-python passes (the CI-diffing contract)."""
+    cmd = [sys.executable, os.path.join(REPO, "tools",
+                                        "collective_lint.py"),
+           "--json", "plans"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    o1 = subprocess.run(cmd, capture_output=True, cwd=REPO, env=env,
+                        timeout=120)
+    o2 = subprocess.run(cmd, capture_output=True, cwd=REPO, env=env,
+                        timeout=120)
+    assert o1.returncode == 0
+    assert o1.stdout == o2.stdout
+
+
+def test_cli_exit_codes_distinguish_crash_from_findings():
+    """Exit 2 = analyzer crash (bad usage / internal error), distinct
+    from exit 1 = findings and exit 0 = clean."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "collective_lint.py"),
+         "no-such-target"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2
 
 
 def test_cli_nonzero_exit_on_findings(tmp_path):
@@ -532,3 +682,59 @@ def test_cli_nonzero_exit_on_findings(tmp_path):
     ))
     findings = analysis.lint_runtime([str(bad)])
     assert [f.rule for f in findings] == [RULE_UNGUARDED]
+
+
+# ---------------------------------------------------------------------------
+# Call-site suppressions (PR 8)
+# ---------------------------------------------------------------------------
+
+def test_suppress_kwarg_filters_jaxpr_findings():
+    mesh = _mesh()
+    fn = _wrap(lambda x: lax.psum(x, "data"), mesh)
+    args = (jnp.ones((8, 4)),)
+    assert analysis.lint_step(fn, *args, mesh={"model": 8})
+    assert analysis.lint_step(
+        fn, *args, mesh={"model": 8}, suppress=["unknown-axis"]
+    ) == []
+    # A non-matching location glob keeps the finding.
+    assert analysis.lint_step(
+        fn, *args, mesh={"model": 8},
+        suppress=["unknown-axis@*elsewhere*"],
+    )
+    # A matching one removes it (locations are jaxpr:<path>/<prim>).
+    assert analysis.lint_step(
+        fn, *args, mesh={"model": 8},
+        suppress=["unknown-axis@jaxpr:*psum*"],
+    ) == []
+
+
+def test_suppressions_context_manager_is_scoped():
+    mesh = _mesh()
+    fn = _wrap(lambda x: lax.psum(x, "data"), mesh)
+    args = (jnp.ones((8, 4)),)
+    with analysis.suppressions("unknown-axis"):
+        assert analysis.lint_step(fn, *args, mesh={"model": 8}) == []
+        with analysis.suppressions("some-other-rule"):
+            # Nesting adds, never replaces.
+            assert analysis.lint_step(fn, *args, mesh={"model": 8}) == []
+    # Out of scope: the finding is back.
+    assert analysis.lint_step(fn, *args, mesh={"model": 8})
+
+
+def test_suppressions_apply_to_divergence_findings():
+    mesh = _mesh()
+
+    def divergent(x):
+        r = lax.axis_index("data")
+        return lax.cond(
+            r == 0, lambda v: lax.psum(v, "data"), lambda v: v, x
+        )
+
+    fn = _wrap(divergent, mesh, out_spec=P("data"))
+    args = (jnp.ones((8, 4)),)
+    assert analysis.analyze_step(fn, *args)
+    assert analysis.analyze_step(
+        fn, *args, suppress=["rank-divergent-collective"]
+    ) == []
+    with analysis.suppressions("rank-divergent-collective"):
+        assert analysis.lint_step(fn, *args, mesh=_mesh()) == []

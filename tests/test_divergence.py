@@ -1,0 +1,234 @@
+"""Pass 4 — SPMD rank-divergence analyzer tests
+(horovod_tpu/analysis/divergence.py).
+
+Acceptance matrix: a seeded rank-divergent collective (collective under
+``lax.cond`` on ``axis_index``) is flagged; the guard's psum agreement
+seam is recognized as the sanctioned convergence pattern; divergence
+over a disjoint mesh axis is allowed; all shipped ``make_train_step``
+variants (posthoc, overlap, hierarchical-auto, guard-skip) report zero
+findings.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvdj
+from horovod_tpu import analysis
+from horovod_tpu.analysis.findings import RULE_RANK_DIVERGENCE
+from horovod_tpu.jax import _shard_map
+from horovod_tpu.parallel.mesh import build_hierarchical_mesh, build_mesh
+
+
+def _mesh():
+    return build_mesh({"data": len(jax.devices())})
+
+
+def _wrap(body, mesh, out_spec=P("data")):
+    return _shard_map(
+        body, mesh, in_specs=(P("data"),), out_specs=out_spec
+    )
+
+
+# ---------------------------------------------------------------------------
+# Seeded divergence is flagged
+# ---------------------------------------------------------------------------
+
+def test_collective_under_rank_cond_flagged():
+    mesh = _mesh()
+
+    def bad(x):
+        r = lax.axis_index("data")
+        return lax.cond(
+            r == 0, lambda v: lax.psum(v, "data"), lambda v: v, x
+        )
+
+    fs = analysis.analyze_step(_wrap(bad, mesh), jnp.ones((8, 4)))
+    assert [f.rule for f in fs] == [RULE_RANK_DIVERGENCE]
+    assert fs[0].severity == "error"
+    assert "axis_index" in fs[0].message
+    assert fs[0].details["tainted_axes"] == ["data"]
+    assert "cond" in fs[0].details["guard"]
+
+
+def test_collective_under_rank_switch_flagged():
+    mesh = _mesh()
+
+    def bad(x):
+        r = lax.axis_index("data")
+        return lax.switch(
+            r % 2,
+            [lambda v: lax.psum(v, "data"), lambda v: v * 2],
+            x,
+        )
+
+    fs = analysis.analyze_step(_wrap(bad, mesh), jnp.ones((8, 4)))
+    assert [f.rule for f in fs] == [RULE_RANK_DIVERGENCE]
+
+
+def test_collective_under_rank_while_flagged():
+    mesh = _mesh()
+
+    def bad(x):
+        r = lax.axis_index("data")
+
+        def cond(c):
+            return c[0] < r
+
+        def body(c):
+            return (c[0] + 1, c[1] + lax.psum(c[1], "data"))
+
+        return lax.while_loop(cond, body, (0, x))[1]
+
+    fs = analysis.analyze_step(_wrap(bad, mesh), jnp.ones((8, 4)))
+    assert [f.rule for f in fs] == [RULE_RANK_DIVERGENCE]
+    assert fs[0].details["guard"] == "while"
+
+
+def test_laundered_taint_through_arithmetic_flagged():
+    """axis_index -> arithmetic -> predicate still taints the guard."""
+    mesh = _mesh()
+
+    def bad(x):
+        r = lax.axis_index("data")
+        derived = (r * 3 + 1) % 5
+        return lax.cond(
+            derived > 2, lambda v: lax.pmax(v, "data"), lambda v: v, x
+        )
+
+    fs = analysis.analyze_step(_wrap(bad, mesh), jnp.ones((8, 4)))
+    assert [f.rule for f in fs] == [RULE_RANK_DIVERGENCE]
+
+
+# ---------------------------------------------------------------------------
+# Sanctioned patterns stay clean
+# ---------------------------------------------------------------------------
+
+def test_psum_agreement_seam_is_sanctioned():
+    """The guard-skip pattern: the flag is psum-agreed before guarding —
+    every rank takes the same branch, no divergence."""
+    mesh = _mesh()
+
+    def good(x):
+        flag = (lax.axis_index("data") == 0).astype(jnp.float32)
+        agreed = lax.psum(flag, "data")
+        return lax.cond(
+            agreed > 0, lambda v: lax.psum(v, "data"), lambda v: v, x
+        )
+
+    assert analysis.analyze_step(_wrap(good, mesh),
+                                 jnp.ones((8, 4))) == []
+
+
+def test_collective_free_divergent_branch_allowed():
+    mesh = _mesh()
+
+    def masky(x):
+        r = lax.axis_index("data")
+        return lax.cond(r == 0, lambda v: v * 2, lambda v: v, x)
+
+    assert analysis.analyze_step(_wrap(masky, mesh),
+                                 jnp.ones((8, 4))) == []
+
+
+def test_disjoint_axis_divergence_allowed():
+    """A cross-rank divergent predicate guarding a collective over a
+    DIFFERENT axis is fine: every member of the collective's group
+    shares the predicate value."""
+    mesh = build_mesh({"cross": 2, "local": 4})
+
+    def fn(x):
+        r = lax.axis_index("cross")
+        return lax.cond(
+            r == 0,
+            lambda v: lax.psum(v, "local"),
+            lambda v: lax.pmax(v, "local"),
+            x,
+        )
+
+    step = _shard_map(fn, mesh, in_specs=(P("cross"),),
+                      out_specs=P("cross"))
+    assert analysis.analyze_step(step, jnp.ones((8, 4))) == []
+
+
+def test_fixed_trip_count_loop_allowed():
+    mesh = _mesh()
+
+    def ok(x):
+        def body(i, c):
+            return c + lax.psum(c, "data")
+
+        return lax.fori_loop(0, 3, body, x)
+
+    assert analysis.analyze_step(_wrap(ok, mesh), jnp.ones((8, 4))) == []
+
+
+def test_straight_line_axis_index_allowed():
+    """axis_index feeding data (ppermute/dynamic_slice) is the normal
+    SPMD idiom — only tainted *control flow* over a collective is
+    flagged."""
+    mesh = _mesh()
+
+    def ok(x):
+        r = lax.axis_index("data")
+        shifted = lax.ppermute(
+            x, "data", [(i, (i + 1) % 8) for i in range(8)]
+        )
+        return shifted + r.astype(x.dtype)
+
+    assert analysis.analyze_step(_wrap(ok, mesh), jnp.ones((8, 4))) == []
+
+
+# ---------------------------------------------------------------------------
+# lint_step integration + shipped variants
+# ---------------------------------------------------------------------------
+
+def test_lint_step_folds_divergence_in():
+    mesh = _mesh()
+
+    def bad(x):
+        r = lax.axis_index("data")
+        return lax.cond(
+            r == 0, lambda v: lax.psum(v, "data"), lambda v: v, x
+        )
+
+    fs = analysis.lint_step(_wrap(bad, mesh), jnp.ones((8, 4)), mesh=mesh)
+    assert RULE_RANK_DIVERGENCE in {f.rule for f in fs}
+    fs = analysis.lint_step(
+        _wrap(bad, mesh), jnp.ones((8, 4)), mesh=mesh, divergence=False
+    )
+    assert RULE_RANK_DIVERGENCE not in {f.rule for f in fs}
+
+
+@pytest.mark.parametrize(
+    "label,kwargs",
+    [
+        ("posthoc", {}),
+        ("overlap", {"overlap": True}),
+        ("hierarchical-auto", {"hierarchical": "auto"}),
+        ("guard-skip", {"nonfinite": "skip"}),
+    ],
+)
+def test_shipped_train_step_variants_are_clean(label, kwargs):
+    """Acceptance: zero rank-divergence findings on every shipped
+    make_train_step variant (the guard-skip variant exercises the psum
+    agreement seam end-to-end)."""
+    mesh = (
+        build_hierarchical_mesh(4)
+        if label == "hierarchical-auto" else _mesh()
+    )
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch @ p["w"] + p["b"]) ** 2)
+
+    params = {"w": jnp.ones((16, 4)), "b": jnp.zeros((4,))}
+    batch = jnp.ones((8, 16))
+    tx = optax.sgd(0.01)
+    step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, **kwargs
+    )
+    opt_state = tx.init(params)
+    assert analysis.analyze_step(step, params, opt_state, batch) == []

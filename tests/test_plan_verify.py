@@ -1,0 +1,229 @@
+"""Pass 3 — symbolic plan verifier tests (horovod_tpu/analysis/plan_verify.py).
+
+Property sweep: every candidate plan ``select_plan`` can emit across the
+topo-smoke topology grid verifies clean. Mutation tests: a corrupted
+schedule (dropped stage, non-bijective permute round, wrong bytes, wrong
+axis, wrong primitive, corrupted split buckets) is rejected with a
+finding naming the stage. No jax required anywhere in this file.
+"""
+
+import dataclasses
+
+import pytest
+
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.analysis import verify_plan, verify_plan_grid
+from horovod_tpu.analysis.findings import (
+    RULE_PLAN_BIJECTION,
+    RULE_PLAN_BYTES,
+    RULE_PLAN_RESULT,
+    RULE_PLAN_STAGE,
+)
+from horovod_tpu.analysis.plan_verify import (
+    DEFAULT_PAYLOADS,
+    DEFAULT_TOPOLOGIES,
+)
+from horovod_tpu.topo import (
+    COLLECTIVES,
+    candidate_plans,
+    perm_rounds,
+    select_plan,
+    stage_kind,
+    synthetic_model,
+)
+
+MODELS = [
+    (name, synthetic_model(generation="v5e", **sizes))
+    for name, sizes in DEFAULT_TOPOLOGIES
+]
+TWO_LEVEL = synthetic_model(local=4, cross=2, generation="v5e")
+THREE_LEVEL = synthetic_model(local=2, cross=2, pod=2, generation="v5e")
+
+
+# ---------------------------------------------------------------------------
+# Property sweep: the whole candidate grid is clean
+# ---------------------------------------------------------------------------
+
+def test_grid_verifies_clean():
+    findings, verified = verify_plan_grid()
+    assert findings == []
+    # Every topology contributes plans for every collective; a shrunken
+    # grid would mean the compositor stopped emitting candidates.
+    assert verified >= 4 * len(COLLECTIVES) * len(DEFAULT_PAYLOADS)
+
+
+@pytest.mark.parametrize("name,model", MODELS)
+@pytest.mark.parametrize("collective", COLLECTIVES)
+def test_every_candidate_plan_verifies(name, model, collective):
+    ops = (
+        (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
+         ReduceOp.PRODUCT)
+        if collective == "allreduce" else (ReduceOp.SUM,)
+    )
+    checked = 0
+    for op in ops:
+        for nbytes in (1024, 64 << 20):
+            for alg, plan in candidate_plans(
+                model, collective, nbytes, op=op
+            ).items():
+                fs = verify_plan(plan, model)
+                assert fs == [], (
+                    f"{name}/{collective}/{alg}/{op}/{nbytes}: "
+                    + "; ".join(f.render() for f in fs)
+                )
+                checked += 1
+    assert checked > 0
+
+
+def test_selected_plan_is_a_verified_candidate():
+    for _, model in MODELS:
+        plan = select_plan(model, "allreduce", 64 << 20)
+        cands = candidate_plans(model, "allreduce", 64 << 20)
+        assert plan.algorithm in cands
+        assert verify_plan(plan, model) == []
+
+
+def test_ineligible_model_collapses_and_verifies():
+    gated = synthetic_model(
+        local=4, cross=2, generation="v5e", eligible=False
+    )
+    plan = select_plan(gated, "allreduce", 64 << 20)
+    assert len(plan.hop_sizes) == 1  # collapsed to flat
+    assert verify_plan(plan, gated) == []
+
+
+# ---------------------------------------------------------------------------
+# Stage metadata (the topo/ side the verifier consumes)
+# ---------------------------------------------------------------------------
+
+def test_stage_kind_decomposition():
+    assert stage_kind("reduce_scatter-ring") == ("reducescatter", "ring",
+                                                 None)
+    assert stage_kind("all_gather-doubling") == ("allgather", "doubling",
+                                                 None)
+    assert stage_kind("reduce_scatter-b1") == ("reducescatter", "", 1)
+    assert stage_kind("all_reduce-b0") == ("allreduce", "", 0)
+    assert stage_kind("broadcast-tree") == ("broadcast", "tree", None)
+    assert stage_kind("block_permute") == ("local", "", None)
+    assert stage_kind("made_up")[0] == "?"
+
+
+def test_perm_rounds_ring_and_halving():
+    ring = perm_rounds("all_gather-ring", 4)
+    assert len(ring) == 3
+    assert ring[0] == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    hd = perm_rounds("reduce_scatter-halving", 8)
+    assert len(hd) == 3
+    for rnd in hd:
+        assert sorted(s for s, _ in rnd) == list(range(8))
+        assert sorted(d for _, d in rnd) == list(range(8))
+    assert perm_rounds("all_reduce", 4) is None  # XLA-native stage
+    assert perm_rounds("all_gather-ring", 1) == []
+
+
+# ---------------------------------------------------------------------------
+# Mutation tests: corrupted schedules are rejected, naming the stage
+# ---------------------------------------------------------------------------
+
+def _mutate(plan, i, **changes):
+    stages = list(plan.stages)
+    stages[i] = dataclasses.replace(stages[i], **changes)
+    return dataclasses.replace(plan, stages=tuple(stages))
+
+
+def test_dropped_stage_rejected():
+    plan = candidate_plans(TWO_LEVEL, "allreduce", 1 << 20)["two-level"]
+    mut = dataclasses.replace(plan, stages=plan.stages[:-1])
+    fs = verify_plan(mut, TWO_LEVEL)
+    assert RULE_PLAN_RESULT in {f.rule for f in fs}
+    assert any("allreduce/two-level" in f.location for f in fs)
+
+
+def test_dropped_stage_rejected_every_collective():
+    for collective in COLLECTIVES:
+        cands = candidate_plans(THREE_LEVEL, collective, 1 << 20)
+        multi = {a: p for a, p in cands.items() if len(p.stages) > 1}
+        assert multi, f"{collective}: no multi-stage candidate"
+        for alg, plan in multi.items():
+            mut = dataclasses.replace(plan, stages=plan.stages[:-1])
+            assert verify_plan(mut, THREE_LEVEL), (
+                f"{collective}/{alg}: dropped stage not caught"
+            )
+
+
+def test_wrong_bytes_rejected():
+    plan = candidate_plans(TWO_LEVEL, "allreduce", 1 << 20)["two-level"]
+    mut = _mutate(plan, 0,
+                  bytes_on_wire=plan.stages[0].bytes_on_wire * 2)
+    fs = verify_plan(mut, TWO_LEVEL)
+    assert [f.rule for f in fs] == [RULE_PLAN_BYTES]
+    assert fs[0].details["stage_index"] == 0
+    assert "stage[0]" in fs[0].location
+
+
+def test_wrong_axis_rejected():
+    plan = candidate_plans(TWO_LEVEL, "allgather", 1 << 20)["two-level"]
+    mut = _mutate(plan, 0, axis="bogus")
+    fs = verify_plan(mut, TWO_LEVEL)
+    assert fs and fs[0].rule == RULE_PLAN_STAGE
+    assert fs[0].details["primitive"] == plan.stages[0].primitive
+
+
+def test_wrong_primitive_rejected():
+    plan = candidate_plans(TWO_LEVEL, "allreduce", 1 << 20)["two-level"]
+    mut = _mutate(plan, 0, primitive="all_to_all")
+    fs = verify_plan(mut, TWO_LEVEL)
+    assert fs and fs[0].rule == RULE_PLAN_STAGE
+    mut = _mutate(plan, 0, primitive="frobnicate")
+    fs = verify_plan(mut, TWO_LEVEL)
+    assert fs and fs[0].rule == RULE_PLAN_STAGE
+
+
+def test_wrong_round_count_rejected():
+    flat8 = synthetic_model(local=8, generation="v5e")
+    plan = candidate_plans(flat8, "allreduce", 64 << 20)["ring"]
+    mut = _mutate(plan, 0, rounds=plan.stages[0].rounds + 3)
+    fs = verify_plan(mut, flat8)
+    assert any(f.rule == RULE_PLAN_STAGE for f in fs)
+
+
+def test_non_bijective_permute_round_rejected():
+    flat8 = synthetic_model(local=8, generation="v5e")
+    plan = candidate_plans(flat8, "allreduce", 64 << 20)["ring"]
+
+    def corrupt(primitive, size):
+        rounds = perm_rounds(primitive, size)
+        if rounds:
+            rounds = [list(r) for r in rounds]
+            rounds[0][0] = (0, rounds[0][1][1])  # duplicate destination
+        return rounds
+
+    fs = verify_plan(plan, flat8, rounds_fn=corrupt)
+    assert fs and fs[0].rule == RULE_PLAN_BIJECTION
+    assert "stage[0]" in fs[0].location
+    assert verify_plan(plan, flat8) == []  # pristine rounds stay clean
+
+
+def test_corrupt_split_buckets_rejected():
+    plan = candidate_plans(TWO_LEVEL, "allreduce", 64 << 20)["split"]
+    mut = dataclasses.replace(
+        plan, split_bytes=(plan.split_bytes[0] + 4096,
+                           plan.split_bytes[1]),
+    )
+    assert any(
+        f.rule == RULE_PLAN_RESULT for f in verify_plan(mut, TWO_LEVEL)
+    )
+
+
+def test_hop_size_mismatch_rejected():
+    plan = candidate_plans(TWO_LEVEL, "allreduce", 1 << 20)["two-level"]
+    other = synthetic_model(local=2, cross=4, generation="v5e")
+    fs = verify_plan(plan, other)
+    assert fs and fs[0].rule == RULE_PLAN_STAGE
+
+
+def test_empty_schedule_rejected_multi_rank():
+    plan = candidate_plans(TWO_LEVEL, "allreduce", 1 << 20)["two-level"]
+    mut = dataclasses.replace(plan, stages=())
+    fs = verify_plan(mut, TWO_LEVEL)
+    assert fs and fs[0].rule == RULE_PLAN_RESULT

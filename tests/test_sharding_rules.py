@@ -1,0 +1,212 @@
+"""Pass 5 — mesh/sharding-rule validator tests
+(horovod_tpu/analysis/sharding_rules.py).
+
+Acceptance matrix: a valid DP x TP rule table is accepted via both the
+API/preflight and the CLI; tables with unknown or duplicated mesh axes
+and non-divisible dims are rejected; unmatched params and sharded
+scalars are reported. The validator itself needs no jax, but jax's real
+PartitionSpec must duck-type through.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu import analysis
+from horovod_tpu.analysis import preflight
+from horovod_tpu.analysis.findings import (
+    RULE_SHARDING_BAD_RULE,
+    RULE_SHARDING_DUP_AXIS,
+    RULE_SHARDING_INDIVISIBLE,
+    RULE_SHARDING_SCALAR,
+    RULE_SHARDING_UNKNOWN_AXIS,
+    RULE_SHARDING_UNMATCHED,
+)
+from horovod_tpu.analysis.sharding_rules import (
+    EXAMPLE_GPT_MESH,
+    EXAMPLE_GPT_RULES,
+    example_gpt_params,
+    normalize_spec,
+    validate_sharding_rules,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MESH = {"data": 4, "model": 2}
+
+
+def _rules_of(fs):
+    return [f.rule for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# Spec normalization
+# ---------------------------------------------------------------------------
+
+def test_normalize_spec_shapes():
+    assert normalize_spec(None) == ()
+    assert normalize_spec("model") == (("model",),)
+    assert normalize_spec((None, "model")) == ((), ("model",))
+    assert normalize_spec((("data", "model"), None)) == (
+        ("data", "model"), (),
+    )
+    assert normalize_spec(42) is None
+    assert normalize_spec((1, 2)) is None
+
+
+def test_jax_partition_spec_duck_types():
+    from jax.sharding import PartitionSpec as P
+
+    assert normalize_spec(P(None, "model")) == ((), ("model",))
+    assert validate_sharding_rules(
+        [(r".*", P("data", "model"))], MESH, {"w": (8, 8)}
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: the valid DP x TP table
+# ---------------------------------------------------------------------------
+
+def test_valid_dp_tp_table_accepted():
+    fs = validate_sharding_rules(
+        EXAMPLE_GPT_RULES, EXAMPLE_GPT_MESH, example_gpt_params()
+    )
+    assert fs == []
+
+
+def test_valid_dp_tp_table_accepted_via_preflight():
+    fs = preflight.check_sharding_rules(
+        EXAMPLE_GPT_RULES, EXAMPLE_GPT_MESH, example_gpt_params()
+    )
+    assert fs == []
+
+
+def test_cli_sharding_target_accepts_reference_table():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "collective_lint.py"),
+         "--json", "sharding"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["summary"]["total"] == 0
+    assert doc["passes"] == ["sharding"]
+
+
+# ---------------------------------------------------------------------------
+# Rejections
+# ---------------------------------------------------------------------------
+
+def test_unknown_axis_rejected():
+    fs = validate_sharding_rules(
+        [(r".*kernel$", (None, "tensor")), (r".*", None)], MESH
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_UNKNOWN_AXIS]
+    assert fs[0].details["axis"] == "tensor"
+    assert fs[0].severity == "error"
+
+
+def test_duplicate_axis_across_dims_rejected():
+    fs = validate_sharding_rules([(r".*", ("model", "model"))], MESH)
+    assert _rules_of(fs) == [RULE_SHARDING_DUP_AXIS]
+    assert fs[0].details["dims"] == [0, 1]
+
+
+def test_duplicate_axis_within_dim_rejected():
+    fs = validate_sharding_rules(
+        [(r".*", (("model", "model"), None))], MESH
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_DUP_AXIS]
+
+
+def test_non_divisible_dim_rejected():
+    fs = validate_sharding_rules(
+        [(r".*", (None, "model")), ], {"data": 4, "model": 3},
+        {"w": (8, 10)},
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_INDIVISIBLE]
+    assert fs[0].details == {
+        "param": "w", "dim": 1, "size": 10, "factor": 3, "rule_index": 0,
+    }
+
+
+def test_multi_axis_product_divisibility():
+    # ("data","model") on dim 0 needs divisibility by 4*2=8.
+    fs = validate_sharding_rules(
+        [(r".*", (("data", "model"), None))], MESH, {"w": (12, 4)}
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_INDIVISIBLE]
+    assert validate_sharding_rules(
+        [(r".*", (("data", "model"), None))], MESH, {"w": (16, 4)}
+    ) == []
+
+
+def test_spec_longer_than_rank_rejected():
+    fs = validate_sharding_rules(
+        [(r".*", (None, None, "model"))], MESH, {"w": (8, 8)}
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_INDIVISIBLE]
+
+
+def test_unmatched_param_rejected():
+    fs = validate_sharding_rules(
+        [(r"^only_this$", None)], MESH, {"w": (8, 8)}
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_UNMATCHED]
+    # Scalars never need a rule (the engine replicates them).
+    assert validate_sharding_rules(
+        [(r"^only_this$", None)], MESH, {"step": ()}
+    ) == []
+
+
+def test_sharded_scalar_warned():
+    fs = validate_sharding_rules(
+        [(r".*", ("model",))], MESH, {"step": ()}
+    )
+    assert _rules_of(fs) == [RULE_SHARDING_SCALAR]
+    assert fs[0].severity == "warning"
+
+
+def test_bad_regex_and_bad_spec_rejected():
+    fs = validate_sharding_rules([(r"[unclosed", None)], MESH)
+    assert _rules_of(fs) == [RULE_SHARDING_BAD_RULE]
+    fs = validate_sharding_rules([(r".*", 42)], MESH)
+    assert _rules_of(fs) == [RULE_SHARDING_BAD_RULE]
+
+
+def test_first_match_wins_like_match_partition_rules():
+    """Rule order is the engine's contract (SNIPPETS.md shape): the
+    first matching rule decides, so a later conflicting rule must not
+    mask an earlier valid one."""
+    rules = [
+        (r"kernel$", (None, "model")),
+        (r".*", None),
+    ]
+    assert validate_sharding_rules(
+        rules, MESH, {"mlp/kernel": (8, 8)}
+    ) == []
+    # Swap the order: the catch-all replicates everything, so the
+    # (would-be indivisible) kernel rule never fires.
+    assert validate_sharding_rules(
+        list(reversed(rules)), MESH, {"mlp/kernel": (8, 9)}
+    ) == []
+
+
+def test_preflight_raises_on_errors():
+    with pytest.raises(analysis.CollectiveSafetyError):
+        preflight.check_sharding_rules(
+            [(r".*", (None, "tensor"))], MESH
+        )
+
+
+def test_suppressions_apply():
+    specs = [(r".*", (None, "tensor")), (r".*", None)]
+    assert validate_sharding_rules(
+        specs, MESH, suppress=["sharding-unknown-axis"]
+    ) == []
+    with analysis.suppressions("sharding-unknown-axis"):
+        assert validate_sharding_rules(specs, MESH) == []

@@ -1,10 +1,17 @@
 #!/usr/bin/env bash
 # CI gates: collective-safety static analysis + chaos smoke.
 #
-# Stage 1 (make lint-collectives): tools/collective_lint.py over the
-# example train steps (Pass 1) and the runtime sources' lock discipline
-# (Pass 2). Exits nonzero on any finding. Budget: under 60s on CPU — the
-# example steps are traced (make_jaxpr), never compiled or executed.
+# Stage 1 (make lint-collectives): tools/collective_lint.py over every
+# analyzer pass — Pass 1 (example train steps), Pass 2 (lock discipline
+# of the runtime + fault/guard/metrics/journal sources), Pass 3
+# (symbolic verification of the full compositor plan grid: every
+# candidate algorithm x every collective x 1/2/3-level topologies),
+# Pass 4 (SPMD rank-divergence over the shipped make_train_step
+# variants: posthoc/overlap/hierarchical-auto/guard-skip), and Pass 5
+# (the reference DP x TP sharding-rule table against its mesh). Exit 1 =
+# findings, exit 2 = analyzer crash. Budget: under 60s on CPU — the
+# example steps are traced (make_jaxpr), never compiled or executed, and
+# passes 3/5 are pure python.
 #
 # Stage 2 (make chaos-smoke; skip with HVD_CI_SKIP_CHAOS=1): the seeded
 # fault-injection smoke — one worker kill, one slow rank, one dropped

@@ -1,25 +1,35 @@
 #!/usr/bin/env python
 """Collective-safety static analyzer CLI.
 
-Runs the two analyzer passes from ``horovod_tpu.analysis``:
+Runs the analyzer passes from ``horovod_tpu.analysis``:
 
- - ``examples``: Pass 1 over the repo's canonical example train steps —
-   the compiled-mode steps the jax examples build (MNIST-CNN
+ - ``examples``: Pass 1 + Pass 4 over the repo's canonical example train
+   steps — the compiled-mode steps the jax examples build (MNIST-CNN
    ``make_train_step``, flat and hierarchical ``allreduce_gradients``,
    Adasum) traced on a virtual 8-device CPU mesh, plus a two-rank
    simulation of the eager MNIST gradient loop's submission order.
- - ``runtime``: Pass 2 (lock-discipline lint) over
-   ``core/runtime.py`` / ``core/native_runtime.py`` /
-   ``core/xla_executor.py``.
- - ``all``: both.
+ - ``runtime``: Pass 2 (lock-discipline lint) over the core runtime
+   sources and the fault/guard/metrics/journal/topo packages.
+ - ``plans``: Pass 3 — symbolic verification of every candidate lowering
+   plan the topology compositor can emit (all collectives x all
+   algorithms x the 1/2/3-level topology grid). Pure python, no jax.
+ - ``divergence``: Pass 4 over the shipped ``make_train_step`` variants
+   (post-hoc, overlap, hierarchical-auto, guard-skip) — the SPMD
+   rank-divergence analyzer must report zero findings on all of them.
+ - ``sharding``: Pass 5 — the reference DP x TP regex->PartitionSpec
+   rule table validated against its mesh and GPT-class param shapes.
+   Pure python, no jax.
+ - ``all``: every pass.
 
-Exit status is nonzero when any finding is reported. ``--json`` prints a
-stable machine-readable document (sorted findings, deterministic key
-order) for CI diffing. See docs/static_analysis.md.
+Exit status: 0 = clean, 1 = findings reported, 2 = the analyzer itself
+crashed (distinct so CI can tell a regression from a broken gate).
+``--json`` prints a stable machine-readable document
+(``schema_version`` 2: sorted findings, deterministic key order, pass
+inventory) for CI diffing. See docs/static_analysis.md.
 
 Usage:
   python tools/collective_lint.py [--json] [--threshold BYTES] \
-      {examples,runtime,all}
+      {examples,runtime,plans,divergence,sharding,all}
 """
 
 from __future__ import annotations
@@ -27,10 +37,20 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import traceback
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
+
+# JSON schema version: bump when the document layout (not the finding
+# list) changes shape. v1 = unversioned PR 1 document; v2 adds the
+# version field itself, the pass inventory, and the plans-verified count.
+SCHEMA_VERSION = 2
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_CRASH = 2
 
 # The example steps trace on a virtual 8-device CPU mesh (same harness as
 # tests/conftest.py). Must be set before jax initializes its backend.
@@ -43,7 +63,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def _lint_examples(threshold: int):
-    """Pass 1 over the example train steps."""
+    """Pass 1 (+ folded-in Pass 4) over the example train steps."""
     import numpy as np
 
     import jax
@@ -143,6 +163,79 @@ def _lint_runtime():
     return analysis.lint_runtime()
 
 
+def _lint_plans():
+    """Pass 3 over the full candidate-plan grid (no jax import)."""
+    from horovod_tpu.analysis.plan_verify import verify_plan_grid
+
+    findings, verified = verify_plan_grid()
+    for f in findings:
+        f.location = f"plans:{f.location}"
+    return findings, verified
+
+
+def _lint_divergence():
+    """Pass 4 over the shipped make_train_step variants: post-hoc,
+    overlap (streamed), hierarchical-auto (compositor-planned), and
+    guard-skip (psum agreement seam). All must be rank-divergence free;
+    the guard-skip variant is the sanctioned convergence pattern."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu import analysis
+    from horovod_tpu.parallel.mesh import (
+        build_hierarchical_mesh,
+        build_mesh,
+    )
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch @ p["w"] + p["b"]) ** 2)
+
+    params = {"w": jnp.ones((16, 4)), "b": jnp.zeros((4,))}
+    batch = jnp.ones((8, 16))
+    mesh = build_mesh({"data": 8})
+    hmesh = build_hierarchical_mesh(4)
+    variants = (
+        ("posthoc", mesh, {}),
+        ("overlap", mesh, {"overlap": True}),
+        ("hierarchical-auto", hmesh, {"hierarchical": "auto"}),
+        ("guard-skip", mesh, {"nonfinite": "skip"}),
+    )
+    findings = []
+    for label, m, kwargs in variants:
+        tx = optax.sgd(0.01)
+        step = hvdj.make_train_step(
+            loss_fn, tx, m, donate=False, **kwargs
+        )
+        opt_state = tx.init(params)
+        fs = analysis.analyze_step(step, params, opt_state, batch)
+        for f in fs:
+            f.location = f"divergence:{label}/{f.location}"
+        findings.extend(fs)
+    return findings
+
+
+def _lint_sharding():
+    """Pass 5 over the reference DP x TP rule table (no jax import)."""
+    from horovod_tpu.analysis.sharding_rules import (
+        EXAMPLE_GPT_MESH,
+        EXAMPLE_GPT_RULES,
+        example_gpt_params,
+        validate_sharding_rules,
+    )
+
+    findings = validate_sharding_rules(
+        EXAMPLE_GPT_RULES, EXAMPLE_GPT_MESH, example_gpt_params()
+    )
+    for f in findings:
+        f.location = f"sharding:{f.location}"
+    return findings
+
+
+TARGETS = ("examples", "runtime", "plans", "divergence", "sharding", "all")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="collective_lint",
@@ -150,13 +243,18 @@ def main(argv=None) -> int:
                     "(see docs/static_analysis.md)",
     )
     parser.add_argument(
-        "target", choices=("examples", "runtime", "all"),
-        help="examples = Pass 1 over example train steps; "
-             "runtime = Pass 2 over the runtime sources; all = both",
+        "target", choices=TARGETS,
+        help="examples = Pass 1+4 over example train steps; "
+             "runtime = Pass 2 over runtime sources; "
+             "plans = Pass 3 over the compositor plan grid; "
+             "divergence = Pass 4 over shipped train-step variants; "
+             "sharding = Pass 5 over the reference rule table; "
+             "all = everything",
     )
     parser.add_argument(
         "--json", action="store_true",
-        help="machine-readable output (stable key/finding order)",
+        help="machine-readable output (stable key/finding order, "
+             f"schema_version {SCHEMA_VERSION})",
     )
     parser.add_argument(
         "--threshold", type=int, default=64 * 1024 * 1024,
@@ -167,23 +265,60 @@ def main(argv=None) -> int:
     from horovod_tpu.analysis import findings_to_json, sort_findings
 
     findings = []
+    passes = []
+    plans_verified = 0
+    # Deterministic pass order — findings are sorted anyway, but the
+    # pass inventory (and therefore the JSON document) must not depend
+    # on which target ran first.
+    if args.target in ("plans", "all"):
+        fs, plans_verified = _lint_plans()
+        findings.extend(fs)
+        passes.append("plans")
+    if args.target in ("sharding", "all"):
+        findings.extend(_lint_sharding())
+        passes.append("sharding")
     if args.target in ("examples", "all"):
         findings.extend(_lint_examples(args.threshold))
+        passes.append("examples")
+    if args.target in ("divergence", "all"):
+        findings.extend(_lint_divergence())
+        passes.append("divergence")
     if args.target in ("runtime", "all"):
         findings.extend(_lint_runtime())
+        passes.append("runtime")
 
     findings = sort_findings(findings)
     if args.json:
-        print(findings_to_json(findings, target=args.target))
+        print(findings_to_json(
+            findings,
+            target=args.target,
+            schema_version=SCHEMA_VERSION,
+            passes=sorted(passes),
+            plans_verified=plans_verified,
+        ))
     else:
         for f in findings:
             print(f.render())
+        extra = (
+            f", {plans_verified} plans verified"
+            if "plans" in passes else ""
+        )
         print(
             f"collective_lint[{args.target}]: "
-            f"{len(findings)} finding(s)"
+            f"{len(findings)} finding(s){extra}"
         )
-    return 1 if findings else 0
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:  # noqa: BLE001 - crash != findings for CI
+        traceback.print_exc()
+        print(
+            "collective_lint: analyzer crashed (exit 2 — distinct from "
+            "exit 1, findings)", file=sys.stderr,
+        )
+        sys.exit(EXIT_CRASH)
