@@ -332,16 +332,19 @@ def collective_plan(
     collective: str = "allreduce",
     nbytes: int = 4 * 1024 * 1024,
     op: Optional[ReduceOp] = None,
+    wire_dtype: str = "f32",
 ) -> dict:
     """The topology compositor's selected lowering plan for one
     collective at one payload size on THIS deployment's interconnect
     model (docs/topology.md): algorithm (flat / ring / recursive-halving
     / two-level / split), per-hop bytes-on-wire, per-stage schedule, and
-    the analytic cost estimate. Uses the initialized runtime's topology
-    when available, else fresh detection; honors the
-    ``HOROVOD_TOPOLOGY_MODEL`` override. Pure cost-model output — no
-    backend is touched, so this also works pre-init (the offline twin is
-    ``tools/topo_plan.py``)."""
+    the analytic cost estimate. ``wire_dtype="int8"`` prices the
+    quantized wire (allreduce SUM/AVERAGE only): int8+scales bytes on
+    the compressed hop(s), full precision elsewhere. Uses the
+    initialized runtime's topology when available, else fresh detection;
+    honors the ``HOROVOD_TOPOLOGY_MODEL`` override. Pure cost-model
+    output — no backend is touched, so this also works pre-init (the
+    offline twin is ``tools/topo_plan.py``)."""
     from .topo import resolve_model, select_plan
 
     topo = (
@@ -352,6 +355,7 @@ def collective_plan(
     plan = select_plan(
         model, collective, int(nbytes),
         op=op if op is not None else ReduceOp.SUM,
+        wire_dtype=wire_dtype,
     )
     out = plan.to_dict()
     out["model"] = model.to_dict()

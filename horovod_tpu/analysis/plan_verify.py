@@ -36,6 +36,7 @@ from typing import (
     Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple,
 )
 
+from ..common.quant import WIRE_DTYPES, WIRE_F32, WIRE_INT8, int8_wire_bytes
 from ..common.types import ReduceOp
 from ..topo import compositor as _comp
 from ..topo.compositor import Plan, Stage, perm_rounds, stage_kind
@@ -253,15 +254,24 @@ class _PlanChecker:
         candidates = [expected]
         if allow_tree is not None:
             candidates.append(allow_tree)
+        if getattr(stage, "wire_dtype", WIRE_F32) == WIRE_INT8:
+            # Compressed stage: the symbolic state still moves the full-
+            # precision payload; what the wire carries is its int8+scales
+            # image. A stage claiming int8 with full-size bytes (or the
+            # converse — small bytes without the wire_dtype marker, which
+            # lands in the plain branch above) fails here.
+            candidates = [
+                Fraction(int8_wire_bytes(int(c))) for c in candidates
+            ]
         if any(abs(declared - c) <= self.byte_tol for c in candidates):
             return
         self._flag(
             RULE_PLAN_BYTES, i, stage,
             f"declares {declared} bytes on wire but the symbolic state "
-            f"implies {int(expected)}"
-            + (f" (or {int(allow_tree)} for a latency tree)"
+            f"implies {int(candidates[0])}"
+            + (f" (or {int(candidates[-1])} for a latency tree)"
                if allow_tree is not None else ""),
-            declared_bytes=declared, expected_bytes=int(expected),
+            declared_bytes=declared, expected_bytes=int(candidates[0]),
         )
 
     # -------------------------------------------------- reduction machine
@@ -582,6 +592,39 @@ class _PlanChecker:
                     f"unknown stage primitive {stage.primitive!r}",
                 )
                 return self.findings
+            wd = getattr(stage, "wire_dtype", WIRE_F32)
+            if wd not in WIRE_DTYPES:
+                self._flag(
+                    RULE_PLAN_STAGE, i, stage,
+                    f"unknown stage wire_dtype {wd!r}; one of "
+                    f"{WIRE_DTYPES}",
+                )
+                return self.findings
+            if wd == WIRE_INT8 and plan.op not in ("SUM", "AVERAGE"):
+                self._flag(
+                    RULE_PLAN_STAGE, i, stage,
+                    f"int8 wire on a {plan.op} schedule: per-hop "
+                    f"requantization accumulates in f32, which is only "
+                    f"sound for additive reductions",
+                )
+                return self.findings
+        if (
+            getattr(plan, "wire_dtype", WIRE_F32) == WIRE_INT8
+            and plan.stages
+            and not any(
+                getattr(s, "wire_dtype", WIRE_F32) == WIRE_INT8
+                for s in plan.stages
+            )
+        ):
+            # A plan CLAIMING compression must actually quantize
+            # somewhere — otherwise its advertised bytes-on-wire savings
+            # are fiction.
+            self._flag_final(
+                "plan declares wire_dtype=int8 but no stage carries the "
+                "int8 wire — compression claimed without a quantize "
+                "stage",
+            )
+            return self.findings
         if self.n > 1 and not plan.stages:
             self._flag_final(
                 f"empty schedule over {self.n} ranks cannot realize "
@@ -668,16 +711,31 @@ def verify_plan_grid(
         for collective in _comp.COLLECTIVES:
             op_list = ops if collective == "allreduce" else (ReduceOp.SUM,)
             for op in op_list:
-                for nbytes in payloads:
-                    cands = _comp.candidate_plans(
-                        model, collective, nbytes, op=op
-                    )
-                    for plan in cands.values():
-                        fs = verify_plan(plan, model, suppress=suppress)
-                        for f in fs:
-                            f.location = f"{topo_name}/{f.location}"
-                            f.details.setdefault("topology", topo_name)
-                            f.details.setdefault("op", str(op))
-                        findings.extend(fs)
-                        verified += 1
+                # Quantized (int8+scales) candidates exist for allreduce
+                # SUM/AVERAGE; sweep them alongside the f32 grid so a
+                # corrupted compressed-bytes declaration is a lint
+                # failure too.
+                wire_dtypes: Tuple[str, ...] = (WIRE_F32,)
+                if collective == "allreduce" and op in (
+                    ReduceOp.SUM, ReduceOp.AVERAGE
+                ):
+                    wire_dtypes = (WIRE_F32, WIRE_INT8)
+                for wire_dtype in wire_dtypes:
+                    for nbytes in payloads:
+                        cands = _comp.candidate_plans(
+                            model, collective, nbytes, op=op,
+                            wire_dtype=wire_dtype,
+                        )
+                        for plan in cands.values():
+                            fs = verify_plan(plan, model,
+                                             suppress=suppress)
+                            for f in fs:
+                                f.location = f"{topo_name}/{f.location}"
+                                f.details.setdefault("topology", topo_name)
+                                f.details.setdefault("op", str(op))
+                                f.details.setdefault(
+                                    "wire_dtype", wire_dtype
+                                )
+                            findings.extend(fs)
+                            verified += 1
     return findings, verified

@@ -122,6 +122,12 @@ HOROVOD_FAULT_DRIVER_BLACKOUT_S = "HOROVOD_FAULT_DRIVER_BLACKOUT_S"
 # (default) keeps plan selection advisory (metrics/introspection only).
 HOROVOD_TOPOLOGY_MODEL = "HOROVOD_TOPOLOGY_MODEL"
 HOROVOD_TOPOLOGY_PLAN = "HOROVOD_TOPOLOGY_PLAN"
+# Quantized wire compression (docs/overlap.md "Quantized wire
+# compression"): default for the compiled-mode ``quantized`` knob when
+# the call site leaves it unset — "1"/"true"/"int8" moves gradient
+# buckets over the int8+scales wire (flat: every hop; hierarchical:
+# DCN only), with the EF residual carried in optimizer state.
+HOROVOD_QUANTIZED_WIRE = "HOROVOD_QUANTIZED_WIRE"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64

@@ -1,0 +1,46 @@
+"""Int8 wire-format constants and byte accounting — NO jax import.
+
+The quantized ring allreduce (``ops/quantized.py``) and the topology
+compositor's planning layer (``topo/compositor.py``) must agree on one
+wire format: symmetric blockwise int8, one float32 scale per ``BLOCK``
+elements, scales packed behind the payload in the same buffer. The
+planning layer (and ``analysis/plan_verify.py``) runs with no backend at
+all, so the format constants and the bytes-on-wire arithmetic live here,
+jax-free, and both sides import them.
+"""
+
+from __future__ import annotations
+
+# Elements sharing one scale. Small enough that a low-magnitude gradient
+# leaf (layernorm/bias) packed into a fusion bucket next to a large-
+# magnitude one keeps its own scales instead of rounding to zero against
+# the bucket's global amax; 4 scale bytes per 256 payload bytes = 1.6%
+# wire overhead.
+BLOCK = 256
+
+# Each scale is one float32.
+SCALE_BYTES = 4
+
+# Wire dtype labels used by compositor plans and the plan verifier.
+WIRE_F32 = "f32"
+WIRE_INT8 = "int8"
+WIRE_DTYPES = (WIRE_F32, WIRE_INT8)
+
+
+def int8_wire_bytes(nbytes: int, dtype_bytes: int = 4) -> int:
+    """Bytes a stage that declared ``nbytes`` of full-precision traffic
+    actually moves with the int8+scales format: one byte per element
+    plus one f32 scale per BLOCK elements. ``dtype_bytes`` is the
+    payload's full-precision element width (plans price f32)."""
+    nbytes = max(int(nbytes), 0)
+    if nbytes == 0:
+        return 0
+    elems = -(-nbytes // int(dtype_bytes))  # ceil
+    blocks = -(-elems // BLOCK)
+    return elems + SCALE_BYTES * blocks
+
+
+def int8_saved_bytes(nbytes: int, dtype_bytes: int = 4) -> int:
+    """Full-precision bytes minus the int8 wire bytes (>= 0 for any
+    dtype wider than 1 byte)."""
+    return max(int(nbytes) - int8_wire_bytes(nbytes, dtype_bytes), 0)

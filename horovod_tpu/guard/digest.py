@@ -25,6 +25,30 @@ import pickle
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
+def strip_rank_local(tree: Any) -> Any:
+    """Drop tracked-but-RANK-LOCAL subtrees before digesting: the
+    error-feedback residual of the quantized wire
+    (``ops/quantized.EFState.residual``) legitimately differs across
+    ranks — each rank compensates its own quantizer — so hashing it
+    would make every digest check a false mismatch. The residual is
+    still elastic state (snapshots/sync carry it); only the CROSS-RANK
+    agreement ignores it. Everything under ``EFState.inner`` stays
+    digest-tracked."""
+    import jax
+
+    from ..ops.quantized import EFState
+
+    def is_ef(node):
+        return isinstance(node, EFState)
+
+    def strip(node):
+        if isinstance(node, EFState):
+            return {"inner": strip_rank_local(node.inner)}
+        return node
+
+    return jax.tree.map(strip, tree, is_leaf=is_ef)
+
+
 def tree_digest(tree: Any, _h=None) -> str:
     """SHA-256 hex digest of an array-leaf pytree (dtype + shape + raw
     bytes per leaf, in pytree order)."""
@@ -55,7 +79,7 @@ def state_digest(state: Any, tracked: Optional[Sequence[str]] = None) -> str:
                 else getattr(state, "_tracked", []))
     h = hashlib.sha256()
     for k in sorted(keys):
-        v = getattr(state, k, None)
+        v = strip_rank_local(getattr(state, k, None))
         h.update(k.encode())
         leaves = jax.tree.leaves(v)
         if leaves and all(hasattr(l, "shape") and hasattr(l, "dtype")

@@ -36,7 +36,9 @@ from ..guard import nonfinite as _nf
 from ..guard import resolve_policy as _resolve_nonfinite
 from ..ops import collectives as _c
 from ..ops import fusion as _fusion
+from ..ops import quantized as _q
 from ..ops.adasum import adasum_reduce_fn
+from ..ops.quantized import EFState, ef_like
 from ..parallel.mesh import (
     CROSS_AXIS,
     DATA_AXIS,
@@ -106,13 +108,40 @@ stream_param_groups = _fusion.stream_param_groups
 
 def collective_plan(collective: str = "allreduce",
                     nbytes: int = 4 * 1024 * 1024,
-                    op: Optional[ReduceOp] = None) -> dict:
+                    op: Optional[ReduceOp] = None,
+                    wire_dtype: str = "f32") -> dict:
     """Compiled-mode alias of :func:`horovod_tpu.collective_plan` —
     the topology compositor's selected plan for one collective at one
-    payload size (docs/topology.md)."""
+    payload size (docs/topology.md). ``wire_dtype="int8"`` prices the
+    int8+scales wire format (allreduce SUM/AVERAGE only): compressed
+    bytes on the slow hop(s), full precision over ICI."""
     from .. import collective_plan as _cp
 
-    return _cp(collective, nbytes, op)
+    return _cp(collective, nbytes, op, wire_dtype=wire_dtype)
+
+
+def _resolve_quantized(quantized: Optional[bool]) -> bool:
+    """Resolve the int8-wire knob: explicit argument >
+    ``HOROVOD_QUANTIZED_WIRE`` env (1/true/int8 = on) > off."""
+    if quantized is not None:
+        return bool(quantized)
+    import os
+
+    from ..common import env as _env
+
+    raw = os.environ.get(_env.HOROVOD_QUANTIZED_WIRE, "").strip().lower()
+    return raw in ("1", "true", "yes", "on", "int8")
+
+
+def error_feedback_state(opt_state: Any, params: Any) -> EFState:
+    """Wrap an inner optimizer state with a zero error-feedback residual
+    — the opt_state shape ``make_train_step(quantized=True)`` threads.
+    Passing a plain opt_state into such a step also works (the residual
+    is materialized as zeros on the first call and the step returns an
+    :class:`EFState` from then on); this helper makes the structure
+    explicit up front, e.g. for ``lax.scan`` carries that need a stable
+    shape."""
+    return EFState(inner=opt_state, residual=ef_like(params))
 
 
 def _resolve_hierarchical(hierarchical, mesh: Optional[Mesh] = None):
@@ -145,13 +174,20 @@ def _resolve_hierarchical(hierarchical, mesh: Optional[Mesh] = None):
     return bool(hierarchical), None
 
 
-def _select_reduce_fn(op: ReduceOp, hierarchical):
+def _select_reduce_fn(op: ReduceOp, hierarchical, quantized: bool = False):
     if op == ReduceOp.ADASUM:
         return adasum_reduce_fn
     if hierarchical == "planned":
         from ..topo import compositor as _compositor
 
-        return _compositor.auto_reduce_fn()
+        return _compositor.auto_reduce_fn(quantized=quantized)
+    if quantized:
+        # Flat: every hop int8 (the EQuARX ring). Hierarchical: int8 on
+        # the outermost (DCN) hop only — reduce-scatter/all-gather stay
+        # full precision over ICI (docs/topology.md).
+        return _q.quantized_reduce_fn(
+            "two-level" if hierarchical else "flat"
+        )
     if hierarchical:
         # axis_name must be the (cross, local) tuple: reduce-scatter rides
         # ICI (local), the shard psum rides DCN (cross).
@@ -193,7 +229,7 @@ def allreduce_gradients(
     fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
     hierarchical: Any = False,
-    quantized: bool = False,
+    quantized: Optional[bool] = None,
     nonfinite: Optional[str] = None,
 ) -> Any:
     """Fusion-bucketed allreduce of a gradient pytree (in-jit).
@@ -201,9 +237,13 @@ def allreduce_gradients(
     The compiled-mode equivalent of the reference's per-gradient
     ``hvd.allreduce`` + background fusion: same-dtype leaves are concatenated
     into buckets up to the fusion threshold and each bucket becomes one XLA
-    collective (see ops/fusion.py). ``quantized=True`` moves each bucket
-    through the int8-wire ring allreduce (``ops/quantized.py``, ~1%
-    gradient noise at 8 ranks) instead of a full-precision ``psum``.
+    collective (see ops/fusion.py). ``quantized=True`` (None reads
+    ``HOROVOD_QUANTIZED_WIRE``) moves each float bucket through the
+    int8-wire ring allreduce (``ops/quantized.py``, ~1% gradient noise at
+    8 ranks) instead of a full-precision ``psum``; composed with
+    ``hierarchical`` the wire compresses ONLY the outermost (DCN) hop —
+    reduce-scatter/all-gather stay full precision over ICI. SUM/AVERAGE
+    only; integer buckets always reduce exactly.
     ``fusion_threshold_bytes=None`` resolves HOROVOD_FUSION_THRESHOLD
     (64 MB default, reference parity).
 
@@ -217,6 +257,7 @@ def allreduce_gradients(
     fusion_threshold_bytes = _fusion.default_threshold_bytes(
         fusion_threshold_bytes
     )
+    quantized = _resolve_quantized(quantized)
     if hierarchical == "auto":
         hierarchical, _ = _resolve_hierarchical(hierarchical)
     axis_name = _normalize_axis(axis_name, hierarchical)
@@ -233,9 +274,9 @@ def allreduce_gradients(
             grads, fusion_threshold_bytes, axis_name
         )
     if quantized:
-        if hierarchical or op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
             raise ValueError(
-                "quantized=True supports flat SUM/AVERAGE reduction only"
+                "quantized=True supports SUM/AVERAGE reduction only"
             )
         if compression is not Compression.none:
             raise ValueError(
@@ -243,36 +284,7 @@ def allreduce_gradients(
                 "stacking cast compression would add loss for no "
                 "bandwidth win"
             )
-
-        def _quantized_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
-                                 postscale_factor=1.0):
-            from ..ops.quantized import quantized_ring_allreduce
-
-            if not jnp.issubdtype(x.dtype, jnp.floating):
-                # Integer buckets reduce exactly: a float32/int8 round
-                # trip would silently corrupt exact sums. Buckets are
-                # same-dtype (fusion groups by dtype), so per-bucket
-                # dispatch loses nothing. Preserve the leaf dtype like
-                # the quantized path does (AVERAGE's true-division
-                # promotes to float; truncate back).
-                out = _select_reduce_fn(op, False)(
-                    x, op=op, axis_name=axis_name,
-                    prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor,
-                )
-                return out.astype(x.dtype)
-            if prescale_factor != 1.0:
-                x = x * prescale_factor
-            out = quantized_ring_allreduce(
-                x, axis_name=axis_name, average=(op == ReduceOp.AVERAGE)
-            )
-            if postscale_factor != 1.0:
-                out = out * postscale_factor
-            return out
-
-        reduce_fn = _quantized_reduce_fn
-    else:
-        reduce_fn = _select_reduce_fn(op, hierarchical)
+    reduce_fn = _select_reduce_fn(op, hierarchical, quantized)
     if compression is not Compression.none:
         leaves, treedef = jax.tree.flatten(grads)
         compressed = [compression.compress(l) for l in leaves]
@@ -297,19 +309,41 @@ def allreduce_gradients(
 
 
 def _check_overlap_rejections(overlap: bool, quantized: bool, op: ReduceOp):
+    if quantized and op not in _fusion._QUANTIZABLE_OPS:
+        raise ValueError(
+            f"quantized=True supports {_fusion._QUANTIZABLE_OPS}; got {op} "
+            "(per-hop int8 requantization accumulates in f32, which is "
+            "only sound for additive reductions)"
+        )
     if not overlap:
         return
-    if quantized:
-        raise ValueError(
-            "overlap=True streams full-precision bucket psums inside the "
-            "backward; the quantized int8 ring allreduce dithers per bucket "
-            "and runs post-hoc only — pick one"
-        )
     if op not in _fusion._STREAMABLE_OPS:
         raise ValueError(
             f"overlap=True supports elementwise reduce ops "
             f"{_fusion._STREAMABLE_OPS}; got {op}"
         )
+
+
+def _resolve_error_feedback(error_feedback: Optional[bool],
+                            quantized: bool, hierarchical) -> bool:
+    """EF defaults ON for the flat int8 wire (where every byte is
+    compressed and the residual compensates this rank's quantizer) and
+    OFF for hierarchical/planned DCN-only compression (the quantizer
+    sees post-local-reduction shards no per-rank residual can
+    attribute); forcing it on there is an error, not a silent noop."""
+    if not quantized:
+        if error_feedback:
+            raise ValueError("error_feedback=True requires quantized=True")
+        return False
+    if hierarchical:
+        if error_feedback:
+            raise ValueError(
+                "error feedback compensates the flat int8 ring; the "
+                "hierarchical DCN-only wire has no per-rank quantizer "
+                "to compensate — leave error_feedback unset"
+            )
+        return False
+    return True if error_feedback is None else bool(error_feedback)
 
 
 def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimizer
@@ -320,7 +354,8 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
     hierarchical: Any = False,
-    quantized: bool = False,
+    quantized: Optional[bool] = None,
+    error_feedback: Optional[bool] = None,
     backward_passes_per_step: int = 1,
     overlap: bool = False,
     nonfinite: Optional[str] = None,
@@ -353,10 +388,24 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     cannot raise usefully from inside a trace) and is surfaced as a
     raised ``HorovodInternalError`` by ``make_train_step`` — see
     docs/fault_tolerance.md "Data-plane integrity".
+
+    ``quantized=True`` (None reads ``HOROVOD_QUANTIZED_WIRE``) moves the
+    gradient buckets over the int8 wire; on the flat (non-hierarchical)
+    path it carries an error-feedback residual in the optimizer state by
+    default (``error_feedback``, EF-SGD: the quantization error is added
+    back into the next step's gradient before quantization, preserving
+    convergence). The wrapped state is then
+    ``EFState(inner=<inner opt state>, residual=<f32 grads-like>)`` —
+    ``tx.init(params)`` builds it, checkpoints carry it, and the guard's
+    digest agreement excludes the rank-local residual. Under
+    ``overlap=True`` the streamed registration owns the residual
+    (``make_train_step`` threads it); this wrapper then leaves EF to the
+    streamed path.
     """
     import jax.numpy as jnp
     import optax
 
+    quantized = _resolve_quantized(quantized)
     _check_overlap_rejections(overlap, quantized, op)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
     # "auto" without a mesh in hand: the detected process topology's
@@ -364,12 +413,32 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     # caller traces under must then carry the (cross, local) axes.
     hierarchical, _ = _resolve_hierarchical(hierarchical)
     norm_axis = _normalize_axis(axis_name, hierarchical)
+    # Under overlap the residual lives with the streamed registration
+    # (the backward rule computes it); the optimizer cannot see it.
+    use_ef = _resolve_error_feedback(
+        error_feedback, quantized, hierarchical
+    ) and not overlap
+    if quantized and compression is not Compression.none:
+        raise ValueError(
+            "quantized=True already compresses the wire to int8; "
+            "stacking cast compression would add loss for no bandwidth win"
+        )
 
     def init_fn(params):
+        if use_ef:
+            return EFState(
+                inner=optimizer.init(params), residual=ef_like(params)
+            )
         return optimizer.init(params)
 
     def update_fn(grads, state, params=None, **extra):
         prescale = 1.0 / backward_passes_per_step if backward_passes_per_step > 1 else 1.0
+        ef = None
+        if use_ef:
+            if isinstance(state, EFState):
+                state, ef = state.inner, state.residual
+            else:
+                ef = ef_like(grads)
         do_reduce = True
         if overlap:
             reg = _fusion.take_stream_registrations()
@@ -394,7 +463,25 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             # Pre-reduce local detection: catches a bad local gradient
             # even under MIN/MAX reductions, where NaN may not propagate.
             flag = _nf.local_flag(grads)
-        if do_reduce:
+        new_ef = ef
+        if do_reduce and ef is not None:
+            # Error-feedback path: sentinel BEFORE the quantizer (a NaN
+            # would poison its block's scale), then reduce g + e over
+            # the int8 wire and carry the fresh residual.
+            if nonfinite_policy == "zero":
+                grads = _nf.sanitize(grads)
+            reduced, new_ef = _fusion.quantized_ef_allreduce(
+                grads, ef,
+                op=op,
+                axis_name=norm_axis,
+                threshold_bytes=fusion_threshold_bytes,
+                label="posthoc-ef",
+            )
+            if nonfinite_policy == "warn":
+                _nf.note_detection("warn", "reduce")(
+                    _nf.local_flag(reduced)
+                )
+        elif do_reduce:
             reduced = allreduce_gradients(
                 grads,
                 op=op,
@@ -433,6 +520,12 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
                 flag, jax.tree.map(jnp.zeros_like, updates), updates
             )
             new_state = _nf.select_on_flag(flag, state, new_state)
+        if use_ef:
+            if flag is not None:
+                # A skipped step discards the gradient, so the residual
+                # computed from it must not carry either.
+                new_ef = _nf.select_on_flag(flag, ef, new_ef)
+            new_state = EFState(inner=new_state, residual=new_ef)
         return updates, new_state
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -465,7 +558,8 @@ def make_train_step(
     fusion_threshold_bytes: Optional[int] = None,
     compression=Compression.none,
     hierarchical: Any = False,
-    quantized: bool = False,
+    quantized: Optional[bool] = None,
+    error_feedback: Optional[bool] = None,
     donate: bool = True,
     has_aux: bool = False,
     overlap: bool = False,
@@ -490,8 +584,19 @@ def make_train_step(
     group's psums are issued INSIDE the backward pass as soon as that
     group's gradients exist — independent collectives XLA can overlap with
     the remaining backward compute. Numerically identical to
-    ``overlap=False`` (elementwise reductions commute with the split);
-    ``quantized=True`` is rejected.
+    ``overlap=False`` (elementwise reductions commute with the split).
+
+    ``quantized=True`` (None reads ``HOROVOD_QUANTIZED_WIRE``) moves each
+    gradient bucket over the int8 wire (``ops/quantized.py``) — composed
+    with ``overlap=True`` the quantize→ring-reduce→dequantize runs inside
+    the backward trace per streamed bucket, preserving the
+    scheduler-overlap property; composed with ``hierarchical`` only the
+    outermost (DCN) hop is compressed. On the flat wire an error-feedback
+    residual (``error_feedback``, default on; EF-SGD) rides the optimizer
+    state: the step accepts a plain ``optimizer.init(params)`` opt_state
+    and returns ``EFState(inner=..., residual=...)`` from the first call
+    on (or start from :func:`error_feedback_state` for a stable
+    structure, e.g. under ``lax.scan``).
 
     ``nonfinite`` (None reads ``HOROVOD_GUARD_NONFINITE``, resolved when
     the step is built) applies the non-finite gradient guard around the
@@ -506,7 +611,13 @@ def make_train_step(
     import jax.numpy as jnp
     import optax
 
+    quantized = _resolve_quantized(quantized)
     _check_overlap_rejections(overlap, quantized, op)
+    if quantized and compression is not Compression.none:
+        raise ValueError(
+            "quantized=True already compresses the wire to int8; "
+            "stacking cast compression would add loss for no bandwidth win"
+        )
     # "auto": the mesh decides — a (pod,) cross, local hierarchy engages
     # per-bucket compositor plan selection (flat/two-level/split by
     # payload bytes, docs/topology.md); a flat data mesh stays flat. This
@@ -517,9 +628,41 @@ def make_train_step(
         axis_name = hier_axes
     axis_name = _normalize_axis(axis_name, hierarchical)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
+    use_ef = _resolve_error_feedback(error_feedback, quantized, hierarchical)
 
     def step(params, opt_state, batch):
-        if overlap:
+        # EF residual rides the opt_state as EFState(inner, residual);
+        # a plain opt_state (first step, old checkpoint) materializes a
+        # zero residual and the step returns EFState from then on.
+        ef = None
+        if use_ef:
+            if isinstance(opt_state, EFState):
+                opt_state, ef = opt_state.inner, opt_state.residual
+            else:
+                ef = ef_like(params)
+        if overlap and use_ef:
+            def streamed_loss_ef(p, e, b):
+                p = _fusion.stream_param_groups(
+                    p,
+                    op=op,
+                    axis_name=axis_name,
+                    threshold_bytes=fusion_threshold_bytes,
+                    first_bucket_bytes=first_bucket_bytes,
+                    hierarchical=hierarchical,
+                    compression=compression,
+                    quantized=True,
+                    ef=e,
+                    nonfinite=nonfinite_policy,
+                )
+                return loss_fn(p, b)
+
+            # Differentiating w.r.t. the residual is the EF side
+            # channel: the streamed backward rule returns the NEXT
+            # residual as ef's "gradient" (ops/fusion.py).
+            grad_fn = jax.value_and_grad(
+                streamed_loss_ef, argnums=(0, 1), has_aux=has_aux
+            )
+        elif overlap:
             def streamed_loss(p, b):
                 p = _fusion.stream_param_groups(
                     p,
@@ -529,6 +672,7 @@ def make_train_step(
                     first_bucket_bytes=first_bucket_bytes,
                     hierarchical=hierarchical,
                     compression=compression,
+                    quantized=quantized,
                     nonfinite=nonfinite_policy,
                 )
                 return loss_fn(p, b)
@@ -536,7 +680,14 @@ def make_train_step(
             grad_fn = jax.value_and_grad(streamed_loss, has_aux=has_aux)
         else:
             grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
-        if has_aux:
+        new_ef = ef
+        if overlap and use_ef:
+            if has_aux:
+                (loss, aux), (grads, new_ef) = grad_fn(params, ef, batch)
+            else:
+                loss, (grads, new_ef) = grad_fn(params, ef, batch)
+                aux = None
+        elif has_aux:
             (loss, aux), grads = grad_fn(params, batch)
         else:
             loss, grads = grad_fn(params, batch)
@@ -547,16 +698,34 @@ def make_train_step(
                 # Pre-reduce local detection (robust under MIN/MAX, where
                 # NaN may not propagate through the reduction).
                 flag = _nf.local_flag(grads)
-            grads = allreduce_gradients(
-                grads,
-                op=op,
-                axis_name=axis_name,
-                fusion_threshold_bytes=fusion_threshold_bytes,
-                compression=compression,
-                hierarchical=hierarchical,
-                quantized=quantized,
-                nonfinite=nonfinite_policy,
-            )
+            if use_ef:
+                # Sentinel BEFORE the quantizer (a NaN would poison its
+                # block's scale), then reduce g + e over the int8 wire
+                # and carry the fresh residual.
+                if nonfinite_policy == "zero":
+                    grads = _nf.sanitize(grads)
+                grads, new_ef = _fusion.quantized_ef_allreduce(
+                    grads, ef,
+                    op=op,
+                    axis_name=axis_name,
+                    threshold_bytes=fusion_threshold_bytes,
+                    label="posthoc-ef",
+                )
+                if nonfinite_policy == "warn":
+                    _nf.note_detection("warn", "reduce")(
+                        _nf.local_flag(grads)
+                    )
+            else:
+                grads = allreduce_gradients(
+                    grads,
+                    op=op,
+                    axis_name=axis_name,
+                    fusion_threshold_bytes=fusion_threshold_bytes,
+                    compression=compression,
+                    hierarchical=hierarchical,
+                    quantized=quantized,
+                    nonfinite=nonfinite_policy,
+                )
         else:
             # Streamed: grads left value_and_grad already reduced (the
             # custom_vjp backward rules issued the bucket psums); consume
@@ -586,6 +755,12 @@ def make_train_step(
             new_opt_state = _nf.select_on_flag(
                 flag, opt_state, new_opt_state
             )
+        if use_ef:
+            if flag is not None:
+                # A skipped step discards the gradient, so the residual
+                # computed from it must not carry either.
+                new_ef = _nf.select_on_flag(flag, ef, new_ef)
+            new_opt_state = EFState(inner=new_opt_state, residual=new_ef)
         outs = [new_params, new_opt_state, loss]
         if has_aux:
             aux = jax.tree.map(lambda a: lax.pmean(a, axis_name), aux)

@@ -199,11 +199,19 @@ def fused_allreduce(
 
 # Ops a streamed reduction may use: per-group reduction must equal the
 # whole-tree reduction, which holds exactly for elementwise reductions.
-# ADASUM normalizes per bucket (bucket plans differ between the paths) and
-# the quantized int8 ring dithers per bucket — both stay post-hoc-only.
+# ADASUM normalizes per bucket (bucket plans differ between the paths)
+# and stays post-hoc-only. The quantized int8 ring dithers per bucket —
+# streamed-quantized equals post-hoc-quantized exactly when the bucket
+# plans coincide (per-leaf buckets make it bitwise; docs/overlap.md
+# "Quantized wire compression"), and its elementwise SUM/AVERAGE still
+# commutes with the group split, so it streams too.
 _STREAMABLE_OPS = (
     ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX,
 )
+
+# Ops the int8 wire supports: per-hop requantization accumulates in f32,
+# which is only sound for additive reductions.
+_QUANTIZABLE_OPS = (ReduceOp.SUM, ReduceOp.AVERAGE)
 
 
 @dataclass(frozen=True)
@@ -222,6 +230,12 @@ class StreamConfig:
     # "planned" in the public entry points.
     planned: bool = False
     compression: Any = None  # a common.compression.Compressor class or None
+    # Int8 wire (ops/quantized.py): each bucket runs quantize -> ring
+    # reduce -> dequantize inside the backward trace. Flat mode moves
+    # every hop int8; hierarchical/planned modes compress ONLY the
+    # outermost (DCN) hop, full precision over ICI (docs/overlap.md
+    # "Quantized wire compression").
+    quantized: bool = False
     label: str = "stream"
     # Non-finite guard policy applied to this group's cotangents BEFORE
     # the psum (docs/fault_tolerance.md "Data-plane integrity"): "zero"
@@ -267,8 +281,17 @@ def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
 
         # Built inside the backward trace: axis sizes come from the live
         # bindings, so each bucket is priced on the mesh it runs over.
+        # quantized=True prices buckets with wire_dtype=int8 and lowers
+        # the selected plan with int8 on the slow hop(s) only.
         reduce_fn = _compositor.planned_reduce_fn(
-            _compositor.model_for_axes(cfg.axis_name), cfg.axis_name
+            _compositor.model_for_axes(cfg.axis_name), cfg.axis_name,
+            quantized=cfg.quantized,
+        )
+    elif cfg.quantized:
+        from .quantized import quantized_reduce_fn
+
+        reduce_fn = quantized_reduce_fn(
+            "two-level" if cfg.hierarchical else "flat", label=cfg.label
         )
     elif cfg.hierarchical:
         reduce_fn = _hier_reduce_fn
@@ -307,6 +330,135 @@ def _stream_bwd(cfg, _res, ct):
 _stream_identity.defvjp(_stream_fwd, _stream_bwd)
 
 
+# --- quantized reduction with error feedback ---------------------------------
+#
+# EF-SGD construction (the standard fix that preserves convergence under
+# biased compressors): each rank keeps a rank-local residual e, sends
+# Q(g + e) instead of Q(g), and carries e' = (g + e) - Q(g + e) into the
+# next step — the quantization error is re-injected instead of lost.
+# The residual compensates THIS rank's first quantization (the dominant
+# local error; later ring hops re-quantize shared partials, which no
+# per-rank state can attribute). Residuals legitimately differ across
+# ranks: the guard's digest agreement excludes them
+# (guard/digest.strip_rank_local).
+
+
+def quantized_ef_allreduce(
+    tree: Any,
+    ef: Any,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    axis_name: Any = DATA_AXIS,
+    threshold_bytes: Optional[int] = None,
+    label: str = "quantized-ef",
+) -> Tuple[Any, Any]:
+    """Bucket-fused int8-wire allreduce with error feedback: returns
+    ``(reduced, new_residual)``. ``ef`` must mirror ``tree``'s structure
+    with float32 leaves (``ops/quantized.ef_like``). Float buckets move
+    ``corrected = g.astype(f32) + e`` through the int8 ring and emit
+    ``corrected - dequant(quant(corrected))`` as the next residual;
+    integer buckets reduce exactly and pass their residual through
+    unchanged (always zero). The SAME function serves the post-hoc and
+    the streamed (per-group) paths, so identical bucket plans give
+    bitwise-identical steps."""
+    from . import collectives as _c
+    from .quantized import (
+        quantize_roundtrip,
+        quantized_ring_allreduce,
+        record_wire_bytes,
+    )
+
+    if op not in _QUANTIZABLE_OPS:
+        raise ValueError(
+            f"quantized reduction supports {_QUANTIZABLE_OPS}; got {op}"
+        )
+    threshold_bytes = default_threshold_bytes(threshold_bytes)
+    leaves, treedef = jax.tree.flatten(tree)
+    ef_leaves, ef_treedef = jax.tree.flatten(ef)
+    if len(ef_leaves) != len(leaves):
+        raise ValueError(
+            f"error-feedback residual has {len(ef_leaves)} leaves but the "
+            f"gradient tree has {len(leaves)} — build it with ef_like(params)"
+        )
+    if not leaves:
+        return tree, ef
+    buckets = plan_buckets(leaves, threshold_bytes)
+    results: List[jax.Array | None] = [None] * len(leaves)
+    residuals: List[jax.Array | None] = [None] * len(leaves)
+    average = op == ReduceOp.AVERAGE
+    for bucket in buckets:
+        first = leaves[bucket[0]]
+        if not jnp.issubdtype(first.dtype, jnp.floating):
+            # Exact sums stay exact: no int8 round trip, residual
+            # untouched (zero).
+            for i in bucket:
+                out = _c.allreduce(leaves[i], op=op, axis_name=axis_name)
+                results[i] = out.astype(leaves[i].dtype)
+                residuals[i] = ef_leaves[i]
+            continue
+        corrected = [
+            leaves[i].astype(jnp.float32) + ef_leaves[i] for i in bucket
+        ]
+        packed = pack_bucket(corrected)
+        if packed.size == 0:
+            for i in bucket:
+                results[i] = leaves[i]
+                residuals[i] = ef_leaves[i]
+            continue
+        record_wire_bytes(packed.size * 4, label)
+        new_res = packed - quantize_roundtrip(packed)
+        reduced = quantized_ring_allreduce(
+            packed, axis_name=axis_name, average=average
+        )
+        shapes = [leaves[i].shape for i in bucket]
+        for i, r, e in zip(
+            bucket, unpack_bucket(reduced, shapes),
+            unpack_bucket(new_res, shapes),
+        ):
+            results[i] = r.astype(leaves[i].dtype)
+            residuals[i] = e
+    return (
+        jax.tree.unflatten(treedef, results),
+        jax.tree.unflatten(ef_treedef, residuals),
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _stream_identity_ef(cfg: StreamConfig, tree: Any, ef: Any) -> Any:
+    return tree
+
+
+def _stream_ef_fwd(cfg, tree, ef):
+    # The residual values ride the forward residuals into the backward
+    # rule; the "gradient" the rule returns for ``ef`` IS the next
+    # step's residual — that is how per-bucket state computed inside the
+    # backward trace escapes the custom_vjp (value_and_grad over
+    # (params, ef) hands it back to the step).
+    return tree, ef
+
+
+def _stream_ef_bwd(cfg, ef, ct):
+    if cfg.nonfinite == "zero":
+        # Sentinel BEFORE the quantizer: a NaN reaching the blockwise
+        # amax would poison the whole block's scale, so sanitization
+        # must run pre-quantize (docs/fault_tolerance.md).
+        from ..guard import nonfinite as _nf
+
+        ct = _nf.sanitize(ct)
+        ef = _nf.sanitize(ef)
+    reduced, new_ef = quantized_ef_allreduce(
+        ct, ef,
+        op=cfg.op,
+        axis_name=cfg.axis_name,
+        threshold_bytes=cfg.threshold_bytes,
+        label=cfg.label,
+    )
+    return reduced, new_ef
+
+
+_stream_identity_ef.defvjp(_stream_ef_fwd, _stream_ef_bwd)
+
+
 # Per-thread trace ledger: DistributedOptimizer(overlap=True) consumes it to
 # detect a model whose layers were never registered for streaming (the
 # silent-fallback hazard the analysis lint warns about).
@@ -338,6 +490,8 @@ def reduce_in_backward(
     threshold_bytes: Optional[int] = None,
     hierarchical: Any = False,
     compression: Any = None,
+    quantized: bool = False,
+    ef: Any = None,
     label: str = "stream",
     nonfinite: str = "off",
 ) -> Any:
@@ -350,6 +504,16 @@ def reduce_in_backward(
     of the params BEFORE the layer's forward computation consumes them;
     ``make_train_step(overlap=True)`` does this automatically via
     :func:`stream_param_groups`.
+
+    ``quantized=True`` moves each bucket through the int8 wire
+    (``ops/quantized.py``) inside the same backward trace — the overlap
+    property is unchanged, only the bytes shrink. With ``ef`` (a float32
+    residual subtree mirroring ``tree``, see ``ops/quantized.ef_like``)
+    the backward applies error feedback: it reduces ``ct + ef`` and the
+    next residual comes back as the GRADIENT of ``ef`` — differentiate
+    with ``jax.value_and_grad(..., argnums=(0, 1))`` over (params, ef)
+    and thread the residual into the next step (``make_train_step`` does
+    this automatically).
     """
     if op not in _STREAMABLE_OPS:
         raise ValueError(
@@ -361,10 +525,31 @@ def reduce_in_backward(
 
         if compression is Compression.none:
             compression = None
+    if quantized:
+        if op not in _QUANTIZABLE_OPS:
+            raise ValueError(
+                f"quantized streaming supports {_QUANTIZABLE_OPS}; got {op}"
+            )
+        if compression is not None:
+            raise ValueError(
+                "quantized=True already compresses the wire to int8; "
+                "stacking cast compression would add loss for no "
+                "bandwidth win"
+            )
+    if ef is not None and not quantized:
+        raise ValueError(
+            "error feedback (ef=...) only applies to quantized streaming"
+        )
     # "planned" = per-bucket compositor plan selection over the axis
     # tuple (hierarchical="auto" at the make_train_step level resolves
     # to this when the mesh carries a (pod, cross, local) hierarchy).
     planned = hierarchical == "planned"
+    if ef is not None and (planned or bool(hierarchical)):
+        raise ValueError(
+            "error feedback compensates the flat int8 ring; the "
+            "hierarchical DCN-only wire quantizes post-local-reduction "
+            "state no per-rank residual can attribute — use ef=None"
+        )
     cfg = StreamConfig(
         op=op,
         axis_name=tuple(axis_name) if isinstance(axis_name, list)
@@ -373,10 +558,13 @@ def reduce_in_backward(
         hierarchical=bool(hierarchical) and not planned,
         planned=planned,
         compression=compression,
+        quantized=bool(quantized),
         label=label,
         nonfinite=str(nonfinite),
     )
     _note_stream_registration(len(jax.tree.leaves(tree)))
+    if ef is not None:
+        return _stream_identity_ef(cfg, tree, ef)
     return _stream_identity(cfg, tree)
 
 
@@ -465,6 +653,8 @@ def stream_param_groups(
     first_bucket_bytes: Optional[int] = None,
     hierarchical: Any = False,
     compression: Any = None,
+    quantized: bool = False,
+    ef: Any = None,
     nonfinite: str = "off",
 ) -> Any:
     """Partition ``params`` by top-level child (for a flax params dict: one
@@ -472,7 +662,12 @@ def stream_param_groups(
     into DDP-style reverse-order groups with a smaller first bucket, and
     register every group for streamed backward reduction. A tree with no
     splittable top level degrades to one group (still overlappable with the
-    optimizer/loss tail, but not intra-backward)."""
+    optimizer/loss tail, but not intra-backward).
+
+    ``quantized``/``ef`` follow :func:`reduce_in_backward`: with ``ef``
+    (same top-level structure as ``params``) each group carries its own
+    error-feedback residual slice and the updated residuals come back as
+    the gradient of the ``ef`` argument."""
     threshold = default_threshold_bytes(threshold_bytes)
     first = default_first_bucket_bytes(first_bucket_bytes)
     split = _top_level_children(params)
@@ -480,9 +675,19 @@ def stream_param_groups(
         return reduce_in_backward(
             params, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
+            quantized=quantized, ef=ef,
             label="stream:g0", nonfinite=nonfinite,
         )
     children, rebuild = split
+    ef_children = None
+    if ef is not None:
+        ef_split = _top_level_children(ef)
+        if ef_split is None or len(ef_split[0]) != len(children):
+            raise ValueError(
+                "ef must mirror params' top-level structure "
+                "(build it with ops.quantized.ef_like(params))"
+            )
+        ef_children = ef_split[0]
     groups = plan_layer_groups(
         [_tree_bytes(c) for c in children], threshold, first
     )
@@ -491,9 +696,14 @@ def stream_param_groups(
     wrapped = list(children)
     for gi, group in enumerate(groups):
         sub = {str(i): children[i] for i in group}
+        sub_ef = (
+            {str(i): ef_children[i] for i in group}
+            if ef_children is not None else None
+        )
         sub = reduce_in_backward(
             sub, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
+            quantized=quantized, ef=sub_ef,
             label=f"stream:g{gi}", nonfinite=nonfinite,
         )
         for i in group:

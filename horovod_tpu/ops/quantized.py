@@ -27,30 +27,35 @@ bounds step time — e.g. DCN-crossing data-parallel axes.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..common.compat import axis_size as _axis_size
+from ..common.quant import BLOCK, int8_saved_bytes, int8_wire_bytes
 from ..parallel.mesh import DATA_AXIS
 
-__all__ = ["quantized_ring_allreduce", "quantized_ring_reduce_scatter"]
-
-
-# Elements sharing one scale. Small enough that a low-magnitude gradient
-# leaf (layernorm/bias) packed into a fusion bucket next to a large-
-# magnitude one keeps its own scales instead of rounding to zero against
-# the bucket's global amax; 4 scale bytes per 256 payload bytes = 1.6%
-# wire overhead.
-BLOCK = 256
+__all__ = [
+    "BLOCK",
+    "EFState",
+    "ef_like",
+    "quantize_roundtrip",
+    "quantized_hierarchical_allreduce",
+    "quantized_reduce_fn",
+    "quantized_ring_allreduce",
+    "quantized_ring_reduce_scatter",
+]
 
 
 def _quantize(v: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Symmetric blockwise int8: (q int8 [m], scales f32 [m/BLOCK]).
-    ``m`` must be a multiple of BLOCK (callers pad)."""
-    vb = v.reshape(-1, BLOCK)
+    ``m`` must be a multiple of BLOCK (callers pad). The arithmetic runs
+    in float32 regardless of the input dtype — a bf16 ``v / scale``
+    would re-round the quantization grid itself (the bf16 round-trip bug
+    the bucket integration surfaced)."""
+    vb = v.astype(jnp.float32).reshape(-1, BLOCK)
     amax = jnp.max(jnp.abs(vb), axis=1, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
     q = jnp.clip(jnp.round(vb / scale), -127, 127).astype(jnp.int8)
@@ -125,7 +130,7 @@ def quantized_ring_reduce_scatter(
             f"quantized reduce-scatter needs len(x) divisible by n*BLOCK "
             f"(= {n * BLOCK}); got {total}"
         )
-    if n == 1:
+    if n == 1 or total == 0:
         return flat.astype(orig_dtype)
     r = lax.axis_index(axis_name)
     k = total // n
@@ -146,7 +151,24 @@ def quantized_ring_allreduce(
 
     Must run inside shard_map/pmap with the axis bound. The result has
     ``x``'s shape and dtype; internal accumulation is float32.
+
+    ``axis_name`` may be a tuple of bound axes: the reduction then chains
+    one int8 ring per axis, innermost (fastest) first — the "flat
+    quantized" lowering of a multi-level plan, every hop compressed.
     """
+    if isinstance(axis_name, (tuple, list)):
+        axes = tuple(axis_name)
+        if len(axes) == 1:
+            return quantized_ring_allreduce(
+                x, axis_name=axes[0], average=average
+            )
+        out = x
+        for ax in reversed(axes):  # innermost first
+            out = quantized_ring_allreduce(out, axis_name=ax)
+        if average:
+            out = (out.astype(jnp.float32)
+                   / _axis_size(axes)).astype(x.dtype)
+        return out
     n = _axis_size(axis_name)
     if n == 1:
         return x
@@ -156,6 +178,10 @@ def quantized_ring_allreduce(
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     total = flat.shape[0]
+    if total == 0:
+        # Zero-length leaves (empty buckets) must be identities, not a
+        # degenerate (n, 0) ring of empty permutes.
+        return x
     k = -(-total // n)  # ceil
     k = -(-k // BLOCK) * BLOCK  # chunk length a multiple of the scale block
     flat = jnp.pad(flat, (0, n * k - total))
@@ -194,3 +220,172 @@ def quantized_ring_allreduce(
     if average:
         result = result / n
     return result.astype(orig_dtype)
+
+
+# --- wire round-trip (error feedback) ----------------------------------------
+
+
+def quantize_roundtrip(x: jax.Array) -> jax.Array:
+    """``dequant(quant(x))`` with the exact padding/block layout the ring
+    applies to a local payload — the compression operator EF-SGD
+    compensates. Returns float32 of ``x``'s shape (the residual
+    ``x - quantize_roundtrip(x)`` must not re-round through bf16).
+
+    The ring pads the flat payload with zeros to a BLOCK-aligned chunk
+    grid before quantizing, so padding here with zeros to the next BLOCK
+    boundary reproduces the per-block scales bit-for-bit: the all-zero
+    tail blocks quantize to zero with unit scale and contribute no
+    error."""
+    flat = x.astype(jnp.float32).reshape(-1)
+    total = flat.shape[0]
+    if total == 0:
+        return flat.reshape(x.shape)
+    pad = (-total) % BLOCK
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    deq = _dequantize(*_quantize(flat))
+    return deq[:total].reshape(x.shape)
+
+
+class EFState(NamedTuple):
+    """Optimizer-state wrapper carrying the error-feedback residual next
+    to the inner optimizer state. ``residual`` is RANK-LOCAL by design —
+    each rank compensates its own quantization error — so the guard's
+    cross-rank digest agreement must (and does) exclude it
+    (``guard/digest.strip_rank_local``); everything under ``inner``
+    stays digest-tracked."""
+
+    inner: Any
+    residual: Any
+
+
+def ef_like(params: Any) -> Any:
+    """Zero-initialized error-feedback residual tree for ``params``:
+    float32 per leaf regardless of the leaf dtype (a bf16 residual would
+    re-round exactly the error it exists to carry)."""
+    return jax.tree.map(
+        lambda l: jnp.zeros(jnp.shape(l), jnp.float32), params
+    )
+
+
+# --- hierarchical (compressed-on-DCN-only) lowering --------------------------
+
+
+def _q2l(flat: jax.Array, axes: Tuple[str, ...]) -> jax.Array:
+    """k-level allreduce on a flat f32 vector with int8 ONLY on the
+    outermost (slowest) hop: RS(inner, full-precision psum_scatter) ->
+    recurse on the 1/L shard -> AG(inner). The base case — the single
+    outermost axis — is the int8 ring. This is EQuARX's observation made
+    structural: the win concentrates on the slow cross-slice hop, so the
+    big ICI payload stays exact and only the 1/L shard moves
+    compressed."""
+    if len(axes) == 1:
+        return quantized_ring_allreduce(flat, axis_name=axes[0])
+    inner = axes[-1]
+    L = _axis_size(inner)
+    n = flat.shape[0]
+    pad = (-n) % L
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, inner, scatter_dimension=0, tiled=True)
+    shard = _q2l(shard, axes[:-1])
+    full = lax.all_gather(shard, inner, tiled=True)
+    if pad:
+        full = full[:n]
+    return full
+
+
+def quantized_hierarchical_allreduce(
+    x: jax.Array,
+    axes,
+    *,
+    average: bool = False,
+) -> jax.Array:
+    """Sum (or average) ``x`` over the hierarchy ``axes`` (outermost
+    first, compositor order) with int8 on the outermost hop only: inner
+    hops run full-precision reduce-scatter/all-gather (ICI), the
+    remaining 1/L shard crosses the slow hop through the int8 ring."""
+    axes = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+    if len(axes) == 1:
+        return quantized_ring_allreduce(x, axis_name=axes[0],
+                                        average=average)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    if flat.shape[0] == 0:
+        return x
+    out = _q2l(flat, axes)
+    if average:
+        out = out / _axis_size(axes)
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+# --- fusion-bucket reduce_fn -------------------------------------------------
+
+
+def record_wire_bytes(nbytes: int, label: str) -> None:
+    """Trace-time hvd_quantized_* counters (one emission per compile,
+    like the fusion-bucket gauges): bytes this bucket puts on the int8
+    wire and bytes saved vs full precision."""
+    from .. import metrics as _metrics
+
+    if not _metrics.ACTIVE:
+        return
+    _metrics.TAP.inc(
+        "hvd_quantized_wire_bytes_total",
+        float(int8_wire_bytes(nbytes)), path=label,
+    )
+    _metrics.TAP.inc(
+        "hvd_quantized_bytes_saved_total",
+        float(int8_saved_bytes(nbytes)), path=label,
+    )
+    _metrics.TAP.inc("hvd_quantized_buckets_total", 1.0, path=label)
+
+
+def quantized_reduce_fn(mode: str = "flat", label: str = "quantized"):
+    """A ``reduce_fn`` for ``ops/fusion.fused_allreduce``: float buckets
+    ride the int8 wire, integer buckets reduce exactly (a float32/int8
+    round trip would silently corrupt exact sums; buckets are same-dtype
+    so per-bucket dispatch loses nothing).
+
+    ``mode``: ``"flat"`` — the int8 ring over the (single or tupled)
+    axis; ``"two-level"`` — compressed-on-DCN-only
+    (:func:`quantized_hierarchical_allreduce`, axis_name must be the
+    hierarchy tuple, outermost first).
+    """
+    from ..common.types import ReduceOp
+
+    if mode not in ("flat", "two-level"):
+        raise ValueError(f"unknown quantized reduce mode {mode!r}")
+
+    def fn(x, *, op, axis_name, prescale_factor=1.0, postscale_factor=1.0):
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            from . import collectives as _c
+
+            out = _c.allreduce(
+                x, op=op, axis_name=axis_name,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+            # AVERAGE's true division promotes to float; preserve the
+            # bucket dtype like the quantized path does.
+            return out.astype(x.dtype)
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise ValueError(
+                f"quantized reduction supports SUM/AVERAGE; got {op}"
+            )
+        if prescale_factor != 1.0:
+            x = x * prescale_factor
+        record_wire_bytes(x.size * 4, label)
+        if mode == "two-level":
+            out = quantized_hierarchical_allreduce(
+                x, axis_name, average=(op == ReduceOp.AVERAGE)
+            )
+        else:
+            out = quantized_ring_allreduce(
+                x, axis_name=axis_name, average=(op == ReduceOp.AVERAGE)
+            )
+        if postscale_factor != 1.0:
+            out = out * postscale_factor
+        return out
+
+    return fn

@@ -53,6 +53,12 @@ import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..common.quant import (
+    WIRE_DTYPES,
+    WIRE_F32,
+    WIRE_INT8,
+    int8_wire_bytes,
+)
 from ..common.types import ReduceOp
 from .model import Hop, InterconnectModel
 
@@ -130,13 +136,17 @@ def perm_rounds(primitive: str, size: int) -> Optional[List[List[Tuple[int, int]
 class Stage:
     """One primitive of a lowering schedule: ``bytes_on_wire`` is the
     per-rank traffic this stage puts on its hop, ``rounds`` its latency
-    cost in units of the hop's per-round latency."""
+    cost in units of the hop's per-round latency. ``wire_dtype`` is the
+    stage's wire format: ``"f32"`` (full precision — the payload's own
+    width) or ``"int8"`` (blockwise int8+scales, ``common/quant.py``),
+    in which case ``bytes_on_wire`` is the COMPRESSED traffic."""
 
     primitive: str
     hop: str
     axis: str
     bytes_on_wire: int
     rounds: int
+    wire_dtype: str = WIRE_F32
 
     def to_dict(self) -> dict:
         return {
@@ -145,6 +155,7 @@ class Stage:
             "axis": self.axis,
             "bytes_on_wire": int(self.bytes_on_wire),
             "rounds": int(self.rounds),
+            "wire_dtype": self.wire_dtype,
         }
 
 
@@ -164,6 +175,11 @@ class Plan:
     # FlexLink split mode only: (flat-bucket bytes, hierarchical-bucket
     # bytes), proportional to per-hop bandwidth.
     split_bytes: Tuple[int, ...] = ()
+    # Requested wire format ("f32" or "int8"). An int8 plan must carry
+    # at least one int8 stage — a plan claiming compression without a
+    # quantize stage fails the symbolic verifier
+    # (analysis/plan_verify.py).
+    wire_dtype: str = WIRE_F32
 
     @property
     def bytes_per_hop(self) -> Dict[str, int]:
@@ -186,6 +202,7 @@ class Plan:
                 k: int(v) for k, v in sorted(self.bytes_per_hop.items())
             },
             "split_bytes": list(self.split_bytes),
+            "wire_dtype": self.wire_dtype,
             "stages": [s.to_dict() for s in self.stages],
         }
 
@@ -241,9 +258,22 @@ def _flat_stages(model: InterconnectModel, primitive: str, nbytes: int,
     )]
 
 
+def _compress_stage(s: Stage) -> Stage:
+    """Re-declare a stage with the int8+scales wire format: same
+    schedule, compressed bytes."""
+    return Stage(
+        primitive=s.primitive, hop=s.hop, axis=s.axis,
+        bytes_on_wire=int8_wire_bytes(s.bytes_on_wire), rounds=s.rounds,
+        wire_dtype=WIRE_INT8,
+    )
+
+
 def _candidates_allreduce(model: InterconnectModel, nbytes: int,
-                          op: ReduceOp) -> Dict[str, List[Stage]]:
+                          op: ReduceOp,
+                          wire_dtype: str = WIRE_F32
+                          ) -> Dict[str, List[Stage]]:
     n = model.size
+    int8 = wire_dtype == WIRE_INT8
     cands: Dict[str, List[Stage]] = {}
     if op not in _HIER_REDUCE_OPS:
         # PRODUCT/ADASUM have no compositor regrouping: one flat plan.
@@ -266,6 +296,11 @@ def _candidates_allreduce(model: InterconnectModel, nbytes: int,
             Stage("all_gather-ring", h.name, h.axis,
                   int(nbytes * (n - 1) / n), n - 1),
         ]
+        if int8:
+            # The EQuARX ring: both phases move int8+scales (the only
+            # single-level quantized lowering shipped; halving-doubling
+            # has no quantized schedule).
+            return {"ring": [_compress_stage(s) for s in cands["ring"]]}
         if n & (n - 1) == 0 and op in _HIER_REDUCE_OPS:
             k = int(math.log2(n))
             cands["recursive-halving"] = [
@@ -279,6 +314,21 @@ def _candidates_allreduce(model: InterconnectModel, nbytes: int,
     cands["flat"] = _flat_stages(
         model, "all_reduce", nbytes, 2 * (n - 1) / n, 2 * (n - 1)
     )
+    if int8:
+        # Flat quantized = chained int8 rings, every hop compressed;
+        # two-level quantized = compressed-on-DCN-only (the outermost
+        # all_reduce stage moves int8+scales, the inner reduce-scatter/
+        # all-gather stay full precision over ICI). Split has no
+        # quantized lowering and is not offered.
+        cands["flat"] = [_compress_stage(s) for s in cands["flat"]]
+        two = _two_level_allreduce_stages(model, nbytes, op)
+        outer = model.hops[0].name
+        cands["two-level"] = [
+            _compress_stage(s)
+            if s.primitive == "all_reduce" and s.hop == outer else s
+            for s in two
+        ]
+        return cands
     if op in _HIER_REDUCE_OPS:
         cands["two-level"] = _two_level_allreduce_stages(model, nbytes, op)
         if (
@@ -485,15 +535,23 @@ def candidate_plans(
     collective: str,
     nbytes: int,
     op: Any = ReduceOp.SUM,
+    wire_dtype: str = WIRE_F32,
 ) -> Dict[str, Plan]:
     """Every candidate lowering the compositor can emit for
     ``collective`` at this payload on this model, as fully-formed costed
     :class:`Plan` objects keyed by algorithm name. :func:`select_plan`
     picks the cheapest of these; the symbolic plan verifier
-    (``analysis/plan_verify.py``) checks every one of them."""
+    (``analysis/plan_verify.py``) checks every one of them.
+    ``wire_dtype="int8"`` (allreduce SUM/AVERAGE only) prices the
+    quantized wire: every hop compressed for flat/ring, only the
+    outermost (DCN) hop for two-level."""
     if collective not in COLLECTIVES:
         raise ValueError(
             f"unknown collective {collective!r}; one of {COLLECTIVES}"
+        )
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"unknown wire_dtype {wire_dtype!r}; one of {WIRE_DTYPES}"
         )
     nbytes = max(int(nbytes), 0)
     op_enum = op if isinstance(op, ReduceOp) else None
@@ -501,9 +559,19 @@ def candidate_plans(
         op_enum = ReduceOp[op.upper()]
     if op_enum is None:
         op_enum = ReduceOp.SUM
+    if wire_dtype == WIRE_INT8 and (
+        collective != "allreduce"
+        or op_enum not in (ReduceOp.SUM, ReduceOp.AVERAGE)
+    ):
+        raise ValueError(
+            "wire_dtype='int8' is an allreduce SUM/AVERAGE construction "
+            f"(got {collective}/{_op_name(op_enum)}): per-hop int8 "
+            "requantization accumulates in f32, which is only sound for "
+            "additive reductions"
+        )
     eff = _effective_model(model)
     if collective == "allreduce":
-        cands = _candidates_allreduce(eff, nbytes, op_enum)
+        cands = _candidates_allreduce(eff, nbytes, op_enum, wire_dtype)
     elif collective == "allgather":
         cands = _candidates_allgather(eff, nbytes)
     elif collective == "reducescatter":
@@ -539,6 +607,7 @@ def candidate_plans(
             stages=tuple(stages),
             cost_us=float(cost),
             split_bytes=split_bytes,
+            wire_dtype=wire_dtype,
         )
     return plans
 
@@ -548,13 +617,14 @@ def select_plan(
     collective: str,
     nbytes: int,
     op: Any = ReduceOp.SUM,
+    wire_dtype: str = WIRE_F32,
 ) -> Plan:
     """Cost every candidate algorithm for ``collective`` at this payload
     on this model and return the cheapest as a :class:`Plan`. An
     ineligible model (ragged/interleaved layout, or a single hop) only
     considers single-level algorithms — the "safe to go hierarchical"
     gate from ``Topology.is_homogeneous``."""
-    plans = candidate_plans(model, collective, nbytes, op)
+    plans = candidate_plans(model, collective, nbytes, op, wire_dtype)
     best: Optional[Plan] = None
     for name in sorted(plans):  # deterministic tie-break
         plan = plans[name]
@@ -749,10 +819,15 @@ def lower_allreduce(
     op: ReduceOp = ReduceOp.SUM,
     algorithm: str = "two-level",
     split_fraction: Optional[float] = None,
+    wire_dtype: str = WIRE_F32,
 ):
     """Allreduce ``x`` over the hierarchy ``axes`` (outermost first) with
     the given algorithm. Numerically equal to
-    ``lax.psum/pmin/pmax(x, tuple(axes))``."""
+    ``lax.psum/pmin/pmax(x, tuple(axes))`` — exactly for f32 wire, to
+    int8 quantization tolerance for ``wire_dtype="int8"`` (SUM/AVERAGE
+    only): flat/ring lower through the int8 ring on every hop,
+    two-level compresses only the outermost hop
+    (``ops/quantized.quantized_hierarchical_allreduce``)."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -760,6 +835,29 @@ def lower_allreduce(
 
     axes = _axes_tuple(axes)
     total = axis_size(axes)
+    if wire_dtype == WIRE_INT8:
+        from ..ops.quantized import (
+            quantized_hierarchical_allreduce,
+            quantized_ring_allreduce,
+        )
+
+        if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            raise ValueError(
+                f"wire_dtype='int8' supports SUM/AVERAGE; got {op}"
+            )
+        average = op == ReduceOp.AVERAGE
+        if algorithm in ("flat", "ring", "recursive-halving"):
+            return quantized_ring_allreduce(
+                x, axis_name=axes if len(axes) > 1 else axes[0],
+                average=average,
+            )
+        if algorithm == "two-level":
+            return quantized_hierarchical_allreduce(
+                x, axes, average=average
+            )
+        raise ValueError(
+            f"allreduce algorithm {algorithm!r} has no int8 lowering"
+        )
     if algorithm == "flat":
         from ..ops import collectives as _c
 
@@ -1077,14 +1175,16 @@ def model_for_axes(axes, generation: Optional[str] = None):
     return apply_override(model)
 
 
-def auto_reduce_fn():
+def auto_reduce_fn(quantized: bool = False):
     """A ``reduce_fn`` that builds the model from the bound axes at trace
     time and then defers to :func:`planned_reduce_fn` — the form the
     compiled-mode binding uses for ``hierarchical="auto"``."""
 
     def fn(x, *, op, axis_name, prescale_factor=1.0, postscale_factor=1.0):
         axes = _axes_tuple(axis_name)
-        return planned_reduce_fn(model_for_axes(axes), axes)(
+        return planned_reduce_fn(
+            model_for_axes(axes), axes, quantized=quantized
+        )(
             x, op=op, axis_name=axes,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
@@ -1093,41 +1193,65 @@ def auto_reduce_fn():
     return fn
 
 
-def planned_reduce_fn(model: InterconnectModel, axes=None):
+def planned_reduce_fn(model: InterconnectModel, axes=None,
+                      quantized: bool = False):
     """A ``reduce_fn`` for ``ops/fusion.py``: per bucket, select the
     allreduce plan for the bucket's payload on this model and lower it
     accordingly — this is what makes ``make_train_step(overlap=True)``
     go hierarchical automatically on multi-slice topologies, per bucket.
     ``axes`` defaults to the model's own axis tuple.
 
+    ``quantized=True`` selects among the wire_dtype=int8 candidates
+    (float SUM/AVERAGE buckets only — integer buckets and other ops fall
+    back to full precision): the chosen plan lowers with int8 on every
+    hop (flat/ring) or on the outermost hop only (two-level).
+
     Single-hop plan labels (``ring`` / ``recursive-halving``) lower via
     the native XLA collective: on one hop XLA already schedules its own
     ring/halving and the label is the cost model's estimate of that, not
     an instruction to hand-roll ``ppermute`` schedules inside a training
     step. The explicit schedules stay reachable through
-    :func:`lower_allreduce` for tests and offline measurement."""
+    :func:`lower_allreduce` for tests and offline measurement. The int8
+    ring is the exception — there IS no native quantized collective, so
+    its explicit schedule is the lowering."""
     from ..common.types import dtype_from_array, dtype_size
 
     axes = _axes_tuple(axes if axes is not None else model.axes)
 
     def fn(x, *, op, axis_name=None, prescale_factor=1.0,
            postscale_factor=1.0):
+        import jax.numpy as jnp
+
         use_axes = _axes_tuple(axis_name) if axis_name is not None else axes
         if prescale_factor != 1.0:
             x = x * prescale_factor
         nbytes = x.size * dtype_size(dtype_from_array(x))
-        plan = record_plan(
-            select_plan(model, "allreduce", nbytes, op=op), where="stream"
+        int8 = (
+            quantized
+            and op in (ReduceOp.SUM, ReduceOp.AVERAGE)
+            and jnp.issubdtype(x.dtype, jnp.floating)
         )
+        wire = WIRE_INT8 if int8 else WIRE_F32
+        plan = record_plan(
+            select_plan(model, "allreduce", nbytes, op=op, wire_dtype=wire),
+            where="stream",
+        )
+        if int8:
+            from ..ops.quantized import record_wire_bytes
+
+            record_wire_bytes(nbytes, "stream")
         algorithm = plan.algorithm
         frac = None
         if algorithm == "split" and plan.nbytes:
             frac = plan.split_bytes[0] / plan.nbytes
         elif algorithm in ("ring", "recursive-halving") or len(use_axes) == 1:
-            algorithm = "flat"
+            # f32 single-hop labels lower natively; the int8 ring label
+            # is handled by lower_allreduce's quantized branch.
+            if not int8:
+                algorithm = "flat"
         out = lower_allreduce(
             x, use_axes, op=op, algorithm=algorithm,
-            split_fraction=frac,
+            split_fraction=frac, wire_dtype=wire,
         )
         if postscale_factor != 1.0:
             out = out * postscale_factor

@@ -5,8 +5,8 @@ Acceptance matrix: a seeded rank-divergent collective (collective under
 ``lax.cond`` on ``axis_index``) is flagged; the guard's psum agreement
 seam is recognized as the sanctioned convergence pattern; divergence
 over a disjoint mesh axis is allowed; all shipped ``make_train_step``
-variants (posthoc, overlap, hierarchical-auto, guard-skip) report zero
-findings.
+variants (posthoc, overlap, hierarchical-auto, guard-skip,
+quantized-overlap) report zero findings.
 """
 
 import jax
@@ -210,6 +210,7 @@ def test_lint_step_folds_divergence_in():
         ("overlap", {"overlap": True}),
         ("hierarchical-auto", {"hierarchical": "auto"}),
         ("guard-skip", {"nonfinite": "skip"}),
+        ("quantized-overlap", {"overlap": True, "quantized": True}),
     ],
 )
 def test_shipped_train_step_variants_are_clean(label, kwargs):

@@ -255,15 +255,27 @@ def test_stream_scan_body_bitwise_parity():
     np.testing.assert_array_equal(np.asarray(gs), np.asarray(gp))
 
 
-def test_overlap_rejects_quantized_and_adasum():
+def test_overlap_rejects_adasum_and_bad_quantized_compositions():
+    """overlap+quantized is now first-class (PR 9); what stays rejected:
+    ADASUM streaming, quantized MIN/MAX, quantized+cast-compression, and
+    error feedback on the hierarchical (DCN-only) wire."""
+    from horovod_tpu.common.compression import Compression
+
     mesh = build_mesh()
-    with pytest.raises(ValueError, match="quantized"):
+    with pytest.raises(ValueError, match="SUM/AVERAGE|quantized"):
         hvdj.make_train_step(
-            _loss_fn, optax.sgd(0.1), mesh, overlap=True, quantized=True
+            _loss_fn, optax.sgd(0.1), mesh, overlap=True, quantized=True,
+            op=ReduceOp.MIN,
         )
-    with pytest.raises(ValueError, match="quantized"):
-        hvdj.DistributedOptimizer(
-            optax.sgd(0.1), overlap=True, quantized=True
+    with pytest.raises(ValueError, match="already compresses"):
+        hvdj.make_train_step(
+            _loss_fn, optax.sgd(0.1), mesh, overlap=True, quantized=True,
+            compression=Compression.fp16,
+        )
+    with pytest.raises(ValueError, match="error feedback|error_feedback"):
+        hvdj.make_train_step(
+            _loss_fn, optax.sgd(0.1), mesh, quantized=True,
+            hierarchical=True, error_feedback=True,
         )
     with pytest.raises(ValueError, match="elementwise"):
         hvdj.make_train_step(
@@ -271,6 +283,15 @@ def test_overlap_rejects_quantized_and_adasum():
         )
     with pytest.raises(ValueError, match="elementwise"):
         F.reduce_in_backward(_params(), op=ReduceOp.ADASUM)
+    with pytest.raises(ValueError, match="quantized streaming"):
+        F.reduce_in_backward(_params(), op=ReduceOp.MIN, quantized=True)
+    from horovod_tpu.ops.quantized import ef_like
+
+    with pytest.raises(ValueError, match="flat int8 ring"):
+        F.reduce_in_backward(
+            _params(), quantized=True, hierarchical=True,
+            ef=ef_like(_params()),
+        )
 
 
 def test_overlap_hierarchical_matches_flat():

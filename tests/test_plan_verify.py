@@ -227,3 +227,120 @@ def test_empty_schedule_rejected_multi_rank():
     mut = dataclasses.replace(plan, stages=())
     fs = verify_plan(mut, TWO_LEVEL)
     assert fs and fs[0].rule == RULE_PLAN_RESULT
+
+
+# ---------------------------------------------------------------------------
+# Quantized (wire_dtype=int8) plans — PR 9
+# ---------------------------------------------------------------------------
+
+def test_int8_candidates_verify_clean():
+    for name, model in MODELS:
+        for op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            for nbytes in (1024, 64 << 20):
+                for alg, plan in candidate_plans(
+                    model, "allreduce", nbytes, op=op, wire_dtype="int8"
+                ).items():
+                    fs = verify_plan(plan, model)
+                    assert fs == [], (
+                        f"{name}/{alg}/{op}/{nbytes}: "
+                        + "; ".join(f.render() for f in fs)
+                    )
+                    assert plan.wire_dtype == "int8"
+                    if plan.stages:
+                        assert any(
+                            s.wire_dtype == "int8" for s in plan.stages
+                        ), alg
+
+
+def test_int8_rejected_for_non_additive_ops():
+    for bad in (ReduceOp.MIN, ReduceOp.MAX, ReduceOp.PRODUCT):
+        with pytest.raises(ValueError, match="SUM/AVERAGE"):
+            candidate_plans(TWO_LEVEL, "allreduce", 1024, op=bad,
+                            wire_dtype="int8")
+    with pytest.raises(ValueError, match="allreduce"):
+        candidate_plans(TWO_LEVEL, "allgather", 1024, wire_dtype="int8")
+    with pytest.raises(ValueError, match="wire_dtype"):
+        candidate_plans(TWO_LEVEL, "allreduce", 1024, wire_dtype="fp8")
+
+
+def _int8_two_level():
+    return candidate_plans(
+        TWO_LEVEL, "allreduce", 64 << 20, op=ReduceOp.SUM,
+        wire_dtype="int8",
+    )["two-level"]
+
+
+def test_int8_stage_with_full_precision_bytes_rejected():
+    """A stage claiming wire_dtype=int8 while declaring uncompressed
+    bytes is a corrupted compressed-bytes declaration -> RULE_PLAN_BYTES
+    naming the stage."""
+    plan = _int8_two_level()
+    f32 = candidate_plans(
+        TWO_LEVEL, "allreduce", 64 << 20, op=ReduceOp.SUM
+    )["two-level"]
+    stages = tuple(
+        dataclasses.replace(s, bytes_on_wire=f32.stages[i].bytes_on_wire)
+        if s.wire_dtype == "int8" else s
+        for i, s in enumerate(plan.stages)
+    )
+    fs = verify_plan(dataclasses.replace(plan, stages=stages), TWO_LEVEL)
+    assert any(f.rule == RULE_PLAN_BYTES for f in fs), [
+        f.render() for f in fs
+    ]
+    assert any("stage" in f.location for f in fs)
+
+
+def test_compressed_bytes_without_quantize_stage_rejected():
+    """A plan declaring compressed bytes WITHOUT any int8 stage must
+    fail verification — compression claimed, no quantizer."""
+    plan = _int8_two_level()
+    # Strip the wire_dtype markers but keep the compressed byte counts.
+    stages = tuple(
+        dataclasses.replace(s, wire_dtype="f32") for s in plan.stages
+    )
+    fs = verify_plan(dataclasses.replace(plan, stages=stages), TWO_LEVEL)
+    assert fs, "compression without a quantize stage verified clean"
+
+    # Same corruption on a plan that doesn't even declare int8 at the
+    # plan level: the per-stage byte accounting still catches it.
+    f32 = candidate_plans(
+        TWO_LEVEL, "allreduce", 64 << 20, op=ReduceOp.SUM
+    )["two-level"]
+    small = tuple(
+        dataclasses.replace(s, bytes_on_wire=s.bytes_on_wire // 4)
+        if s.primitive == "all_reduce" else s
+        for s in f32.stages
+    )
+    fs2 = verify_plan(dataclasses.replace(f32, stages=small), TWO_LEVEL)
+    assert any(f.rule == RULE_PLAN_BYTES for f in fs2)
+
+
+def test_int8_wrong_op_stage_rejected():
+    """wire_dtype=int8 on a MIN plan's stage must be flagged (the grid
+    can't emit it; a hand-built or corrupted plan could)."""
+    minplan = candidate_plans(
+        TWO_LEVEL, "allreduce", 1024, op=ReduceOp.MIN
+    )["two-level"]
+    stages = tuple(
+        dataclasses.replace(
+            s, wire_dtype="int8",
+        ) for s in minplan.stages
+    )
+    fs = verify_plan(dataclasses.replace(minplan, stages=stages), TWO_LEVEL)
+    assert any(f.rule == RULE_PLAN_STAGE for f in fs)
+
+
+def test_unknown_wire_dtype_rejected():
+    plan = _int8_two_level()
+    stages = (dataclasses.replace(plan.stages[0], wire_dtype="fp4"),
+              ) + plan.stages[1:]
+    fs = verify_plan(dataclasses.replace(plan, stages=stages), TWO_LEVEL)
+    assert any("wire_dtype" in f.message for f in fs)
+
+
+def test_grid_sweeps_int8_plans():
+    """verify_plan_grid covers the int8 candidates too (plans_verified
+    grew past the f32-only grid)."""
+    findings, verified = verify_plan_grid()
+    assert findings == []
+    assert verified >= 255, verified

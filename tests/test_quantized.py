@@ -119,8 +119,18 @@ def test_allreduce_gradients_quantized(mesh):
         a, e = np.asarray(got[k]), np.asarray(exact[k])
         assert np.linalg.norm(a - e) / np.linalg.norm(e) < 3e-2, k
 
-    with pytest.raises(ValueError, match="flat SUM/AVERAGE"):
-        hvdj.allreduce_gradients(grads, quantized=True, hierarchical=True)
+    # hierarchical+quantized is now the DCN-only compressed path; the
+    # rejections left are non-additive ops and stacked cast compression.
+    from horovod_tpu.common.types import ReduceOp
+
+    with pytest.raises(ValueError, match="SUM/AVERAGE"):
+        hvdj.allreduce_gradients(grads, quantized=True, op=ReduceOp.MIN)
+    from horovod_tpu.common.compression import Compression
+
+    with pytest.raises(ValueError, match="already compresses"):
+        hvdj.allreduce_gradients(
+            grads, quantized=True, compression=Compression.fp16
+        )
 
 
 def test_blockwise_scales_preserve_small_leaves(mesh):
@@ -233,3 +243,435 @@ def test_integer_bucket_reduces_exactly(mesh):
     )
     expected_w = 0.001 * sum(range(1, N_DEV + 1))
     assert np.allclose(np.asarray(out["w"]), expected_w, rtol=0.05)
+
+
+# --- PR 9: quantized streamed collectives with error feedback ----------------
+
+
+def test_quantize_roundtrip_error_bound_per_block():
+    """Property: |x - dequant(quant(x))| <= scale/2 per element, where
+    scale is the element's BLOCK's amax/127 — the symmetric-quantizer
+    bound the EF residual construction relies on. Result is f32."""
+    from horovod_tpu.ops.quantized import BLOCK, quantize_roundtrip
+
+    rng = np.random.RandomState(11)
+    for total in (BLOCK, 3 * BLOCK, 5 * BLOCK + 17, 1):
+        x = rng.randn(total).astype(np.float32) * rng.uniform(1e-4, 10)
+        rt = np.asarray(quantize_roundtrip(jnp.asarray(x)))
+        assert rt.dtype == np.float32
+        pad = (-total) % BLOCK
+        xp = np.pad(x, (0, pad)).reshape(-1, BLOCK)
+        scales = np.abs(xp).max(axis=1) / 127.0
+        bound = np.repeat(np.maximum(scales, 0), BLOCK)[:total]
+        err = np.abs(x - rt)
+        assert (err <= bound / 2 + 1e-7).all(), err.max()
+    # Zeros are exact.
+    z = np.asarray(quantize_roundtrip(jnp.zeros((2 * BLOCK,))))
+    np.testing.assert_array_equal(z, np.zeros(2 * BLOCK, np.float32))
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4, 8])
+def test_scale_packing_bijective(n_ranks):
+    """_pack/_unpack round-trips (q, scales) exactly at the chunk sizes
+    a 2/4/8-rank ring produces — the wire format is lossless for what it
+    carries (the loss lives only in the quantizer)."""
+    from horovod_tpu.ops.quantized import (
+        BLOCK, _pack, _quantize, _unpack,
+    )
+
+    rng = np.random.RandomState(n_ranks)
+    total = n_ranks * 2 * BLOCK
+    k = total // n_ranks
+    v = jnp.asarray(rng.randn(k).astype(np.float32))
+    q, s = _quantize(v)
+    q2, s2 = _unpack(_pack(q, s), k)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s2))
+
+
+def test_zero_length_leaf_is_identity(mesh):
+    """A zero-length leaf in a quantized bucket must pass through (no
+    degenerate (n, 0) ring) — surfaced by bucket integration."""
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.ops.quantized import quantized_ring_allreduce
+
+    def body(x):
+        return quantized_ring_allreduce(x[0], axis_name="data")
+
+    fn = jax.jit(_shard_map(
+        body, mesh, in_specs=(P("data"),), out_specs=P("data"),
+    ))
+    out = fn(jnp.zeros((N_DEV, 1, 0), jnp.float32))
+    assert out.size == 0
+
+    grads = {
+        "w": jnp.ones((300,), jnp.float32),
+        "empty": jnp.zeros((0,), jnp.float32),
+    }
+
+    def body2(g):
+        return hvdj.allreduce_gradients(g, quantized=True)
+
+    got = jax.jit(_shard_map(
+        body2, mesh, in_specs=(P(),), out_specs=P(),
+    ))(grads)
+    assert got["empty"].shape == (0,)
+    np.testing.assert_allclose(np.asarray(got["w"]), 1.0, rtol=0.05)
+
+
+def test_bf16_roundtrips_through_f32(mesh):
+    """bf16 inputs: the quantizer arithmetic must run in f32 — a bf16
+    v/scale would re-round the grid. _quantize(bf16 x) must equal
+    _quantize(f32 x) bit-for-bit, and the ring must return bf16 with
+    error bounded by the quantizer (not bf16 double-rounding)."""
+    from horovod_tpu.ops.quantized import (
+        BLOCK, _quantize, quantize_roundtrip, quantized_ring_allreduce,
+    )
+
+    rng = np.random.RandomState(12)
+    xf = jnp.asarray(rng.randn(2 * BLOCK).astype(np.float32))
+    xb = xf.astype(jnp.bfloat16)
+    qb, sb = _quantize(xb)
+    qf, sf = _quantize(xb.astype(jnp.float32))
+    np.testing.assert_array_equal(np.asarray(qb), np.asarray(qf))
+    np.testing.assert_array_equal(np.asarray(sb), np.asarray(sf))
+    rt = quantize_roundtrip(xb)
+    assert rt.dtype == jnp.float32
+
+    def body(x):
+        return quantized_ring_allreduce(x[0], axis_name="data")
+
+    x = rng.randn(N_DEV, 2 * BLOCK).astype(np.float32) * 0.01
+    got = np.asarray(jax.jit(_shard_map(
+        body, mesh, in_specs=(P("data"),), out_specs=P("data"),
+    ))(jnp.asarray(x, jnp.bfloat16).reshape(N_DEV, 1, -1)))
+    assert got.dtype == jnp.bfloat16
+    exact = x.astype(np.float32).sum(axis=0)
+    rel = (np.linalg.norm(got.astype(np.float32).reshape(N_DEV, -1)[0]
+                          - exact) / np.linalg.norm(exact))
+    assert rel < 6e-2, rel
+
+
+def _mlp_params(n_layers=3, seed=5, d=12):
+    rng = np.random.RandomState(seed)
+    return {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(d, d).astype(np.float32) * 0.3),
+            "b": jnp.zeros((d,), jnp.float32),
+        }
+        for i in range(n_layers)
+    }
+
+
+def _mlp_loss(p, batch):
+    x, y = batch
+    h = x
+    for k in sorted(p):
+        h = jnp.tanh(h @ p[k]["w"] + p[k]["b"])
+    return jnp.mean((h - y) ** 2)
+
+
+def _mlp_batch(rows, seed=6, d=12):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(rows, d).astype(np.float32)),
+        jnp.asarray(rng.randn(rows, d).astype(np.float32)),
+    )
+
+
+def test_streamed_quantized_equals_posthoc_quantized_bitwise(mesh):
+    """Acceptance: with matching bucket plans (per-leaf buckets), the
+    streamed-quantized step and the post-hoc quantized step are BITWISE
+    identical — params, losses, and EF residuals — because both run the
+    same quantized_ef_allreduce per bucket."""
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.jax import EFState
+
+    params = _mlp_params()
+    batch = _mlp_batch(4 * N_DEV)
+    tx = optax.sgd(0.05)
+    kw = dict(fusion_threshold_bytes=1, first_bucket_bytes=1, donate=False)
+    step_s = hvdj.make_train_step(
+        _mlp_loss, tx, mesh, overlap=True, quantized=True, **kw
+    )
+    step_p = hvdj.make_train_step(_mlp_loss, tx, mesh, quantized=True, **kw)
+    ps, ss = params, tx.init(params)
+    pp, sp = params, tx.init(params)
+    for _ in range(4):
+        ps, ss, ls = step_s(ps, ss, batch)
+        pp, sp, lp = step_p(pp, sp, batch)
+        assert float(ls) == float(lp)
+    assert isinstance(ss, EFState) and isinstance(sp, EFState)
+    for a, b in zip(jax.tree.leaves(ps), jax.tree.leaves(pp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(ss.residual), jax.tree.leaves(sp.residual)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # EF is live: residuals move off zero.
+    assert sum(
+        float(np.abs(np.asarray(x)).sum())
+        for x in jax.tree.leaves(ss.residual)
+    ) > 0
+
+
+def test_ef_convergence_smoke(mesh):
+    """EF-SGD convergence: a quantized+EF training run must track the
+    full-precision loss within tolerance (the standard error-feedback
+    guarantee), and carrying the residual must not do worse than
+    dropping the quantization error on the floor."""
+    import optax
+
+    import horovod_tpu.jax as hvdj
+
+    params = _mlp_params(seed=7)
+    batch = _mlp_batch(4 * N_DEV, seed=8)
+    tx = optax.sgd(0.1)
+    kw = dict(fusion_threshold_bytes=1 << 16, donate=False)
+    step_fp = hvdj.make_train_step(_mlp_loss, tx, mesh, **kw)
+    step_ef = hvdj.make_train_step(
+        _mlp_loss, tx, mesh, quantized=True, **kw
+    )
+    step_nf = hvdj.make_train_step(
+        _mlp_loss, tx, mesh, quantized=True, error_feedback=False, **kw
+    )
+    runs = {}
+    for name, step in (("fp", step_fp), ("ef", step_ef), ("noef", step_nf)):
+        p, s = params, tx.init(params)
+        for _ in range(40):
+            p, s, loss = step(p, s, batch)
+        runs[name] = float(loss)
+    gap_ef = abs(runs["ef"] - runs["fp"]) / max(runs["fp"], 1e-9)
+    gap_nf = abs(runs["noef"] - runs["fp"]) / max(runs["fp"], 1e-9)
+    assert gap_ef < 0.05, runs
+    assert gap_ef <= gap_nf + 1e-3, runs
+
+
+def test_guard_sentinel_runs_before_quantizer(mesh):
+    """nonfinite='zero' + quantized streaming: one rank's NaN is zeroed
+    BEFORE quantization — a NaN reaching the blockwise amax would poison
+    the whole block's scale and the result would be NaN everywhere."""
+    import optax
+
+    import horovod_tpu.jax as hvdj
+
+    params = _mlp_params()
+    x, y = _mlp_batch(2 * N_DEV)
+    x = x.at[0, 0].set(np.nan)  # poisons rank 0's shard only
+    tx = optax.sgd(0.05)
+    step = hvdj.make_train_step(
+        _mlp_loss, tx, mesh, overlap=True, quantized=True,
+        nonfinite="zero", donate=False,
+        fusion_threshold_bytes=1, first_bucket_bytes=1,
+    )
+    p, s, loss = step(params, tx.init(params), (x, y))
+    for leaf in jax.tree.leaves(p):
+        assert bool(jnp.all(jnp.isfinite(leaf))), "NaN leaked past sentinel"
+
+
+def test_distributed_optimizer_quantized_ef(mesh):
+    """DistributedOptimizer(quantized=True): EFState-wrapped opt state,
+    residual evolves, and the reduced update tracks the full-precision
+    wrapper within quantization tolerance."""
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.jax import EFState
+
+    params = _mlp_params()
+    batch = _mlp_batch(2 * N_DEV)
+    txq = hvdj.DistributedOptimizer(optax.sgd(0.05), quantized=True)
+    txf = hvdj.DistributedOptimizer(optax.sgd(0.05))
+    sq = txq.init(params)
+    assert isinstance(sq, EFState)
+
+    def mk(tx):
+        def step(p, s, b):
+            loss, grads = jax.value_and_grad(_mlp_loss)(p, b)
+            u, s = tx.update(grads, s, p)
+            import optax as _ox
+
+            return _ox.apply_updates(p, u), s, jax.lax.pmean(loss, "data")
+
+        return jax.jit(_shard_map(
+            step, mesh, in_specs=(P(), P(), P("data")), out_specs=P(),
+        ))
+
+    fq, ff = mk(txq), mk(txf)
+    pq, pf, sf = params, params, txf.init(params)
+    for _ in range(3):
+        pq, sq, _ = fq(pq, sq, batch)
+        pf, sf, _ = ff(pf, sf, batch)
+    assert isinstance(sq, EFState)
+    assert sum(
+        float(np.abs(np.asarray(r)).sum())
+        for r in jax.tree.leaves(sq.residual)
+    ) > 0
+    for a, b in zip(jax.tree.leaves(pq), jax.tree.leaves(pf)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-9) < 0.05
+
+
+def test_hierarchical_quantized_dcn_only(mesh):
+    """quantized + hierarchical: the two-level lowering keeps ICI
+    reduce-scatter/all-gather full precision and moves only the
+    cross-slice shard int8 — numerics track the flat psum, and the HLO
+    shows f32 reduce-scatter alongside s8 permutes."""
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.parallel.mesh import build_hierarchical_mesh
+
+    hmesh = build_hierarchical_mesh(local_size=4)
+    params = _mlp_params()
+    batch = _mlp_batch(2 * N_DEV)
+    tx = optax.sgd(0.05)
+    step_h = hvdj.make_train_step(
+        _mlp_loss, tx, hmesh, hierarchical=True, quantized=True,
+        donate=False,
+    )
+    step_f = hvdj.make_train_step(_mlp_loss, tx, mesh, donate=False)
+    ph, sh = params, tx.init(params)
+    pf, sf = params, tx.init(params)
+    for _ in range(2):
+        ph, sh, lh = step_h(ph, sh, batch)
+        pf, sf, lf = step_f(pf, sf, batch)
+    for a, b in zip(jax.tree.leaves(ph), jax.tree.leaves(pf)):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-9) < 0.05
+
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, tx.init(params), batch),
+    )
+    hlo = step_h.lower(*avals).compiler_ir(dialect="hlo").as_hlo_text()
+    import re
+
+    s8_perm = [
+        ln for ln in hlo.splitlines()
+        if "collective-permute" in ln and re.search(r"s8\[", ln)
+    ]
+    f32_rs = [
+        ln for ln in hlo.splitlines()
+        if "reduce-scatter" in ln and re.search(r"f32\[", ln)
+    ]
+    assert s8_perm, "no s8 wire on the cross hop"
+    assert f32_rs, "ICI reduce-scatter lost full precision"
+
+
+def test_collective_plan_int8_reports_fewer_dcn_bytes():
+    """Acceptance: two-level wire_dtype=int8 plans report strictly fewer
+    DCN bytes-on-wire than full precision, same ICI bytes — in the plan
+    API and after symbolic verification."""
+    from horovod_tpu.analysis.plan_verify import verify_plan
+    from horovod_tpu.common.types import ReduceOp
+    from horovod_tpu.topo import candidate_plans, synthetic_model
+
+    m = synthetic_model(local=4, cross=2, generation="v5e")
+    for nbytes in (1 << 20, 64 << 20):
+        f32 = candidate_plans(m, "allreduce", nbytes,
+                              op=ReduceOp.SUM)["two-level"]
+        i8 = candidate_plans(m, "allreduce", nbytes, op=ReduceOp.SUM,
+                             wire_dtype="int8")["two-level"]
+        assert i8.bytes_per_hop["dcn"] < f32.bytes_per_hop["dcn"]
+        assert i8.bytes_per_hop["ici"] == f32.bytes_per_hop["ici"]
+        assert i8.to_dict()["wire_dtype"] == "int8"
+        assert verify_plan(i8, m) == []
+        assert verify_plan(f32, m) == []
+
+    # hvd.collective_plan plumbs wire_dtype through.
+    import horovod_tpu.jax as hvdj
+
+    plan = hvdj.collective_plan("allreduce", 1 << 20, wire_dtype="int8")
+    assert plan["wire_dtype"] == "int8"
+
+
+def test_ef_residual_excluded_from_digest():
+    """Guard integration: the EF residual is tracked-but-rank-local —
+    two states differing ONLY in residual digest identically; differing
+    inner state still trips the check."""
+    from horovod_tpu.guard.digest import state_digest, strip_rank_local
+    from horovod_tpu.ops.quantized import EFState
+
+    class S:
+        _tracked = ["opt", "step"]
+
+    def mk(inner, residual, step=3):
+        s = S()
+        s.opt = EFState(inner={"m": np.full(4, inner, np.float32)},
+                        residual={"m": np.full(4, residual, np.float32)})
+        s.step = step
+        return s
+
+    assert state_digest(mk(1.0, 0.0)) == state_digest(mk(1.0, 9.0))
+    assert state_digest(mk(1.0, 0.0)) != state_digest(mk(2.0, 0.0))
+    assert state_digest(mk(1.0, 0.0, step=3)) != state_digest(
+        mk(1.0, 0.0, step=4)
+    )
+    stripped = strip_rank_local({"a": mk(1.0, 5.0).opt})
+    assert "residual" not in str(jax.tree.structure(stripped))
+
+
+def test_quantized_wire_env_knob(mesh, monkeypatch):
+    """HOROVOD_QUANTIZED_WIRE makes quantized the default when the call
+    site leaves the knob unset; an explicit False still wins."""
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.common import env as env_mod
+
+    monkeypatch.setenv(env_mod.HOROVOD_QUANTIZED_WIRE, "int8")
+    assert hvdj._resolve_quantized(None) is True
+    assert hvdj._resolve_quantized(False) is False
+    monkeypatch.setenv(env_mod.HOROVOD_QUANTIZED_WIRE, "0")
+    assert hvdj._resolve_quantized(None) is False
+    monkeypatch.setenv(env_mod.HOROVOD_QUANTIZED_WIRE, "1")
+
+    params = _mlp_params()
+    batch = _mlp_batch(2 * N_DEV)
+    tx = optax.sgd(0.05)
+    step = hvdj.make_train_step(_mlp_loss, tx, mesh, donate=False)
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, tx.init(params), batch),
+    )
+    hlo = step.lower(*avals).compiler_ir(dialect="hlo").as_hlo_text()
+    import re
+
+    assert any(
+        "collective-permute" in ln and re.search(r"s8\[", ln)
+        for ln in hlo.splitlines()
+    ), "env knob did not engage the int8 wire"
+
+
+def test_quantized_metrics_counters(mesh):
+    """hvd_quantized_* trace-time counters: wire bytes + bytes saved per
+    bucket, labeled by path."""
+    import optax
+
+    from horovod_tpu import metrics
+
+    import horovod_tpu.jax as hvdj
+
+    metrics.install(True)
+    try:
+        params = _mlp_params()
+        batch = _mlp_batch(2 * N_DEV)
+        tx = optax.sgd(0.05)
+        step = hvdj.make_train_step(
+            _mlp_loss, tx, mesh, overlap=True, quantized=True,
+            donate=False, fusion_threshold_bytes=1, first_bucket_bytes=1,
+        )
+        step(params, tx.init(params), batch)
+        snap = metrics.snapshot()
+        assert "hvd_quantized_wire_bytes_total" in snap
+        assert "hvd_quantized_bytes_saved_total" in snap
+        saved = sum(
+            s["value"]
+            for s in snap["hvd_quantized_bytes_saved_total"]["series"]
+        )
+        assert saved > 0
+    finally:
+        metrics.reset()

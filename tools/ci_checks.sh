@@ -47,6 +47,13 @@
 # synthetic topologies byte-identical across two runs, hierarchical DCN
 # bytes strictly below flat, homogeneity gate enforced
 # (docs/topology.md). Pure cost model, no backend. Budget: under 10s.
+#
+# Stage 8 (make quant-smoke; skip with HVD_CI_SKIP_QUANT=1): the
+# quantized-wire smoke — a 2-rank streamed-quantized train step with EF
+# state threaded, bitwise-equal to the post-hoc quantized step, every
+# collective-permute payload s8 in the lowered HLO, and the event log
+# byte-identical across two runs (docs/overlap.md "Quantized wire
+# compression"). Budget: under 15s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -97,4 +104,11 @@ if [ "${HVD_CI_SKIP_TOPO:-0}" != "1" ]; then
     python tools/topo_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: topo smoke plans stable in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_QUANT:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/quant_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: quant smoke bitwise+s8+EF verified in ${elapsed}s"
 fi

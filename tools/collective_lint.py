@@ -14,8 +14,9 @@ Runs the analyzer passes from ``horovod_tpu.analysis``:
    plan the topology compositor can emit (all collectives x all
    algorithms x the 1/2/3-level topology grid). Pure python, no jax.
  - ``divergence``: Pass 4 over the shipped ``make_train_step`` variants
-   (post-hoc, overlap, hierarchical-auto, guard-skip) — the SPMD
-   rank-divergence analyzer must report zero findings on all of them.
+   (post-hoc, overlap, hierarchical-auto, guard-skip,
+   quantized-overlap) — the SPMD rank-divergence analyzer must report
+   zero findings on all of them.
  - ``sharding``: Pass 5 — the reference DP x TP regex->PartitionSpec
    rule table validated against its mesh and GPT-class param shapes.
    Pure python, no jax.
@@ -201,6 +202,10 @@ def _lint_divergence():
         ("overlap", mesh, {"overlap": True}),
         ("hierarchical-auto", hmesh, {"hierarchical": "auto"}),
         ("guard-skip", mesh, {"nonfinite": "skip"}),
+        # Int8 wire + EF residual threaded through the opt state: the
+        # quantized ring's axis_index/ppermute fori_loops must not trip
+        # the rank-divergence analyzer (constant trip counts).
+        ("quantized-overlap", mesh, {"overlap": True, "quantized": True}),
     )
     findings = []
     for label, m, kwargs in variants:

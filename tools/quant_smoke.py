@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Quantized-wire CI smoke (docs/overlap.md "Quantized wire compression").
+
+One process, a 2-rank virtual CPU mesh, <10s:
+
+1. STREAMED-QUANTIZED STEP — ``make_train_step(overlap=True,
+   quantized=True)`` with per-leaf buckets, EF state threaded through
+   the returned ``EFState`` opt state; the residual must be nonzero
+   after a few steps (error feedback is live, not a silent noop).
+2. PARITY — the post-hoc quantized step with the same bucket plan must
+   match the streamed one BITWISE (params and residuals): the two paths
+   share one reduction (`ops/fusion.quantized_ef_allreduce`).
+3. WIRE — the lowered HLO's collective-permutes all carry s8 payloads.
+4. BYTE-STABLE EVENT LOG — the whole run (per-step losses + a params
+   digest + the wire report) is serialized to a normalized JSON log and
+   the run is executed TWICE; the two logs must be byte-identical
+   (quantization is deterministic; a nondeterministic wire would poison
+   every replica-consistency guarantee the guard makes).
+
+Exit 0 = all assertions hold. Wired as tools/ci_checks.sh stage 8
+(skip: HVD_CI_SKIP_QUANT=1) and `make quant-smoke`.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 2-rank virtual mesh; must precede the first jax backend touch.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+D = 16
+STEPS = 4
+
+
+def _build():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(7)
+    params = {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3),
+            "b": jnp.zeros((D,), jnp.float32),
+        }
+        for i in range(3)
+    }
+    batch = (
+        jnp.asarray(rng.randn(8, D).astype(np.float32)),
+        jnp.asarray(rng.randn(8, D).astype(np.float32)),
+    )
+    return params, batch
+
+
+def _loss_fn(params, batch):
+    import jax.numpy as jnp
+
+    x, y = batch
+    h = x
+    for k in sorted(params):
+        h = jnp.tanh(h @ params[k]["w"] + params[k]["b"])
+    return jnp.mean((h - y) ** 2)
+
+
+def _digest(tree) -> str:
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.device_get(jax.tree.leaves(tree)):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _run_once() -> str:
+    """One full smoke pass; returns the normalized event log."""
+    import numpy as np
+
+    import jax
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.jax import EFState
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 2})
+    params, batch = _build()
+    tx = optax.sgd(0.05)
+    # Per-leaf buckets: the streamed groups and the post-hoc plan then
+    # quantize identical payloads -> bitwise parity.
+    kw = dict(fusion_threshold_bytes=1, first_bucket_bytes=1, donate=False)
+    step_stream = hvdj.make_train_step(
+        _loss_fn, tx, mesh, overlap=True, quantized=True, **kw
+    )
+    step_posthoc = hvdj.make_train_step(
+        _loss_fn, tx, mesh, quantized=True, **kw
+    )
+
+    events = []
+    ps, ss = params, tx.init(params)
+    pp, sp = params, tx.init(params)
+    for i in range(STEPS):
+        ps, ss, ls = step_stream(ps, ss, batch)
+        pp, sp, lp = step_posthoc(pp, sp, batch)
+        assert isinstance(ss, EFState) and isinstance(sp, EFState), (
+            "EF state not threaded through the opt state"
+        )
+        assert float(ls) == float(lp), (
+            f"step {i}: streamed loss {float(ls)} != posthoc {float(lp)}"
+        )
+        events.append({"step": i, "loss": f"{float(ls):.9e}"})
+    for a, b in zip(jax.tree.leaves(ps), jax.tree.leaves(pp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree.leaves(ss.residual), jax.tree.leaves(sp.residual)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    res_l1 = sum(
+        float(abs(np.asarray(x)).sum())
+        for x in jax.tree.leaves(ss.residual)
+    )
+    assert res_l1 > 0, "EF residual stayed zero — error feedback dead"
+
+    # Wire check: every collective-permute payload is s8.
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, tx.init(params), batch),
+    )
+    hlo = step_stream.lower(*avals).compiler_ir(
+        dialect="hlo"
+    ).as_hlo_text()
+    perms = [
+        ln for ln in hlo.splitlines() if "collective-permute" in ln
+    ]
+    assert perms, "no collective-permute in the quantized streamed HLO"
+    not_s8 = [ln for ln in perms if not re.search(r"s8\[", ln)]
+    assert not not_s8, f"non-s8 wire payloads: {not_s8[:2]}"
+
+    from horovod_tpu.common.quant import int8_saved_bytes
+
+    n_grad_bytes = 4 * sum(x.size for x in jax.tree.leaves(params))
+    log = {
+        "events": events,
+        "params_digest": _digest(ps),
+        "residual_digest": _digest(ss.residual),
+        "collective_permutes": len(perms),
+        "gradient_bytes": n_grad_bytes,
+        "bytes_saved_per_round": int8_saved_bytes(n_grad_bytes),
+    }
+    return json.dumps(log, sort_keys=True)
+
+
+def main() -> int:
+    t0 = time.time()
+    log1 = _run_once()
+    log2 = _run_once()
+    assert log1 == log2, (
+        "quantized smoke is not byte-stable across runs:\n"
+        f"run1: {log1}\nrun2: {log2}"
+    )
+    doc = json.loads(log1)
+    print(
+        f"[quant-smoke] OK in {time.time() - t0:.1f}s: "
+        f"{STEPS} streamed==posthoc steps bitwise, EF live, "
+        f"{doc['collective_permutes']} s8 permutes, "
+        f"{doc['bytes_saved_per_round']}/{doc['gradient_bytes']} bytes "
+        f"saved per round, log byte-stable"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

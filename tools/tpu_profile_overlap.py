@@ -508,6 +508,9 @@ def _dependency_stats(pre_hlo: str) -> dict:
     token_re = re.compile(r"%?[A-Za-z_][\w.\-]*")
     ar_re = re.compile(r"\ball-reduce(?:-start)?\(")
     scalar_re = re.compile(r"^\(?\s*\w+\[\]")
+    # Full-scalar result only: a while carrying (s32[], f32[1024], ...)
+    # is NOT scalar even though its type string starts with s32[].
+    pure_scalar_re = re.compile(r"^\s*\w+\[\]\s")
     compute_re = re.compile(r"=?\s*.*\b(dot|convolution|fusion)\(")
 
     total = {
@@ -515,8 +518,16 @@ def _dependency_stats(pre_hlo: str) -> dict:
         "scalar_all_reduce_count": 0,
         "independent_all_reduce_groups": 0,
         "overlappable_compute_per_all_reduce": [],
+        # Superset counters that also see collectives buried in called
+        # computations (the quantized ring's ppermute fori_loops): a
+        # "collective node" is a direct wire op or a call/while whose
+        # body transitively executes one.
+        "collective_count": 0,
+        "independent_collective_groups": 0,
     }
-    for insts in _parse_hlo(pre_hlo).values():
+    comps = _parse_hlo(pre_hlo)
+    coll_comps = _collective_comp_names(comps)
+    for insts in comps.values():
         defined = {name: rhs for name, rhs in insts}
         deps = {}
         for name, rhs in insts:
@@ -528,8 +539,19 @@ def _dependency_stats(pre_hlo: str) -> dict:
         for name, ds in deps.items():
             for d in ds:
                 rdeps.setdefault(d, set()).add(name)
+        # Indirect collectives: only while loops (the quantized ring's
+        # fori_loop form) — generic call/tuple wrappers would add one
+        # phantom "group" per nesting level.
+        colls = [
+            n for n, r in insts
+            if (_collective_re().search(r)
+                or (" while(" in r
+                    and any(t in coll_comps
+                            for t in token_re.findall(r))))
+            and not pure_scalar_re.match(r)
+        ]
         ars = [n for n, r in insts if ar_re.search(r)]
-        if not ars:
+        if not ars and not colls:
             continue
         grad_ars = [n for n in ars if not scalar_re.match(defined[n])]
         total["all_reduce_count"] += len(grad_ars)
@@ -546,6 +568,11 @@ def _dependency_stats(pre_hlo: str) -> dict:
             total["overlappable_compute_per_all_reduce"].append(
                 len(compute - anc - desc)
             )
+        total["collective_count"] += len(colls)
+        for c in colls:
+            anc = _reach(c, deps)
+            if not any(o in anc for o in colls if o != c):
+                total["independent_collective_groups"] += 1
     return total
 
 
@@ -588,6 +615,83 @@ _HLO_DTYPE_BYTES = {
     "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "s32": 4,
     "u64": 8, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
 }
+
+_COLLECTIVE_RE = None
+
+
+def _collective_re():
+    global _COLLECTIVE_RE
+    if _COLLECTIVE_RE is None:
+        import re
+
+        _COLLECTIVE_RE = re.compile(
+            r"\b(all-reduce|collective-permute|all-gather|reduce-scatter"
+            r"|all-to-all)(?:-start)?\("
+        )
+    return _COLLECTIVE_RE
+
+
+def _collective_comp_names(comps) -> set:
+    """Computations that (transitively) execute a wire collective: a
+    while/call/fusion whose body contains one IS a collective node for
+    dependence purposes — the quantized ring lives inside ``fori_loop``
+    while bodies, invisible to a flat all-reduce scan."""
+    import re
+
+    token_re = re.compile(r"%?[A-Za-z_][\w.\-]*")
+    direct = _collective_re()
+    coll = {
+        name for name, insts in comps.items()
+        if any(direct.search(rhs) for _, rhs in insts)
+    }
+    changed = True
+    while changed:
+        changed = False
+        for name, insts in comps.items():
+            if name in coll:
+                continue
+            for _, rhs in insts:
+                if any(t in coll for t in token_re.findall(rhs)):
+                    coll.add(name)
+                    changed = True
+                    break
+    return coll
+
+
+def _wire_bytes_stats(pre_hlo: str) -> dict:
+    """Static bytes-on-wire per collective opcode, keyed by element
+    dtype, read off the pre-optimization HLO result shapes (s8 vs f32
+    operand widths — the structural evidence that the quantized build
+    actually moves int8+scales, not f32). Scalar ([] ) results are
+    excluded (loss pmeans); a ring stage inside a while body is counted
+    once per instruction, not per trip — this is a structural census,
+    not a dynamic byte meter."""
+    import re
+
+    shape_re = re.compile(r"(\w+)\[([\d,]*)\]")
+    out: dict = {"by_dtype": {}, "by_op": {}}
+    for insts in _parse_hlo(pre_hlo).values():
+        for _, rhs in insts:
+            m = _collective_re().search(rhs)
+            if not m:
+                continue
+            op = m.group(1)
+            # Only the result type portion, left of the opcode.
+            type_part = rhs[:m.start()]
+            for dtype, dims in shape_re.findall(type_part):
+                if dtype not in _HLO_DTYPE_BYTES or not dims.strip():
+                    continue  # unknown token or scalar
+                elems = 1
+                for d in dims.split(","):
+                    if d.strip():
+                        elems *= int(d)
+                nbytes = elems * _HLO_DTYPE_BYTES[dtype]
+                out["by_dtype"][dtype] = (
+                    out["by_dtype"].get(dtype, 0) + nbytes
+                )
+                per_op = out["by_op"].setdefault(op, {})
+                per_op[dtype] = per_op.get(dtype, 0) + nbytes
+    return out
 
 
 def _topo_plan_report(pre_hlo: str) -> dict:
@@ -656,16 +760,19 @@ def _structural_stats(lowered) -> dict:
     out["overlap_eligible_all_reduces"] = sum(
         1 for c in out["overlappable_compute_per_all_reduce"] if c > 0
     )
+    out["bytes_on_wire"] = _wire_bytes_stats(pre)
     out["topo_plans"] = _topo_plan_report(pre)
     return out
 
 
-def _structural_mlp(overlap: bool):
+def _structural_mlp(overlap: bool, quantized: bool = False):
     """The 3-layer MLP phase-B program. The default build runs the
     post-hoc path at the reference 64 MB fusion threshold — one bucket,
     one barrier-like all-reduce depending on the whole backward ("vs 1
     today"). The overlap build streams with a 64 KB first bucket and a
-    1 MB threshold so the 1 MB fp32 layers each become a streamed group."""
+    1 MB threshold so the 1 MB fp32 layers each become a streamed group;
+    the quantized build additionally moves each streamed bucket over the
+    int8 wire (collective-permutes on s8 instead of one f32 psum)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -690,7 +797,8 @@ def _structural_mlp(overlap: bool):
         if overlap else {}
     )
     step = hvdj.make_train_step(
-        loss_fn, tx, mesh, donate=False, overlap=overlap, **kw,
+        loss_fn, tx, mesh, donate=False, overlap=overlap,
+        quantized=quantized, **kw,
     )
     params_aval = {
         f"layer{i}": {
@@ -707,7 +815,7 @@ def _structural_mlp(overlap: bool):
     return step.lower(params_aval, opt_aval, batch_aval)
 
 
-def _structural_transformer(overlap: bool):
+def _structural_transformer(overlap: bool, quantized: bool = False):
     """A small fp32 TransformerLM phase-B program (dense attention — the
     Pallas interpreter would bury the backward in while loops and hide the
     compute from the structural counters)."""
@@ -751,7 +859,8 @@ def _structural_transformer(overlap: bool):
         if overlap else {}
     )
     step = hvdj.make_train_step(
-        loss_fn, tx, mesh, donate=False, overlap=overlap, **kw,
+        loss_fn, tx, mesh, donate=False, overlap=overlap,
+        quantized=quantized, **kw,
     )
     params_aval = jax.eval_shape(
         lambda r, t: model.init(r, t)["params"],
@@ -773,18 +882,28 @@ def structural_mode(args) -> int:
     jax.config.update("jax_platforms", "cpu")
 
     results = {}
-    for mode, overlap in (("default", False), ("overlap", True)):
+    for mode, overlap, quantized in (
+        ("default", False, False),
+        ("overlap", True, False),
+        ("quantized", True, True),
+    ):
         t0 = time.time()
         per = {}
         for prog, builder in (
             ("mlp3", _structural_mlp),
             ("transformer", _structural_transformer),
         ):
-            per[prog] = _structural_stats(builder(overlap))
+            per[prog] = _structural_stats(builder(overlap, quantized))
             print(
                 f"[overlap] structural {mode}/{prog}: "
                 f"independent_groups={per[prog]['independent_all_reduce_groups']} "
+                f"independent_collectives={per[prog]['independent_collective_groups']} "
                 f"pairs_with_overlap={per[prog]['pairs_with_overlap']}",
+                flush=True,
+            )
+            wb = per[prog]["bytes_on_wire"]["by_dtype"]
+            print(
+                f"[overlap] wire bytes {mode}/{prog}: {wb}",
                 flush=True,
             )
             tp = per[prog]["topo_plans"]
@@ -803,6 +922,7 @@ def structural_mode(args) -> int:
                 "status": "ok",
                 "kind": "cpu-structural",
                 "overlap": overlap,
+                "quantized": quantized,
                 "elapsed_s": round(time.time() - t0, 2),
                 **per,
             },
@@ -831,6 +951,24 @@ def structural_mode(args) -> int:
                     f"{prog}: overlap groups not > default "
                     f"({st['independent_all_reduce_groups']} vs "
                     f"{base['independent_all_reduce_groups']})"
+                )
+            # Quantized-overlap: >= 3 independent collective groups
+            # (the streamed buckets, now int8 ring loops) and the wire
+            # payload actually s8 — non-scalar f32 collective traffic
+            # must vanish (only the int8+scales buffers move).
+            qt = results["quantized"]["phase_b"][prog]
+            if qt["independent_collective_groups"] < 3:
+                failed.append(
+                    f"{prog}: quantized independent_collective_groups="
+                    f"{qt['independent_collective_groups']} < 3"
+                )
+            qwb = qt["bytes_on_wire"]["by_dtype"]
+            if qwb.get("s8", 0) <= 0:
+                failed.append(f"{prog}: quantized build moves no s8 bytes")
+            if qwb.get("f32", 0) > 0:
+                failed.append(
+                    f"{prog}: quantized build still moves "
+                    f"{qwb['f32']} non-scalar f32 collective bytes"
                 )
         if failed:
             print("[overlap] STRUCTURAL ASSERTIONS FAILED:", file=sys.stderr)
