@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -16,7 +16,7 @@ test:
 # Pass 4 over the shipped train-step variants, Pass 5 over the reference
 # sharding-rule table.
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -60,4 +60,13 @@ topo-smoke:
 quant-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/quant_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke test
+# Fleet-tracing smoke (docs/timeline.md "Fleet tracing"): 2-rank run with
+# a seeded rank-1 delay fault — merged Perfetto trace with per-rank +
+# driver lanes and clock metadata, hvd_step_skew_seconds /
+# hvd_straggler_total{rank="1"} on /metrics, flight-recorder dumps from
+# an injected guard abort rendered as an aligned postmortem, normalized
+# summary byte-identical across two runs, ~2x15s CPU.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke test

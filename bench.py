@@ -576,6 +576,17 @@ def run_lm_benchmark(args) -> int:
 
     calls_per_iter = 1 if args.scan else args.num_batches_per_iter
     steps_per_iter = args.num_batches_per_iter
+    # Fleet-tracing step tap (docs/timeline.md "Step spans"): with
+    # HOROVOD_TRACE set the timed calls record host-side step-boundary
+    # spans (stamped with the wire/overlap correlation ids) feeding the
+    # per-step summary below; disabled, wrap_step returns fn UNCHANGED.
+    from horovod_tpu import trace as _trace
+
+    fn = _trace.wrap_step(
+        fn,
+        overlap=bool(args.overlap), quantized=bool(args.quantized),
+        wire_dtype="int8" if args.quantized else "f32",
+    )
     tok_secs, iter_times = [], []
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
@@ -618,6 +629,35 @@ def run_lm_benchmark(args) -> int:
     if args.zero1:
         mode += "+zero1"
 
+    # Per-step skew summary (docs/timeline.md "Step spans & straggler
+    # attribution"): a single-controller bench has one host process, so
+    # cross-rank HOST skew is structurally zero here — the block still
+    # reports the local step-span distribution (trace tap when armed,
+    # else iteration-level timing), and a multi-process `hvdrun` round
+    # gets real skew via the driver's hvd_step_skew_seconds /
+    # hvd_straggler_total metrics and tools/trace_merge.py.
+    span_summary = _trace.step_summary()
+    if not span_summary.get("steps"):
+        per_step = sorted(dt / steps_per_iter for dt in iter_times)
+        span_summary = {
+            "steps": steps_per_iter * args.num_iters,
+            "p50_s": round(per_step[len(per_step) // 2], 6),
+            "p99_s": round(per_step[-1], 6),
+            "source": "iter-timing",
+        }
+    else:
+        span_summary["source"] = "trace-step-tap"
+    step_skew = {
+        "step_spans": span_summary,
+        "p50_skew_s": 0.0,
+        "p99_skew_s": 0.0,
+        "worst_rank": None,
+        "ranks_observed": 1,
+        "note": "single-controller run: host-side cross-rank skew needs "
+                "the multi-process launcher (hvd_step_skew_seconds / "
+                "hvd_straggler_total on the driver's /metrics)",
+    }
+
     print(json.dumps({
         "metric": "transformer_synthetic_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -650,6 +690,7 @@ def run_lm_benchmark(args) -> int:
                     if full_wire else 0.0
                 ),
             },
+            "step_skew": step_skew,
             "scan": bool(args.scan),
             "mfu": mfu,
             "flops_per_step_per_chip": (

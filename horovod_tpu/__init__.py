@@ -169,6 +169,7 @@ def init(config: Optional[Config] = None) -> None:
                 )
                 _start_profiler(cfg)
                 _start_metrics_pusher(topo)
+                _start_trace_pusher(topo)
                 return
             except NotImplementedError:
                 raise
@@ -184,6 +185,7 @@ def init(config: Optional[Config] = None) -> None:
         _runtime.start()
         _start_profiler(cfg)
         _start_metrics_pusher(topo)
+        _start_trace_pusher(topo)
 
 
 def _start_profiler(cfg: Config) -> None:
@@ -210,6 +212,42 @@ def _start_profiler(cfg: Config) -> None:
 
 _profiler_active = False
 _metrics_pusher = None
+_trace_pusher = None
+
+
+def _start_trace_pusher(topo) -> None:
+    """Worker-side fleet-trace publisher (docs/timeline.md "Fleet
+    tracing"): with HOROVOD_TRACE set and an elastic KV rendezvous in
+    the environment, estimate the clock offset against the driver (KV
+    ping RTT/2, recorded as trace metadata) and push this rank's span
+    window so the driver can merge the fleet. No-op otherwise."""
+    global _trace_pusher
+    from . import trace as _trace_mod
+
+    if not _trace_mod.ACTIVE:
+        return
+    # The tap armed at import, possibly before this generation's rank
+    # assignment landed in the env — adopt the live rank (an in-process
+    # rejoin re-enters here after shutdown() stopped the old pusher).
+    _trace_mod.TAP.rank = topo.rank
+    if _trace_pusher is not None:
+        return
+    import os as _os
+
+    addr = _os.environ.get("HOROVOD_ELASTIC_KV_ADDR", "")
+    port = _os.environ.get("HOROVOD_ELASTIC_KV_PORT", "")
+    if not addr or not port:
+        return
+    from .trace.pusher import TracePusher
+
+    try:
+        _trace_pusher = TracePusher(addr, int(port), topo.rank)
+    except Exception as exc:  # noqa: BLE001 - tracing never blocks init
+        import logging
+
+        logging.getLogger("horovod_tpu").warning(
+            "could not start the trace pusher: %s", exc
+        )
 
 
 def _start_metrics_pusher(topo) -> None:
@@ -254,11 +292,19 @@ def metrics_snapshot() -> dict:
 
 def shutdown() -> None:
     global _runtime, _mesh, _profiler_active, _ps_barrier_seq
-    global _metrics_pusher
+    global _metrics_pusher, _trace_pusher
     with _lock:
         if _runtime is not None:
             _runtime.shutdown()
             _runtime = None
+        if _trace_pusher is not None:
+            # Stopped AFTER the runtime so the final window carries the
+            # teardown-time spans (same ordering as the metrics pusher).
+            try:
+                _trace_pusher.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            _trace_pusher = None
         if _metrics_pusher is not None:
             # Stopped AFTER the runtime so the final push carries the
             # teardown-time counter values.
@@ -1238,9 +1284,13 @@ __all__ = [
     "elastic",
     "metrics",
     "metrics_snapshot",
+    "trace",
 ]
 
 from . import elastic  # noqa: E402  (hvd.elastic.run / State / ObjectState)
 # hvd.metrics is the metrics subpackage, made callable so hvd.metrics()
 # returns the flat snapshot dict (see metrics/__init__.py).
 from . import metrics  # noqa: E402, F401
+# hvd.trace is the fleet-tracing subpackage (docs/timeline.md "Fleet
+# tracing"): step tap, flight recorder, KV trace shipping.
+from . import trace  # noqa: E402, F401

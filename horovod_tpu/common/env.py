@@ -128,6 +128,19 @@ HOROVOD_TOPOLOGY_PLAN = "HOROVOD_TOPOLOGY_PLAN"
 # buckets over the int8+scales wire (flat: every hop; hierarchical:
 # DCN only), with the EF residual carried in optimizer state.
 HOROVOD_QUANTIZED_WIRE = "HOROVOD_QUANTIZED_WIRE"
+# Fleet tracing (docs/timeline.md "Fleet tracing"; horovod_tpu/trace
+# reads these directly, like the fault/metrics/guard knobs):
+# HOROVOD_TRACE arms the span ring + step tap + KV shipping;
+# HOROVOD_TRACE_DIR points the flight recorder and the driver's
+# collection at a directory (setting it alone also arms the recorder);
+# the remaining knobs set the ring capacity (events), the worker push
+# cadence, and the cross-rank step skew above which the slowest rank is
+# charged one hvd_straggler_total count.
+HOROVOD_TRACE = "HOROVOD_TRACE"
+HOROVOD_TRACE_DIR = "HOROVOD_TRACE_DIR"
+HOROVOD_TRACE_RING_EVENTS = "HOROVOD_TRACE_RING_EVENTS"
+HOROVOD_TRACE_PUSH_INTERVAL_S = "HOROVOD_TRACE_PUSH_INTERVAL_S"
+HOROVOD_TRACE_STRAGGLER_THRESHOLD_S = "HOROVOD_TRACE_STRAGGLER_THRESHOLD_S"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64

@@ -26,6 +26,7 @@ from ..common.topology import Topology
 from ..fault import injector as _fault
 from .. import guard as _guard
 from .. import metrics as _metrics
+from .. import trace as _trace
 from ..common.types import (
     DataType,
     ReduceOp,
@@ -483,6 +484,20 @@ class NativeRuntime:
             if status_code == 0
             else Status(StatusType(status_code), error)
         )
+        if _trace.ACTIVE:
+            # Fleet-trace span carrying the SAME hvd_plan_<id> string
+            # the C++ timeline stamps on this plan's activity events and
+            # the jax.profiler annotation above wraps its execution in —
+            # one id links step → plan → collective across all three
+            # artifacts (docs/timeline.md).
+            _trace.TAP.event(
+                "hvd_plan", ph="X", cat="plan",
+                ts=time.time() - duration, dur=duration,
+                plan=f"hvd_plan_{plan['id']}", op=op_label,
+                tensors=len(names),
+                bytes=int(plan.get("total_bytes", 0) or 0),
+                ok=status_code == 0,
+            )
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_plans_total", op=op_label)
             _metrics.TAP.observe(

@@ -35,6 +35,7 @@ from ..common.topology import Topology
 from ..fault import injector as _fault
 from .. import guard as _guard
 from .. import metrics as _metrics
+from .. import trace as _trace
 from ..common.types import (
     DUPLICATE_NAME_ERROR_FMT,
     ReduceOp,
@@ -884,6 +885,11 @@ class Runtime:
                 )
             if report.shutdown:
                 _metrics.TAP.inc("hvd_stall_shutdowns_total")
+        if report.aborted and _trace.ACTIVE:
+            # Flight recorder (docs/timeline.md): a stall escalation is
+            # exactly the moment "what was the fleet doing" matters —
+            # persist the last moments before the waiters unwind.
+            _trace.TAP.flight_dump("stall-abort")
         for name in report.aborted:
             # Rung 2: abort the individual stalled tensor — hand its
             # waiter a named status instead of letting it hang — and keep
@@ -916,6 +922,8 @@ class Runtime:
                 "the runtime so elastic recovery can re-form the world"
             )
             logger.error("%s", self._drain_status.reason)
+            if _trace.ACTIVE:
+                _trace.TAP.flight_dump("stall-shutdown")
             self._shutdown.set()
 
     def _perform_operation(self, response: Response) -> None:
@@ -960,7 +968,10 @@ class Runtime:
                     _metrics.TAP.observe(
                         "hvd_op_negotiate_seconds", now - ts, op=op_label
                     )
-        exec_t0 = time.perf_counter() if _metrics.ACTIVE else 0.0
+        exec_t0 = (
+            time.perf_counter()
+            if (_metrics.ACTIVE or _trace.ACTIVE) else 0.0
+        )
         if response.response_type == ResponseType.ERROR:
             # Coordinator-detected metadata conflict (or negotiation
             # failure): a named ABORT, same status class as the stall
@@ -988,6 +999,17 @@ class Runtime:
                 _metrics.TAP.observe("hvd_op_bytes", nbytes, op=op_label)
             if not status.ok():
                 _metrics.TAP.inc("hvd_op_errors_total", op=op_label)
+        if _trace.ACTIVE:
+            # Fleet-trace span for the fused response (the eager path's
+            # step → plan → collective link; the native core's analogue
+            # carries the hvd_plan_<id> correlation id).
+            _dur = time.perf_counter() - exec_t0
+            _trace.TAP.event(
+                "hvd_response", ph="X", cat="op",
+                ts=time.time() - _dur, dur=_dur,
+                op=op_label, tensors=len(entries),
+                ok=bool(status.ok()),
+            )
         if self.timeline.initialized:
             for e in entries:
                 self.timeline.end(e.name, timeline_name)

@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .. import guard as _guard
 from .. import metrics as _metrics
+from .. import trace as _trace
 from ..fault import injector as _fault_injector
 from ..fault import preemption as _preemption
 from ..fault.preemption import PreemptionInterrupt  # noqa: F401 (re-export)
@@ -183,6 +184,11 @@ class _ElasticContext:
         self.epoch = int(epoch)
         self._probe_failures = 0
         self.signal_attach()
+        if _trace.ACTIVE:
+            _trace.TAP.event(
+                "hvd_worker_reattach", cat="elastic",
+                gen=self.gen, epoch=self.epoch,
+            )
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_worker_reattaches_total")
         if _fault_injector.ACTIVE:
@@ -366,6 +372,10 @@ def _park_and_reattach(ctx: _ElasticContext, state=None) -> None:
     from ..fault.backoff import Backoff
 
     ctx._parks += 1
+    if _trace.ACTIVE:
+        _trace.TAP.event(
+            "hvd_worker_park", cat="elastic", gen=ctx.gen, epoch=ctx.epoch,
+        )
     if _metrics.ACTIVE:
         _metrics.TAP.inc("hvd_worker_parks_total")
     if _fault_injector.ACTIVE:
@@ -901,6 +911,13 @@ class State:
             cb()
 
     def commit(self) -> None:
+        if _trace.ACTIVE:
+            # Fleet-tracing step boundary (docs/timeline.md "Step
+            # spans"): one commit == one training step for loops that
+            # commit per step, so the inter-commit window doubles as the
+            # step span feeding the driver's skew attribution — unless a
+            # wrap_step tap already records real step spans.
+            _trace.TAP.commit_step()
         if _fault_injector.ACTIVE:
             # Chaos tap: one commit == one training step; kill/preempt
             # actions with at_step target this counter.

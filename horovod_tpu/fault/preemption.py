@@ -49,6 +49,17 @@ def request_preemption(reason: str = "") -> None:
             "preemption notice received (%s); will drain at the next "
             "commit", _reason,
         )
+        try:
+            # Flight recorder (docs/timeline.md): a SIGTERM'd worker may
+            # be gone before the graceful drain completes — persist the
+            # last moments the instant the notice lands. No-op when
+            # tracing is disabled.
+            from .. import trace as _trace
+
+            if _trace.ACTIVE:
+                _trace.TAP.flight_dump(f"preempt:{_reason}")
+        except Exception:  # noqa: BLE001 - the notice path must not die
+            pass
     _flag.set()
 
 

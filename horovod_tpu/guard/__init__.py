@@ -154,7 +154,12 @@ class GuardTap:
         )
         if self.policy == "abort":
             from .. import HorovodInternalError
+            from .. import trace as _trace
 
+            if _trace.ACTIVE:
+                # Flight recorder (docs/timeline.md): persist the last
+                # moments before the abort unwinds the submitter.
+                _trace.TAP.flight_dump("guard-abort")
             raise HorovodInternalError(
                 f"non-finite gradient guard (policy abort): tensor "
                 f"'{name}' contains {n_bad} non-finite value(s); refusing "

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import trace as _trace
 from ..common.compression import Compression
 from ..common.types import Adasum, Average, ReduceOp, Sum
 from ..guard import nonfinite as _nf
@@ -423,6 +424,18 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             "quantized=True already compresses the wire to int8; "
             "stacking cast compression would add loss for no bandwidth win"
         )
+    if _trace.ACTIVE:
+        # Step-span correlation ids for loops driven by this optimizer:
+        # the host-side step boundaries themselves come from wrap_step
+        # or the elastic commit seam (an optax transformation runs
+        # inside the caller's jit and has no host boundary of its own),
+        # but every step span they record carries this wire/overlap
+        # configuration. Disabled → not reached (NULL_TAP discipline).
+        _trace.TAP.note_plan(
+            optimizer="DistributedOptimizer",
+            wire_dtype="int8" if quantized else "f32",
+            overlap=bool(overlap),
+        )
 
     def init_fn(params):
         if use_ef:
@@ -775,8 +788,25 @@ def make_train_step(
         step, mesh, in_specs=(P(), P(), P(axis_name)), out_specs=P()
     )
     jitted = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+    def _maybe_trace(step_fn):
+        # Fleet-tracing step tap (docs/timeline.md "Step spans"):
+        # host-side step-boundary timestamps + step index, stamped with
+        # the build-time correlation ids so one trace links step →
+        # bucket → collective → hop. NULL_TAP discipline: disabled →
+        # the jitted function is returned UNCHANGED (wrap_step(f) is f).
+        return _trace.wrap_step(
+            step_fn,
+            overlap=overlap,
+            quantized=quantized,
+            hierarchical=str(hierarchical),
+            wire_dtype="int8" if quantized else "f32",
+            op=ReduceOp(op).name,
+            nonfinite=nonfinite_policy,
+        )
+
     if nonfinite_policy != "abort":
-        return jitted
+        return _maybe_trace(jitted)
 
     def aborting_step(params, opt_state, batch):
         import numpy as np
@@ -786,6 +816,10 @@ def make_train_step(
         if float(np.asarray(flag)) > 0:
             from .. import HorovodInternalError
 
+            if _trace.ACTIVE:
+                # Flight recorder: the abort is about to unwind into the
+                # elastic rollback — persist the last moments first.
+                _trace.TAP.flight_dump("guard-abort")
             raise HorovodInternalError(
                 "non-finite gradient guard (policy abort): a rank "
                 "produced NaN/Inf gradients this step; the update was "
@@ -794,7 +828,7 @@ def make_train_step(
             )
         return out[:-1]
 
-    return aborting_step
+    return _maybe_trace(aborting_step)
 
 
 class GradientAccumulator:

@@ -117,6 +117,25 @@ _CATALOG: Dict[str, str] = {
                               "interconnect hop for the selected plan",
     "hvd_mesh_fallback_total": "build_mesh degraded to a bare device "
                                "reshape (ICI adjacency lost)",
+    # Fleet tracing (docs/timeline.md "Fleet tracing").
+    "hvd_timeline_dropped_total": "Timeline events dropped after a "
+                                  "writer-thread failure or an "
+                                  "undrained shutdown",
+    "hvd_step_skew_seconds": "Cross-rank spread of step-end times per "
+                             "step (driver-side, raw wall clock)",
+    "hvd_straggler_total": "Steps on which this rank finished last with "
+                           "skew above the straggler threshold "
+                           "(labeled by rank)",
+    "hvd_trace_pushes_total": "Trace windows pushed to the driver over "
+                              "the KV plane",
+    "hvd_trace_collections_total": "Trace windows collected by the "
+                                   "driver's supervision loop",
+    "hvd_trace_flight_dumps_total": "Flight-recorder dumps written "
+                                    "(labeled by reason)",
+    "hvd_trace_clock_offset_seconds": "This worker's estimated wall-"
+                                      "clock offset vs the driver "
+                                      "(KV ping RTT/2; recorded, never "
+                                      "applied)",
 }
 
 _BUCKET_OVERRIDES = {

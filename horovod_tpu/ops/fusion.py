@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import metrics as _metrics
+from .. import trace as _trace
 from ..common import env as _env
 from ..common.types import ReduceOp, dtype_size, dtype_from_array
 from ..parallel.mesh import DATA_AXIS
@@ -145,6 +146,13 @@ def fused_allreduce(
     if not leaves:
         return tree
     buckets = plan_buckets(leaves, threshold_bytes)
+    if _trace.ACTIVE:
+        # Correlation ids for the fleet-trace step spans (trace-time,
+        # one note per compile): which fusion path reduced how many
+        # buckets this step.
+        _trace.TAP.note_plan(
+            fusion_path=label, fusion_buckets=len(buckets)
+        )
     if _metrics.ACTIVE:
         # Trace-time plan stats (one emission per compile, not per step).
         _metrics.TAP.set(
@@ -383,6 +391,12 @@ def quantized_ef_allreduce(
     if not leaves:
         return tree, ef
     buckets = plan_buckets(leaves, threshold_bytes)
+    if _trace.ACTIVE:
+        # Correlation ids for the fleet-trace step spans (trace-time):
+        # the EF int8 wire reduced this many buckets under this label.
+        _trace.TAP.note_plan(
+            fusion_path=label, fusion_buckets=len(buckets)
+        )
     results: List[jax.Array | None] = [None] * len(leaves)
     residuals: List[jax.Array | None] = [None] * len(leaves)
     average = op == ReduceOp.AVERAGE

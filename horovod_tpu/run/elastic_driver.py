@@ -38,6 +38,7 @@ import signal
 from . import journal as _journal_mod
 from . import launcher, safe_shell_exec
 from .. import metrics as _metrics
+from .. import trace as _trace
 from ..fault import injector as _fault
 from ..fault.plan import DRIVER_KINDS
 from .http_server import KVStoreServer
@@ -378,6 +379,32 @@ class ElasticDriver:
                 os.path.join(self._output_dir, "fault_events.driver.jsonl"),
             )
             self._log(f"fault plan armed (seed {plan.seed}): {sched_path}")
+        # Fleet tracing (docs/timeline.md "Fleet tracing"): the driver
+        # collects worker-pushed span windows off the KV plane, persists
+        # them (+ its own elastic/HA events) next to the worker logs for
+        # tools/trace_merge.py, and attributes per-step stragglers into
+        # hvd_step_skew_seconds / hvd_straggler_total{rank}.
+        self._trace_dir: Optional[str] = None
+        self._skew = None
+        if _trace.ACTIVE and self._output_dir:
+            self._trace_dir = (
+                self._env.get(_trace.TRACE_DIR_ENV, "")
+                or os.path.join(self._output_dir, "trace")
+            )
+            os.makedirs(self._trace_dir, exist_ok=True)
+            # Workers inherit the dir so flight-recorder dumps land
+            # where the postmortem collection can find them (same-host
+            # jobs; remote hosts keep their dumps locally).
+            self._env.setdefault(_trace.TRACE_DIR_ENV, self._trace_dir)
+            os.environ.setdefault(_trace.TRACE_DIR_ENV, self._trace_dir)
+            from ..trace.pusher import StepSkewTracker
+
+            self._skew = StepSkewTracker()
+            self._trace_event(
+                "hvd_driver_start",
+                resume=bool(self._resume), epoch=self._epoch,
+            )
+            self._log(f"fleet trace: collecting into {self._trace_dir}")
         if _metrics.ACTIVE:
             _metrics.TAP.set("hvd_driver_epoch", float(self._epoch))
         if self._journal is not None:
@@ -389,6 +416,115 @@ class ElasticDriver:
         self._log(f"rejoin mode: {self._rejoin_mode}")
 
     # ------------------------------------------------------------ pieces
+    def _trace_event(self, name: str, **args) -> None:
+        """One driver-lane fleet-trace event (generation publishes,
+        blacklists, failures, straggler attributions) — rendered on the
+        driver's own lane by tools/trace_merge.py. No-op when tracing is
+        disabled."""
+        if _trace.ACTIVE:
+            _trace.TAP.event(name, cat="driver", **args)
+
+    def _trace_collect(self, final: bool = False) -> None:
+        """Collect worker-pushed trace windows off the KV plane: persist
+        each rank's freshest window (and the driver's own lane) into the
+        trace directory, and feed per-step end times into the straggler
+        attribution. Runs on the supervision-loop beat; ``final`` also
+        bundles surviving flight-recorder dumps."""
+        if self._trace_dir is None:
+            return
+        from ..trace import pusher as _tpush
+        from ..utils.checkpoint import _atomic_write
+
+        windows: Dict[int, dict] = {}
+        for key, payload in self._kv.snapshot(_trace.KV_SCOPE).items():
+            if not key.startswith("rank."):
+                continue
+            suffix = key.split(".", 1)[1]
+            if not suffix.isdigit():
+                continue
+            doc = _tpush.decode_window(payload)
+            if doc is None:
+                continue
+            rank = int(suffix)
+            windows[rank] = doc
+            data = json.dumps(doc, sort_keys=True).encode()
+            try:
+                _atomic_write(
+                    os.path.join(self._trace_dir, f"rank.{rank}.json"),
+                    lambda f, d=data: f.write(d),
+                )
+            except OSError:
+                pass
+        if windows and _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_trace_collections_total")
+        if self._skew is not None:
+            for idx, skew, worst in self._skew.update(windows):
+                if _metrics.ACTIVE:
+                    _metrics.TAP.observe("hvd_step_skew_seconds", skew)
+                if skew >= self._skew.threshold_s:
+                    if _metrics.ACTIVE:
+                        _metrics.TAP.inc(
+                            "hvd_straggler_total", rank=str(worst)
+                        )
+                    self._trace_event(
+                        "hvd_straggler", step=idx, rank=worst,
+                        skew_s=round(skew, 6),
+                    )
+        try:
+            data = json.dumps(
+                _trace.TAP.window(), sort_keys=True
+            ).encode()
+            _atomic_write(
+                os.path.join(self._trace_dir, "driver.json"),
+                lambda f: f.write(data),
+            )
+        except OSError:
+            pass
+        if final:
+            self._collect_postmortem()
+
+    def _collect_postmortem(self) -> None:
+        """Bundle surviving per-rank flight-recorder dumps into
+        ``postmortem.json`` — the artifact ``tools/trace_merge.py
+        --postmortem`` renders as "the last N seconds before death, all
+        ranks, aligned"."""
+        import re as _re
+
+        try:
+            names = sorted(os.listdir(self._trace_dir))
+        except OSError:
+            return
+        dumps = []
+        for fn in names:
+            if not _re.fullmatch(r"flight\.rank\d+\.json", fn):
+                continue
+            try:
+                with open(os.path.join(self._trace_dir, fn)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(doc, dict):
+                dumps.append(doc)
+        if not dumps:
+            return
+        from ..utils.checkpoint import _atomic_write
+
+        bundle = json.dumps(
+            {"schema": 1, "collected_at": time.time(), "dumps": dumps},
+            sort_keys=True,
+        ).encode()
+        try:
+            _atomic_write(
+                os.path.join(self._trace_dir, "postmortem.json"),
+                lambda f: f.write(bundle),
+            )
+        except OSError:
+            return
+        self._log(
+            f"fleet trace: collected {len(dumps)} flight-recorder "
+            "dump(s) into postmortem.json"
+        )
+
     def _log(self, msg: str) -> None:
         line = f"[hvdrun elastic] {msg}"
         print(line, file=sys.stderr, flush=True)
@@ -809,6 +945,7 @@ class ElasticDriver:
     def _blacklist_host(self, host: str) -> None:
         strikes = self._quarantine_strikes.get(host, 0) + 1
         self._quarantine_strikes[host] = strikes
+        self._trace_event("hvd_blacklist", host=host, strikes=strikes)
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_elastic_blacklists_total", host=host)
         if self._blacklist_cooldown > 0:
@@ -988,6 +1125,7 @@ class ElasticDriver:
                 if now - w.spawned_at < action.after_s:
                     continue
                 self._preempts_fired.add(key)
+                self._trace_event("hvd_preempt_notice", worker=wid)
                 if _metrics.ACTIVE:
                     _metrics.TAP.inc("hvd_elastic_preempt_notices_total")
                 _fault.record_event(
@@ -1107,6 +1245,10 @@ class ElasticDriver:
         self._log(
             f"generation {self._gen}: size {len(slots)} over "
             f"{sorted({s.hostname for s in slots})}"
+        )
+        self._trace_event(
+            "hvd_generation_publish", gen=self._gen, size=len(slots),
+            epoch=self._epoch, sync_root=sync_root,
         )
         return {
             "controller_addr": controller_addr,
@@ -1272,6 +1414,12 @@ class ElasticDriver:
                     w.proc.terminate()
                 for f in w.outfiles:
                     f.close()
+            # Final fleet-trace collection (the workers' shutdown push
+            # landed by now) + the flight-dump postmortem bundle.
+            try:
+                self._trace_collect(final=True)
+            except Exception:  # noqa: BLE001 - teardown must complete
+                pass
             self._retire_services(keep=0)
             self._kv.stop()
             # Local respawn snapshots are keyed by this driver's pid —
@@ -1305,6 +1453,7 @@ class ElasticDriver:
                 # periodic journal refresh of worker-written KV signals.
                 self._publish_driver_doc()
                 self._journal_sync()
+                self._trace_collect()
             # Reap draining removed workers (exit code irrelevant);
             # terminate stragglers past the grace window.
             still_removing = []
@@ -1399,6 +1548,10 @@ class ElasticDriver:
                         self._log(
                             f"{wid} failed with exit code {rc} "
                             f"(host failures: {count})"
+                        )
+                        self._trace_event(
+                            "hvd_worker_failure", worker=wid, rc=rc,
+                            host_failures=count,
                         )
                     if self._finishing:
                         # A straggler crashing while the job winds down is

@@ -27,6 +27,21 @@ class _Handler(BaseHTTPRequestHandler):
         if key == "/metrics":
             self._serve_metrics()
             return
+        if key == "/clock":
+            # Fleet-tracing clock probe (docs/timeline.md "Fleet
+            # tracing"): workers ping this at attach and estimate their
+            # offset as driver_time - (t_send + t_recv)/2; the estimate
+            # is trace METADATA only, never applied to timestamps.
+            import json as _json
+            import time as _time
+
+            body = _json.dumps({"time": _time.time()}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_kv_server_requests_total", method="GET")
         with self.server.kv_lock:

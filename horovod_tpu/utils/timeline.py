@@ -13,10 +13,16 @@ The JSON loads in chrome://tracing / Perfetto exactly like the reference's.
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
 import time
 from typing import Optional
+
+from .. import metrics as _metrics
+from .. import trace as _trace
+
+logger = logging.getLogger("horovod_tpu.timeline")
 
 # Activity names, mirroring reference common.h:31-59 where applicable.
 QUEUE = "QUEUE"
@@ -39,18 +45,58 @@ class TimelineWriter:
         self._queue: "queue.Queue[Optional[dict]]" = queue.Queue()
         self._filename = filename
         self._healthy = True
+        self._drop_lock = threading.Lock()
+        self._crash_exc: Optional[BaseException] = None
+        self._warned = False
+        # Events lost to a dead writer thread or an undrained shutdown —
+        # also counted in hvd_timeline_dropped_total so a silently
+        # truncated trace is visible on /metrics.
+        self.dropped = 0
         self._thread = threading.Thread(
             target=self._run, name="hvd_timeline_writer", daemon=True
         )
         self._thread.start()
 
+    def _note_drops(self, n: int, why: str) -> None:
+        if n <= 0:
+            return
+        with self._drop_lock:
+            self.dropped += n
+            first = not self._warned
+            self._warned = True
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_timeline_dropped_total", n)
+        if first:
+            # One-shot: name the ORIGINAL failure — every later enqueue
+            # is dropped for the same root cause, and re-warning per
+            # event would bury it.
+            logger.warning(
+                "timeline %s: dropping events (%s; original error: %r); "
+                "further drops are counted in hvd_timeline_dropped_total "
+                "only", self._filename, why, self._crash_exc,
+            )
+
     def enqueue(self, event: dict) -> None:
         if self._healthy:
             self._queue.put(event)
+        else:
+            self._note_drops(1, "writer thread died")
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout: float = 5.0) -> None:
         self._queue.put(None)
-        self._thread.join(timeout=5.0)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # The join timed out with the thread still draining (or
+            # wedged on a slow filesystem): whatever is still queued
+            # will never be written by the time callers treat the file
+            # as final — say so instead of returning as if complete.
+            pending = self._queue.qsize()
+            logger.warning(
+                "timeline: writer thread still alive after %.1fs "
+                "shutdown timeout; ~%d queued event(s) will not reach "
+                "%s", timeout, pending, self._filename,
+            )
+            self._note_drops(max(pending, 1), "shutdown join timed out")
 
     def _run(self) -> None:
         try:
@@ -71,8 +117,11 @@ class TimelineWriter:
                     if self._queue.empty():
                         f.flush()
                 f.write("\n]\n")
-        except OSError:
+        except OSError as exc:
+            self._crash_exc = exc
             self._healthy = False
+            # Anything already queued behind the crash is lost too.
+            self._note_drops(self._queue.qsize(), "writer thread died")
 
 
 class Timeline:
@@ -144,6 +193,13 @@ class Timeline:
     def _emit(self, ev: dict) -> None:
         if self._writer is not None:
             self._writer.enqueue(ev)
+            if _trace.ACTIVE:
+                # Fleet tracing mirror (docs/timeline.md "Fleet
+                # tracing"): the same record lands in the bounded trace
+                # ring, wall-clock stamped, so the driver-merged fleet
+                # view and the flight recorder carry the per-tensor
+                # phases too. Disabled → not reached.
+                _trace.TAP.timeline_event(ev)
 
     def metadata(self, name: str, args: dict) -> None:
         """Emit a process-scoped metadata record (Chrome-trace "M" phase) —

@@ -54,6 +54,14 @@
 # collective-permute payload s8 in the lowered HLO, and the event log
 # byte-identical across two runs (docs/overlap.md "Quantized wire
 # compression"). Budget: under 15s.
+#
+# Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
+# fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
+# merged Perfetto trace (per-rank + driver lanes, clock-offset
+# metadata), hvd_step_skew_seconds + hvd_straggler_total{rank="1"} on
+# /metrics, flight-recorder dumps from an injected guard abort rendered
+# as an aligned postmortem, normalized summary byte-identical across
+# two runs (docs/timeline.md "Fleet tracing"). Budget: under 60s.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -111,4 +119,11 @@ if [ "${HVD_CI_SKIP_QUANT:-0}" != "1" ]; then
     python tools/quant_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: quant smoke bitwise+s8+EF verified in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_TRACE:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/trace_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: trace smoke merged+attributed+postmortem in ${elapsed}s"
 fi
