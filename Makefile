@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -16,7 +16,7 @@ test:
 # Pass 4 over the shipped train-step variants, Pass 5 over the reference
 # sharding-rule table.
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -69,4 +69,12 @@ quant-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/trace_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke test
+# Compiled-path offline-tuner smoke (docs/autotune.md "Compiled-path
+# offline tuning"): two tools/autotune_compiled.py runs byte-identical,
+# the tuned mlp3 step bitwise-equal to the untuned (and hand-set) step,
+# modeled cost <= default with a strict free-objective win on the
+# transformer program, stale-signature fallback warned loudly, <60s CPU.
+tune-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/tune_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke test

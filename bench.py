@@ -212,6 +212,15 @@ def _parse_args(argv=None):
              "incompatible with --zero1 (ZeRO re-shapes the reduction "
              "post-hoc)",
     )
+    parser.add_argument(
+        "--tuned", default="",
+        help="apply a pinned compiled-path tuning (tuned.json from "
+             "tools/autotune_compiled.py; docs/autotune.md) to the "
+             "benchmark step when its signature matches this "
+             "program+mesh — the chosen knobs are reported in the JSON "
+             "detail so tuner wins are attributable; a mismatch warns "
+             "and runs untuned",
+    )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.zero1 and args.model != "transformer":
@@ -275,6 +284,31 @@ def _force_platform(platform: str, cpu_devices: int) -> None:
         jax.config.update("jax_platforms", platform)
     except Exception:
         pass
+
+
+def _resolve_tuned(args, params, mesh):
+    """Resolve --tuned against the live program: returns
+    ``(step_kwargs_or_None, detail_block_or_None)``. The detail block
+    always lands in the report (matched or not) so a bench capture is
+    attributable to the exact knobs that produced it."""
+    if not getattr(args, "tuned", ""):
+        return None, None
+    from horovod_tpu import tune as T
+
+    cfg = T.load_tuned(args.tuned)
+    live = T.step_signature(params, mesh=mesh)
+    matched = T.signatures_match(cfg.signature, live)
+    if not matched:
+        T.warn_signature_mismatch(cfg, live.get("hash", "?"), "bench")
+    T.note_applied("file", cfg.signature_hash, matched, "bench")
+    detail = {
+        "path": args.tuned,
+        "program": cfg.program,
+        "signature": cfg.signature_hash,
+        "matched": bool(matched),
+        "knobs": dict(cfg.knobs) if matched else None,
+    }
+    return (T.tuned_step_kwargs(cfg) if matched else None), detail
 
 
 def _init_backend_with_retry(max_tries=4, base_sleep=15.0):
@@ -480,6 +514,30 @@ def run_lm_benchmark(args) -> int:
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     tx = optax.adamw(3e-4)
 
+    # Pinned offline tuning (--tuned; docs/autotune.md): applies to the
+    # replicated reduction paths (posthoc / overlap); ZeRO-1 reshapes
+    # the reduction and keeps its own knobs. Explicit CLI flags win.
+    tuned_kw, tuned_detail = _resolve_tuned(args, params, mesh)
+    if args.zero1 and tuned_detail is not None:
+        tuned_detail["note"] = (
+            "zero1 reshapes the reduction (reduce-scatter + gather); "
+            "tuned knobs not applied"
+        )
+        tuned_kw = None
+    quantized_eff = bool(args.quantized) or bool(
+        tuned_kw and tuned_kw["quantized"]
+    )
+    spg_kw = dict(quantized=quantized_eff)
+    ar_kw = dict(quantized=quantized_eff)
+    if tuned_kw:
+        spg_kw.update(
+            threshold_bytes=tuned_kw["fusion_threshold_bytes"],
+            first_bucket_bytes=tuned_kw["first_bucket_bytes"],
+        )
+        ar_kw.update(
+            fusion_threshold_bytes=tuned_kw["fusion_threshold_bytes"]
+        )
+
     def loss_fn(p, tok, lab):
         logits = model.apply({"params": p}, tok)
         return optax.softmax_cross_entropy_with_integer_labels(
@@ -516,17 +574,14 @@ def run_lm_benchmark(args) -> int:
                     # backward trace (EF off in the bench — it measures
                     # throughput; the residual add is elementwise noise).
                     return loss_fn(
-                        hvdj.stream_param_groups(
-                            p_, quantized=args.quantized
-                        ), tok_, lab_
+                        hvdj.stream_param_groups(p_, **spg_kw),
+                        tok_, lab_
                     )
 
                 loss, grads = jax.value_and_grad(streamed)(p, tok, lab)
             else:
                 loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
-                grads = hvdj.allreduce_gradients(
-                    grads, quantized=args.quantized
-                )
+                grads = hvdj.allreduce_gradients(grads, **ar_kw)
             updates, s = tx.update(grads, s, p)
             p = optax.apply_updates(p, updates)
             return p, s, jax.lax.pmean(loss, "data")
@@ -584,8 +639,8 @@ def run_lm_benchmark(args) -> int:
 
     fn = _trace.wrap_step(
         fn,
-        overlap=bool(args.overlap), quantized=bool(args.quantized),
-        wire_dtype="int8" if args.quantized else "f32",
+        overlap=bool(args.overlap), quantized=quantized_eff,
+        wire_dtype="int8" if quantized_eff else "f32",
     )
     tok_secs, iter_times = [], []
     for _ in range(args.num_iters):
@@ -619,15 +674,17 @@ def run_lm_benchmark(args) -> int:
     full_wire = int(grad_bytes * ring_factor)
     wire_bytes = (
         int(int8_wire_bytes(grad_bytes) * ring_factor)
-        if args.quantized else full_wire
+        if quantized_eff else full_wire
     )
     mode = (
         ("overlap+" if args.overlap else "")
-        + ("quantized" if args.quantized else
+        + ("quantized" if quantized_eff else
            ("streamed" if args.overlap else "posthoc"))
     )
     if args.zero1:
         mode += "+zero1"
+    if tuned_kw:
+        mode += "+tuned"
 
     # Per-step skew summary (docs/timeline.md "Step spans & straggler
     # attribution"): a single-controller bench has one host process, so
@@ -675,9 +732,10 @@ def run_lm_benchmark(args) -> int:
             "attention": "pallas-flash (interpret off-TPU)",
             "optimizer_state": "zero1-sharded" if args.zero1 else "replicated",
             "gradient_wire": (
-                "int8-quantized" if args.quantized else "full-precision"
+                "int8-quantized" if quantized_eff else "full-precision"
             ),
             "reduction_mode": mode,
+            "tuned": tuned_detail,
             "step_time_s": round(
                 float(np.mean(iter_times)) / steps_per_iter, 6
             ),
@@ -908,6 +966,20 @@ def run_benchmark(args) -> int:
     tx = optax.sgd(0.01, momentum=0.9)
     opt_state = tx.init(params)
 
+    # Pinned offline tuning (--tuned; docs/autotune.md).
+    tuned_kw, tuned_detail = _resolve_tuned(args, params, mesh)
+    spg_kw, ar_kw = {}, {}
+    if tuned_kw:
+        spg_kw = dict(
+            threshold_bytes=tuned_kw["fusion_threshold_bytes"],
+            first_bucket_bytes=tuned_kw["first_bucket_bytes"],
+            quantized=tuned_kw["quantized"],
+        )
+        ar_kw = dict(
+            fusion_threshold_bytes=tuned_kw["fusion_threshold_bytes"],
+            quantized=tuned_kw["quantized"],
+        )
+
     def loss_fn(p, bs, x, y, it):
         var_in = {"params": p, **({"batch_stats": bs} if has_bn else {})}
         out = model.apply(
@@ -927,7 +999,8 @@ def run_benchmark(args) -> int:
         if args.overlap:
             def streamed(p_, bs_, x_, y_, it_):
                 return loss_fn(
-                    hvdj.stream_param_groups(p_), bs_, x_, y_, it_
+                    hvdj.stream_param_groups(p_, **spg_kw),
+                    bs_, x_, y_, it_
                 )
 
             (loss, new_bs), grads = jax.value_and_grad(
@@ -939,7 +1012,7 @@ def run_benchmark(args) -> int:
             )(p, bs, x, y, it)
             # The whole reference DistributedOptimizer pipeline: fusion-
             # bucketed allreduce of gradients over the data axis.
-            grads = hvdj.allreduce_gradients(grads)
+            grads = hvdj.allreduce_gradients(grads, **ar_kw)
         new_bs = jax.tree.map(lambda v: jax.lax.pmean(v, "data"), new_bs)
         updates, s = tx.update(grads, s, p)
         p = optax.apply_updates(p, updates)
@@ -1049,6 +1122,7 @@ def run_benchmark(args) -> int:
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "scan": bool(args.scan),
         "dtype": "bf16 compute / f32 params",
+        "tuned": tuned_detail,
         "mfu": mfu,
         "flops_per_step_per_chip": (
             round(flops_per_step) if flops_per_step else None

@@ -281,4 +281,75 @@ void ParameterManager::Tune(double median_score) {
                       " flags=" + std::to_string(flags));
 }
 
+// --- offline-tuner golden probe ---------------------------------------------
+// The compiled-path offline tuner (horovod_tpu/tune/gp.py) is a pure-
+// Python port of the GP/EI math above. This exported probe runs the SAME
+// fit + acquisition (Kernel/Cholesky/CholSolve, the Tune() normalization
+// and EI formulas) on caller-provided 5-D observations, so the port is
+// golden-tested against the native engine itself instead of against a
+// hand-copied trace. Inputs are row-major: xs = n x 5 normalized design
+// points, ys = n raw scores, cands = m x 5 candidates. Outputs (any may
+// be null): posterior mean/variance and EI per candidate, plus the EI
+// argmax (first-wins tie break, like the Tune() grid scan). Returns 0,
+// or 1 on bad sizes, or 2 when the Cholesky fails.
+extern "C" int hvd_autotune_gp_probe(
+    const double* xs, const double* ys, int n,
+    const double* cands, int m,
+    double* post_mean, double* post_var, double* ei_out, int* ei_argmax) {
+  if (n <= 0 || m <= 0 || !xs || !ys || !cands) return 1;
+  std::vector<std::array<double, 5>> X(n);
+  for (int i = 0; i < n; ++i) {
+    for (int d = 0; d < 5; ++d) X[i][d] = xs[i * 5 + d];
+  }
+  double ymax = 1e-9;
+  for (int i = 0; i < n; ++i) ymax = std::max(ymax, ys[i]);
+  std::vector<double> y(n);
+  double mean = 0;
+  for (int i = 0; i < n; ++i) {
+    y[i] = ys[i] / ymax;
+    mean += y[i];
+  }
+  mean /= n;
+  for (auto& v : y) v -= mean;
+  std::vector<double> K(n * n);
+  constexpr double kNoise = 0.05;
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < n; ++j) K[i * n + j] = Kernel(X[i], X[j]);
+    K[i * n + i] += kNoise;
+  }
+  std::vector<double> L = K;
+  if (!Cholesky(L, n)) return 2;
+  std::vector<double> alpha = y;
+  CholSolve(L, n, alpha);
+  double fbest = *std::max_element(y.begin(), y.end());
+  double best_ei = -1;
+  int best = 0;
+  for (int c = 0; c < m; ++c) {
+    std::array<double, 5> x;
+    for (int d = 0; d < 5; ++d) x[d] = cands[c * 5 + d];
+    std::vector<double> k(n);
+    for (int i = 0; i < n; ++i) k[i] = Kernel(x, X[i]);
+    double mu = 0;
+    for (int i = 0; i < n; ++i) mu += k[i] * alpha[i];
+    std::vector<double> v = k;
+    CholSolve(L, n, v);
+    double var = Kernel(x, x) + kNoise;
+    for (int i = 0; i < n; ++i) var -= k[i] * v[i];
+    var = std::max(var, 1e-10);
+    double sigma = std::sqrt(var);
+    constexpr double kXi = 0.01;
+    double z = (mu - fbest - kXi) / sigma;
+    double e = (mu - fbest - kXi) * NormCdf(z) + sigma * NormPdf(z);
+    if (post_mean) post_mean[c] = mu;
+    if (post_var) post_var[c] = var;
+    if (ei_out) ei_out[c] = e;
+    if (e > best_ei) {
+      best_ei = e;
+      best = c;
+    }
+  }
+  if (ei_argmax) *ei_argmax = best;
+  return 0;
+}
+
 }  // namespace hvd

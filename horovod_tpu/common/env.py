@@ -128,6 +128,13 @@ HOROVOD_TOPOLOGY_PLAN = "HOROVOD_TOPOLOGY_PLAN"
 # buckets over the int8+scales wire (flat: every hop; hierarchical:
 # DCN only), with the EF residual carried in optimizer state.
 HOROVOD_QUANTIZED_WIRE = "HOROVOD_QUANTIZED_WIRE"
+# Compiled-path offline tuning (docs/autotune.md "Compiled-path offline
+# tuning"): path to a ``tuned.json`` emitted by
+# tools/autotune_compiled.py. ``make_train_step`` / DistributedOptimizer
+# read it when their ``tuned`` argument is left unset and apply the
+# pinned knobs IF the live step's signature matches; a mismatch warns
+# loudly and runs untuned. horovod_tpu/tune reads this directly.
+HOROVOD_TUNED_FILE = "HOROVOD_TUNED_FILE"
 # Fleet tracing (docs/timeline.md "Fleet tracing"; horovod_tpu/trace
 # reads these directly, like the fault/metrics/guard knobs):
 # HOROVOD_TRACE arms the span ring + step tap + KV shipping;
@@ -290,6 +297,8 @@ class Config:
     # XLA perf-flag preset name ("auto" resolves per platform).
     fusion_first_bucket_bytes: int = 1024 * 1024
     xla_perf_preset: str = "auto"
+    # Compiled-path pinned tuning file ("" = untuned; docs/autotune.md).
+    tuned_file: str = ""
     cycle_time_ms: float = 5.0
     cache_capacity: int = 1024
     cache_enabled: bool = True
@@ -342,6 +351,7 @@ class Config:
         cfg.xla_perf_preset = (
             os.environ.get(HOROVOD_XLA_PERF_PRESET, "") or cfg.xla_perf_preset
         )
+        cfg.tuned_file = os.environ.get(HOROVOD_TUNED_FILE, cfg.tuned_file)
         # Reference accepts cycle time in ms as float via HOROVOD_CYCLE_TIME.
         cfg.cycle_time_ms = _get_float(HOROVOD_CYCLE_TIME, cfg.cycle_time_ms)
         cfg.cache_capacity = _get_int(HOROVOD_CACHE_CAPACITY, cfg.cache_capacity)

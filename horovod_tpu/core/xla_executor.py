@@ -187,6 +187,27 @@ class XlaPlanExecutor(PlanExecutor):
         )
         self._fn_cache: Dict[Tuple, Any] = {}
         self._lock = threading.Lock()
+        # Compiled-path tuned source (docs/autotune.md "Compiled-path
+        # offline tuning"): the eager verdict already carries the native
+        # core's categorical `tuned_flags`; this records what the
+        # COMPILED path is tuned from — file/env/none plus the tuned
+        # signature hash — stamped into every executed plan (see
+        # execute()) and exported as the hvd_tuned_info gauge.
+        try:
+            from .. import tune as _tune
+
+            self._tuned_info = _tune.current_tuned_source()
+        except Exception:  # noqa: BLE001 - tuning must not block the plane
+            self._tuned_info = {"source": "none", "signature": "-",
+                                "matched": False, "where": "-"}
+        if _metrics.ACTIVE:
+            _metrics.TAP.set(
+                "hvd_tuned_info", 1.0,
+                source=str(self._tuned_info.get("source", "none")),
+                signature=str(self._tuned_info.get("signature", "-")),
+                matched="1" if self._tuned_info.get("matched") else "0",
+                where="executor",
+            )
         # Device-order fence: the previous plan's output arrays. XLA
         # dispatch is async (CPU included), and plans may be consumed by
         # DIFFERENT threads (the executor thread or an inline
@@ -361,8 +382,17 @@ class XlaPlanExecutor(PlanExecutor):
         return np.asarray(shard[0].data if shard else garr.addressable_shards[0].data)
 
     # --- execution ---
+    def tuned_info(self) -> Dict[str, Any]:
+        """The compiled-path tuned source this executor records into
+        verdicts (`file`/`env`/`none` + signature hash)."""
+        return dict(self._tuned_info)
+
     def execute(self, plan: dict, entries, topo: Topology) -> Dict[str, Any]:
         ptype = plan["type"]
+        # Verdict stamp: alongside the eager core's tuned_flags int the
+        # plan now names the compiled-path tuned source, so a timeline /
+        # test reading executed plans can attribute knob provenance.
+        plan.setdefault("tuned_info", dict(self._tuned_info))
         # Device-order fence (see _inflight_outs): the previous plan's
         # collective must be fully done before this one dispatches.
         prev = self._inflight_outs

@@ -175,13 +175,16 @@ def _resolve_hierarchical(hierarchical, mesh: Optional[Mesh] = None):
     return bool(hierarchical), None
 
 
-def _select_reduce_fn(op: ReduceOp, hierarchical, quantized: bool = False):
+def _select_reduce_fn(op: ReduceOp, hierarchical, quantized: bool = False,
+                      topo_algorithm: Optional[str] = None):
     if op == ReduceOp.ADASUM:
         return adasum_reduce_fn
     if hierarchical == "planned":
         from ..topo import compositor as _compositor
 
-        return _compositor.auto_reduce_fn(quantized=quantized)
+        return _compositor.auto_reduce_fn(
+            quantized=quantized, algorithm=topo_algorithm
+        )
     if quantized:
         # Flat: every hop int8 (the EQuARX ring). Hierarchical: int8 on
         # the outermost (DCN) hop only — reduce-scatter/all-gather stay
@@ -232,6 +235,7 @@ def allreduce_gradients(
     hierarchical: Any = False,
     quantized: Optional[bool] = None,
     nonfinite: Optional[str] = None,
+    topo_algorithm: Optional[str] = None,
 ) -> Any:
     """Fusion-bucketed allreduce of a gradient pytree (in-jit).
 
@@ -254,6 +258,10 @@ def allreduce_gradients(
     peers), ``warn`` detects on the reduced result and logs. The
     step-level policies (``skip``/``abort``) are applied by
     ``DistributedOptimizer`` / ``make_train_step``, not here.
+
+    ``topo_algorithm`` pins one compositor lowering for every bucket
+    (the offline tuner's verdict, docs/autotune.md) — meaningful only
+    when ``hierarchical`` resolves to planned mode.
     """
     fusion_threshold_bytes = _fusion.default_threshold_bytes(
         fusion_threshold_bytes
@@ -285,7 +293,8 @@ def allreduce_gradients(
                 "stacking cast compression would add loss for no "
                 "bandwidth win"
             )
-    reduce_fn = _select_reduce_fn(op, hierarchical, quantized)
+    reduce_fn = _select_reduce_fn(op, hierarchical, quantized,
+                                  topo_algorithm=topo_algorithm)
     if compression is not Compression.none:
         leaves, treedef = jax.tree.flatten(grads)
         compressed = [compression.compress(l) for l in leaves]
@@ -360,6 +369,8 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     backward_passes_per_step: int = 1,
     overlap: bool = False,
     nonfinite: Optional[str] = None,
+    tuned: Any = None,
+    topo_algorithm: Optional[str] = None,
 ):
     """Wrap an optax ``GradientTransformation`` so its update first
     allreduces gradients across the data axis.
@@ -402,10 +413,27 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     ``overlap=True`` the streamed registration owns the residual
     (``make_train_step`` threads it); this wrapper then leaves EF to the
     streamed path.
+
+    ``tuned`` (None reads ``HOROVOD_TUNED_FILE``; a path or a
+    :class:`horovod_tpu.tune.TunedConfig`) applies a pinned offline
+    tuning (docs/autotune.md "Compiled-path offline tuning") to the
+    knobs the caller left at their defaults. The gradient tree an
+    optimizer sees carries no mesh, so only the params half of the
+    tuning's step signature is checked here (``make_train_step`` checks
+    both); a mismatch warns loudly and keeps the untuned defaults.
+    ``topo_algorithm`` pins one compositor lowering under planned
+    hierarchy — normally set via ``tuned``, exposed for hand
+    experiments.
     """
     import jax.numpy as jnp
     import optax
 
+    from .. import tune as _tune
+
+    tuned_cfg, tuned_source = _tune.resolve_tuned(tuned)
+    caller_quantized = quantized
+    caller_hierarchical = hierarchical
+    caller_threshold = fusion_threshold_bytes
     quantized = _resolve_quantized(quantized)
     _check_overlap_rejections(overlap, quantized, op)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
@@ -424,6 +452,66 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             "quantized=True already compresses the wire to int8; "
             "stacking cast compression would add loss for no bandwidth win"
         )
+
+    base_knobs = {
+        "fusion_threshold_bytes": fusion_threshold_bytes,
+        "quantized": quantized,
+        "hierarchical": hierarchical,
+        "norm_axis": norm_axis,
+        "use_ef": use_ef,
+        "topo_algorithm": topo_algorithm,
+    }
+    _tuned_resolution: dict = {}
+
+    def _knobs(tree, where):
+        """Trace-time knob resolution: with a tuned config in hand, the
+        first traced pytree (params at init, gradients at update — the
+        same structure) decides whether the pinned knobs apply. The
+        verdict is cached: init and update must agree or the EF state
+        shape would be inconsistent."""
+        if tuned_cfg is None:
+            return base_knobs
+        r = _tuned_resolution.get("r")
+        if r is not None:
+            return r
+        live = _tune.step_signature(tree)
+        matched = _tune.signatures_match(
+            tuned_cfg.signature, live, require_mesh=False
+        )
+        if matched:
+            tk = _tune.tuned_step_kwargs(tuned_cfg)
+            q = (quantized if caller_quantized is not None
+                 else tk["quantized"])
+            h = (caller_hierarchical if caller_hierarchical is not False
+                 else tk["hierarchical"])
+            h, _ = _resolve_hierarchical(h)
+            r = {
+                "fusion_threshold_bytes": (
+                    caller_threshold if caller_threshold is not None
+                    else tk["fusion_threshold_bytes"]
+                ),
+                "quantized": q,
+                "hierarchical": h,
+                "norm_axis": _normalize_axis(axis_name, h),
+                "use_ef": _resolve_error_feedback(
+                    error_feedback, q, h
+                ) and not overlap,
+                "topo_algorithm": (
+                    topo_algorithm if topo_algorithm is not None
+                    else tk["topo_algorithm"]
+                ),
+            }
+        else:
+            _tune.warn_signature_mismatch(
+                tuned_cfg, live.get("hash", "?"), "DistributedOptimizer"
+            )
+            r = base_knobs
+        _tune.note_applied(
+            tuned_source, tuned_cfg.signature_hash, matched,
+            "DistributedOptimizer",
+        )
+        _tuned_resolution["r"] = r
+        return r
     if _trace.ACTIVE:
         # Step-span correlation ids for loops driven by this optimizer:
         # the host-side step boundaries themselves come from wrap_step
@@ -438,16 +526,17 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
         )
 
     def init_fn(params):
-        if use_ef:
+        if _knobs(params, "init")["use_ef"]:
             return EFState(
                 inner=optimizer.init(params), residual=ef_like(params)
             )
         return optimizer.init(params)
 
     def update_fn(grads, state, params=None, **extra):
+        k = _knobs(grads, "update")
         prescale = 1.0 / backward_passes_per_step if backward_passes_per_step > 1 else 1.0
         ef = None
-        if use_ef:
+        if k["use_ef"]:
             if isinstance(state, EFState):
                 state, ef = state.inner, state.residual
             else:
@@ -486,8 +575,8 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             reduced, new_ef = _fusion.quantized_ef_allreduce(
                 grads, ef,
                 op=op,
-                axis_name=norm_axis,
-                threshold_bytes=fusion_threshold_bytes,
+                axis_name=k["norm_axis"],
+                threshold_bytes=k["fusion_threshold_bytes"],
                 label="posthoc-ef",
             )
             if nonfinite_policy == "warn":
@@ -499,11 +588,12 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
                 grads,
                 op=op,
                 axis_name=axis_name,
-                fusion_threshold_bytes=fusion_threshold_bytes,
+                fusion_threshold_bytes=k["fusion_threshold_bytes"],
                 compression=compression,
-                hierarchical=hierarchical,
-                quantized=quantized,
+                hierarchical=k["hierarchical"],
+                quantized=k["quantized"],
                 nonfinite=nonfinite_policy,
+                topo_algorithm=k["topo_algorithm"],
             )
         else:
             reduced = grads
@@ -522,7 +612,7 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
             # commit check uses). Post-reduce detection is OR-ed in so an
             # overflow created BY the summation is also caught.
             flag = jnp.maximum(flag, _nf.local_flag(reduced))
-            flag = _nf.agree_flag(flag, norm_axis)
+            flag = _nf.agree_flag(flag, k["norm_axis"])
             _nf.note_detection(nonfinite_policy, "optimizer")(flag)
         if prescale != 1.0:
             reduced = jax.tree.map(lambda g: g * prescale, reduced)
@@ -533,7 +623,7 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
                 flag, jax.tree.map(jnp.zeros_like, updates), updates
             )
             new_state = _nf.select_on_flag(flag, state, new_state)
-        if use_ef:
+        if k["use_ef"]:
             if flag is not None:
                 # A skipped step discards the gradient, so the residual
                 # computed from it must not carry either.
@@ -561,7 +651,7 @@ def broadcast_variables(
     return jax.jit(fn)(variables)
 
 
-def make_train_step(
+def _build_train_step(
     loss_fn: Callable[..., jax.Array],
     optimizer,
     mesh: Mesh,
@@ -578,6 +668,7 @@ def make_train_step(
     overlap: bool = False,
     first_bucket_bytes: Optional[int] = None,
     nonfinite: Optional[str] = None,
+    topo_algorithm: Optional[str] = None,
 ):
     """Build a jitted SPMD training step: per-shard grads → fused allreduce
     → optax update, with the batch sharded over ``axis_name`` and
@@ -642,6 +733,9 @@ def make_train_step(
     axis_name = _normalize_axis(axis_name, hierarchical)
     nonfinite_policy = _resolve_nonfinite(nonfinite)
     use_ef = _resolve_error_feedback(error_feedback, quantized, hierarchical)
+    # A pinned compositor algorithm only reaches the lowering in planned
+    # mode; anywhere else (flat mesh, forced two-level) it is moot.
+    pin_algorithm = topo_algorithm if hierarchical == "planned" else None
 
     def step(params, opt_state, batch):
         # EF residual rides the opt_state as EFState(inner, residual);
@@ -666,6 +760,7 @@ def make_train_step(
                     quantized=True,
                     ef=e,
                     nonfinite=nonfinite_policy,
+                    algorithm=pin_algorithm,
                 )
                 return loss_fn(p, b)
 
@@ -687,6 +782,7 @@ def make_train_step(
                     compression=compression,
                     quantized=quantized,
                     nonfinite=nonfinite_policy,
+                    algorithm=pin_algorithm,
                 )
                 return loss_fn(p, b)
 
@@ -738,6 +834,7 @@ def make_train_step(
                     hierarchical=hierarchical,
                     quantized=quantized,
                     nonfinite=nonfinite_policy,
+                    topo_algorithm=pin_algorithm,
                 )
         else:
             # Streamed: grads left value_and_grad already reduced (the
@@ -829,6 +926,98 @@ def make_train_step(
         return out[:-1]
 
     return _maybe_trace(aborting_step)
+
+
+def make_train_step(
+    loss_fn: Callable[..., jax.Array],
+    optimizer,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    op: ReduceOp = Average,
+    fusion_threshold_bytes: Optional[int] = None,
+    compression=Compression.none,
+    hierarchical: Any = False,
+    quantized: Optional[bool] = None,
+    error_feedback: Optional[bool] = None,
+    donate: bool = True,
+    has_aux: bool = False,
+    overlap: bool = False,
+    first_bucket_bytes: Optional[int] = None,
+    nonfinite: Optional[str] = None,
+    tuned: Any = None,
+    topo_algorithm: Optional[str] = None,
+):
+    """See :func:`_build_train_step` for the core semantics — this public
+    wrapper adds pinned offline tuning (docs/autotune.md "Compiled-path
+    offline tuning").
+
+    ``tuned`` takes a ``tuned.json`` path, a
+    :class:`horovod_tpu.tune.TunedConfig`, ``None`` (read
+    ``HOROVOD_TUNED_FILE``), or ``False`` (explicitly untuned). With a
+    tuning in hand the step build is deferred to the FIRST call: the
+    live params' abstract signature (pytree structure + leaf
+    shapes/dtypes + mesh axes) is compared against the tuning's key —
+    on a match the pinned knobs fill every knob the caller left at its
+    default (explicit arguments always win); on a mismatch a loud
+    warning is logged and the step builds with untuned defaults, never
+    with stale knobs. The applied source lands in ``hvd_tuned_info``
+    (docs/metrics.md) and in eager plan verdicts
+    (``core/xla_executor.py``).
+
+    A tuned build is bitwise-identical to passing the same knob values
+    by hand — ``horovod_tpu.tune.tuned_step_kwargs`` is the exact
+    mapping, asserted by ``make tune-smoke``.
+    """
+    from .. import tune as _tune
+
+    kwargs = dict(
+        axis_name=axis_name, op=op,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        compression=compression, hierarchical=hierarchical,
+        quantized=quantized, error_feedback=error_feedback,
+        donate=donate, has_aux=has_aux, overlap=overlap,
+        first_bucket_bytes=first_bucket_bytes, nonfinite=nonfinite,
+        topo_algorithm=topo_algorithm,
+    )
+    tuned_cfg, tuned_source = _tune.resolve_tuned(tuned)
+    if tuned_cfg is None:
+        return _build_train_step(loss_fn, optimizer, mesh, **kwargs)
+
+    state: dict = {}
+
+    def dispatch(params, opt_state, batch):
+        step = state.get("step")
+        if step is None:
+            live = _tune.step_signature(params, mesh=mesh)
+            matched = _tune.signatures_match(tuned_cfg.signature, live)
+            kw = dict(kwargs)
+            if matched:
+                tk = _tune.tuned_step_kwargs(tuned_cfg)
+                if kw["fusion_threshold_bytes"] is None:
+                    kw["fusion_threshold_bytes"] = tk[
+                        "fusion_threshold_bytes"]
+                if kw["first_bucket_bytes"] is None:
+                    kw["first_bucket_bytes"] = tk["first_bucket_bytes"]
+                if kw["quantized"] is None:
+                    kw["quantized"] = tk["quantized"]
+                if kw["hierarchical"] is False:
+                    kw["hierarchical"] = tk["hierarchical"]
+                if kw["topo_algorithm"] is None:
+                    kw["topo_algorithm"] = tk["topo_algorithm"]
+            else:
+                _tune.warn_signature_mismatch(
+                    tuned_cfg, live.get("hash", "?"), "make_train_step"
+                )
+            _tune.note_applied(
+                tuned_source, tuned_cfg.signature_hash, matched,
+                "make_train_step",
+            )
+            step = _build_train_step(loss_fn, optimizer, mesh, **kw)
+            state["step"] = step
+        return step(params, opt_state, batch)
+
+    return dispatch
 
 
 class GradientAccumulator:

@@ -109,6 +109,10 @@ _CATALOG: Dict[str, str] = {
                                          "(worker)",
     "hvd_elastic_preemptions_total": "Preemption interrupts (worker)",
     "hvd_elastic_rejoins_total": "World rejoins completed (worker)",
+    # Compiled-path offline tuning (docs/autotune.md).
+    "hvd_tuned_info": "Compiled-path tuned source (value is always 1; "
+                      "source=arg/file/env/none, signature hash, "
+                      "matched, where in labels)",
     # Topology-aware collective compositor (docs/topology.md).
     "hvd_topo_plan_info": "Selected compositor lowering plan (value is "
                           "always 1; collective/algorithm/op/where in "

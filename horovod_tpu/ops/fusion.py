@@ -237,6 +237,9 @@ class StreamConfig:
     # algorithm (flat / two-level / split). Set by hierarchical="auto" /
     # "planned" in the public entry points.
     planned: bool = False
+    # Pinned topo algorithm for planned mode (the offline tuner's
+    # verdict, docs/autotune.md); None = per-bucket cost selection.
+    algorithm: Optional[str] = None
     compression: Any = None  # a common.compression.Compressor class or None
     # Int8 wire (ops/quantized.py): each bucket runs quantize -> ring
     # reduce -> dequantize inside the backward trace. Flat mode moves
@@ -293,7 +296,7 @@ def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
         # the selected plan with int8 on the slow hop(s) only.
         reduce_fn = _compositor.planned_reduce_fn(
             _compositor.model_for_axes(cfg.axis_name), cfg.axis_name,
-            quantized=cfg.quantized,
+            quantized=cfg.quantized, algorithm=cfg.algorithm,
         )
     elif cfg.quantized:
         from .quantized import quantized_reduce_fn
@@ -508,6 +511,7 @@ def reduce_in_backward(
     ef: Any = None,
     label: str = "stream",
     nonfinite: str = "off",
+    algorithm: Optional[str] = None,
 ) -> Any:
     """Register a parameter subtree for streamed gradient reduction.
 
@@ -558,6 +562,13 @@ def reduce_in_backward(
     # tuple (hierarchical="auto" at the make_train_step level resolves
     # to this when the mesh carries a (pod, cross, local) hierarchy).
     planned = hierarchical == "planned"
+    if algorithm is not None and not planned:
+        raise ValueError(
+            "algorithm= pins a compositor plan and needs "
+            "hierarchical='planned' (or 'auto' resolving to it); with "
+            f"hierarchical={hierarchical!r} the pin would be silently "
+            "ignored"
+        )
     if ef is not None and (planned or bool(hierarchical)):
         raise ValueError(
             "error feedback compensates the flat int8 ring; the "
@@ -571,6 +582,7 @@ def reduce_in_backward(
         threshold_bytes=default_threshold_bytes(threshold_bytes),
         hierarchical=bool(hierarchical) and not planned,
         planned=planned,
+        algorithm=algorithm,
         compression=compression,
         quantized=bool(quantized),
         label=label,
@@ -658,6 +670,24 @@ def plan_layer_groups(
     return groups
 
 
+def layer_group_bytes(
+    layer_bytes: Sequence[int],
+    threshold_bytes: int,
+    first_bucket_bytes: int,
+) -> List[int]:
+    """Per-group payload bytes of the :func:`plan_layer_groups`
+    partition, in reduction order — the pure accounting the offline
+    tuner (``horovod_tpu/tune``) prices with the compositor cost model.
+    One source of truth: a tuned partition and the traced partition can
+    never disagree because both come from ``plan_layer_groups``."""
+    return [
+        sum(int(layer_bytes[i]) for i in group)
+        for group in plan_layer_groups(
+            layer_bytes, threshold_bytes, first_bucket_bytes
+        )
+    ]
+
+
 def stream_param_groups(
     params: Any,
     *,
@@ -670,6 +700,7 @@ def stream_param_groups(
     quantized: bool = False,
     ef: Any = None,
     nonfinite: str = "off",
+    algorithm: Optional[str] = None,
 ) -> Any:
     """Partition ``params`` by top-level child (for a flax params dict: one
     child per module, in construction ≈ forward order), pack the children
@@ -690,7 +721,7 @@ def stream_param_groups(
             params, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
             quantized=quantized, ef=ef,
-            label="stream:g0", nonfinite=nonfinite,
+            label="stream:g0", nonfinite=nonfinite, algorithm=algorithm,
         )
     children, rebuild = split
     ef_children = None
@@ -719,6 +750,7 @@ def stream_param_groups(
             hierarchical=hierarchical, compression=compression,
             quantized=quantized, ef=sub_ef,
             label=f"stream:g{gi}", nonfinite=nonfinite,
+            algorithm=algorithm,
         )
         for i in group:
             wrapped[i] = sub[str(i)]

@@ -1185,15 +1185,19 @@ def model_for_axes(axes, generation: Optional[str] = None):
     return apply_override(model)
 
 
-def auto_reduce_fn(quantized: bool = False):
+def auto_reduce_fn(quantized: bool = False,
+                   algorithm: Optional[str] = None):
     """A ``reduce_fn`` that builds the model from the bound axes at trace
     time and then defers to :func:`planned_reduce_fn` — the form the
-    compiled-mode binding uses for ``hierarchical="auto"``."""
+    compiled-mode binding uses for ``hierarchical="auto"``.
+    ``algorithm`` pins one allreduce lowering (the offline tuner's
+    verdict, docs/autotune.md) instead of per-bucket cost selection."""
 
     def fn(x, *, op, axis_name, prescale_factor=1.0, postscale_factor=1.0):
         axes = _axes_tuple(axis_name)
         return planned_reduce_fn(
-            model_for_axes(axes), axes, quantized=quantized
+            model_for_axes(axes), axes, quantized=quantized,
+            algorithm=algorithm,
         )(
             x, op=op, axis_name=axes,
             prescale_factor=prescale_factor,
@@ -1204,7 +1208,8 @@ def auto_reduce_fn(quantized: bool = False):
 
 
 def planned_reduce_fn(model: InterconnectModel, axes=None,
-                      quantized: bool = False):
+                      quantized: bool = False,
+                      algorithm: Optional[str] = None):
     """A ``reduce_fn`` for ``ops/fusion.py``: per bucket, select the
     allreduce plan for the bucket's payload on this model and lower it
     accordingly — this is what makes ``make_train_step(overlap=True)``
@@ -1223,7 +1228,13 @@ def planned_reduce_fn(model: InterconnectModel, axes=None,
     step. The explicit schedules stay reachable through
     :func:`lower_allreduce` for tests and offline measurement. The int8
     ring is the exception — there IS no native quantized collective, so
-    its explicit schedule is the lowering."""
+    its explicit schedule is the lowering.
+
+    ``algorithm`` (the offline tuner's pinned topo choice) bypasses cost
+    selection: when the compositor offers that candidate at the bucket's
+    payload it is used; a payload where the pin is unrealizable (e.g.
+    split below its minimum size) falls back to cost selection — the
+    same fallback the planner's own selection would make."""
     from ..common.types import dtype_from_array, dtype_size
 
     axes = _axes_tuple(axes if axes is not None else model.axes)
@@ -1242,25 +1253,31 @@ def planned_reduce_fn(model: InterconnectModel, axes=None,
             and jnp.issubdtype(x.dtype, jnp.floating)
         )
         wire = WIRE_INT8 if int8 else WIRE_F32
-        plan = record_plan(
-            select_plan(model, "allreduce", nbytes, op=op, wire_dtype=wire),
-            where="stream",
-        )
+        plan = None
+        if algorithm:
+            plan = candidate_plans(
+                model, "allreduce", nbytes, op=op, wire_dtype=wire
+            ).get(algorithm)
+        if plan is None:
+            plan = select_plan(
+                model, "allreduce", nbytes, op=op, wire_dtype=wire
+            )
+        plan = record_plan(plan, where="stream")
         if int8:
             from ..ops.quantized import record_wire_bytes
 
             record_wire_bytes(nbytes, "stream")
-        algorithm = plan.algorithm
+        lower_algo = plan.algorithm
         frac = None
-        if algorithm == "split" and plan.nbytes:
+        if lower_algo == "split" and plan.nbytes:
             frac = plan.split_bytes[0] / plan.nbytes
-        elif algorithm in ("ring", "recursive-halving") or len(use_axes) == 1:
+        elif lower_algo in ("ring", "recursive-halving") or len(use_axes) == 1:
             # f32 single-hop labels lower natively; the int8 ring label
             # is handled by lower_allreduce's quantized branch.
             if not int8:
-                algorithm = "flat"
+                lower_algo = "flat"
         out = lower_allreduce(
-            x, use_axes, op=op, algorithm=algorithm,
+            x, use_axes, op=op, algorithm=lower_algo,
             split_fraction=frac, wire_dtype=wire,
         )
         if postscale_factor != 1.0:

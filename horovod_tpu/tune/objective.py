@@ -1,0 +1,157 @@
+"""Free (no-hardware) objectives for the compiled-path tuner.
+
+Two cost models the repo already trusts, composed into one scalar score:
+
+1. **Structural overlap** — the streamed path's group partition
+   (``ops/fusion.plan_layer_groups``: the same DDP-style reverse-order
+   packing ``stream_param_groups`` performs at trace time) gives the
+   independent-AR-group count, and the overlappable-compute staircase:
+   group ``i`` (reduction order) can hide its transfer behind the
+   backward compute of every group still to come. This is the pure-
+   python form of what ``tools/tpu_profile_overlap.py --structural``
+   measures from HLO — the group partition IS the independent-collective
+   structure the HLO analysis counts.
+2. **Compositor pricing** — each group's packed payload is priced by the
+   topology compositor's exact alpha-beta cost model
+   (``topo.compositor.candidate_plans`` / ``select_plan``), honoring the
+   pinned topology algorithm and wire dtype.
+
+The scalar the GP maximizes is ``-exposed_us``: per group, the modeled
+collective cost discounted by the fraction of backward compute available
+to hide it (``cost_us_i * (1 - overlappable_i / total)``), summed. More
+groups ⇒ earlier wire starts ⇒ more hiding; cheaper plans / int8 wire ⇒
+less to hide. A measured step time (when a backend is reachable) can be
+mixed in by the caller via ``measured_us`` — the free model stays the
+inner loop either way (HiCCL's framing: the analytic model is the
+trustworthy stand-in when hardware is scarce).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common.quant import WIRE_F32, WIRE_INT8
+from ..common.types import ReduceOp
+
+
+@dataclass(frozen=True)
+class ProgramSpec:
+    """The abstract training program the tuner scores: top-level layer
+    granularity (name, gradient bytes) in FORWARD order — exactly the
+    granularity ``stream_param_groups`` partitions at."""
+
+    name: str
+    layers: Tuple[Tuple[str, int], ...]
+    signature: Dict = field(default_factory=dict)
+
+    @property
+    def layer_bytes(self) -> List[int]:
+        return [int(b) for _, b in self.layers]
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.layer_bytes)
+
+
+def _bottleneck_hop(model):
+    return min(model.hops, key=lambda h: h.bandwidth_gbps)
+
+
+def plan_for_bucket(model, nbytes: int, config: Dict,
+                    op: ReduceOp = ReduceOp.AVERAGE):
+    """The allreduce plan a bucket of ``nbytes`` would lower with under
+    ``config``: the pinned algorithm when the compositor offers it at
+    this payload, else the cost-selected plan (the same fallback the
+    lowering performs). Returns ``(plan, pinned_honored)``."""
+    from ..topo.compositor import candidate_plans, select_plan
+
+    wire = config.get("wire_dtype", WIRE_F32)
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        wire = WIRE_F32
+    algo = config.get("topo_algorithm") or "auto"
+    if algo != "auto":
+        cands = candidate_plans(model, "allreduce", nbytes, op=op,
+                                wire_dtype=wire)
+        if algo in cands:
+            return cands[algo], True
+    return select_plan(model, "allreduce", nbytes, op=op,
+                       wire_dtype=wire), algo == "auto"
+
+
+def free_objectives(spec: ProgramSpec, config: Dict, model,
+                    op: ReduceOp = ReduceOp.AVERAGE) -> Dict:
+    """Score ``config`` on ``spec`` over ``model`` with the two free
+    cost models. Returns a plain dict (stable key order for the
+    tuned.json record) whose ``score`` the GP maximizes."""
+    from ..ops.fusion import plan_layer_groups
+
+    layer_bytes = spec.layer_bytes
+    total = max(spec.total_bytes, 1)
+    groups = plan_layer_groups(
+        layer_bytes,
+        int(config["fusion_threshold_bytes"]),
+        int(config["first_bucket_bytes"]),
+    )
+    bneck = _bottleneck_hop(model).name
+    per_group: List[Dict] = []
+    cost_us = 0.0
+    exposed_us = 0.0
+    wire_bytes = 0
+    remaining = total
+    pinned_honored = True
+    for gi, group in enumerate(groups):
+        nb = sum(layer_bytes[i] for i in group)
+        remaining -= nb
+        plan, honored = plan_for_bucket(model, nb, config, op=op)
+        pinned_honored = pinned_honored and honored
+        overlappable = remaining / total
+        g_exposed = plan.cost_us * (1.0 - overlappable)
+        g_wire = int(plan.bytes_per_hop.get(bneck, 0))
+        cost_us += plan.cost_us
+        exposed_us += g_exposed
+        wire_bytes += g_wire
+        per_group.append({
+            "group": gi,
+            "layers": [spec.layers[i][0] for i in group],
+            "nbytes": nb,
+            "algorithm": plan.algorithm,
+            "wire_dtype": plan.wire_dtype,
+            "cost_us": round(plan.cost_us, 4),
+            "overlappable_fraction": round(overlappable, 6),
+            "bottleneck_bytes": g_wire,
+        })
+    return {
+        "n_groups": len(groups),
+        "cost_us": round(cost_us, 4),
+        "exposed_us": round(exposed_us, 4),
+        "wire_bytes": int(wire_bytes),
+        "bottleneck_hop": bneck,
+        "pinned_honored": pinned_honored,
+        "per_group": per_group,
+        # The GP maximizes this: hide-adjusted modeled communication
+        # time, negated. Rounded so the score (and therefore the whole
+        # sample trace) serializes byte-identically.
+        "score": round(-exposed_us, 6),
+    }
+
+
+def group_plans(spec: ProgramSpec, config: Dict, model,
+                op: ReduceOp = ReduceOp.AVERAGE) -> List:
+    """The concrete compositor plans ``config`` pins for every stream
+    group — the artifacts the symbolic verifier checks before the tuner
+    is allowed to emit them."""
+    from ..ops.fusion import plan_layer_groups
+
+    layer_bytes = spec.layer_bytes
+    groups = plan_layer_groups(
+        layer_bytes,
+        int(config["fusion_threshold_bytes"]),
+        int(config["first_bucket_bytes"]),
+    )
+    plans = []
+    for group in groups:
+        nb = sum(layer_bytes[i] for i in group)
+        plan, _ = plan_for_bucket(model, nb, config, op=op)
+        plans.append(plan)
+    return plans

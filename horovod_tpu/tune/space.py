@@ -1,0 +1,161 @@
+"""The joint compiled-path knob space the offline tuner searches.
+
+Five dimensions, mirroring the eager engine's 2-continuous +
+3-categorical shape (``cpp/src/autotune.cc`` — the golden-trace test
+depends on the kernel treating dims this way):
+
+- ``x0`` — log2(HOROVOD_FUSION_THRESHOLD) in [16, 28], normalized to
+  [0, 1] (the same range the eager tuner sweeps);
+- ``x1`` — log2(HOROVOD_FUSION_FIRST_BUCKET_BYTES) in [12, 24],
+  normalized (the streamed path's DDP-style small first bucket;
+  together with x0 this determines the whole ``stream_param_groups``
+  partition);
+- ``x2``/``x3`` — the per-collective topology-plan choice for the
+  gradient allreduce, two {0,1} embeddings encoding
+  ``(auto, flat, two-level, split)``;
+- ``x4`` — ``wire_dtype`` {0,1} = f32/int8 (docs/overlap.md "Quantized
+  wire compression").
+
+Categorical dims that the target topology cannot realize (two-level on a
+single-hop model, int8 when the caller pins f32) are FROZEN at their
+default instead of dropped, exactly like the C++ engine freezes the
+hierarchical dims when no (cross, local) grid exists — the space stays
+5-D, the candidate grid just never varies them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common.quant import WIRE_DTYPES, WIRE_F32, WIRE_INT8
+
+# log2 bounds, continuous dims (x0 matches autotune.cc kF0/kF1).
+FUSION_LOG2_LO, FUSION_LOG2_HI = 16.0, 28.0
+FIRST_LOG2_LO, FIRST_LOG2_HI = 12.0, 24.0
+
+# Topology-plan choice encoded in (x2, x3). "auto" = per-bucket
+# select_plan (the planner decides by payload); the rest pin one
+# algorithm for every bucket.
+TOPO_CHOICES: Tuple[str, ...] = ("auto", "flat", "two-level", "split")
+
+# Grid resolution for the continuous dims (the C++ engine's 9x9 EI grid).
+GRID_POINTS = 9
+
+DEFAULT_FUSION_BYTES = 64 * 1024 * 1024
+DEFAULT_FIRST_BUCKET_BYTES = 1024 * 1024
+
+
+def _norm(log2v: float, lo: float, hi: float) -> float:
+    return (min(max(log2v, lo), hi) - lo) / (hi - lo)
+
+
+def _denorm_bytes(x: float, lo: float, hi: float) -> int:
+    return int(round(2.0 ** (lo + min(max(x, 0.0), 1.0) * (hi - lo))))
+
+
+@dataclass(frozen=True)
+class SearchSpace:
+    """The admissible slice of the 5-D space for one target topology.
+
+    ``topo_choices`` lists the realizable plan choices (a single-hop
+    model lowers natively whatever the label says, so only "auto" is
+    offered there); ``allow_int8`` gates the wire dim (SUM/AVERAGE float
+    gradients only — and the tune-smoke pins it off so the tuned step
+    stays bitwise-identical to the untuned one)."""
+
+    topo_choices: Tuple[str, ...] = TOPO_CHOICES
+    allow_int8: bool = True
+    dims: int = field(default=5, init=False)
+
+    def encode(self, config: Dict) -> Tuple[float, ...]:
+        import math
+
+        topo = config.get("topo_algorithm") or "auto"
+        idx = TOPO_CHOICES.index(topo) if topo in TOPO_CHOICES else 0
+        wire = config.get("wire_dtype", WIRE_F32)
+        return (
+            _norm(math.log2(max(int(config["fusion_threshold_bytes"]), 1)),
+                  FUSION_LOG2_LO, FUSION_LOG2_HI),
+            _norm(math.log2(max(int(config["first_bucket_bytes"]), 1)),
+                  FIRST_LOG2_LO, FIRST_LOG2_HI),
+            float(idx & 1),
+            float((idx >> 1) & 1),
+            1.0 if wire == WIRE_INT8 else 0.0,
+        )
+
+    def decode(self, x: Sequence[float]) -> Dict:
+        idx = (1 if x[2] > 0.5 else 0) | ((1 if x[3] > 0.5 else 0) << 1)
+        topo = TOPO_CHOICES[idx]
+        if topo not in self.topo_choices:
+            topo = "auto"
+        wire = WIRE_INT8 if (self.allow_int8 and x[4] > 0.5) else WIRE_F32
+        return {
+            "fusion_threshold_bytes": _denorm_bytes(
+                x[0], FUSION_LOG2_LO, FUSION_LOG2_HI),
+            "first_bucket_bytes": _denorm_bytes(
+                x[1], FIRST_LOG2_LO, FIRST_LOG2_HI),
+            "topo_algorithm": topo,
+            "wire_dtype": wire,
+        }
+
+    def default_config(self) -> Dict:
+        return {
+            "fusion_threshold_bytes": DEFAULT_FUSION_BYTES,
+            "first_bucket_bytes": DEFAULT_FIRST_BUCKET_BYTES,
+            "topo_algorithm": "auto",
+            "wire_dtype": WIRE_F32,
+        }
+
+    def _cat_combos(self) -> List[Tuple[float, float, float]]:
+        combos: List[Tuple[float, float, float]] = []
+        for idx, name in enumerate(TOPO_CHOICES):
+            if name not in self.topo_choices:
+                continue
+            for wire in (0.0, 1.0) if self.allow_int8 else (0.0,):
+                combos.append(
+                    (float(idx & 1), float((idx >> 1) & 1), wire)
+                )
+        return combos
+
+    def candidate_grid(self) -> List[Tuple[float, ...]]:
+        """The deterministic EI candidate grid: GRID_POINTS^2 continuous
+        cells x the admissible categorical combinations, in a fixed
+        iteration order (grid scan, then categories) so EI ties break
+        identically on every run."""
+        grid: List[Tuple[float, ...]] = []
+        for gi in range(GRID_POINTS):
+            for gj in range(GRID_POINTS):
+                for cat in self._cat_combos():
+                    grid.append((
+                        gi / (GRID_POINTS - 1.0),
+                        gj / (GRID_POINTS - 1.0),
+                    ) + cat)
+        return grid
+
+    def validate(self, config: Dict) -> Dict:
+        if config.get("wire_dtype", WIRE_F32) not in WIRE_DTYPES:
+            raise ValueError(
+                f"unknown wire_dtype {config.get('wire_dtype')!r}; one of "
+                f"{WIRE_DTYPES}"
+            )
+        topo = config.get("topo_algorithm") or "auto"
+        if topo not in TOPO_CHOICES:
+            raise ValueError(
+                f"unknown topo_algorithm {topo!r}; one of {TOPO_CHOICES}"
+            )
+        return config
+
+
+def space_for_model(model, allow_int8: bool = True) -> SearchSpace:
+    """The admissible space for an interconnect model: single-hop models
+    freeze the topology dims (every label lowers natively flat there);
+    two-level models drop "split" unless the FlexLink conditions
+    (exactly two hops) hold."""
+    if model.levels <= 1:
+        choices: Tuple[str, ...] = ("auto",)
+    elif model.levels == 2:
+        choices = TOPO_CHOICES
+    else:
+        choices = ("auto", "flat", "two-level")
+    return SearchSpace(topo_choices=choices, allow_int8=bool(allow_int8))

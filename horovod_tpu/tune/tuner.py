@@ -1,0 +1,271 @@
+"""The offline GP/EI tuner for the compiled path, and the ``tuned.json``
+artifact it emits.
+
+The loop is the eager engine's (``cpp/src/autotune.cc``) transplanted to
+trace-time knobs and free objectives: evaluate the untuned default
+first (so "strictly better than default" is always measurable), seed a
+few deterministic design points, then fit the GP and walk Expected
+Improvement over the candidate grid until the sample budget is spent.
+Scoring is the structural-overlap + compositor-cost objective
+(``tune/objective.py``); a measured step time can be mixed in by
+passing ``measure_fn`` when hardware is reachable.
+
+Before a winner is pinned, every stream-group plan it implies is run
+through the symbolic plan verifier (``analysis/plan_verify.py``) — a
+tuner must never emit a ``tuned.json`` whose schedule cannot be proven
+to realize the collective. Verification failures raise
+:class:`TuneVerificationError` instead of writing output.
+
+Everything is seeded and pure-python: two runs from the same inputs
+produce byte-identical ``tuned.json`` files (asserted by
+``make tune-smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..common.types import ReduceOp
+from . import gp as _gp
+from .objective import ProgramSpec, free_objectives, group_plans
+from .signature import signature_hash
+from .space import SearchSpace, space_for_model
+
+TUNED_VERSION = 1
+
+
+class TuneVerificationError(RuntimeError):
+    """The winning configuration's plan failed symbolic verification;
+    no ``tuned.json`` may be emitted."""
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        lines = "\n".join(f"  {f.render()}" for f in self.findings[:8])
+        super().__init__(
+            f"refusing to pin a tuned configuration: "
+            f"{len(self.findings)} plan-verification finding(s)\n{lines}"
+        )
+
+
+@dataclass
+class TunedConfig:
+    """A pinned compiled-path tuning: the knob values, the step
+    signature they are valid for, and the evidence (chosen vs baseline
+    objectives, sample history) that justified them."""
+
+    knobs: Dict
+    signature: Dict
+    objectives: Dict
+    baseline: Dict
+    program: str = ""
+    model: Dict = field(default_factory=dict)
+    search: Dict = field(default_factory=dict)
+    history: List[Dict] = field(default_factory=list)
+    version: int = TUNED_VERSION
+
+    def to_dict(self) -> Dict:
+        return {
+            "version": self.version,
+            "program": self.program,
+            "knobs": dict(self.knobs),
+            "signature": dict(self.signature),
+            "objectives": dict(self.objectives),
+            "baseline": dict(self.baseline),
+            "model": dict(self.model),
+            "search": dict(self.search),
+            "history": list(self.history),
+        }
+
+    def to_json(self) -> str:
+        """Stable serialization — sorted keys, no timestamps — so the CI
+        smoke can diff two tuner runs byte-for-byte."""
+        return json.dumps(self.to_dict(), sort_keys=True, indent=1) + "\n"
+
+    @staticmethod
+    def from_dict(d: Dict) -> "TunedConfig":
+        return TunedConfig(
+            knobs=dict(d.get("knobs", {})),
+            signature=dict(d.get("signature", {})),
+            objectives=dict(d.get("objectives", {})),
+            baseline=dict(d.get("baseline", {})),
+            program=str(d.get("program", "")),
+            model=dict(d.get("model", {})),
+            search=dict(d.get("search", {})),
+            history=list(d.get("history", [])),
+            version=int(d.get("version", TUNED_VERSION)),
+        )
+
+    @property
+    def signature_hash(self) -> str:
+        h = self.signature.get("hash")
+        return str(h) if h else signature_hash(self.signature)
+
+
+def save_tuned(cfg: TunedConfig, path: str) -> str:
+    with open(path, "w") as f:
+        f.write(cfg.to_json())
+    return path
+
+
+def load_tuned(path: str) -> TunedConfig:
+    with open(path) as f:
+        return TunedConfig.from_dict(json.load(f))
+
+
+def _round_x(x: Sequence[float]) -> List[float]:
+    return [round(float(v), 6) for v in x]
+
+
+def tune(
+    spec: ProgramSpec,
+    model,
+    *,
+    samples: int = 16,
+    seed: int = 0,
+    space: Optional[SearchSpace] = None,
+    allow_int8: bool = True,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    measure_fn: Optional[Callable[[Dict], float]] = None,
+    rounds_fn: Optional[Callable] = None,
+    verify: bool = True,
+) -> TunedConfig:
+    """Search the joint compiled-path space for ``spec`` on ``model``.
+
+    ``measure_fn(config) -> step_seconds`` (optional) mixes a measured
+    objective into the score as ``-1e6 * step_seconds`` (microseconds,
+    same unit as the modeled cost) — the free objectives still run so
+    the emitted evidence block is always populated. ``rounds_fn`` is
+    forwarded to the plan verifier (tests inject corrupted schedules
+    through it). ``verify=False`` is for unit tests only.
+    """
+    space = space or space_for_model(model, allow_int8=allow_int8)
+    grid = space.candidate_grid()
+    rng = _gp.Lcg(seed)
+    samples = max(int(samples), 1)
+
+    def evaluate(config: Dict) -> Tuple[Dict, float]:
+        obj = free_objectives(spec, config, model, op=op)
+        score = obj["score"]
+        if measure_fn is not None:
+            measured_s = float(measure_fn(config))
+            obj["measured_step_s"] = round(measured_s, 6)
+            score = round(-1e6 * measured_s, 6)
+            obj["score"] = score
+        return obj, score
+
+    xs: List[Tuple[float, ...]] = []
+    ys: List[float] = []
+    configs: List[Dict] = []
+    objs: List[Dict] = []
+    seen = set()
+
+    def try_point(x: Tuple[float, ...]) -> None:
+        config = space.validate(space.decode(x))
+        key = tuple(_round_x(space.encode(config)))
+        if key in seen:
+            return
+        seen.add(key)
+        obj, score = evaluate(config)
+        xs.append(key)
+        ys.append(score)
+        configs.append(config)
+        objs.append(obj)
+
+    # Sample 0 is ALWAYS the untuned default — the baseline every
+    # improvement claim is measured against.
+    default = space.default_config()
+    try_point(space.encode(default))
+    baseline = dict(objs[0])
+
+    # Informed corners before the random design: the small-bucket corner
+    # (more stream groups → earlier wire starts) and, when admissible,
+    # the int8 default — each teaches the GP one knob axis, so even an
+    # ~8-sample smoke budget explores every direction instead of
+    # betting the whole budget on random grid cells.
+    corners: List[Dict] = [dict(default)]
+    corners[-1].update(fusion_threshold_bytes=2 << 20,
+                       first_bucket_bytes=256 << 10)
+    if space.allow_int8:
+        corners.append(dict(default, wire_dtype="int8"))
+        corners.append(dict(corners[0], wire_dtype="int8"))
+    for c in corners:
+        if len(xs) >= samples:
+            break
+        try_point(space.encode(c))
+
+    # A few seeded random design points before the GP has anything to
+    # say (deterministic LCG — byte-stable across runs).
+    n_seed = min(3, max(samples - len(xs), 0))
+    guard = 0
+    while len(xs) < 1 + len(corners) + n_seed and guard < 64:
+        if len(xs) >= samples:
+            break
+        guard += 1
+        try_point(grid[rng.next_index(len(grid))])
+
+    while len(xs) < samples:
+        model_gp = _gp.fit(xs, ys)
+        if model_gp is None:
+            break
+        # Best unseen EI candidate (strict >, iteration order breaks
+        # ties) — the C++ grid scan with a dedupe, since re-sampling a
+        # deterministic objective teaches the GP nothing.
+        best_ei, best_x = -1.0, None
+        for c in grid:
+            key = tuple(_round_x(
+                space.encode(space.validate(space.decode(c)))
+            ))
+            if key in seen:
+                continue
+            ei = _gp.expected_improvement(model_gp, c)
+            if ei > best_ei:
+                best_ei, best_x = ei, c
+        if best_x is None:
+            break  # grid exhausted
+        try_point(best_x)
+
+    best_i = 0
+    for i in range(1, len(ys)):
+        if ys[i] > ys[best_i]:
+            best_i = i
+    best_config = configs[best_i]
+    best_obj = objs[best_i]
+
+    findings: List = []
+    if verify:
+        from ..analysis.plan_verify import verify_plan
+
+        for plan in group_plans(spec, best_config, model, op=op):
+            findings.extend(verify_plan(plan, model, rounds_fn=rounds_fn))
+        if findings:
+            raise TuneVerificationError(findings)
+
+    history = [
+        {"x": _round_x(x), "config": configs[i],
+         "score": round(ys[i], 6)}
+        for i, x in enumerate(xs)
+    ]
+    return TunedConfig(
+        knobs=dict(best_config),
+        signature=dict(spec.signature),
+        objectives=best_obj,
+        baseline=baseline,
+        program=spec.name,
+        model=model.to_dict(),
+        search={
+            "samples": len(xs),
+            "requested_samples": samples,
+            "seed": int(seed),
+            "objective": "measured" if measure_fn is not None else "free",
+            "space": {
+                "topo_choices": list(space.topo_choices),
+                "allow_int8": bool(space.allow_int8),
+            },
+            "verified_plans": 0 if not verify else len(
+                group_plans(spec, best_config, model, op=op)
+            ),
+        },
+        history=history,
+    )

@@ -1,0 +1,498 @@
+"""Compiled-path offline tuner (horovod_tpu/tune, docs/autotune.md
+"Compiled-path offline tuning").
+
+Covers the GP/EI port's determinism and its golden-trace agreement with
+the native engine (``cpp/src/autotune.cc`` via a test-compiled
+``hvd_autotune_gp_probe``), the signature-keyed application seam
+(``make_train_step(tuned=...)`` / ``DistributedOptimizer(tuned=...)`` /
+staleness fallback), the plan-verifier gate, and the ``hvd_tuned_info``
+provenance surface.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import metrics as hvd_metrics
+from horovod_tpu import tune as T
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.ops.fusion import layer_group_bytes, plan_layer_groups
+from horovod_tpu.topo.model import synthetic_model
+from horovod_tpu.tune import gp as gp_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy_spec(n_layers=6, layer_bytes=1 << 20, name="toy"):
+    return T.ProgramSpec(
+        name=name,
+        layers=tuple((f"l{i}", layer_bytes) for i in range(n_layers)),
+        signature={"hash": "deadbeef", "treedef": "t", "leaves": [],
+                   "mesh": {}},
+    )
+
+
+# --- GP port ---------------------------------------------------------------
+
+
+def test_gp_fit_interpolates_observations():
+    xs = [(0.1, 0.2, 0.0, 1.0, 0.0), (0.8, 0.5, 1.0, 0.0, 1.0),
+          (0.4, 0.9, 0.0, 0.0, 0.0)]
+    ys = [10.0, 30.0, 20.0]
+    gp = gp_mod.fit(xs, ys)
+    assert gp is not None
+    # Posterior mean at an observed point tracks its (normalized,
+    # centered) observation within the noise floor.
+    ymax = max(ys)
+    mean = sum(y / ymax for y in ys) / len(ys)
+    for x, y in zip(xs, ys):
+        mu, var = gp_mod.posterior(gp, x)
+        assert abs(mu - (y / ymax - mean)) < 0.1
+        assert var > 0
+
+
+def test_gp_deterministic_sample_sequence():
+    """Byte-identical tuned.json (including the full sample history)
+    for a fixed seed across two runs."""
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    spec = _toy_spec()
+    a = T.tune(spec, model, samples=10, seed=3)
+    b = T.tune(spec, model, samples=10, seed=3)
+    assert a.to_json() == b.to_json()
+    c = T.tune(spec, model, samples=10, seed=4)
+    # A different seed explores a different design (histories differ
+    # even if the winner coincides).
+    assert [h["x"] for h in c.history] != [h["x"] for h in a.history]
+
+
+def _build_probe():
+    """Compile autotune.cc + a two-symbol shim into a standalone .so so
+    the golden test exercises the REAL C++ file, not a copy."""
+    gxx = shutil.which("g++")
+    if gxx is None:
+        pytest.skip("g++ unavailable")
+    td = tempfile.mkdtemp(prefix="gp_probe_")
+    shim = os.path.join(td, "shim.cc")
+    with open(shim, "w") as f:
+        f.write(
+            '#include "hvd/core.h"\n'
+            "namespace hvd {\n"
+            "void Log(LogLevel, const std::string&) {}\n"
+            "double NowSec() { return 0.0; }\n"
+            "}\n"
+        )
+    out = os.path.join(td, "libgpprobe.so")
+    cmd = [gxx, "-O2", "-std=c++17", "-fPIC", "-shared",
+           "-I" + os.path.join(REPO, "cpp", "include"),
+           os.path.join(REPO, "cpp", "src", "autotune.cc"), shim,
+           "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.skip(f"probe build failed: {proc.stderr[-500:]}")
+    return ctypes.CDLL(out)
+
+
+def test_gp_golden_trace_matches_cpp():
+    """The Python port and cpp/src/autotune.cc agree on a 5-D trace:
+    posterior means/variances to 1e-9, EI argmax exactly."""
+    lib = _build_probe()
+    fn = lib.hvd_autotune_gp_probe
+    fn.restype = ctypes.c_int
+    dbl_p = ctypes.POINTER(ctypes.c_double)
+    fn.argtypes = [dbl_p, dbl_p, ctypes.c_int, dbl_p, ctypes.c_int,
+                   dbl_p, dbl_p, dbl_p, ctypes.POINTER(ctypes.c_int)]
+
+    xs = [
+        (0.25, 0.125, 0.0, 0.0, 0.0),
+        (0.75, 0.50, 1.0, 0.0, 1.0),
+        (0.125, 0.875, 0.0, 1.0, 0.0),
+        (0.50, 0.25, 1.0, 1.0, 1.0),
+        (0.875, 0.625, 0.0, 0.0, 1.0),
+        (0.375, 0.375, 1.0, 0.0, 0.0),
+    ]
+    ys = [120.0, 310.0, 95.0, 270.0, 330.0, 180.0]
+    cands = [
+        (i / 8.0, j / 8.0, float(b0), float(b1), float(w))
+        for i in range(0, 9, 2) for j in range(0, 9, 2)
+        for b0 in (0, 1) for b1 in (0, 1) for w in (0, 1)
+    ]
+
+    n, m = len(xs), len(cands)
+    xs_c = (ctypes.c_double * (n * 5))(*[v for x in xs for v in x])
+    ys_c = (ctypes.c_double * n)(*ys)
+    cd_c = (ctypes.c_double * (m * 5))(*[v for c in cands for v in c])
+    mu_c = (ctypes.c_double * m)()
+    var_c = (ctypes.c_double * m)()
+    ei_c = (ctypes.c_double * m)()
+    am_c = ctypes.c_int(-1)
+    rc = fn(xs_c, ys_c, n, cd_c, m, mu_c, var_c, ei_c,
+            ctypes.byref(am_c))
+    assert rc == 0
+
+    gp = gp_mod.fit(xs, ys)
+    assert gp is not None
+    for i, c in enumerate(cands):
+        mu, var = gp_mod.posterior(gp, c)
+        assert abs(mu - mu_c[i]) < 1e-9, (i, mu, mu_c[i])
+        assert abs(var - var_c[i]) < 1e-9, (i, var, var_c[i])
+        assert abs(gp_mod.expected_improvement(gp, c) - ei_c[i]) < 1e-9
+    assert gp_mod.ei_argmax(gp, cands) == am_c.value
+
+
+# --- space / objective -----------------------------------------------------
+
+
+def test_space_encode_decode_roundtrip():
+    space = T.SearchSpace()
+    for config in (
+        space.default_config(),
+        {"fusion_threshold_bytes": 1 << 20,
+         "first_bucket_bytes": 1 << 16,
+         "topo_algorithm": "split", "wire_dtype": "int8"},
+    ):
+        assert space.decode(space.encode(config)) == config
+
+
+def test_space_freezes_topo_on_flat_model():
+    space = T.space_for_model(synthetic_model(local=8))
+    assert space.topo_choices == ("auto",)
+    x = space.encode({"fusion_threshold_bytes": 1 << 20,
+                      "first_bucket_bytes": 1 << 16,
+                      "topo_algorithm": "two-level",
+                      "wire_dtype": "f32"})
+    assert space.decode(x)["topo_algorithm"] == "auto"
+
+
+def test_layer_group_bytes_matches_partition():
+    layer_bytes = [3 << 20, 1 << 20, 2 << 20, 512 << 10]
+    groups = plan_layer_groups(layer_bytes, 4 << 20, 1 << 20)
+    per = layer_group_bytes(layer_bytes, 4 << 20, 1 << 20)
+    assert len(per) == len(groups)
+    assert sum(per) == sum(layer_bytes)
+    for g, b in zip(groups, per):
+        assert sum(layer_bytes[i] for i in g) == b
+
+
+def test_free_objectives_int8_cheaper_on_wire():
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    spec = _toy_spec()
+    space = T.SearchSpace()
+    base = T.free_objectives(spec, space.default_config(), model)
+    q = T.free_objectives(
+        spec, dict(space.default_config(), wire_dtype="int8"), model
+    )
+    assert q["wire_bytes"] < base["wire_bytes"]
+    assert q["cost_us"] < base["cost_us"]
+
+
+# --- verifier gate ---------------------------------------------------------
+
+
+def test_tuner_refuses_corrupted_plan():
+    """A corrupted ring schedule (seeded through rounds_fn, the same
+    injection seam tests/test_plan_verify.py uses) must abort the pin:
+    no TunedConfig comes back."""
+    from horovod_tpu.topo.compositor import perm_rounds
+
+    model = synthetic_model(local=8)  # flat: ring/halving stages
+    spec = _toy_spec()
+
+    def corrupted(primitive, size):
+        rounds = perm_rounds(primitive, size)
+        if rounds:
+            # Break round 0's bijectivity: everyone sends to rank 0.
+            rounds = [[(s, 0) for s, _ in rounds[0]]] + rounds[1:]
+        return rounds
+
+    with pytest.raises(T.TuneVerificationError) as exc:
+        T.tune(spec, model, samples=4, seed=0, rounds_fn=corrupted)
+    assert exc.value.findings
+
+
+def test_tuner_verifies_clean_grid():
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    cfg = T.tune(_toy_spec(), model, samples=6, seed=0)
+    assert cfg.search["verified_plans"] >= 1
+
+
+# --- signature keying ------------------------------------------------------
+
+
+def test_signature_stable_and_mesh_sensitive():
+    params = {"a": jax.ShapeDtypeStruct((4, 4), jnp.float32)}
+    s1 = T.step_signature(params, mesh={"data": 8})
+    s2 = T.step_signature(params, mesh={"data": 8})
+    s3 = T.step_signature(params, mesh={"cross": 2, "local": 4})
+    assert s1["hash"] == s2["hash"]
+    assert s1["hash"] != s3["hash"]
+    assert T.signatures_match(s1, s2)
+    assert not T.signatures_match(s1, s3)
+    # Params-only comparison ignores the mesh half.
+    assert T.signatures_match(s1, s3, require_mesh=False)
+
+
+# --- application seam ------------------------------------------------------
+
+
+D = 64
+
+
+def _mlp_setup(devices):
+    import optax
+
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh()
+    n = len(devices)
+    rng = np.random.RandomState(0)
+    params = {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.1),
+            "b": jnp.asarray(rng.randn(D).astype(np.float32) * 0.1),
+        }
+        for i in range(3)
+    }
+    batch = (
+        jnp.asarray(rng.randn(2 * n, D).astype(np.float32)),
+        jnp.asarray(rng.randn(2 * n, D).astype(np.float32)),
+    )
+
+    def loss_fn(p, b):
+        x, y = b
+        h = x
+        for i in range(3):
+            h = jnp.tanh(h @ p[f"layer{i}"]["w"] + p[f"layer{i}"]["b"])
+        return jnp.mean((h - y) ** 2)
+
+    tx = optax.sgd(0.01)
+    return mesh, params, batch, loss_fn, tx
+
+
+def _hand_cfg(params, mesh, knobs=None):
+    sig = T.step_signature(params, mesh=mesh)
+    return T.TunedConfig(
+        knobs=knobs or {
+            "fusion_threshold_bytes": 1 << 20,
+            "first_bucket_bytes": 1 << 14,
+            "topo_algorithm": "auto",
+            "wire_dtype": "f32",
+        },
+        signature=sig, objectives={}, baseline={}, program="test-mlp",
+    )
+
+
+def test_make_train_step_tuned_matches_hand_set(devices):
+    import horovod_tpu.jax as hvdj
+
+    mesh, params, batch, loss_fn, tx = _mlp_setup(devices)
+    opt_state = tx.init(params)
+    cfg = _hand_cfg(params, mesh)
+
+    tuned_step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True, tuned=cfg)
+    hand_step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True, tuned=False,
+        **T.tuned_step_kwargs(cfg))
+    untuned_step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True, tuned=False)
+
+    p_t, _, _ = tuned_step(params, opt_state, batch)
+    info = T.applied_tuned_info()
+    assert info and info["matched"] and info["source"] == "arg"
+    p_h, _, _ = hand_step(params, opt_state, batch)
+    p_u, _, _ = untuned_step(params, opt_state, batch)
+    for a, b in zip(jax.tree.leaves(p_t), jax.tree.leaves(p_h)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # f32 regrouping is bitwise-neutral: tuned == untuned too.
+    for a, b in zip(jax.tree.leaves(p_t), jax.tree.leaves(p_u)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_make_train_step_stale_signature_falls_back(devices, caplog):
+    import logging
+
+    import horovod_tpu.jax as hvdj
+
+    mesh, params, batch, loss_fn, tx = _mlp_setup(devices)
+    opt_state = tx.init(params)
+    # Signature from DIFFERENT params (extra layer) — stale by
+    # construction.
+    other = dict(params)
+    other["layer3"] = params["layer0"]
+    cfg = _hand_cfg(other, mesh)
+
+    stale_step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True, tuned=cfg)
+    untuned_step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True, tuned=False)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        p_s, _, _ = stale_step(params, opt_state, batch)
+    assert any("FALLING BACK" in r.message for r in caplog.records)
+    info = T.applied_tuned_info()
+    assert info and not info["matched"]
+    p_u, _, _ = untuned_step(params, opt_state, batch)
+    for a, b in zip(jax.tree.leaves(p_s), jax.tree.leaves(p_u)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_make_train_step_env_knob(devices, tmp_path, monkeypatch):
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.common import env as henv
+
+    mesh, params, batch, loss_fn, tx = _mlp_setup(devices)
+    opt_state = tx.init(params)
+    cfg = _hand_cfg(params, mesh)
+    path = tmp_path / "tuned.json"
+    T.save_tuned(cfg, str(path))
+    monkeypatch.setenv(henv.HOROVOD_TUNED_FILE, str(path))
+    step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True)
+    step(params, opt_state, batch)
+    info = T.applied_tuned_info()
+    assert info and info["matched"] and info["source"] == "env"
+    assert henv.Config.from_env().tuned_file == str(path)
+
+
+def test_distributed_optimizer_tuned(devices, caplog):
+    import logging
+
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.jax import _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh, params, batch, loss_fn, tx_inner = _mlp_setup(devices)
+    cfg = _hand_cfg(params, mesh=None)  # optimizer checks params half only
+
+    def run(tx):
+        def step(p, s, b):
+            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s
+
+        fn = jax.jit(_shard_map(
+            step, mesh, in_specs=(P(), P(), P("data")), out_specs=P(),
+        ))
+        s0 = tx.init(params)
+        p1, _ = fn(params, s0, batch)
+        return jax.tree.leaves(p1)
+
+    import optax as _optax
+
+    tuned = run(hvdj.DistributedOptimizer(_optax.sgd(0.01), tuned=cfg))
+    info = T.applied_tuned_info()
+    assert info and info["matched"]
+    assert info["where"] == "DistributedOptimizer"
+    untuned = run(hvdj.DistributedOptimizer(_optax.sgd(0.01)))
+    for a, b in zip(tuned, untuned):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Stale signature: warns and keeps defaults.
+    other = {"only": params["layer0"]}
+    stale_cfg = _hand_cfg(other, mesh=None)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu"):
+        stale = run(hvdj.DistributedOptimizer(_optax.sgd(0.01),
+                                              tuned=stale_cfg))
+    assert any("FALLING BACK" in r.message for r in caplog.records)
+    for a, b in zip(stale, untuned):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- provenance surfaces ---------------------------------------------------
+
+
+def test_tuned_info_gauge_and_source():
+    hvd_metrics.install(True)
+    try:
+        T.note_applied("file", "cafe0123", True, "test")
+        flat = hvd_metrics.flat()
+        key = [k for k in flat if k.startswith("hvd_tuned_info")]
+        assert key, flat
+        assert 'source="file"' in key[0]
+        assert T.applied_tuned_info()["matched"] is True
+        assert T.current_tuned_source()["source"] == "file"
+    finally:
+        hvd_metrics.install(False)
+
+
+def test_current_tuned_source_env(tmp_path, monkeypatch):
+    from horovod_tpu.common import env as henv
+    import horovod_tpu.tune as tune_mod
+
+    monkeypatch.setattr(tune_mod, "_applied_info", None)
+    monkeypatch.delenv(henv.HOROVOD_TUNED_FILE, raising=False)
+    assert T.current_tuned_source()["source"] == "none"
+    cfg = T.TunedConfig(
+        knobs={"fusion_threshold_bytes": 1, "first_bucket_bytes": 1,
+               "topo_algorithm": "auto", "wire_dtype": "f32"},
+        signature={"hash": "beef"}, objectives={}, baseline={},
+    )
+    path = tmp_path / "t.json"
+    T.save_tuned(cfg, str(path))
+    monkeypatch.setenv(henv.HOROVOD_TUNED_FILE, str(path))
+    src = T.current_tuned_source()
+    assert src["source"] == "env"
+    assert src["signature"] == "beef"
+
+
+def test_executor_stamps_tuned_info_into_verdict(devices, tmp_path,
+                                                 monkeypatch):
+    """The eager executor's plan verdicts carry the compiled-path tuned
+    source (file/env/none + signature hash) next to the native core's
+    tuned_flags int."""
+    import horovod_tpu.tune as tune_mod
+    from horovod_tpu.common import env as henv
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.common.types import TensorTableEntry
+    from horovod_tpu.core.xla_executor import XlaPlanExecutor
+
+    cfg = T.TunedConfig(
+        knobs={"fusion_threshold_bytes": 1, "first_bucket_bytes": 1,
+               "topo_algorithm": "auto", "wire_dtype": "f32"},
+        signature={"hash": "feed0123"}, objectives={}, baseline={},
+    )
+    path = tmp_path / "t.json"
+    T.save_tuned(cfg, str(path))
+    monkeypatch.setattr(tune_mod, "_applied_info", None)
+    monkeypatch.setenv(henv.HOROVOD_TUNED_FILE, str(path))
+
+    topo = Topology(rank=0, size=1, local_rank=0, local_size=1,
+                    cross_rank=0, cross_size=1)
+    ex = XlaPlanExecutor(topo)
+    assert ex.tuned_info()["source"] == "env"
+    assert ex.tuned_info()["signature"] == "feed0123"
+    plan = {"type": 0, "op": int(ReduceOp.SUM), "participants": 1}
+    entries = [TensorTableEntry(
+        name="t", tensor=np.ones((4,), np.float32))]
+    out = ex.execute(plan, entries, topo)
+    np.testing.assert_array_equal(np.asarray(out["t"]), np.ones(4))
+    assert plan["tuned_info"]["source"] == "env"
+    assert plan["tuned_info"]["signature"] == "feed0123"
+
+
+def test_tuned_step_kwargs_mapping():
+    def mk(topo, wire="f32"):
+        return T.TunedConfig(
+            knobs={"fusion_threshold_bytes": 123, "first_bucket_bytes": 7,
+                   "topo_algorithm": topo, "wire_dtype": wire},
+            signature={}, objectives={}, baseline={},
+        )
+
+    kw = T.tuned_step_kwargs(mk("flat"))
+    assert kw["hierarchical"] is False and kw["topo_algorithm"] is None
+    kw = T.tuned_step_kwargs(mk("two-level"))
+    assert kw["hierarchical"] == "auto"
+    assert kw["topo_algorithm"] == "two-level"
+    kw = T.tuned_step_kwargs(mk("auto", wire="int8"))
+    assert kw["quantized"] is True and kw["topo_algorithm"] is None
+    assert kw["fusion_threshold_bytes"] == 123
+    assert kw["first_bucket_bytes"] == 7

@@ -55,6 +55,15 @@
 # byte-identical across two runs (docs/overlap.md "Quantized wire
 # compression"). Budget: under 15s.
 #
+# Stage 10 (make tune-smoke; skip with HVD_CI_SKIP_TUNE=1): the
+# compiled-path offline-tuner smoke — tools/autotune_compiled.py run
+# twice on the mlp3 program (cost-model-only objectives, ~8 samples)
+# asserting tuned.json byte-identical, a make_train_step(tuned=...)
+# build numerically identical to the untuned step, the tuned plan's
+# modeled cost <= the default plan's (with a strict free-objective win
+# on the transformer program), and the stale-signature fallback loud
+# (docs/autotune.md "Compiled-path offline tuning"). Budget: under 60s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -126,4 +135,11 @@ if [ "${HVD_CI_SKIP_TRACE:-0}" != "1" ]; then
     python tools/trace_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: trace smoke merged+attributed+postmortem in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_TUNE:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/tune_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: tune smoke deterministic+bitwise+modeled-win in ${elapsed}s"
 fi
