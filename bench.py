@@ -208,9 +208,10 @@ def _parse_args(argv=None):
         help="streamed in-backward gradient reduction (docs/overlap.md): "
              "per-layer-group bucket psums issued inside the backward so "
              "XLA can overlap them with remaining backward compute; "
-             "composes with --quantized (int8 wire per streamed bucket); "
-             "incompatible with --zero1 (ZeRO re-shapes the reduction "
-             "post-hoc)",
+             "composes with --quantized (int8 wire per streamed bucket) "
+             "and with --zero1 (per-bucket reduce-scatter inside the "
+             "backward, shard-local update, param all-gather — "
+             "docs/overlap.md \"Streamed ZeRO-1\")",
     )
     parser.add_argument(
         "--tuned", default="",
@@ -227,8 +228,6 @@ def _parse_args(argv=None):
         parser.error("--zero1 is implemented for --model transformer only")
     if args.quantized and args.model != "transformer":
         parser.error("--quantized applies to --model transformer only")
-    if args.overlap and args.zero1:
-        parser.error("--overlap is incompatible with --zero1")
     return args
 
 
@@ -514,16 +513,12 @@ def run_lm_benchmark(args) -> int:
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     tx = optax.adamw(3e-4)
 
-    # Pinned offline tuning (--tuned; docs/autotune.md): applies to the
-    # replicated reduction paths (posthoc / overlap); ZeRO-1 reshapes
-    # the reduction and keeps its own knobs. Explicit CLI flags win.
+    # Pinned offline tuning (--tuned; docs/autotune.md): applies to every
+    # reduction mode — including zero1, whose streamed form shares the
+    # threshold/first-bucket partition and wire dtype with the overlap
+    # fast path (the tuner prices its RS+AG shape, tune/objective.py).
+    # Explicit CLI flags win.
     tuned_kw, tuned_detail = _resolve_tuned(args, params, mesh)
-    if args.zero1 and tuned_detail is not None:
-        tuned_detail["note"] = (
-            "zero1 reshapes the reduction (reduce-scatter + gather); "
-            "tuned knobs not applied"
-        )
-        tuned_kw = None
     quantized_eff = bool(args.quantized) or bool(
         tuned_kw and tuned_kw["quantized"]
     )
@@ -544,14 +539,62 @@ def run_lm_benchmark(args) -> int:
             logits, lab
         ).mean()
 
-    if args.zero1:
+    if args.zero1 and args.overlap:
+        # Streamed ZeRO-1 (docs/overlap.md "Streamed ZeRO-1"): each
+        # stream_param_groups bucket reduce-scatters INSIDE the backward
+        # (int8 ring with --quantized), the shard-local update runs
+        # against the per-bucket sharded state, and the updated shards
+        # all-gather back — the overlap property of the streamed path at
+        # half the gradient wire bytes.
+        from horovod_tpu.parallel.zero import (
+            Zero1State,
+            init_zero1_stream_state,
+            zero1_stream_update,
+        )
+
+        zknobs = dict(
+            threshold_bytes=(
+                tuned_kw["fusion_threshold_bytes"] if tuned_kw else None
+            ),
+            first_bucket_bytes=(
+                tuned_kw["first_bucket_bytes"] if tuned_kw else None
+            ),
+        )
+        # EF off in the bench — it measures throughput; the residual add
+        # is elementwise noise (same policy as the overlap path).
+        opt_state = init_zero1_stream_state(
+            tx, params, n_chips, quantized=quantized_eff,
+            error_feedback=False, **zknobs,
+        )
+
+        def step(p, s_stacked, tok, lab):
+            s = jax.tree.map(lambda x: x[0], s_stacked)
+
+            def streamed(p_, tok_, lab_):
+                return loss_fn(
+                    hvdj.stream_param_groups(
+                        p_, zero1=True, quantized=quantized_eff, **zknobs
+                    ),
+                    tok_, lab_,
+                )
+
+            loss, grads = jax.value_and_grad(streamed)(p, tok, lab)
+            p, new_opt = zero1_stream_update(
+                tx, p, s.opt, grads, axis_name="data",
+                n_shards=n_chips, quantized=quantized_eff, **zknobs,
+            )
+            news = Zero1State(opt=new_opt, ef=None)
+            return (p, jax.tree.map(lambda x: x[None], news),
+                    jax.lax.pmean(loss, "data"))
+    elif args.zero1:
         # Optimizer state sharded 1/n_chips over the data axis; the
         # gradient allreduce becomes reduce-scatter + all-gather around
-        # the shard-local update (parallel/zero.py).
+        # the shard-local update (parallel/zero.py). Post-hoc: the RS
+        # waits for the whole backward (no overlap).
         from horovod_tpu.parallel.zero import init_zero1_state, zero1_update
 
         opt_state = init_zero1_state(
-            tx, params, n_chips, quantized=args.quantized
+            tx, params, n_chips, quantized=quantized_eff
         )
 
         def step(p, s_stacked, tok, lab):
@@ -559,7 +602,7 @@ def run_lm_benchmark(args) -> int:
             loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
             p, s = zero1_update(
                 tx, p, s, grads, axis_name="data", n_shards=n_chips,
-                quantized=args.quantized,
+                quantized=quantized_eff,
             )
             return (p, jax.tree.map(lambda x: x[None], s),
                     jax.lax.pmean(loss, "data"))
@@ -671,11 +714,26 @@ def run_lm_benchmark(args) -> int:
 
     grad_bytes = 4 * n_params
     ring_factor = 2 * (n_chips - 1) / max(n_chips, 1)
+    rs_factor = (n_chips - 1) / max(n_chips, 1)
     full_wire = int(grad_bytes * ring_factor)
-    wire_bytes = (
-        int(int8_wire_bytes(grad_bytes) * ring_factor)
-        if quantized_eff else full_wire
-    )
+    rs_bytes = ag_bytes = None
+    if args.zero1:
+        # ZeRO-1 decomposes the exchange: gradient reduce-scatter
+        # ((n-1)/n, int8-compressible) + parameter all-gather ((n-1)/n,
+        # always full precision — replicas must stay exact). Reported
+        # separately so "+overlap+zero1+quantized" savings are honest:
+        # only the gradient hop shrinks.
+        rs_bytes = int(
+            (int8_wire_bytes(grad_bytes) if quantized_eff else grad_bytes)
+            * rs_factor
+        )
+        ag_bytes = int(grad_bytes * rs_factor)
+        wire_bytes = rs_bytes + ag_bytes
+    else:
+        wire_bytes = (
+            int(int8_wire_bytes(grad_bytes) * ring_factor)
+            if quantized_eff else full_wire
+        )
     mode = (
         ("overlap+" if args.overlap else "")
         + ("quantized" if quantized_eff else
@@ -747,6 +805,14 @@ def run_lm_benchmark(args) -> int:
                     round(1.0 - wire_bytes / full_wire, 4)
                     if full_wire else 0.0
                 ),
+                **({
+                    "reduce_scatter_bytes_per_step_per_chip": rs_bytes,
+                    "all_gather_bytes_per_step_per_chip": ag_bytes,
+                    "gradient_reduction_savings_ratio": (
+                        round(1.0 - rs_bytes / (full_wire / 2), 4)
+                        if full_wire else 0.0
+                    ),
+                } if args.zero1 else {}),
             },
             "step_skew": step_skew,
             "scan": bool(args.scan),

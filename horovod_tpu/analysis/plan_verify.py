@@ -716,9 +716,12 @@ def verify_plan_grid(
                 # corrupted compressed-bytes declaration is a lint
                 # failure too.
                 wire_dtypes: Tuple[str, ...] = (WIRE_F32,)
-                if collective == "allreduce" and op in (
+                if collective in ("allreduce", "reducescatter") and op in (
                     ReduceOp.SUM, ReduceOp.AVERAGE
                 ):
+                    # Reduce-scatter joined the int8 grid with streamed
+                    # ZeRO-1 (the gradient hop of the RS+AG
+                    # decomposition).
                     wire_dtypes = (WIRE_F32, WIRE_INT8)
                 for wire_dtype in wire_dtypes:
                     for nbytes in payloads:
@@ -738,4 +741,61 @@ def verify_plan_grid(
                                 )
                             findings.extend(fs)
                             verified += 1
+    return findings, verified
+
+
+# --- streamed ZeRO-1: the implied per-bucket RS+AG plan grid -----------------
+
+
+def zero1_bucket_plans(
+    model: InterconnectModel,
+    bucket_bytes: Sequence[int],
+    *,
+    quantized: bool = False,
+    op: ReduceOp = ReduceOp.SUM,
+) -> List[Tuple[Plan, Plan]]:
+    """The compositor plans a streamed-zero1 build implies, per bucket:
+    the gradient reduce-scatter (int8 wire when ``quantized``) and the
+    parameter all-gather of the 1/N shard that returns after the
+    shard-local update. These are the artifacts the symbolic checker
+    verifies before a zero1 configuration ships (the same gate
+    ``verify_plan_grid`` provides for the allreduce paths)."""
+    from ..common.quant import WIRE_INT8 as _I8
+
+    plans: List[Tuple[Plan, Plan]] = []
+    n = max(model.size, 1)
+    for nb in bucket_bytes:
+        rs = _comp.select_plan(
+            model, "reducescatter", int(nb), op=op,
+            wire_dtype=_I8 if quantized else WIRE_F32,
+        )
+        shard = math.ceil(int(nb) / n)
+        ag = _comp.select_plan(model, "allgather", shard)
+        plans.append((rs, ag))
+    return plans
+
+
+def verify_zero1_stream_plans(
+    model: InterconnectModel,
+    bucket_bytes: Sequence[int],
+    *,
+    quantized: bool = False,
+    op: ReduceOp = ReduceOp.SUM,
+    suppress: Optional[Sequence[str]] = None,
+) -> Tuple[List[Finding], int]:
+    """Symbolically verify every per-bucket RS and AG plan a
+    streamed-zero1 build implies on ``model``. Returns
+    ``(findings, plans_verified)``."""
+    findings: List[Finding] = []
+    verified = 0
+    for rs, ag in zero1_bucket_plans(
+        model, bucket_bytes, quantized=quantized, op=op
+    ):
+        for plan in (rs, ag):
+            fs = verify_plan(plan, model, suppress=suppress)
+            for f in fs:
+                f.location = f"zero1/{f.location}"
+                f.details.setdefault("zero1", True)
+            findings.extend(fs)
+            verified += 1
     return findings, verified

@@ -33,20 +33,37 @@ def strip_rank_local(tree: Any) -> Any:
     would make every digest check a false mismatch. The residual is
     still elastic state (snapshots/sync carry it); only the CROSS-RANK
     agreement ignores it. Everything under ``EFState.inner`` stays
-    digest-tracked."""
+    digest-tracked.
+
+    Streamed-ZeRO-1 state (``parallel/zero.Zero1State``) is sharded BY
+    DESIGN: each rank holds only its row of every stacked bucket state
+    (and sharded EF residual), so the bytes intentionally diverge across
+    ranks. The digest keeps the shard LAYOUT (dtype/shape headers per
+    leaf — identical across ranks exactly when the partition is) and
+    drops the bytes; a rank whose shard layout drifted still mismatches
+    loudly."""
     import jax
 
     from ..ops.quantized import EFState
+    from ..parallel.zero import Zero1State
 
-    def is_ef(node):
-        return isinstance(node, EFState)
+    def is_rank_local(node):
+        return isinstance(node, (EFState, Zero1State))
 
     def strip(node):
         if isinstance(node, EFState):
             return {"inner": strip_rank_local(node.inner)}
+        if isinstance(node, Zero1State):
+            import numpy as np
+
+            return {"zero1_shard_layout": [
+                f"{np.dtype(getattr(l, 'dtype', type(l)))}"
+                f"{tuple(getattr(l, 'shape', ()))}"
+                for l in jax.tree.leaves(node)
+            ]}
         return node
 
-    return jax.tree.map(strip, tree, is_leaf=is_ef)
+    return jax.tree.map(strip, tree, is_leaf=is_rank_local)
 
 
 def tree_digest(tree: Any, _h=None) -> str:

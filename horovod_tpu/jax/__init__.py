@@ -40,6 +40,12 @@ from ..ops import fusion as _fusion
 from ..ops import quantized as _q
 from ..ops.adasum import adasum_reduce_fn
 from ..ops.quantized import EFState, ef_like
+from ..parallel.zero import (
+    Zero1State,
+    init_zero1_stream_state,
+    zero1_posthoc_reduce,
+    zero1_stream_update,
+)
 from ..parallel.mesh import (
     CROSS_AXIS,
     DATA_AXIS,
@@ -356,6 +362,136 @@ def _resolve_error_feedback(error_feedback: Optional[bool],
     return True if error_feedback is None else bool(error_feedback)
 
 
+def _zero1_distributed_optimizer(
+    optimizer,
+    *,
+    op: ReduceOp,
+    axis_name: str,
+    fusion_threshold_bytes: Optional[int],
+    first_bucket_bytes: Optional[int],
+    compression,
+    hierarchical: Any,
+    quantized: bool,
+    error_feedback: Optional[bool],
+    overlap: bool,
+    nonfinite: Optional[str],
+    zero1_shards: Optional[int],
+    tuned: Any,
+):
+    """The ``DistributedOptimizer(zero1=True)`` construction — see the
+    public wrapper's docstring for the contract."""
+    import optax
+
+    from ..parallel import zero as _zero
+
+    if zero1_shards is None or int(zero1_shards) < 1:
+        raise ValueError(
+            "DistributedOptimizer(zero1=True) needs zero1_shards=<data-"
+            "axis size>: init builds the sharded state before any axis "
+            "is bound, so the shard count cannot be inferred"
+        )
+    n_shards = int(zero1_shards)
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"zero1=True shards the optimizer update over a summed "
+            f"gradient; op must be SUM/AVERAGE, got {ReduceOp(op).name}"
+        )
+    if compression is not Compression.none:
+        raise ValueError(
+            "zero1=True reduce-scatters raw buckets; cast compression "
+            "has no shard-image form — use quantized=True instead"
+        )
+    if bool(hierarchical):
+        raise ValueError(
+            "DistributedOptimizer(zero1=True) runs over the flat data "
+            "axis; hierarchical zero1 lives in make_train_step(zero1="
+            "True, hierarchical='auto'), which owns the mesh"
+        )
+    if error_feedback:
+        raise ValueError(
+            "zero1 error feedback rides the streamed backward's side "
+            "channel, which only make_train_step(zero1=True, "
+            "quantized=True) can thread — leave error_feedback unset"
+        )
+    if tuned not in (None, False):
+        _logger.warning(
+            "DistributedOptimizer(zero1=True) ignores tuned=: the "
+            "sharded state layout is keyed by the knobs the state was "
+            "built with — apply tunings via make_train_step(zero1=True, "
+            "tuned=...)"
+        )
+    nonfinite_policy = _resolve_nonfinite(nonfinite)
+    if nonfinite_policy in ("skip", "abort"):
+        raise ValueError(
+            "nonfinite skip/abort need the step-level agreement seam "
+            "(make_train_step); the zero1 optax wrapper supports "
+            "off/zero/warn"
+        )
+    knobs = dict(
+        threshold_bytes=fusion_threshold_bytes,
+        first_bucket_bytes=first_bucket_bytes,
+    )
+    if _trace.ACTIVE:
+        _trace.TAP.note_plan(
+            optimizer="DistributedOptimizer",
+            wire_dtype="int8" if quantized else "f32",
+            overlap=bool(overlap), zero1=True,
+        )
+
+    def init_fn(params):
+        return _zero.init_zero1_stream_state(
+            optimizer, params, n_shards,
+            quantized=quantized, error_feedback=False, **knobs,
+        )
+
+    def update_fn(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError(
+                "DistributedOptimizer(zero1=True) needs the params "
+                "argument: the shard-local update slices this rank's "
+                "parameter shard"
+            )
+        if not isinstance(state, Zero1State):
+            raise TypeError(
+                "zero1 update expects the Zero1State this wrapper's "
+                f"init built; got {type(state).__name__}"
+            )
+        state_rows = jax.tree.map(lambda s: s[0], state)
+        do_reduce = True
+        if overlap:
+            reg = _fusion.take_stream_registrations()
+            do_reduce = reg["calls"] == 0
+            if do_reduce:
+                _logger.warning(
+                    "overlap=True but no parameter subtree was "
+                    "registered with stream_param_groups(zero1=True); "
+                    "reduce-scattering post-hoc (correct, zero overlap)"
+                )
+        if nonfinite_policy == "zero" and do_reduce:
+            grads = _nf.sanitize(grads)
+        if do_reduce:
+            grads, _ = _zero.zero1_posthoc_reduce(
+                grads, op=op, axis_name=axis_name, quantized=quantized,
+                **knobs,
+            )
+        if nonfinite_policy == "warn":
+            _nf.note_detection("warn", "zero1-optimizer")(
+                _nf.local_flag(grads)
+            )
+        new_params, new_opt = _zero.zero1_stream_update(
+            optimizer, params, state_rows.opt, grads,
+            axis_name=axis_name, n_shards=n_shards,
+            quantized=quantized, **knobs,
+        )
+        updates = jax.tree.map(
+            lambda a, b: a - b, new_params, params
+        )
+        new_state = Zero1State(opt=new_opt, ef=state_rows.ef)
+        return updates, jax.tree.map(lambda s: s[None], new_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimizer
     optimizer,
     *,
@@ -371,6 +507,8 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     nonfinite: Optional[str] = None,
     tuned: Any = None,
     topo_algorithm: Optional[str] = None,
+    zero1: bool = False,
+    zero1_shards: Optional[int] = None,
 ):
     """Wrap an optax ``GradientTransformation`` so its update first
     allreduces gradients across the data axis.
@@ -424,12 +562,37 @@ def DistributedOptimizer(  # noqa: N802 - API parity with hvd.DistributedOptimiz
     ``topo_algorithm`` pins one compositor lowering under planned
     hierarchy — normally set via ``tuned``, exposed for hand
     experiments.
+
+    ``zero1=True`` (with ``zero1_shards=<data-axis size>``) shards the
+    optimizer state per streamed bucket (docs/overlap.md "Streamed
+    ZeRO-1"): ``init`` builds the stacked :class:`Zero1State` — thread
+    it through your ``shard_map`` with ``P(axis_name)`` on the leading
+    axis — and ``update`` runs the shard-local optax update against the
+    bucketized shard layout, returning full-tree updates
+    (``gathered_new_params - params``; note ``apply_updates`` re-adds,
+    so the result matches ``make_train_step(zero1=True)`` to float-add
+    round-off, not bitwise). Under ``overlap=True`` the gradients must
+    arrive as shard images from ``stream_param_groups(zero1=True)``;
+    without registrations the wrapper reduce-scatters post-hoc (correct,
+    zero overlap). Error feedback needs the backward side channel only
+    ``make_train_step`` owns and is rejected here.
     """
     import jax.numpy as jnp
     import optax
 
     from .. import tune as _tune
 
+    if zero1:
+        return _zero1_distributed_optimizer(
+            optimizer, op=op, axis_name=axis_name,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            first_bucket_bytes=None,
+            compression=compression, hierarchical=hierarchical,
+            quantized=_resolve_quantized(quantized),
+            error_feedback=error_feedback, overlap=overlap,
+            nonfinite=nonfinite, zero1_shards=zero1_shards,
+            tuned=tuned,
+        )
     tuned_cfg, tuned_source = _tune.resolve_tuned(tuned)
     caller_quantized = quantized
     caller_hierarchical = hierarchical
@@ -669,6 +832,7 @@ def _build_train_step(
     first_bucket_bytes: Optional[int] = None,
     nonfinite: Optional[str] = None,
     topo_algorithm: Optional[str] = None,
+    zero1: bool = False,
 ):
     """Build a jitted SPMD training step: per-shard grads → fused allreduce
     → optax update, with the batch sharded over ``axis_name`` and
@@ -721,6 +885,17 @@ def _build_train_step(
         raise ValueError(
             "quantized=True already compresses the wire to int8; "
             "stacking cast compression would add loss for no bandwidth win"
+        )
+    if zero1:
+        return _build_zero1_train_step(
+            loss_fn, optimizer, mesh,
+            axis_name=axis_name, op=op,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            compression=compression, hierarchical=hierarchical,
+            quantized=quantized, error_feedback=error_feedback,
+            donate=donate, has_aux=has_aux, overlap=overlap,
+            first_bucket_bytes=first_bucket_bytes, nonfinite=nonfinite,
+            topo_algorithm=topo_algorithm,
         )
     # "auto": the mesh decides — a (pod,) cross, local hierarchy engages
     # per-bucket compositor plan selection (flat/two-level/split by
@@ -928,6 +1103,234 @@ def _build_train_step(
     return _maybe_trace(aborting_step)
 
 
+def _build_zero1_train_step(
+    loss_fn: Callable[..., jax.Array],
+    optimizer,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    op: ReduceOp = Average,
+    fusion_threshold_bytes: Optional[int] = None,
+    compression=Compression.none,
+    hierarchical: Any = False,
+    quantized: bool = False,
+    error_feedback: Optional[bool] = None,
+    donate: bool = True,
+    has_aux: bool = False,
+    overlap: bool = False,
+    first_bucket_bytes: Optional[int] = None,
+    nonfinite: Optional[str] = None,
+    topo_algorithm: Optional[str] = None,
+):
+    """The streamed-ZeRO-1 step (docs/overlap.md "Streamed ZeRO-1"):
+    ``step(params, zero1_state, batch)`` with the optimizer state
+    sharded per streamed bucket (``init_zero1_stream_state``). Under
+    ``overlap=True`` each bucket reduce-scatters INSIDE the backward
+    trace — each rank keeps only its shard's cotangents, (n-1)/n of the
+    gradient payload rides the wire, and the scheduler hides it behind
+    the remaining backward compute; ``overlap=False`` runs the identical
+    per-bucket reduction post-hoc (bitwise-equal, zero overlap). The
+    shard-local optax update and parameter all-gather run against the
+    same bucket plan (``parallel/zero.zero1_stream_update``).
+
+    ``quantized=True`` moves each bucket through the int8 ring
+    reduce-scatter with the error-feedback residual carried SHARDED in
+    the ``Zero1State`` (flat axis only — DCN-only compression has no
+    RS+AG form); ``hierarchical="auto"`` on a multi-slice mesh lowers
+    each bucket's RS/AG via the compositor's two-level schedules (only
+    the 1/L shard crosses DCN). ``topo_algorithm`` pins nothing here —
+    the RS lowering is determined by the axis shape — except ``"split"``
+    which has no reduce-scatter form and raises.
+    """
+    import jax.numpy as jnp
+
+    from ..parallel import zero as _zero
+
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"zero1=True shards the optimizer update over a summed "
+            f"gradient; op must be SUM/AVERAGE, got {ReduceOp(op).name}"
+        )
+    if compression is not Compression.none:
+        raise ValueError(
+            "zero1=True reduce-scatters raw buckets; cast compression "
+            "has no shard-image form — use quantized=True instead"
+        )
+    if topo_algorithm == "split":
+        raise ValueError(
+            "topo_algorithm='split' has no reduce-scatter decomposition; "
+            "zero1 lowers flat or two-level by the mesh shape"
+        )
+    hierarchical, hier_axes = _resolve_hierarchical(hierarchical, mesh)
+    if hierarchical == "planned" and hier_axes and axis_name == DATA_AXIS:
+        axis_name = hier_axes
+    axis_name = _normalize_axis(axis_name, hierarchical)
+    if quantized and not isinstance(axis_name, str):
+        raise ValueError(
+            "quantized zero1 runs the flat int8 ring reduce-scatter "
+            "over ONE axis; hierarchical (DCN-only) compression is not "
+            "defined for the RS+AG decomposition — drop hierarchical or "
+            "quantized"
+        )
+    nonfinite_policy = _resolve_nonfinite(nonfinite)
+    use_ef = _resolve_error_feedback(error_feedback, quantized, False)
+    axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    n_shards = 1
+    for a in axes:
+        n_shards *= int(mesh.shape[a])
+    knobs = dict(
+        threshold_bytes=fusion_threshold_bytes,
+        first_bucket_bytes=first_bucket_bytes,
+    )
+    state_spec = P(axes[0] if len(axes) == 1 else axes)
+
+    def step(params, opt_state, batch):
+        if not isinstance(opt_state, Zero1State):
+            raise TypeError(
+                "zero1=True expects the sharded Zero1State from "
+                "hvd.init_zero1_stream_state(optimizer, params, "
+                f"{n_shards}, ...); got {type(opt_state).__name__}"
+            )
+        state = jax.tree.map(lambda s: s[0], opt_state)
+        ef = None
+        if use_ef:
+            if state.ef is None:
+                raise ValueError(
+                    "the quantized zero1 wire carries a SHARDED "
+                    "error-feedback residual in the optimizer state; "
+                    "rebuild it with init_zero1_stream_state(..., "
+                    "quantized=True) or pass error_feedback=False"
+                )
+            ef = state.ef
+        new_ef = ef
+        if overlap and use_ef:
+            def streamed_loss_ef(p, e, b):
+                p = _fusion.stream_param_groups(
+                    p, op=op, axis_name=axis_name,
+                    quantized=True, ef=e, nonfinite=nonfinite_policy,
+                    zero1=True, **knobs,
+                )
+                return loss_fn(p, b)
+
+            grad_fn = jax.value_and_grad(
+                streamed_loss_ef, argnums=(0, 1), has_aux=has_aux
+            )
+            if has_aux:
+                (loss, aux), (grads, new_ef) = grad_fn(params, ef, batch)
+            else:
+                loss, (grads, new_ef) = grad_fn(params, ef, batch)
+                aux = None
+        elif overlap:
+            def streamed_loss(p, b):
+                p = _fusion.stream_param_groups(
+                    p, op=op, axis_name=axis_name,
+                    hierarchical=hierarchical, quantized=quantized,
+                    nonfinite=nonfinite_policy, zero1=True, **knobs,
+                )
+                return loss_fn(p, b)
+
+            grad_fn = jax.value_and_grad(streamed_loss, has_aux=has_aux)
+            if has_aux:
+                (loss, aux), grads = grad_fn(params, batch)
+            else:
+                loss, grads = grad_fn(params, batch)
+                aux = None
+        else:
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            if has_aux:
+                (loss, aux), grads = grad_fn(params, batch)
+            else:
+                loss, grads = grad_fn(params, batch)
+                aux = None
+            if nonfinite_policy == "zero":
+                grads = _nf.sanitize(grads)
+            grads, new_ef = _zero.zero1_posthoc_reduce(
+                grads, op=op, axis_name=axis_name, quantized=quantized,
+                ef=ef, **knobs,
+            )
+        if overlap:
+            # Consume the registration ledger (same discipline as the
+            # streamed allreduce step).
+            _fusion.take_stream_registrations()
+        flag = None
+        if nonfinite_policy in ("skip", "abort"):
+            # Post-reduce detection: zero1 is SUM/AVERAGE-only, so a NaN
+            # from any rank propagates into its shard image; the psum
+            # agreement seam makes every rank skip together.
+            flag = _nf.agree_flag(_nf.local_flag(grads), axis_name)
+            _nf.note_detection(nonfinite_policy, "train_step")(flag)
+        elif nonfinite_policy == "warn":
+            _nf.note_detection("warn", "zero1")(_nf.local_flag(grads))
+        loss = lax.pmean(loss, axis_name)
+        new_params, new_opt = _zero.zero1_stream_update(
+            optimizer, params, state.opt, grads,
+            axis_name=axis_name, n_shards=n_shards,
+            quantized=quantized, **knobs,
+        )
+        if flag is not None:
+            new_params = _nf.select_on_flag(flag, params, new_params)
+            new_opt = _nf.select_on_flag(flag, state.opt, new_opt)
+            if use_ef:
+                new_ef = _nf.select_on_flag(flag, ef, new_ef)
+        new_state = Zero1State(
+            opt=new_opt, ef=new_ef if use_ef else state.ef
+        )
+        outs = [
+            new_params,
+            jax.tree.map(lambda s: s[None], new_state),
+            loss,
+        ]
+        if has_aux:
+            aux = jax.tree.map(lambda a: lax.pmean(a, axis_name), aux)
+            outs.append(aux)
+        if nonfinite_policy == "abort":
+            outs.append(flag)
+        return tuple(outs)
+
+    fn = _shard_map(
+        step, mesh,
+        in_specs=(P(), state_spec, P(axes[0] if len(axes) == 1 else axes)),
+        out_specs=(P(), state_spec, P()) + ((P(),) * (
+            (1 if has_aux else 0) + (1 if nonfinite_policy == "abort" else 0)
+        )),
+    )
+    jitted = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+    def _maybe_trace(step_fn):
+        return _trace.wrap_step(
+            step_fn,
+            overlap=overlap,
+            quantized=quantized,
+            hierarchical=str(hierarchical),
+            wire_dtype="int8" if quantized else "f32",
+            op=ReduceOp(op).name,
+            nonfinite=nonfinite_policy,
+            zero1=True,
+        )
+
+    if nonfinite_policy != "abort":
+        return _maybe_trace(jitted)
+
+    def aborting_step(params, opt_state, batch):
+        import numpy as np
+
+        out = jitted(params, opt_state, batch)
+        flag = out[-1]
+        if float(np.asarray(flag)) > 0:
+            from .. import HorovodInternalError
+
+            if _trace.ACTIVE:
+                _trace.TAP.flight_dump("guard-abort")
+            raise HorovodInternalError(
+                "non-finite gradient guard (policy abort): a rank "
+                "produced NaN/Inf gradients this step; the zero1 update "
+                "was not applied on any rank (cross-rank agreed)"
+            )
+        return out[:-1]
+
+    return _maybe_trace(aborting_step)
+
+
 def make_train_step(
     loss_fn: Callable[..., jax.Array],
     optimizer,
@@ -947,10 +1350,22 @@ def make_train_step(
     nonfinite: Optional[str] = None,
     tuned: Any = None,
     topo_algorithm: Optional[str] = None,
+    zero1: bool = False,
 ):
     """See :func:`_build_train_step` for the core semantics — this public
     wrapper adds pinned offline tuning (docs/autotune.md "Compiled-path
     offline tuning").
+
+    ``zero1=True`` (docs/overlap.md "Streamed ZeRO-1") shards the
+    optimizer state per streamed bucket over the data axis: the step
+    takes the :class:`Zero1State` from :func:`init_zero1_stream_state`
+    (built with the SAME threshold/first-bucket/quantized knobs),
+    reduce-scatters each gradient bucket — inside the backward with
+    ``overlap=True`` — and all-gathers the shard-updated parameters.
+    Composes with ``quantized=True`` (int8 ring RS, sharded EF residual)
+    and ``hierarchical="auto"`` (two-level RS/AG on multi-slice meshes);
+    a matching ``tuned`` config fills the same knobs it fills for the
+    allreduce paths.
 
     ``tuned`` takes a ``tuned.json`` path, a
     :class:`horovod_tpu.tune.TunedConfig`, ``None`` (read
@@ -978,7 +1393,7 @@ def make_train_step(
         quantized=quantized, error_feedback=error_feedback,
         donate=donate, has_aux=has_aux, overlap=overlap,
         first_bucket_bytes=first_bucket_bytes, nonfinite=nonfinite,
-        topo_algorithm=topo_algorithm,
+        topo_algorithm=topo_algorithm, zero1=zero1,
     )
     tuned_cfg, tuned_source = _tune.resolve_tuned(tuned)
     if tuned_cfg is None:
@@ -1005,6 +1420,11 @@ def make_train_step(
                     kw["hierarchical"] = tk["hierarchical"]
                 if kw["topo_algorithm"] is None:
                     kw["topo_algorithm"] = tk["topo_algorithm"]
+                if kw["zero1"] and kw["topo_algorithm"] == "split":
+                    # No reduce-scatter decomposition of the FlexLink
+                    # split exists; the zero1 lowering is decided by the
+                    # mesh shape — fall back to per-bucket selection.
+                    kw["topo_algorithm"] = None
             else:
                 _tune.warn_signature_mismatch(
                     tuned_cfg, live.get("hash", "?"), "make_train_step"

@@ -254,6 +254,14 @@ class StreamConfig:
     # policies act at the step level (jax/__init__.py) — the streamed
     # group only sanitizes.
     nonfinite: str = "off"
+    # Streamed ZeRO-1 (docs/overlap.md "Streamed ZeRO-1"): each bucket
+    # runs reduce-scatter instead of allreduce inside the backward
+    # trace — the rule returns a SHARD IMAGE (this rank's reduced shard
+    # scattered into a zero bucket buffer), so only 1/N of each bucket's
+    # cotangents carry data and only (n-1)/n of the payload rides the
+    # wire. Consumed by ``parallel/zero.zero1_stream_update``, which
+    # round-trips the identical bucket plan.
+    zero1: bool = False
 
 
 def _hier_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
@@ -271,6 +279,263 @@ def _hier_reduce_fn(x, *, op, axis_name, prescale_factor=1.0,
     return out
 
 
+# --- streamed ZeRO-1: per-bucket reduce-scatter ------------------------------
+#
+# ZeRO-1's gradient exchange is a reduce-scatter, not an allreduce: each
+# rank only needs the shard of the summed gradient its optimizer-state
+# shard updates. Run per streamed bucket INSIDE the backward trace, the
+# RS keeps the overlap property of the streamed path while moving half
+# of the ring-allreduce's gradient bytes — and the cotangent that leaves
+# the custom_vjp is a SHARD IMAGE (the reduced shard scattered into a
+# zero bucket buffer), so only 1/N of each bucket carries live data.
+# ``parallel/zero.zero1_stream_update`` recovers the shard bitwise by
+# re-packing the same bucket plan and slicing at this rank's offset.
+
+
+def _axes_of(axis_name) -> Tuple[Any, ...]:
+    if isinstance(axis_name, (tuple, list)):
+        return tuple(axis_name)
+    return (axis_name,)
+
+
+def zero1_axis_rank(axis_name):
+    """This rank's flat index over an axis (or outer-major axis tuple) —
+    the shard offset the streamed-zero1 bucket layout is keyed by. The
+    outer-major order matches the compositor's flat rank order, so the
+    two-level reduce-scatter lowering and this index always agree."""
+    from jax import lax
+
+    idx = 0
+    for a in _axes_of(axis_name):
+        idx = idx * _axis_size_of(a) + lax.axis_index(a)
+    return idx
+
+
+def _axis_size_of(axis_name) -> int:
+    from ..common.compat import axis_size
+
+    return axis_size(axis_name)
+
+
+def zero1_shard_len(total: int, n_shards: int, quantized: bool) -> int:
+    """Per-rank shard length of a packed bucket of ``total`` elements:
+    ceil-divided over the shards and, on the int8 wire, rounded up to
+    the quantizer's BLOCK so every shard keeps whole scale blocks."""
+    k = -(-max(int(total), 1) // n_shards)
+    if quantized:
+        from ..common.quant import BLOCK
+
+        k = -(-k // BLOCK) * BLOCK
+    return k
+
+
+def zero1_group_layout(params: Any, threshold_bytes: Optional[int] = None,
+                       first_bucket_bytes: Optional[int] = None):
+    """The streamed-zero1 group partition over ``params``: returns
+    ``(children, rebuild, groups)`` — or ``(None, None, None)`` when the
+    tree has no splittable top level (one implicit group, the whole
+    tree). This is the SAME partition ``stream_param_groups`` wraps, and
+    the single source both the backward reduce-scatter and the
+    shard-local update derive their bucket layout from: a group's
+    registered subtree is ``{str(i): children[i] for i in group}`` and
+    its bucket plan is ``plan_buckets`` over that subtree's leaves."""
+    threshold = default_threshold_bytes(threshold_bytes)
+    first = default_first_bucket_bytes(first_bucket_bytes)
+    split = _top_level_children(params)
+    if split is None:
+        return None, None, None
+    children, rebuild = split
+    groups = plan_layer_groups(
+        [_tree_bytes(c) for c in children], threshold, first
+    )
+    return children, rebuild, groups
+
+
+def _record_zero1_bucket(n_shards: int, k: int, dsize: int,
+                         quantized: bool, label: str) -> None:
+    """Trace-time hvd_zero_* gauges (one emission per compile): what one
+    bucket's reduce-scatter puts on the wire (ring accounting, n-1 hops
+    of one shard — int8+scales per hop on the quantized wire) and the
+    per-rank shard bytes each rank keeps."""
+    if not _metrics.ACTIVE:
+        return
+    from ..common.quant import int8_wire_bytes
+
+    shard_bytes = k * dsize
+    hop_bytes = (
+        int8_wire_bytes(shard_bytes) if quantized else shard_bytes
+    )
+    _metrics.TAP.inc(
+        "hvd_zero_wire_bytes_total",
+        float(max(n_shards - 1, 0) * hop_bytes), path=label,
+    )
+    _metrics.TAP.observe(
+        "hvd_zero_shard_bytes", float(shard_bytes), path=label
+    )
+
+
+def fused_reduce_scatter(
+    tree: Any,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    axis_name: Any = DATA_AXIS,
+    threshold_bytes: Optional[int] = None,
+    quantized: bool = False,
+    ef: Any = None,
+    label: str = "zero1",
+) -> Tuple[Any, Any]:
+    """Per-bucket reduce-scatter of a pytree into shard images.
+
+    Must run inside an axis-binding context. Leaves are bucketed with
+    :func:`plan_buckets` (same plan as the allreduce paths), each bucket
+    is packed, padded to ``n_shards`` BLOCK-aligned shards, and
+    reduce-scattered so rank r keeps the complete reduction of chunk r;
+    the shard is scattered back into a zero buffer at this rank's offset
+    and unpacked, so the returned tree has ``tree``'s exact structure
+    with only this rank's shard elements live — the layout
+    ``parallel/zero.zero1_stream_update`` round-trips bitwise.
+
+    Lowerings: a single bound axis runs ``lax.psum_scatter`` (or the
+    int8 ring RS with ``quantized=True``, ``ops/quantized.py``); an axis
+    tuple runs the compositor's hierarchical reduce-scatter (inner hop
+    first — the big payload stays on ICI, only the 1/L shard crosses
+    DCN). MIN/MAX have no native reduce-scatter and lower exactly as
+    reduce+slice (bitwise, no wire saving); int buckets reduce exactly.
+
+    ``ef`` (quantized only) is the SHARDED error-feedback residual: a
+    ``{"b<i>": f32[k_i]}`` dict over the float buckets. Each rank adds
+    its residual to its own chunk of the local payload before the ring
+    and carries ``corrected - roundtrip(corrected)`` forward — the
+    sharded EF-SGD construction (1/N coverage: a rank compensates its
+    own contribution to its own shard; docs/overlap.md). Returns
+    ``(shard_images, new_ef)`` (``new_ef`` mirrors ``ef``; None when
+    ``ef`` is None)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if op not in _STREAMABLE_OPS:
+        raise ValueError(
+            f"fused_reduce_scatter supports elementwise ops "
+            f"{_STREAMABLE_OPS}; got {op}"
+        )
+    axes = _axes_of(axis_name)
+    if quantized:
+        if op not in _QUANTIZABLE_OPS:
+            raise ValueError(
+                f"quantized reduce-scatter supports {_QUANTIZABLE_OPS}; "
+                f"got {op}"
+            )
+        if len(axes) > 1:
+            raise ValueError(
+                "quantized zero1 runs the flat int8 ring reduce-scatter; "
+                "hierarchical (DCN-only) compression is not defined for "
+                "the RS+AG decomposition — drop hierarchical or "
+                "quantized"
+            )
+    if ef is not None and not quantized:
+        raise ValueError(
+            "sharded error feedback (ef=...) only applies to the "
+            "quantized zero1 wire"
+        )
+    threshold_bytes = default_threshold_bytes(threshold_bytes)
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree, ef
+    n = _axis_size_of(axes if len(axes) > 1 else axes[0])
+    buckets = plan_buckets(leaves, threshold_bytes)
+    if _trace.ACTIVE:
+        _trace.TAP.note_plan(
+            fusion_path=label, fusion_buckets=len(buckets),
+            zero1_reduction="reduce-scatter",
+        )
+    if _metrics.ACTIVE:
+        _metrics.TAP.set(
+            "hvd_fusion_buckets", float(len(buckets)), path=label
+        )
+    idx = zero1_axis_rank(axes if len(axes) > 1 else axes[0])
+    results: List[jax.Array | None] = [None] * len(leaves)
+    new_ef: Dict[str, Any] = {}
+    average = op == ReduceOp.AVERAGE
+    for bi, bucket in enumerate(buckets):
+        bleaves = [leaves[i] for i in bucket]
+        packed = pack_bucket(bleaves)
+        total = packed.shape[0]
+        if total == 0:
+            # Zero-length leaves are identities — no ring, no state.
+            for i in bucket:
+                results[i] = leaves[i]
+            continue
+        dtype = packed.dtype
+        is_float = jnp.issubdtype(dtype, jnp.floating)
+        k = zero1_shard_len(total, n, quantized and is_float)
+        padded = n * k
+        buf = jnp.pad(packed, (0, padded - total))
+        if quantized and is_float:
+            from .quantized import (
+                quantize_roundtrip,
+                quantized_ring_reduce_scatter,
+            )
+
+            work = buf.astype(jnp.float32)
+            ef_key = f"b{bi}"
+            if ef is not None:
+                if ef_key not in ef:
+                    raise ValueError(
+                        f"sharded EF residual is missing bucket "
+                        f"{ef_key!r} — build it with "
+                        f"parallel/zero.init_zero1_stream_state"
+                    )
+                chunk = lax.dynamic_slice(work, (idx * k,), (k,))
+                corrected = chunk + ef[ef_key]
+                work = lax.dynamic_update_slice(
+                    work, corrected, (idx * k,)
+                )
+                new_ef[ef_key] = corrected - quantize_roundtrip(corrected)
+            shard = quantized_ring_reduce_scatter(
+                work, axis_name=axes[0], average=average
+            ).astype(dtype)
+        elif op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+            if len(axes) > 1:
+                from ..topo import compositor as _compositor
+
+                shard = _compositor.lower_reducescatter(
+                    buf, axes, op=ReduceOp.SUM, algorithm="two-level"
+                )
+            else:
+                shard = lax.psum_scatter(buf, axes[0], tiled=True)
+            if average:
+                shard = shard / n if is_float else shard // n
+        else:
+            # MIN/MAX: no native reduce-scatter — reduce then slice
+            # (exact, bitwise with the flat reduction; no wire saving).
+            red = lax.pmin if op == ReduceOp.MIN else lax.pmax
+            full = red(buf, axes if len(axes) > 1 else axes[0])
+            shard = lax.dynamic_slice(full, (idx * k,), (k,))
+        _record_zero1_bucket(
+            n, k, dtype_size(dtype_from_array(packed)),
+            quantized and is_float, label,
+        )
+        image = lax.dynamic_update_slice(
+            jnp.zeros((padded,), dtype), shard.astype(dtype), (idx * k,)
+        )
+        for i, r in zip(
+            bucket,
+            unpack_bucket(image[:total], [leaves[i].shape for i in bucket]),
+        ):
+            results[i] = r
+    out = jax.tree.unflatten(treedef, results)
+    if ef is None:
+        return out, None
+    missing = set(ef) - set(new_ef)
+    if missing:
+        raise ValueError(
+            f"sharded EF residual carries buckets {sorted(missing)} the "
+            f"bucket plan does not — the residual layout is stale for "
+            f"this partition (rebuild with init_zero1_stream_state)"
+        )
+    return out, new_ef
+
+
 def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
     """Reduce one registered subtree's cotangents (runs inside the backward
     trace, under the same axis binding as the forward)."""
@@ -280,6 +545,18 @@ def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
         from ..guard import nonfinite as _nf
 
         ct = _nf.sanitize(ct)
+    if cfg.zero1:
+        # Streamed ZeRO-1: reduce-scatter the bucket (shard images out),
+        # no compression layer (the int8 wire is cfg.quantized).
+        images, _ = fused_reduce_scatter(
+            ct,
+            op=cfg.op,
+            axis_name=cfg.axis_name,
+            threshold_bytes=cfg.threshold_bytes,
+            quantized=cfg.quantized,
+            label=cfg.label,
+        )
+        return images
     compression = cfg.compression
     ctxs = None
     if compression is not None:
@@ -463,6 +740,19 @@ def _stream_ef_bwd(cfg, ef, ct):
 
         ct = _nf.sanitize(ct)
         ef = _nf.sanitize(ef)
+    if cfg.zero1:
+        # Streamed ZeRO-1 with the sharded EF residual: the per-bucket
+        # int8 ring RS corrects this rank's own chunk and the fresh
+        # shard residual comes back as ef's "gradient".
+        return fused_reduce_scatter(
+            ct,
+            op=cfg.op,
+            axis_name=cfg.axis_name,
+            threshold_bytes=cfg.threshold_bytes,
+            quantized=True,
+            ef=ef,
+            label=cfg.label,
+        )
     reduced, new_ef = quantized_ef_allreduce(
         ct, ef,
         op=cfg.op,
@@ -512,6 +802,7 @@ def reduce_in_backward(
     label: str = "stream",
     nonfinite: str = "off",
     algorithm: Optional[str] = None,
+    zero1: bool = False,
 ) -> Any:
     """Register a parameter subtree for streamed gradient reduction.
 
@@ -532,6 +823,13 @@ def reduce_in_backward(
     with ``jax.value_and_grad(..., argnums=(0, 1))`` over (params, ef)
     and thread the residual into the next step (``make_train_step`` does
     this automatically).
+
+    ``zero1=True`` switches the bucket reduction from allreduce to
+    reduce-scatter (docs/overlap.md "Streamed ZeRO-1"): the backward
+    returns SHARD IMAGES — only this rank's shard of each bucket is
+    live — consumed by ``parallel/zero.zero1_stream_update``; ``ef``
+    then takes the SHARDED residual dict (``{"b<i>": f32[k_i]}``), not a
+    params-shaped tree.
     """
     if op not in _STREAMABLE_OPS:
         raise ValueError(
@@ -558,6 +856,25 @@ def reduce_in_backward(
         raise ValueError(
             "error feedback (ef=...) only applies to quantized streaming"
         )
+    if zero1:
+        if compression is not None:
+            raise ValueError(
+                "zero1 streaming reduce-scatters raw buckets; cast "
+                "compression has no shard-image form — use "
+                "quantized=True for the int8 wire instead"
+            )
+        if algorithm is not None:
+            raise ValueError(
+                "zero1 streaming lowers reduce-scatter directly (flat "
+                "ring or the compositor two-level); a pinned allreduce "
+                "algorithm does not apply — drop algorithm="
+            )
+        if quantized and bool(hierarchical):
+            raise ValueError(
+                "quantized zero1 runs the flat int8 ring "
+                "reduce-scatter; hierarchical (DCN-only) compression is "
+                "not defined for the RS+AG decomposition"
+            )
     # "planned" = per-bucket compositor plan selection over the axis
     # tuple (hierarchical="auto" at the make_train_step level resolves
     # to this when the mesh carries a (pod, cross, local) hierarchy).
@@ -587,6 +904,7 @@ def reduce_in_backward(
         quantized=bool(quantized),
         label=label,
         nonfinite=str(nonfinite),
+        zero1=bool(zero1),
     )
     _note_stream_registration(len(jax.tree.leaves(tree)))
     if ef is not None:
@@ -615,9 +933,20 @@ def stream_scan_body(
 def _top_level_children(tree: Any):
     """Split a pytree into its top-level children (the layer granularity
     streamed grouping works at). Returns (children, rebuild) or None when
-    the tree has no splittable top level."""
+    the tree has no splittable top level.
+
+    Dict children are walked in SORTED key order — jax's canonical
+    flatten order, which is what a dict looks like after any
+    jit/shard_map boundary reconstructs it. Host-side consumers (the
+    zero1 state init, the tuner's program spec) must see the same
+    partition the in-trace registration sees, and insertion order does
+    not survive the trace boundary."""
     if isinstance(tree, dict) and tree:
         keys = list(tree.keys())
+        try:
+            keys = sorted(keys)
+        except TypeError:  # unsortable mixed-type keys: keep list order
+            pass
 
         def rebuild(vals, keys=keys, cls=type(tree)):
             out = dict(zip(keys, vals))
@@ -701,6 +1030,7 @@ def stream_param_groups(
     ef: Any = None,
     nonfinite: str = "off",
     algorithm: Optional[str] = None,
+    zero1: bool = False,
 ) -> Any:
     """Partition ``params`` by top-level child (for a flax params dict: one
     child per module, in construction ≈ forward order), pack the children
@@ -712,7 +1042,12 @@ def stream_param_groups(
     ``quantized``/``ef`` follow :func:`reduce_in_backward`: with ``ef``
     (same top-level structure as ``params``) each group carries its own
     error-feedback residual slice and the updated residuals come back as
-    the gradient of the ``ef`` argument."""
+    the gradient of the ``ef`` argument.
+
+    ``zero1=True`` registers each group for streamed reduce-scatter
+    (shard images out; docs/overlap.md "Streamed ZeRO-1"); ``ef`` is
+    then the SHARDED residual keyed by group (``{"g<gi>": {"b<bi>":
+    f32[k]}}``, rows of ``parallel/zero.Zero1State.ef``)."""
     threshold = default_threshold_bytes(threshold_bytes)
     first = default_first_bucket_bytes(first_bucket_bytes)
     split = _top_level_children(params)
@@ -720,12 +1055,14 @@ def stream_param_groups(
         return reduce_in_backward(
             params, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
-            quantized=quantized, ef=ef,
+            quantized=quantized,
+            ef=(ef["g0"] if zero1 and ef is not None else ef),
             label="stream:g0", nonfinite=nonfinite, algorithm=algorithm,
+            zero1=zero1,
         )
     children, rebuild = split
     ef_children = None
-    if ef is not None:
+    if ef is not None and not zero1:
         ef_split = _top_level_children(ef)
         if ef_split is None or len(ef_split[0]) != len(children):
             raise ValueError(
@@ -741,16 +1078,24 @@ def stream_param_groups(
     wrapped = list(children)
     for gi, group in enumerate(groups):
         sub = {str(i): children[i] for i in group}
-        sub_ef = (
-            {str(i): ef_children[i] for i in group}
-            if ef_children is not None else None
-        )
+        if zero1 and ef is not None:
+            gkey = f"g{gi}"
+            if gkey not in ef:
+                raise ValueError(
+                    f"sharded EF residual is missing group {gkey!r} — "
+                    f"build it with parallel/zero.init_zero1_stream_state"
+                )
+            sub_ef: Any = ef[gkey]
+        elif ef_children is not None:
+            sub_ef = {str(i): ef_children[i] for i in group}
+        else:
+            sub_ef = None
         sub = reduce_in_backward(
             sub, op=op, axis_name=axis_name, threshold_bytes=threshold,
             hierarchical=hierarchical, compression=compression,
             quantized=quantized, ef=sub_ef,
             label=f"stream:g{gi}", nonfinite=nonfinite,
-            algorithm=algorithm,
+            algorithm=algorithm, zero1=zero1,
         )
         for i in group:
             wrapped[i] = sub[str(i)]

@@ -119,6 +119,13 @@ def quantized_ring_reduce_scatter(
     (the plain ring finishes at chunk (r+1) mod n), which is exactly the
     gradient shard ZeRO-1 needs — composing the int8 wire with sharded
     optimizer state costs no extra hop."""
+    if isinstance(axis_name, (tuple, list)):
+        raise ValueError(
+            "quantized reduce-scatter is the flat int8 ring over ONE "
+            "axis; hierarchical (DCN-only) compression is not defined "
+            "for the RS+AG decomposition — reduce over a single bound "
+            f"axis (got {axis_name!r})"
+        )
     n = _axis_size(axis_name)
     orig_dtype = x.dtype
     flat = x.astype(jnp.float32).reshape(-1)

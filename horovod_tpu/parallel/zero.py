@@ -27,7 +27,7 @@ instead.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -40,7 +40,15 @@ from jax.sharding import PartitionSpec as P
 
 from .mesh import DATA_AXIS
 
-__all__ = ["init_zero1_state", "make_zero1_train_step", "zero1_update"]
+__all__ = [
+    "Zero1State",
+    "init_zero1_state",
+    "init_zero1_stream_state",
+    "make_zero1_train_step",
+    "zero1_posthoc_reduce",
+    "zero1_stream_update",
+    "zero1_update",
+]
 
 
 def _flat_meta(params, n_shards: int, block: int = 1):
@@ -77,6 +85,21 @@ def init_zero1_state(optimizer, params, n_shards: int,
     return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
 
 
+def _check_axis_shards(axis_name, n_shards: int, where: str) -> None:
+    """A silent mismatch between the bound axis size and the shard count
+    the state was built for misaligns every shard offset; fail loudly."""
+    from ..common.compat import axis_size
+
+    live = axis_size(axis_name)
+    if live != n_shards:
+        raise ValueError(
+            f"{where}: optimizer state is sharded {n_shards} ways but "
+            f"the bound axis {axis_name!r} has size {live} — the shard "
+            f"offsets would silently misalign; rebuild the state for "
+            f"this mesh"
+        )
+
+
 def zero1_update(optimizer, params, state, grads, *,
                  axis_name: str = DATA_AXIS, n_shards: int,
                  quantized: bool = False):
@@ -88,6 +111,7 @@ def zero1_update(optimizer, params, state, grads, *,
     the packaged whole-step version."""
     import optax
 
+    _check_axis_shards(axis_name, n_shards, "zero1_update")
     flat_p, unravel, total, padded, k = _flat_meta(
         params, n_shards, _block(quantized)
     )
@@ -157,3 +181,263 @@ def make_zero1_train_step(
         donate_argnums=(0, 1) if donate else (),
     )
     return fn
+
+
+# --- streamed ZeRO-1: per-bucket shard layout --------------------------------
+#
+# The whole-flat-vector schedule above reduce-scatters AFTER the backward
+# completes, so it can never overlap with compute. The streamed variant
+# (docs/overlap.md "Streamed ZeRO-1") re-expresses ZeRO-1 over the SAME
+# bucket partition the overlap fast path streams: each
+# ``stream_param_groups`` bucket runs reduce-scatter inside the
+# custom_vjp backward (``ops/fusion.fused_reduce_scatter``), each rank
+# keeps only its shard's cotangents per bucket, the optimizer state is
+# sharded per bucket, and the updated shards all-gather back. The bucket
+# layout round-trips exactly through ``ops/fusion.plan_buckets`` — the
+# backward and the update derive it from the same planners, so the shard
+# a rank updates is bitwise the shard its backward reduced.
+
+
+class Zero1State(NamedTuple):
+    """Streamed-ZeRO-1 optimizer state: per-group, per-bucket optax
+    states stacked on a leading ``[n_shards]`` axis (``opt["g<gi>"]
+    ["b<bi>"]``), plus the optional SHARDED error-feedback residuals for
+    the quantized wire (``ef`` mirrors ``opt``'s keys with f32
+    ``[n_shards, k]`` leaves; None without EF). Shard rows are RANK-LOCAL
+    by construction — each rank holds and updates only its row — so the
+    guard's cross-rank digest agreement hashes only the structure, never
+    the bytes (``guard/digest.strip_rank_local``)."""
+
+    opt: Any
+    ef: Any
+
+
+def _zero1_groups(params, threshold_bytes, first_bucket_bytes):
+    """Resolve the streamed group partition: returns ``(items, finish)``
+    where ``items`` is ``[(label, sub_params)]`` in group order and
+    ``finish(new_subs)`` rebuilds the full tree from the per-group
+    results (``new_subs`` keyed by label)."""
+    from ..ops import fusion as F
+
+    children, rebuild, groups = F.zero1_group_layout(
+        params, threshold_bytes, first_bucket_bytes
+    )
+    if children is None:
+        def finish_single(new_subs):
+            return new_subs["g0"]
+
+        return [("g0", params)], finish_single
+
+    items = []
+    membership = []
+    for gi, group in enumerate(groups):
+        items.append((f"g{gi}", {str(i): children[i] for i in group}))
+        membership.append(group)
+
+    def finish(new_subs):
+        out = list(children)
+        for gi, group in enumerate(membership):
+            sub = new_subs[f"g{gi}"]
+            for i in group:
+                out[i] = sub[str(i)]
+        return rebuild(out)
+
+    return items, finish
+
+
+def init_zero1_stream_state(
+    optimizer,
+    params,
+    n_shards: int,
+    *,
+    threshold_bytes: Optional[int] = None,
+    first_bucket_bytes: Optional[int] = None,
+    quantized: bool = False,
+    error_feedback: Optional[bool] = None,
+) -> Zero1State:
+    """Build the :class:`Zero1State` for ``make_train_step(zero1=True)``:
+    for every streamed group and fusion bucket, ``optimizer.init`` of
+    each rank's packed parameter shard, stacked on a leading
+    ``[n_shards]`` axis (shard the leading axis over the data axis /
+    hierarchy tuple). Non-float and zero-length buckets carry no state
+    (the update passes them through). ``error_feedback`` (default: on
+    for the quantized wire) adds the zero sharded residuals."""
+    from ..ops import fusion as F
+
+    use_ef = bool(quantized) if error_feedback is None else bool(error_feedback)
+    if use_ef and not quantized:
+        raise ValueError("error_feedback=True requires quantized=True")
+    items, _ = _zero1_groups(params, threshold_bytes, first_bucket_bytes)
+    threshold = F.default_threshold_bytes(threshold_bytes)
+    opt: Dict[str, Dict[str, Any]] = {}
+    ef: Dict[str, Dict[str, Any]] = {}
+    for label, sub in items:
+        leaves = jax.tree.leaves(sub)
+        g_opt: Dict[str, Any] = {}
+        g_ef: Dict[str, Any] = {}
+        for bi, bucket in enumerate(F.plan_buckets(leaves, threshold)):
+            packed = F.pack_bucket([leaves[i] for i in bucket])
+            total = packed.shape[0]
+            if total == 0 or not jnp.issubdtype(packed.dtype, jnp.floating):
+                continue
+            k = F.zero1_shard_len(total, n_shards, quantized)
+            buf = jnp.pad(packed, (0, n_shards * k - total))
+            states = [
+                optimizer.init(lax.dynamic_slice(buf, (r * k,), (k,)))
+                for r in range(n_shards)
+            ]
+            g_opt[f"b{bi}"] = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *states
+            )
+            if use_ef:
+                g_ef[f"b{bi}"] = jnp.zeros((n_shards, k), jnp.float32)
+        opt[label] = g_opt
+        if use_ef:
+            ef[label] = g_ef
+    return Zero1State(opt=opt, ef=ef if use_ef else None)
+
+
+def zero1_posthoc_reduce(
+    grads,
+    *,
+    op=None,
+    axis_name: Any = DATA_AXIS,
+    threshold_bytes: Optional[int] = None,
+    first_bucket_bytes: Optional[int] = None,
+    quantized: bool = False,
+    ef: Any = None,
+    label: str = "zero1-posthoc",
+):
+    """Post-hoc form of the streamed-zero1 reduction: the SAME group
+    partition and per-bucket reduce-scatter the backward rule runs,
+    applied to an already-computed gradient tree. Returns
+    ``(shard_images, new_ef)`` — bitwise identical to the streamed
+    path's output (one reduction, two call sites)."""
+    from ..common.types import ReduceOp
+    from ..ops import fusion as F
+
+    op = ReduceOp.AVERAGE if op is None else op
+    items, finish = _zero1_groups(
+        grads, threshold_bytes, first_bucket_bytes
+    )
+    threshold = F.default_threshold_bytes(threshold_bytes)
+    new_subs: Dict[str, Any] = {}
+    new_ef: Dict[str, Any] = {}
+    for gi, (glabel, sub) in enumerate(items):
+        sub_ef = None
+        if ef is not None:
+            if glabel not in ef:
+                raise ValueError(
+                    f"sharded EF residual is missing group {glabel!r} — "
+                    f"build it with init_zero1_stream_state"
+                )
+            sub_ef = ef[glabel]
+        images, sub_new_ef = F.fused_reduce_scatter(
+            sub,
+            op=op,
+            axis_name=axis_name,
+            threshold_bytes=threshold,
+            quantized=quantized,
+            ef=sub_ef,
+            label=f"{label}:{glabel}",
+        )
+        new_subs[glabel] = images
+        if sub_new_ef is not None:
+            new_ef[glabel] = sub_new_ef
+    return finish(new_subs), (new_ef if ef is not None else None)
+
+
+def zero1_stream_update(
+    optimizer,
+    params,
+    opt_buckets,
+    grads,
+    *,
+    axis_name: Any = DATA_AXIS,
+    n_shards: int,
+    threshold_bytes: Optional[int] = None,
+    first_bucket_bytes: Optional[int] = None,
+    quantized: bool = False,
+):
+    """The shard-local update against the bucketized shard layout:
+    ``grads`` are SHARD IMAGES (from the streamed backward or
+    :func:`zero1_posthoc_reduce`), ``opt_buckets`` is this rank's row of
+    ``Zero1State.opt``. Per bucket: re-pack the image (recovering the
+    reduce-scattered shard bitwise), slice this rank's parameter shard,
+    optax-update it against the bucket's 1/N state, and all-gather the
+    updated shards back into the full parameter layout (hierarchical
+    all-gather on an axis tuple — only the 1/L shard crosses DCN).
+    Returns ``(new_params, new_opt_buckets)``. Padding is proven
+    zero-contribution: padded tails never leave the gather (the image is
+    truncated to the bucket's true length before unpacking)."""
+    import optax
+
+    from ..ops import fusion as F
+
+    axes = F._axes_of(axis_name)
+    _check_axis_shards(
+        axes if len(axes) > 1 else axes[0], n_shards, "zero1_stream_update"
+    )
+    items, finish = _zero1_groups(params, threshold_bytes, first_bucket_bytes)
+    g_items, _ = _zero1_groups(grads, threshold_bytes, first_bucket_bytes)
+    threshold = F.default_threshold_bytes(threshold_bytes)
+    idx = F.zero1_axis_rank(axes if len(axes) > 1 else axes[0])
+    new_subs: Dict[str, Any] = {}
+    new_opt: Dict[str, Dict[str, Any]] = {}
+    for (glabel, sub_p), (_, sub_g) in zip(items, g_items):
+        p_leaves, treedef = jax.tree.flatten(sub_p)
+        g_leaves = jax.tree.leaves(sub_g)
+        states = opt_buckets.get(glabel, {})
+        results = list(p_leaves)
+        g_opt: Dict[str, Any] = {}
+        for bi, bucket in enumerate(F.plan_buckets(p_leaves, threshold)):
+            bkey = f"b{bi}"
+            packed_p = F.pack_bucket([p_leaves[i] for i in bucket])
+            total = packed_p.shape[0]
+            if (
+                total == 0
+                or not jnp.issubdtype(packed_p.dtype, jnp.floating)
+            ):
+                continue  # no shard state: parameters pass through
+            if bkey not in states:
+                raise ValueError(
+                    f"zero1 optimizer state is missing bucket "
+                    f"{glabel}/{bkey} — the state was built for a "
+                    f"different partition (threshold/first-bucket/"
+                    f"quantized knobs must match init_zero1_stream_state)"
+                )
+            packed_g = F.pack_bucket([g_leaves[i] for i in bucket])
+            k = F.zero1_shard_len(total, n_shards, quantized)
+            pad = n_shards * k - total
+            buf_p = jnp.pad(packed_p, (0, pad))
+            buf_g = jnp.pad(packed_g, (0, pad))
+            g_shard = lax.dynamic_slice(buf_g, (idx * k,), (k,))
+            p_shard = lax.dynamic_slice(buf_p, (idx * k,), (k,))
+            updates, new_state = optimizer.update(
+                g_shard, states[bkey], p_shard
+            )
+            new_p_shard = optax.apply_updates(p_shard, updates)
+            if len(axes) > 1:
+                from ..topo import compositor as _compositor
+
+                full = _compositor.lower_allgather(
+                    new_p_shard, axes, algorithm="two-level"
+                )
+            else:
+                full = lax.all_gather(new_p_shard, axes[0], tiled=True)
+            unpacked = F.unpack_bucket(
+                full[:total], [p_leaves[i].shape for i in bucket]
+            )
+            for i, r in zip(bucket, unpacked):
+                results[i] = r
+            g_opt[bkey] = new_state
+        stale = set(states) - set(g_opt)
+        if stale:
+            raise ValueError(
+                f"zero1 optimizer state carries buckets {sorted(stale)} "
+                f"the live partition of group {glabel!r} does not — "
+                f"stale shard layout"
+            )
+        new_subs[glabel] = jax.tree.unflatten(treedef, results)
+        new_opt[glabel] = g_opt
+    return finish(new_subs), new_opt

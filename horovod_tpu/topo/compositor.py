@@ -416,15 +416,23 @@ def _candidates_allgather(model: InterconnectModel,
     return cands
 
 
-def _candidates_reducescatter(model: InterconnectModel,
-                              nbytes: int) -> Dict[str, List[Stage]]:
+def _candidates_reducescatter(model: InterconnectModel, nbytes: int,
+                              wire_dtype: str = WIRE_F32
+                              ) -> Dict[str, List[Stage]]:
     n = model.size
+    int8 = wire_dtype == WIRE_INT8
     if model.levels == 1:
         h = model.hops[0]
-        return {"ring": [Stage(
+        ring = [Stage(
             "reduce_scatter-ring", h.name, h.axis,
             int(nbytes * (n - 1) / max(n, 1)), max(n - 1, 0),
-        )]}
+        )]
+        if int8:
+            # The int8 ring RS (ops/quantized.py, ZeRO-1's gradient
+            # hop): the single reduce-scatter phase of the EQuARX ring,
+            # every hop int8+scales.
+            return {"ring": [_compress_stage(s) for s in ring]}
+        return {"ring": ring}
     cands = {"flat": _flat_stages(
         model, "reduce_scatter", nbytes, (n - 1) / n, n - 1
     )}
@@ -440,6 +448,17 @@ def _candidates_reducescatter(model: InterconnectModel,
         ))
         remaining = math.ceil(remaining / s)
     cands["two-level"] = stages
+    if int8:
+        # Planning-level quantized RS on a hierarchy: flat rides the
+        # bottleneck as the int8 ring; two-level compresses only the
+        # outermost (DCN) stage — the 1/L shard that actually crosses
+        # the slow hop — like the allreduce DCN-only construction.
+        outer = model.hops[0].name
+        cands["flat"] = [_compress_stage(s) for s in cands["flat"]]
+        cands["two-level"] = [
+            _compress_stage(s) if s.hop == outer else s
+            for s in cands["two-level"]
+        ]
     return cands
 
 
@@ -542,7 +561,8 @@ def candidate_plans(
     :class:`Plan` objects keyed by algorithm name. :func:`select_plan`
     picks the cheapest of these; the symbolic plan verifier
     (``analysis/plan_verify.py``) checks every one of them.
-    ``wire_dtype="int8"`` (allreduce SUM/AVERAGE only) prices the
+    ``wire_dtype="int8"`` (allreduce and reduce-scatter, SUM/AVERAGE
+    only — reduce-scatter is ZeRO-1's gradient hop) prices the
     quantized wire: every hop compressed for flat/ring, only the
     outermost (DCN) hop for two-level."""
     if collective not in COLLECTIVES:
@@ -560,14 +580,15 @@ def candidate_plans(
     if op_enum is None:
         op_enum = ReduceOp.SUM
     if wire_dtype == WIRE_INT8 and (
-        collective != "allreduce"
+        collective not in ("allreduce", "reducescatter")
         or op_enum not in (ReduceOp.SUM, ReduceOp.AVERAGE)
     ):
         raise ValueError(
-            "wire_dtype='int8' is an allreduce SUM/AVERAGE construction "
-            f"(got {collective}/{_op_name(op_enum)}): per-hop int8 "
-            "requantization accumulates in f32, which is only sound for "
-            "additive reductions"
+            "wire_dtype='int8' is an allreduce/reduce-scatter "
+            f"SUM/AVERAGE construction (got {collective}/"
+            f"{_op_name(op_enum)}): per-hop int8 requantization "
+            "accumulates in f32, which is only sound for additive "
+            "reductions"
         )
     eff = _effective_model(model)
     if collective == "allreduce":
@@ -575,7 +596,7 @@ def candidate_plans(
     elif collective == "allgather":
         cands = _candidates_allgather(eff, nbytes)
     elif collective == "reducescatter":
-        cands = _candidates_reducescatter(eff, nbytes)
+        cands = _candidates_reducescatter(eff, nbytes, wire_dtype)
     elif collective == "broadcast":
         cands = _candidates_broadcast(eff, nbytes)
     else:

@@ -59,31 +59,48 @@ def _bottleneck_hop(model):
 
 
 def plan_for_bucket(model, nbytes: int, config: Dict,
-                    op: ReduceOp = ReduceOp.AVERAGE):
-    """The allreduce plan a bucket of ``nbytes`` would lower with under
+                    op: ReduceOp = ReduceOp.AVERAGE,
+                    collective: str = "allreduce"):
+    """The plan a bucket of ``nbytes`` would lower with under
     ``config``: the pinned algorithm when the compositor offers it at
     this payload, else the cost-selected plan (the same fallback the
-    lowering performs). Returns ``(plan, pinned_honored)``."""
+    lowering performs). Returns ``(plan, pinned_honored)``.
+    ``collective`` defaults to the allreduce fast path; the zero1
+    objective prices ``"reducescatter"`` (int8-eligible) and
+    ``"allgather"`` (always full precision — parameters)."""
     from ..topo.compositor import candidate_plans, select_plan
 
     wire = config.get("wire_dtype", WIRE_F32)
-    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+    if (
+        op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
+        or collective == "allgather"
+    ):
         wire = WIRE_F32
     algo = config.get("topo_algorithm") or "auto"
     if algo != "auto":
-        cands = candidate_plans(model, "allreduce", nbytes, op=op,
+        cands = candidate_plans(model, collective, nbytes, op=op,
                                 wire_dtype=wire)
         if algo in cands:
             return cands[algo], True
-    return select_plan(model, "allreduce", nbytes, op=op,
+    return select_plan(model, collective, nbytes, op=op,
                        wire_dtype=wire), algo == "auto"
 
 
 def free_objectives(spec: ProgramSpec, config: Dict, model,
-                    op: ReduceOp = ReduceOp.AVERAGE) -> Dict:
+                    op: ReduceOp = ReduceOp.AVERAGE,
+                    zero1: bool = False) -> Dict:
     """Score ``config`` on ``spec`` over ``model`` with the two free
     cost models. Returns a plain dict (stable key order for the
-    tuned.json record) whose ``score`` the GP maximizes."""
+    tuned.json record) whose ``score`` the GP maximizes.
+
+    ``zero1=True`` prices the streamed-ZeRO-1 reduction shape: each
+    group lowers as reduce-scatter (int8-eligible, hidden behind the
+    backward staircase like the allreduce) plus the parameter
+    all-gather of the 1/N shard (full precision — parameters; priced
+    fully exposed, a conservative stand-in for next-forward overlap).
+    This is what lets ``tuned.json`` stop exempting the zero1 mode."""
+    import math as _math
+
     from ..ops.fusion import plan_layer_groups
 
     layer_bytes = spec.layer_bytes
@@ -103,15 +120,30 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
     for gi, group in enumerate(groups):
         nb = sum(layer_bytes[i] for i in group)
         remaining -= nb
-        plan, honored = plan_for_bucket(model, nb, config, op=op)
+        if zero1:
+            plan, honored = plan_for_bucket(
+                model, nb, config, op=op, collective="reducescatter"
+            )
+            shard = _math.ceil(nb / max(model.size, 1))
+            ag_plan, _ = plan_for_bucket(
+                model, shard, config, op=op, collective="allgather"
+            )
+        else:
+            plan, honored = plan_for_bucket(model, nb, config, op=op)
+            ag_plan = None
         pinned_honored = pinned_honored and honored
         overlappable = remaining / total
         g_exposed = plan.cost_us * (1.0 - overlappable)
         g_wire = int(plan.bytes_per_hop.get(bneck, 0))
-        cost_us += plan.cost_us
+        g_cost = plan.cost_us
+        if ag_plan is not None:
+            g_cost += ag_plan.cost_us
+            g_exposed += ag_plan.cost_us  # AG: conservatively exposed
+            g_wire += int(ag_plan.bytes_per_hop.get(bneck, 0))
+        cost_us += g_cost
         exposed_us += g_exposed
         wire_bytes += g_wire
-        per_group.append({
+        entry = {
             "group": gi,
             "layers": [spec.layers[i][0] for i in group],
             "nbytes": nb,
@@ -120,7 +152,23 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
             "cost_us": round(plan.cost_us, 4),
             "overlappable_fraction": round(overlappable, 6),
             "bottleneck_bytes": g_wire,
-        })
+        }
+        if ag_plan is not None:
+            entry["ag_algorithm"] = ag_plan.algorithm
+            entry["ag_cost_us"] = round(ag_plan.cost_us, 4)
+        per_group.append(entry)
+    if zero1:
+        return {
+            "zero1": True,
+            "n_groups": len(groups),
+            "cost_us": round(cost_us, 4),
+            "exposed_us": round(exposed_us, 4),
+            "wire_bytes": int(wire_bytes),
+            "bottleneck_hop": bneck,
+            "pinned_honored": pinned_honored,
+            "per_group": per_group,
+            "score": round(-exposed_us, 6),
+        }
     return {
         "n_groups": len(groups),
         "cost_us": round(cost_us, 4),
@@ -137,10 +185,14 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
 
 
 def group_plans(spec: ProgramSpec, config: Dict, model,
-                op: ReduceOp = ReduceOp.AVERAGE) -> List:
+                op: ReduceOp = ReduceOp.AVERAGE,
+                zero1: bool = False) -> List:
     """The concrete compositor plans ``config`` pins for every stream
     group — the artifacts the symbolic verifier checks before the tuner
-    is allowed to emit them."""
+    is allowed to emit them. ``zero1=True`` yields the RS and AG plan
+    for each group (interleaved, reduction order)."""
+    import math as _math
+
     from ..ops.fusion import plan_layer_groups
 
     layer_bytes = spec.layer_bytes
@@ -152,6 +204,16 @@ def group_plans(spec: ProgramSpec, config: Dict, model,
     plans = []
     for group in groups:
         nb = sum(layer_bytes[i] for i in group)
-        plan, _ = plan_for_bucket(model, nb, config, op=op)
-        plans.append(plan)
+        if zero1:
+            rs, _ = plan_for_bucket(
+                model, nb, config, op=op, collective="reducescatter"
+            )
+            ag, _ = plan_for_bucket(
+                model, _math.ceil(nb / max(model.size, 1)), config,
+                op=op, collective="allgather",
+            )
+            plans.extend([rs, ag])
+        else:
+            plan, _ = plan_for_bucket(model, nb, config, op=op)
+            plans.append(plan)
     return plans

@@ -147,15 +147,22 @@ class SearchSpace:
         return config
 
 
-def space_for_model(model, allow_int8: bool = True) -> SearchSpace:
+def space_for_model(model, allow_int8: bool = True,
+                    zero1: bool = False) -> SearchSpace:
     """The admissible space for an interconnect model: single-hop models
     freeze the topology dims (every label lowers natively flat there);
     two-level models drop "split" unless the FlexLink conditions
-    (exactly two hops) hold."""
+    (exactly two hops) hold. ``zero1=True`` (the streamed-ZeRO-1
+    reduction shape) additionally drops "split" everywhere — the
+    FlexLink concurrent-bucket mode has no reduce-scatter + all-gather
+    decomposition — so the tuner never pins an unrealizable plan for a
+    zero1 program."""
     if model.levels <= 1:
         choices: Tuple[str, ...] = ("auto",)
     elif model.levels == 2:
         choices = TOPO_CHOICES
     else:
         choices = ("auto", "flat", "two-level")
+    if zero1:
+        choices = tuple(c for c in choices if c != "split")
     return SearchSpace(topo_choices=choices, allow_int8=bool(allow_int8))

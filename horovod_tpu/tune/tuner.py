@@ -130,6 +130,7 @@ def tune(
     measure_fn: Optional[Callable[[Dict], float]] = None,
     rounds_fn: Optional[Callable] = None,
     verify: bool = True,
+    zero1: bool = False,
 ) -> TunedConfig:
     """Search the joint compiled-path space for ``spec`` on ``model``.
 
@@ -139,14 +140,22 @@ def tune(
     the emitted evidence block is always populated. ``rounds_fn`` is
     forwarded to the plan verifier (tests inject corrupted schedules
     through it). ``verify=False`` is for unit tests only.
+
+    ``zero1=True`` tunes the streamed-ZeRO-1 reduction shape: groups
+    are priced as per-bucket reduce-scatter + parameter all-gather
+    (``free_objectives(zero1=True)``), "split" is dropped from the
+    admissible topology choices, and the emitted RS/AG plans are the
+    ones symbolically verified before pinning — this is what lets
+    ``tuned.json`` stop exempting ``--zero1``.
     """
-    space = space or space_for_model(model, allow_int8=allow_int8)
+    space = space or space_for_model(model, allow_int8=allow_int8,
+                                     zero1=zero1)
     grid = space.candidate_grid()
     rng = _gp.Lcg(seed)
     samples = max(int(samples), 1)
 
     def evaluate(config: Dict) -> Tuple[Dict, float]:
-        obj = free_objectives(spec, config, model, op=op)
+        obj = free_objectives(spec, config, model, op=op, zero1=zero1)
         score = obj["score"]
         if measure_fn is not None:
             measured_s = float(measure_fn(config))
@@ -237,7 +246,8 @@ def tune(
     if verify:
         from ..analysis.plan_verify import verify_plan
 
-        for plan in group_plans(spec, best_config, model, op=op):
+        for plan in group_plans(spec, best_config, model, op=op,
+                                zero1=zero1):
             findings.extend(verify_plan(plan, model, rounds_fn=rounds_fn))
         if findings:
             raise TuneVerificationError(findings)
@@ -259,12 +269,13 @@ def tune(
             "requested_samples": samples,
             "seed": int(seed),
             "objective": "measured" if measure_fn is not None else "free",
+            "zero1": bool(zero1),
             "space": {
                 "topo_choices": list(space.topo_choices),
                 "allow_int8": bool(space.allow_int8),
             },
             "verified_plans": 0 if not verify else len(
-                group_plans(spec, best_config, model, op=op)
+                group_plans(spec, best_config, model, op=op, zero1=zero1)
             ),
         },
         history=history,

@@ -1,0 +1,494 @@
+"""Streamed ZeRO-1 — docs/overlap.md "Streamed ZeRO-1".
+
+The claims under test:
+
+1. PARITY — streamed-zero1 ≡ post-hoc-zero1 BITWISE (same bucket plan:
+   one reduction, two call sites), and zero1 final params numerically
+   equal plain replicated DP at 2/4/8 ranks (tolerance for float SUM —
+   psum_scatter vs psum reassociates).
+2. OP GRID — ``fused_reduce_scatter`` shard images equal reduce+slice:
+   bitwise for int32 SUM and MIN/MAX, tolerance for float SUM.
+3. WIRE — quantized zero1 (int8 ring RS + sharded EF) tracks the
+   full-precision trajectory and converges; hierarchical-auto zero1
+   lowers reduce-scatter on the inner axis.
+4. EDGES — non-divisible parameter counts pad per bucket with zero
+   contribution, zero-length leaves are identities, axis/shard
+   mismatches and stale state layouts fail loudly.
+5. GUARD — the sharded state is digest-rank-local at 2 and 4 ranks.
+6. PLANS — every implied per-bucket RS/AG plan passes the symbolic
+   checker; the tuner's zero1 objective prices RS+AG and never pins
+   "split".
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvdj
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.jax import _shard_map
+from horovod_tpu.ops import fusion as F
+from horovod_tpu.parallel import zero as Z
+from horovod_tpu.parallel.mesh import build_hierarchical_mesh, build_mesh
+
+D = 12
+KW = dict(fusion_threshold_bytes=1 << 9, first_bucket_bytes=1)
+ZKW = dict(threshold_bytes=1 << 9, first_bucket_bytes=1)
+
+
+def _params(n_layers=3, seed=1, d=D):
+    rng = np.random.RandomState(seed)
+    return {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(d, d).astype(np.float32) * 0.3),
+            "b": jnp.zeros((d,), jnp.float32),
+        }
+        for i in range(n_layers)
+    }
+
+
+def _loss_fn(params, batch):
+    X, y = batch
+    h = X
+    for k in sorted(params):
+        h = jnp.tanh(h @ params[k]["w"] + params[k]["b"])
+    return jnp.mean((h - y) ** 2)
+
+
+def _batch(n_rows, seed=0, d=D):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randn(n_rows, d).astype(np.float32)),
+        jnp.asarray(rng.randn(n_rows, d).astype(np.float32)),
+    )
+
+
+def _tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --- 1. parity ---------------------------------------------------------------
+
+
+def test_streamed_equals_posthoc_zero1_bitwise():
+    """Same bucket plan -> the streamed backward RS and the post-hoc RS
+    are the same function; params, losses and states stay bitwise
+    equal."""
+    mesh = build_mesh({"data": 8})
+    params = _params()
+    tx = optax.adamw(1e-2)
+    batch = _batch(32)
+    state = hvdj.init_zero1_stream_state(tx, params, 8, **ZKW)
+    step_s = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True, **KW
+    )
+    step_p = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, zero1=True, **KW
+    )
+    ps, ss = params, state
+    pp, sp = params, state
+    for _ in range(4):
+        ps, ss, ls = step_s(ps, ss, batch)
+        pp, sp, lp = step_p(pp, sp, batch)
+        assert float(ls) == float(lp)
+    _tree_equal(ps, pp)
+    _tree_equal(ss.opt, sp.opt)
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4, 8])
+def test_zero1_matches_replicated_dp(n_ranks):
+    """zero1 final params ~= plain-DP allreduce (float SUM tolerance:
+    reduce-scatter reassociates the sum)."""
+    mesh = build_mesh(
+        {"data": n_ranks}, devices=jax.devices()[:n_ranks]
+    )
+    params = _params()
+    tx = optax.sgd(0.05, momentum=0.9)
+    batch = _batch(4 * n_ranks)
+    state = hvdj.init_zero1_stream_state(tx, params, n_ranks, **ZKW)
+    step_z = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True, **KW
+    )
+    step_d = hvdj.make_train_step(_loss_fn, tx, mesh, donate=False)
+    pz, sz = params, state
+    pd, sd = params, tx.init(params)
+    for _ in range(5):
+        pz, sz, lz = step_z(pz, sz, batch)
+        pd, sd, ld = step_d(pd, sd, batch)
+        np.testing.assert_allclose(float(lz), float(ld), rtol=1e-6)
+    for x, y in zip(jax.tree.leaves(pz), jax.tree.leaves(pd)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_zero1_state_is_bucket_sharded():
+    """The memory win: every live bucket state leaf carries a leading
+    [n_shards] axis holding 1/N of that bucket's packed vector."""
+    params = _params()
+    tx = optax.adam(1e-3)
+    state = hvdj.init_zero1_stream_state(tx, params, 8, **ZKW)
+    n_buckets = 0
+    for g in state.opt.values():
+        for s in g.values():
+            vecs = [
+                leaf for leaf in jax.tree.leaves(s)
+                if getattr(leaf, "ndim", 0) == 2
+            ]
+            assert vecs, "expected stacked mu/nu leaves"
+            for leaf in vecs:
+                assert leaf.shape[0] == 8, leaf.shape
+            n_buckets += 1
+    assert n_buckets >= 3, n_buckets
+
+
+# --- 2. op grid --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op", [ReduceOp.SUM, ReduceOp.MIN, ReduceOp.MAX])
+@pytest.mark.parametrize("dtype", ["int32", "float32"])
+def test_fused_reduce_scatter_op_grid(op, dtype):
+    """Summed shard images across ranks == the flat reduction: bitwise
+    for int32 and MIN/MAX (exact regroupings), tolerance for float SUM.
+    The shard images partition the payload, so psum over the axis
+    reassembles the full reduced tree."""
+    if dtype == "int32" and op != ReduceOp.SUM:
+        pytest.skip("one integer op exercises the exact path")
+    n = 8
+    mesh = build_mesh({"data": n})
+    rng = np.random.RandomState(3)
+    if dtype == "int32":
+        tree = {
+            "a": jnp.asarray(rng.randint(-50, 50, (37,)), jnp.int32),
+            "b": jnp.asarray(rng.randint(-50, 50, (5, 3)), jnp.int32),
+        }
+    else:
+        tree = {
+            "a": jnp.asarray(rng.randn(37).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(5, 3).astype(np.float32)),
+        }
+
+    def body(t):
+        r = jax.lax.axis_index("data")
+        local = jax.tree.map(
+            lambda x: x + jnp.asarray(r + 1, x.dtype), t
+        )
+        images, _ = F.fused_reduce_scatter(
+            local, op=op, axis_name="data", threshold_bytes=1 << 20,
+        )
+        # Reassemble: images are disjoint shards of the reduced buffer,
+        # zeros elsewhere -> psum reassembles (MIN/MAX images are
+        # slices of the SAME reduced value, so psum of disjoint
+        # supports also reassembles exactly).
+        full = jax.tree.map(lambda x: jax.lax.psum(x, "data"), images)
+        if op == ReduceOp.SUM:
+            want = jax.tree.map(lambda x: jax.lax.psum(x, "data"), local)
+        elif op == ReduceOp.MIN:
+            want = jax.tree.map(lambda x: jax.lax.pmin(x, "data"), local)
+        else:
+            want = jax.tree.map(lambda x: jax.lax.pmax(x, "data"), local)
+        return full, want
+
+    fn = jax.jit(_shard_map(body, mesh, in_specs=(P(),), out_specs=P()))
+    full, want = fn(tree)
+    for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(want)):
+        if dtype == "int32" or op in (ReduceOp.MIN, ReduceOp.MAX):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+            )
+
+
+# --- 3. wire -----------------------------------------------------------------
+
+
+def test_quantized_zero1_tracks_fp32_and_ef_converges():
+    """Quantized+EF-sharded convergence smoke: the int8-RS trajectory
+    with the sharded residual must train (loss decreasing) and stay
+    near the f32 zero1 trajectory."""
+    mesh = build_mesh({"data": 8})
+    params = _params(seed=5)
+    tx = optax.sgd(0.1, momentum=0.9)
+    batch = _batch(32, seed=5)
+    sq = hvdj.init_zero1_stream_state(tx, params, 8, quantized=True, **ZKW)
+    sf = hvdj.init_zero1_stream_state(tx, params, 8, **ZKW)
+    step_q = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True,
+        quantized=True, **KW,
+    )
+    step_f = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True, **KW
+    )
+    pq, pf = params, params
+    losses_q = []
+    for _ in range(30):
+        pq, sq, lq = step_q(pq, sq, batch)
+        pf, sf, lf = step_f(pf, sf, batch)
+        losses_q.append(float(lq))
+    assert losses_q[-1] < losses_q[0] * 0.8, losses_q[::10]
+    assert abs(losses_q[-1] - float(lf)) < 0.05 * max(float(lf), 1e-3)
+    res_l1 = sum(
+        float(abs(np.asarray(x)).sum()) for x in jax.tree.leaves(sq.ef)
+    )
+    assert res_l1 > 0, "sharded EF residual stayed zero"
+
+
+def test_hierarchical_zero1_hlo_reduce_scatters_inner_axis():
+    """hierarchical='auto' zero1 on a (cross, local) mesh lowers each
+    bucket via the compositor's two-level RS: the HLO carries
+    reduce-scatter instructions whose replica groups are the INNER
+    (local) axis partitions — the big payload stays on ICI."""
+    import re
+
+    hmesh = build_hierarchical_mesh(local_size=4)
+    params = _params()
+    tx = optax.sgd(0.05)
+    state = hvdj.init_zero1_stream_state(tx, params, 8, **ZKW)
+    step = hvdj.make_train_step(
+        _loss_fn, tx, hmesh, donate=False, overlap=True, zero1=True,
+        hierarchical="auto", **KW,
+    )
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (params, state, _batch(32)),
+    )
+    hlo = step.lower(*avals).compiler_ir(dialect="hlo").as_hlo_text()
+    rs_lines = [
+        ln for ln in hlo.splitlines()
+        if re.search(r"\breduce-scatter\(", ln)
+    ]
+    assert len(rs_lines) >= 3, len(rs_lines)
+    # Inner-axis grouping: with (cross=2, local=4) the local-hop RS
+    # partitions ranks into 2 groups of 4.
+    inner = [
+        ln for ln in rs_lines
+        if re.search(r"replica_groups=\{\{0,1,2,3\},\{4,5,6,7\}\}", ln)
+        or re.search(r"replica_groups=.*\[2,4\]<=\[8\]", ln)
+    ]
+    assert inner, rs_lines[:3]
+
+
+def test_quantized_zero1_rejects_hierarchical():
+    mesh = build_mesh({"data": 8})
+    with pytest.raises(ValueError, match="flat int8 ring"):
+        hvdj.make_train_step(
+            _loss_fn, optax.sgd(0.1), mesh, zero1=True, quantized=True,
+            hierarchical=True,
+        )
+    with pytest.raises(ValueError, match="SUM/AVERAGE"):
+        hvdj.make_train_step(
+            _loss_fn, optax.sgd(0.1), mesh, zero1=True, op=ReduceOp.MIN
+        )
+    from horovod_tpu.common.compression import Compression
+
+    with pytest.raises(ValueError, match="shard-image"):
+        hvdj.make_train_step(
+            _loss_fn, optax.sgd(0.1), mesh, zero1=True,
+            compression=Compression.fp16,
+        )
+    with pytest.raises(ValueError, match="split"):
+        hvdj.make_train_step(
+            _loss_fn, optax.sgd(0.1), mesh, zero1=True,
+            topo_algorithm="split",
+        )
+
+
+# --- 4. edges ----------------------------------------------------------------
+
+
+def test_zero1_padding_is_zero_contribution():
+    """Deliberately non-divisible parameter counts: the padded tail
+    never reaches the gathered params (the image/gather truncate), so
+    zero1 still matches DP."""
+    mesh = build_mesh({"data": 8})
+    params = _params(d=13)  # 13*13 + 13 per layer: not divisible by 8
+    tx = optax.adamw(1e-2)
+    batch = _batch(32, d=13)
+    state = hvdj.init_zero1_stream_state(tx, params, 8, **ZKW)
+    step_z = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True, **KW
+    )
+    step_d = hvdj.make_train_step(_loss_fn, tx, mesh, donate=False)
+    pz, sz = params, state
+    pd, sd = params, tx.init(params)
+    for _ in range(5):
+        pz, sz, _ = step_z(pz, sz, batch)
+        pd, sd, _ = step_d(pd, sd, batch)
+    for x, y in zip(jax.tree.leaves(pz), jax.tree.leaves(pd)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_zero_length_leaves_are_identities():
+    mesh = build_mesh({"data": 8})
+    tree = {
+        "a": jnp.zeros((0,), jnp.float32),
+        "b": jnp.ones((16,), jnp.float32),
+    }
+
+    def body(t):
+        images, _ = F.fused_reduce_scatter(
+            t, op=ReduceOp.SUM, axis_name="data", threshold_bytes=1,
+        )
+        return images
+
+    fn = jax.jit(_shard_map(body, mesh, in_specs=(P(),), out_specs=P()))
+    out = fn(tree)
+    assert out["a"].shape == (0,)
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(out)[1]).sum(), 16.0 * 8 / 8, rtol=1e-6
+    )
+
+
+def test_zero1_update_validates_axis_size():
+    """A shard count that disagrees with the bound axis must fail
+    loudly, not silently misalign shard offsets."""
+    mesh = build_mesh({"data": 8})
+    params = _params()
+    tx = optax.sgd(0.1)
+    grads = jax.tree.map(jnp.ones_like, params)
+    state = hvdj.init_zero1_stream_state(tx, params, 4, **ZKW)
+
+    def body(p, s, g):
+        return Z.zero1_stream_update(
+            tx, p, s.opt, g, axis_name="data", n_shards=4, **ZKW
+        )[0]
+
+    fn = jax.jit(_shard_map(
+        body, mesh, in_specs=(P(), P("data"), P()), out_specs=P()
+    ))
+    with pytest.raises(ValueError, match="sharded 4 ways .* size 8"):
+        fn(params, state, grads)
+
+    # The legacy whole-vector path validates too (satellite contract).
+    st_legacy = Z.init_zero1_state(tx, params, 4)
+
+    def legacy(p, s, g):
+        return Z.zero1_update(
+            tx, p, jax.tree.map(lambda x: x[0], s), g,
+            axis_name="data", n_shards=4,
+        )[0]
+
+    fn2 = jax.jit(_shard_map(
+        legacy, mesh, in_specs=(P(), P("data"), P()), out_specs=P()
+    ))
+    with pytest.raises(ValueError, match="sharded 4 ways .* size 8"):
+        fn2(params, st_legacy, grads)
+
+
+def test_stale_state_layout_fails_loudly():
+    """State built for one partition used with different knobs must
+    raise, not misalign."""
+    mesh = build_mesh({"data": 8})
+    params = _params()
+    tx = optax.sgd(0.1)
+    state = hvdj.init_zero1_stream_state(
+        tx, params, 8, threshold_bytes=1, first_bucket_bytes=1
+    )
+    step = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True,
+        fusion_threshold_bytes=1 << 20, first_bucket_bytes=1 << 20,
+    )
+    with pytest.raises(Exception, match="partition|missing bucket|stale"):
+        step(params, state, _batch(32))
+
+
+# --- 5. guard ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_ranks", [2, 4])
+def test_digest_is_shard_aware(n_ranks):
+    """Intentionally divergent per-rank shard rows (and sharded EF
+    residuals) must NOT trip the cross-rank digest agreement; the shard
+    LAYOUT still is digest-tracked."""
+    from horovod_tpu.guard.digest import (
+        find_quorum,
+        strip_rank_local,
+        tree_digest,
+    )
+
+    params = _params()
+    tx = optax.adam(1e-3)
+    state = hvdj.init_zero1_stream_state(
+        tx, params, n_ranks, quantized=True, **ZKW
+    )
+    digests = []
+    for r in range(n_ranks):
+        row = jax.tree.map(lambda x, r=r: x + float(r), state)
+        digests.append(tree_digest(strip_rank_local(row)))
+    ok, ref, outliers = find_quorum(digests)
+    assert ok and not outliers, (digests, outliers)
+    # ...but a LAYOUT drift (different bucket shapes) still mismatches.
+    other = hvdj.init_zero1_stream_state(
+        tx, _params(d=16), n_ranks, quantized=True, **ZKW
+    )
+    assert tree_digest(strip_rank_local(other)) != digests[0]
+
+
+# --- 6. plans & tuner --------------------------------------------------------
+
+
+def test_zero1_plan_grid_verifies_clean():
+    from horovod_tpu.analysis.plan_verify import verify_zero1_stream_plans
+    from horovod_tpu.topo.model import synthetic_model
+
+    for kw in (dict(local=8), dict(local=4, cross=2),
+               dict(local=2, cross=2, pod=2)):
+        model = synthetic_model(generation="v5e", **kw)
+        fs, n = verify_zero1_stream_plans(
+            model, [1024, 1 << 20, 64 << 20]
+        )
+        assert not fs and n == 6, (kw, [f.render() for f in fs])
+    model = synthetic_model(local=8, generation="v5e")
+    fs, n = verify_zero1_stream_plans(
+        model, [1 << 20], quantized=True
+    )
+    assert not fs and n == 2
+
+
+def test_tuner_zero1_objective_prices_rs_plus_ag():
+    from horovod_tpu import tune as T
+    from horovod_tpu.topo.model import synthetic_model
+
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    spec = T.ProgramSpec(
+        name="mlp3-zero1",
+        layers=(("l0", 1 << 20), ("l1", 1 << 20), ("l2", 1 << 20)),
+    )
+    space = T.space_for_model(model, zero1=True)
+    assert "split" not in space.topo_choices
+    cfg = space.default_config()
+    obj_ar = T.free_objectives(spec, cfg, model)
+    obj_z = T.free_objectives(spec, cfg, model, zero1=True)
+    assert obj_z["zero1"] is True
+    assert all("ag_algorithm" in g for g in obj_z["per_group"])
+    # The zero1 reduction hop is cheaper than the allreduce (RS moves
+    # half the ring traffic), but the exposed total also carries the AG.
+    rs_cost = sum(g["cost_us"] for g in obj_z["per_group"])
+    ar_cost = sum(g["cost_us"] for g in obj_ar["per_group"])
+    assert rs_cost < ar_cost
+    plans = T.group_plans(spec, cfg, model, zero1=True)
+    assert len(plans) == 2 * obj_z["n_groups"]
+    assert {p.collective for p in plans} == {"reducescatter", "allgather"}
+
+    tuned = T.tune(spec, model, samples=6, zero1=True)
+    assert tuned.search["zero1"] is True
+    assert tuned.knobs.get("topo_algorithm") != "split"
+
+
+def test_distributed_optimizer_zero1_needs_shards_and_params():
+    with pytest.raises(ValueError, match="zero1_shards"):
+        hvdj.DistributedOptimizer(optax.sgd(0.1), zero1=True)
+    tx = hvdj.DistributedOptimizer(
+        optax.sgd(0.1), zero1=True, zero1_shards=8
+    )
+    params = _params()
+    state = tx.init(params)
+    assert isinstance(state, hvdj.Zero1State)

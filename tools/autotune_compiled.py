@@ -225,6 +225,12 @@ def main() -> int:
                          "reachable backend (free objectives still "
                          "recorded)")
     ap.add_argument("--measure-reps", type=int, default=5)
+    ap.add_argument("--zero1", action="store_true",
+                    help="tune the streamed-ZeRO-1 reduction shape: "
+                         "groups priced as per-bucket reduce-scatter + "
+                         "parameter all-gather, 'split' dropped from "
+                         "the topo choices, RS+AG plans verified "
+                         "before pinning (docs/overlap.md)")
     args = ap.parse_args()
 
     # Planning never needs an accelerator; pin CPU so a dead TPU tunnel
@@ -242,7 +248,8 @@ def main() -> int:
     )
     mesh_axes = _mesh_axes(args)
     spec, params_aval = _build_spec(args, mesh_axes)
-    space = T.space_for_model(model, allow_int8=args.wire != "f32")
+    space = T.space_for_model(model, allow_int8=args.wire != "f32",
+                              zero1=args.zero1)
     if args.wire == "int8":
         # Pin the wire dim at int8 by seeding the default there: the
         # space still carries the dim, the default just starts from it.
@@ -258,7 +265,7 @@ def main() -> int:
         cfg = T.tune(
             spec, model,
             samples=args.samples, seed=args.seed, space=space,
-            measure_fn=measure_fn,
+            measure_fn=measure_fn, zero1=args.zero1,
         )
     except T.TuneVerificationError as e:
         print(f"[autotune] {e}", file=sys.stderr)
@@ -272,6 +279,7 @@ def main() -> int:
     T.save_tuned(cfg, args.out)
     print(json.dumps({
         "program": spec.name,
+        "zero1": bool(args.zero1),
         "out": args.out,
         "signature": cfg.signature_hash,
         "samples": cfg.search["samples"],

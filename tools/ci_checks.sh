@@ -64,6 +64,14 @@
 # on the transformer program), and the stale-signature fallback loud
 # (docs/autotune.md "Compiled-path offline tuning"). Budget: under 60s.
 #
+# Stage 11 (make zero-smoke; skip with HVD_CI_SKIP_ZERO=1): the
+# streamed-ZeRO-1 smoke — a 2-rank streamed-zero1+quantized step
+# bitwise-equal to the post-hoc zero1 step, the shard-local update
+# verified against the gathered (replicated DP) reference, the sharded
+# EF residual live, the guard digest shard-aware, and the event log
+# byte-identical across two runs (docs/overlap.md "Streamed ZeRO-1").
+# Budget: under 15s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -142,4 +150,11 @@ if [ "${HVD_CI_SKIP_TUNE:-0}" != "1" ]; then
     python tools/tune_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: tune smoke deterministic+bitwise+modeled-win in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_ZERO:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/zero_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: zero smoke streamed==posthoc+sharded+byte-stable in ${elapsed}s"
 fi

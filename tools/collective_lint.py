@@ -206,6 +206,11 @@ def _lint_divergence():
         # quantized ring's axis_index/ppermute fori_loops must not trip
         # the rank-divergence analyzer (constant trip counts).
         ("quantized-overlap", mesh, {"overlap": True, "quantized": True}),
+        # Streamed ZeRO-1: per-bucket reduce-scatter in the backward +
+        # shard-local update + param all-gather — the shard slicing is
+        # axis_index-driven BY DESIGN and must still come out
+        # divergence-clean (the gathered params are replicated again).
+        ("zero1-overlap", mesh, {"overlap": True, "zero1": True}),
     )
     findings = []
     for label, m, kwargs in variants:
@@ -213,7 +218,14 @@ def _lint_divergence():
         step = hvdj.make_train_step(
             loss_fn, tx, m, donate=False, **kwargs
         )
-        opt_state = tx.init(params)
+        if kwargs.get("zero1"):
+            from horovod_tpu.parallel.zero import init_zero1_stream_state
+
+            opt_state = init_zero1_stream_state(
+                tx, params, int(m.shape["data"])
+            )
+        else:
+            opt_state = tx.init(params)
         fs = analysis.analyze_step(step, params, opt_state, batch)
         for f in fs:
             f.location = f"divergence:{label}/{f.location}"

@@ -507,6 +507,7 @@ def _dependency_stats(pre_hlo: str) -> dict:
 
     token_re = re.compile(r"%?[A-Za-z_][\w.\-]*")
     ar_re = re.compile(r"\ball-reduce(?:-start)?\(")
+    rs_re = re.compile(r"\breduce-scatter(?:-start)?\(")
     scalar_re = re.compile(r"^\(?\s*\w+\[\]")
     # Full-scalar result only: a while carrying (s32[], f32[1024], ...)
     # is NOT scalar even though its type string starts with s32[].
@@ -518,6 +519,12 @@ def _dependency_stats(pre_hlo: str) -> dict:
         "scalar_all_reduce_count": 0,
         "independent_all_reduce_groups": 0,
         "overlappable_compute_per_all_reduce": [],
+        # Streamed-zero1 counters: gradient reduce-scatters with no
+        # other gradient reduction in their operand cone — the
+        # independent RS groups the scheduler can start as soon as
+        # their own layer suffix finishes.
+        "reduce_scatter_count": 0,
+        "independent_reduce_scatter_groups": 0,
         # Superset counters that also see collectives buried in called
         # computations (the quantized ring's ppermute fori_loops): a
         # "collective node" is a direct wire op or a call/while whose
@@ -551,7 +558,11 @@ def _dependency_stats(pre_hlo: str) -> dict:
             and not pure_scalar_re.match(r)
         ]
         ars = [n for n, r in insts if ar_re.search(r)]
-        if not ars and not colls:
+        rss = [
+            n for n, r in insts
+            if rs_re.search(r) and not scalar_re.match(defined[n])
+        ]
+        if not ars and not colls and not rss:
             continue
         grad_ars = [n for n in ars if not scalar_re.match(defined[n])]
         total["all_reduce_count"] += len(grad_ars)
@@ -568,6 +579,12 @@ def _dependency_stats(pre_hlo: str) -> dict:
             total["overlappable_compute_per_all_reduce"].append(
                 len(compute - anc - desc)
             )
+        total["reduce_scatter_count"] += len(rss)
+        grad_reds = grad_ars + rss
+        for rs in rss:
+            anc = _reach(rs, deps)
+            if not any(o in anc for o in grad_reds if o != rs):
+                total["independent_reduce_scatter_groups"] += 1
         total["collective_count"] += len(colls)
         for c in colls:
             anc = _reach(c, deps)
@@ -694,6 +711,87 @@ def _wire_bytes_stats(pre_hlo: str) -> dict:
     return out
 
 
+def _ring_wire_model(by_op: dict, n: int = 8) -> dict:
+    """Per-step bytes-on-wire modeled from the structural census with
+    ring accounting (per-chip): an all-reduce of result B moves
+    2(n-1)/n*B, a reduce-scatter whose RESULT is the 1/n shard moves
+    (n-1)*B_result, an all-gather whose result is the full buffer moves
+    (n-1)/n*B_result, an all-to-all (n-1)/n*B; collective-permute
+    payloads (the int8 ring's hops live inside while bodies the census
+    counts once per instruction) are taken as counted. Split into the
+    GRADIENT-REDUCTION wire (all-reduce + reduce-scatter + permutes —
+    the cotangent exchange ZeRO-1 halves and int8 compresses) and the
+    PARAMETER wire (all-gather — ZeRO-1's shard return, always full
+    precision): ZeRO-1's total equals the allreduce decomposition by
+    construction; the claimable win is on the reduction hop."""
+    factors = {
+        "all-reduce": lambda b: 2 * (n - 1) / n * b,
+        "reduce-scatter": lambda b: (n - 1) * b,
+        "all-gather": lambda b: (n - 1) / n * b,
+        "all-to-all": lambda b: (n - 1) / n * b,
+        "collective-permute": lambda b: float(b),
+    }
+    per_op = {}
+    grad = 0.0
+    param = 0.0
+    for op, dtypes in by_op.items():
+        nbytes = sum(dtypes.values())
+        modeled = factors.get(op, lambda b: float(b))(nbytes)
+        per_op[op] = int(modeled)
+        if op == "all-gather":
+            param += modeled
+        else:
+            grad += modeled
+    return {
+        "ranks": n,
+        "per_op": dict(sorted(per_op.items())),
+        "grad_reduction_bytes": int(grad),
+        "param_gather_bytes": int(param),
+        "total_bytes": int(grad + param),
+    }
+
+
+def _zero1_plan_report(pre_hlo: str, n: int = 8) -> dict:
+    """Verify every per-bucket RS plan the streamed-zero1 program
+    implies: bucket payloads are read off the non-scalar reduce-scatter
+    results in the pre-optimization HLO (result = the 1/n shard, so
+    bucket = n * result bytes) and swept through the symbolic plan
+    checker on the two-slice synthetic model — RS and the returning AG
+    both (``analysis/plan_verify.verify_zero1_stream_plans``)."""
+    import re
+
+    from horovod_tpu.analysis.plan_verify import verify_zero1_stream_plans
+    from horovod_tpu.topo import synthetic_model
+
+    shape_re = re.compile(r"^\(?\s*(\w+)\[([\d,]*)\]")
+    scalar_re = re.compile(r"^\(?\s*\w+\[\]")
+    rs_re = re.compile(r"\breduce-scatter(?:-start)?\(")
+    buckets = []
+    for insts in _parse_hlo(pre_hlo).values():
+        for _, rhs in insts:
+            if not rs_re.search(rhs) or scalar_re.match(rhs):
+                continue
+            m = shape_re.match(rhs)
+            if not m:
+                continue
+            dsize = _HLO_DTYPE_BYTES.get(m.group(1), 4)
+            elems = 1
+            for d in m.group(2).split(","):
+                if d.strip():
+                    elems *= int(d)
+            buckets.append(elems * dsize * n)
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    findings, verified = verify_zero1_stream_plans(
+        model, sorted(buckets, reverse=True)
+    )
+    return {
+        "bucket_count": len(buckets),
+        "bucket_bytes": sorted(buckets, reverse=True),
+        "plans_verified": verified,
+        "findings": [f.render() for f in findings],
+    }
+
+
 def _topo_plan_report(pre_hlo: str) -> dict:
     """Bytes-per-hop per collective from the compositor's chosen plans
     (docs/topology.md): every gradient all-reduce in the program is
@@ -752,7 +850,7 @@ def _topo_plan_report(pre_hlo: str) -> dict:
     }
 
 
-def _structural_stats(lowered) -> dict:
+def _structural_stats(lowered, zero1: bool = False) -> dict:
     pre = lowered.compiler_ir(dialect="hlo").as_hlo_text()
     compiled = lowered.compile().as_text()
     out = _dependency_stats(pre)
@@ -761,11 +859,40 @@ def _structural_stats(lowered) -> dict:
         1 for c in out["overlappable_compute_per_all_reduce"] if c > 0
     )
     out["bytes_on_wire"] = _wire_bytes_stats(pre)
+    out["wire_model"] = _ring_wire_model(out["bytes_on_wire"]["by_op"])
     out["topo_plans"] = _topo_plan_report(pre)
+    if zero1:
+        out["zero1_plans"] = _zero1_plan_report(pre)
     return out
 
 
-def _structural_mlp(overlap: bool, quantized: bool = False):
+def _zero1_step_and_avals(loss_fn, tx, mesh, params_aval, kw):
+    """make_train_step(zero1=True) plus the abstract Zero1State aval
+    (eval_shape over init_zero1_stream_state — shapes only, nothing
+    executes)."""
+    import jax
+
+    import horovod_tpu.jax as hvdj
+
+    step = hvdj.make_train_step(
+        loss_fn, tx, mesh, donate=False, overlap=True, zero1=True,
+        fusion_threshold_bytes=kw.get("fusion_threshold_bytes"),
+        first_bucket_bytes=kw.get("first_bucket_bytes"),
+    )
+    n = len(jax.devices())
+    opt_aval = jax.eval_shape(
+        lambda p: hvdj.init_zero1_stream_state(
+            tx, p, n,
+            threshold_bytes=kw.get("fusion_threshold_bytes"),
+            first_bucket_bytes=kw.get("first_bucket_bytes"),
+        ),
+        params_aval,
+    )
+    return step, opt_aval
+
+
+def _structural_mlp(overlap: bool, quantized: bool = False,
+                    zero1: bool = False):
     """The 3-layer MLP phase-B program. The default build runs the
     post-hoc path at the reference 64 MB fusion threshold — one bucket,
     one barrier-like all-reduce depending on the whole backward ("vs 1
@@ -796,10 +923,6 @@ def _structural_mlp(overlap: bool, quantized: bool = False):
         dict(fusion_threshold_bytes=1 << 20, first_bucket_bytes=1 << 16)
         if overlap else {}
     )
-    step = hvdj.make_train_step(
-        loss_fn, tx, mesh, donate=False, overlap=overlap,
-        quantized=quantized, **kw,
-    )
     params_aval = {
         f"layer{i}": {
             "w": jax.ShapeDtypeStruct((D, D), jnp.float32),
@@ -807,7 +930,16 @@ def _structural_mlp(overlap: bool, quantized: bool = False):
         }
         for i in range(3)
     }
-    opt_aval = jax.eval_shape(tx.init, params_aval)
+    if zero1:
+        step, opt_aval = _zero1_step_and_avals(
+            loss_fn, tx, mesh, params_aval, kw
+        )
+    else:
+        step = hvdj.make_train_step(
+            loss_fn, tx, mesh, donate=False, overlap=overlap,
+            quantized=quantized, **kw,
+        )
+        opt_aval = jax.eval_shape(tx.init, params_aval)
     batch_aval = (
         jax.ShapeDtypeStruct((2 * n, D), jnp.float32),
         jax.ShapeDtypeStruct((2 * n, D), jnp.float32),
@@ -815,7 +947,8 @@ def _structural_mlp(overlap: bool, quantized: bool = False):
     return step.lower(params_aval, opt_aval, batch_aval)
 
 
-def _structural_transformer(overlap: bool, quantized: bool = False):
+def _structural_transformer(overlap: bool, quantized: bool = False,
+                            zero1: bool = False):
     """A small fp32 TransformerLM phase-B program (dense attention — the
     Pallas interpreter would bury the backward in while loops and hide the
     compute from the structural counters)."""
@@ -858,16 +991,21 @@ def _structural_transformer(overlap: bool, quantized: bool = False):
         dict(fusion_threshold_bytes=256 << 10, first_bucket_bytes=16 << 10)
         if overlap else {}
     )
-    step = hvdj.make_train_step(
-        loss_fn, tx, mesh, donate=False, overlap=overlap,
-        quantized=quantized, **kw,
-    )
     params_aval = jax.eval_shape(
         lambda r, t: model.init(r, t)["params"],
         jax.ShapeDtypeStruct((2,), jnp.uint32),
         jax.ShapeDtypeStruct((1, T), jnp.int32),
     )
-    opt_aval = jax.eval_shape(tx.init, params_aval)
+    if zero1:
+        step, opt_aval = _zero1_step_and_avals(
+            loss_fn, tx, mesh, params_aval, kw
+        )
+    else:
+        step = hvdj.make_train_step(
+            loss_fn, tx, mesh, donate=False, overlap=overlap,
+            quantized=quantized, **kw,
+        )
+        opt_aval = jax.eval_shape(tx.init, params_aval)
     tok_aval = jax.ShapeDtypeStruct((2 * n, T), jnp.int32)
     return step.lower(params_aval, opt_aval, (tok_aval, tok_aval))
 
@@ -882,10 +1020,11 @@ def structural_mode(args) -> int:
     jax.config.update("jax_platforms", "cpu")
 
     results = {}
-    for mode, overlap, quantized in (
-        ("default", False, False),
-        ("overlap", True, False),
-        ("quantized", True, True),
+    for mode, overlap, quantized, zero1 in (
+        ("default", False, False, False),
+        ("overlap", True, False, False),
+        ("quantized", True, True, False),
+        ("zero1", True, False, True),
     ):
         t0 = time.time()
         per = {}
@@ -893,17 +1032,23 @@ def structural_mode(args) -> int:
             ("mlp3", _structural_mlp),
             ("transformer", _structural_transformer),
         ):
-            per[prog] = _structural_stats(builder(overlap, quantized))
+            per[prog] = _structural_stats(
+                builder(overlap, quantized, zero1), zero1=zero1
+            )
             print(
                 f"[overlap] structural {mode}/{prog}: "
                 f"independent_groups={per[prog]['independent_all_reduce_groups']} "
+                f"independent_rs_groups={per[prog]['independent_reduce_scatter_groups']} "
                 f"independent_collectives={per[prog]['independent_collective_groups']} "
                 f"pairs_with_overlap={per[prog]['pairs_with_overlap']}",
                 flush=True,
             )
             wb = per[prog]["bytes_on_wire"]["by_dtype"]
+            wm = per[prog]["wire_model"]
             print(
-                f"[overlap] wire bytes {mode}/{prog}: {wb}",
+                f"[overlap] wire bytes {mode}/{prog}: {wb} | modeled "
+                f"grad={wm['grad_reduction_bytes']} "
+                f"param={wm['param_gather_bytes']}",
                 flush=True,
             )
             tp = per[prog]["topo_plans"]
@@ -914,6 +1059,14 @@ def structural_mode(args) -> int:
                 f"(flat would put {tp['flat_dcn_bytes_total']} on dcn)",
                 flush=True,
             )
+            if zero1:
+                zp = per[prog]["zero1_plans"]
+                print(
+                    f"[overlap] zero1 plans {mode}/{prog}: "
+                    f"{zp['plans_verified']} RS+AG plans verified, "
+                    f"{len(zp['findings'])} findings",
+                    flush=True,
+                )
         results[mode] = {
             "captured_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
@@ -923,6 +1076,7 @@ def structural_mode(args) -> int:
                 "kind": "cpu-structural",
                 "overlap": overlap,
                 "quantized": quantized,
+                "zero1": zero1,
                 "elapsed_s": round(time.time() - t0, 2),
                 **per,
             },
@@ -969,6 +1123,47 @@ def structural_mode(args) -> int:
                 failed.append(
                     f"{prog}: quantized build still moves "
                     f"{qwb['f32']} non-scalar f32 collective bytes"
+                )
+            # Streamed ZeRO-1: >= 3 independent reduce-scatter groups
+            # (each bucket's RS starts as soon as its own layer suffix
+            # finishes), the modeled gradient-reduction wire strictly
+            # below the streamed allreduce build (RS is half the ring-AR
+            # traffic; the param all-gather is reported separately and
+            # keeps the TOTAL at parity — the standard ZeRO-1 result),
+            # and every implied per-bucket RS/AG plan symbolically
+            # verified.
+            zt = results["zero1"]["phase_b"][prog]
+            if zt["independent_reduce_scatter_groups"] < 3:
+                failed.append(
+                    f"{prog}: zero1 independent_reduce_scatter_groups="
+                    f"{zt['independent_reduce_scatter_groups']} < 3"
+                )
+            z_grad = zt["wire_model"]["grad_reduction_bytes"]
+            ar_grad = st["wire_model"]["grad_reduction_bytes"]
+            if not z_grad < ar_grad:
+                failed.append(
+                    f"{prog}: zero1 gradient-reduction wire {z_grad} "
+                    f"not strictly below streamed allreduce {ar_grad}"
+                )
+            if zt["wire_model"]["total_bytes"] > st["wire_model"][
+                "total_bytes"
+            ]:
+                failed.append(
+                    f"{prog}: zero1 total wire "
+                    f"{zt['wire_model']['total_bytes']} above streamed "
+                    f"allreduce {st['wire_model']['total_bytes']} "
+                    f"(must be at parity or below)"
+                )
+            if zt["zero1_plans"]["findings"]:
+                failed.append(
+                    f"{prog}: zero1 per-bucket RS/AG plans failed "
+                    f"verification: {zt['zero1_plans']['findings'][:2]}"
+                )
+            if zt["zero1_plans"]["plans_verified"] < 6:
+                failed.append(
+                    f"{prog}: only "
+                    f"{zt['zero1_plans']['plans_verified']} zero1 plans "
+                    f"verified (expected >= 6: 3+ buckets x RS+AG)"
                 )
         if failed:
             print("[overlap] STRUCTURAL ASSERTIONS FAILED:", file=sys.stderr)

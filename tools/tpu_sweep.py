@@ -35,6 +35,9 @@ QUEUE = [
                          "--quantized"]),
     ("lm_b8_zero1_quant", ["--model", "transformer", "--batch-size", "8",
                            "--zero1", "--quantized"]),
+    ("lm_b8_overlap_zero1_quant", ["--model", "transformer",
+                                   "--batch-size", "8", "--overlap",
+                                   "--zero1", "--quantized"]),
     ("micro_r18_b32", ["--model", "resnet18", "--batch-size", "32",
                        "--micro"]),
     ("moe_b8", ["--model", "moe", "--batch-size", "8"]),

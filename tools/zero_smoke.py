@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Streamed-ZeRO-1 CI smoke (docs/overlap.md "Streamed ZeRO-1").
+
+One process, a 2-rank virtual CPU mesh, <15s:
+
+1. STREAMED-ZERO1+QUANTIZED STEP — ``make_train_step(overlap=True,
+   zero1=True, quantized=True)`` with per-leaf buckets: each bucket
+   reduce-scatters over the int8 ring INSIDE the backward, the sharded
+   EF residual rides the ``Zero1State``, and the shard-local update +
+   parameter all-gather run against the same bucket plan.
+2. SHARD-LOCAL vs GATHERED REFERENCE — the same trajectory is recomputed
+   with the post-hoc per-bucket reduction (``zero1_posthoc_reduce``) and
+   must match the streamed one BITWISE (params, losses, EF residuals):
+   one reduction, two call sites. The f32 zero1 step must additionally
+   match plain replicated DP to float tolerance (the gathered
+   reference: same update math on the full vector).
+3. STATE IS SHARDED — live bucket states carry the [n_shards, k]
+   leading axis (the memory win), and the guard digest treats the
+   shards as rank-local (intentionally divergent rows digest equal).
+4. BYTE-STABLE EVENT LOG — per-step losses + params/EF digests + the
+   per-bucket plan summary serialize to a normalized JSON log; the run
+   executes TWICE and the logs must be byte-identical.
+
+Exit 0 = all assertions hold. Wired as the next ``tools/ci_checks.sh``
+stage (skip: HVD_CI_SKIP_ZERO=1) and ``make zero-smoke``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 2-rank virtual mesh; must precede the first jax backend touch.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+D = 16
+STEPS = 4
+N_RANKS = 2
+
+
+def _build():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    params = {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3),
+            "b": jnp.zeros((D,), jnp.float32),
+        }
+        for i in range(3)
+    }
+    batch = (
+        jnp.asarray(rng.randn(8, D).astype(np.float32)),
+        jnp.asarray(rng.randn(8, D).astype(np.float32)),
+    )
+    return params, batch
+
+
+def _loss_fn(params, batch):
+    import jax.numpy as jnp
+
+    x, y = batch
+    h = x
+    for k in sorted(params):
+        h = jnp.tanh(h @ params[k]["w"] + params[k]["b"])
+    return jnp.mean((h - y) ** 2)
+
+
+def _digest(tree) -> str:
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.device_get(jax.tree.leaves(tree)):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _run_once() -> str:
+    """One full smoke pass; returns the normalized event log."""
+    import numpy as np
+
+    import jax
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.guard.digest import strip_rank_local, tree_digest
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": N_RANKS})
+    params, batch = _build()
+    tx = optax.sgd(0.05, momentum=0.9)
+    # Per-leaf buckets: streamed and post-hoc quantize identical
+    # payloads -> bitwise parity.
+    kw = dict(fusion_threshold_bytes=1, first_bucket_bytes=1)
+    state0 = hvdj.init_zero1_stream_state(
+        tx, params, N_RANKS, threshold_bytes=1, first_bucket_bytes=1,
+        quantized=True,
+    )
+    step_stream = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True,
+        quantized=True, **kw,
+    )
+    step_posthoc = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, zero1=True, quantized=True, **kw,
+    )
+
+    events = []
+    ps, ss = params, state0
+    pp, sp = params, state0
+    for i in range(STEPS):
+        ps, ss, ls = step_stream(ps, ss, batch)
+        pp, sp, lp = step_posthoc(pp, sp, batch)
+        assert float(ls) == float(lp), (
+            f"step {i}: streamed loss {float(ls)} != posthoc {float(lp)}"
+        )
+        events.append({"step": i, "loss": f"{float(ls):.9e}"})
+    for a, b in zip(jax.tree.leaves(ps), jax.tree.leaves(pp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(ss.ef), jax.tree.leaves(sp.ef)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    res_l1 = sum(
+        float(abs(np.asarray(x)).sum()) for x in jax.tree.leaves(ss.ef)
+    )
+    assert res_l1 > 0, "sharded EF residual stayed zero — EF dead"
+
+    # Shard-local update vs the gathered reference: the f32 zero1 step
+    # must track plain replicated DP (same optimizer on the full
+    # vector) to float tolerance.
+    statef = hvdj.init_zero1_stream_state(
+        tx, params, N_RANKS, threshold_bytes=1, first_bucket_bytes=1,
+    )
+    step_f32 = hvdj.make_train_step(
+        _loss_fn, tx, mesh, donate=False, overlap=True, zero1=True, **kw,
+    )
+    step_dp = hvdj.make_train_step(_loss_fn, tx, mesh, donate=False)
+    pf, sf = params, statef
+    pd, sd = params, tx.init(params)
+    for _ in range(STEPS):
+        pf, sf, _ = step_f32(pf, sf, batch)
+        pd, sd, _ = step_dp(pd, sd, batch)
+    for a, b in zip(jax.tree.leaves(pf), jax.tree.leaves(pd)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-6, atol=1e-7
+        )
+
+    # The memory win is real: every live bucket state is [n_shards, k].
+    n_bucket_states = 0
+    for g in state0.opt.values():
+        for s in g.values():
+            for leaf in jax.tree.leaves(s):
+                if getattr(leaf, "ndim", 0) >= 1:
+                    assert leaf.shape[0] == N_RANKS, leaf.shape
+            n_bucket_states += 1
+    assert n_bucket_states >= 3, n_bucket_states
+
+    # Digest shard-awareness: intentionally divergent rows agree.
+    row0 = jax.tree.map(lambda x: x + 0.0, ss)
+    row1 = jax.tree.map(lambda x: x + 1.0, ss)
+    assert tree_digest(strip_rank_local(row0)) == tree_digest(
+        strip_rank_local(row1)
+    ), "zero1 sharded state reached the cross-rank digest"
+
+    log = {
+        "events": events,
+        "params_digest": _digest(ps),
+        "ef_digest": _digest(ss.ef),
+        "bucket_states": n_bucket_states,
+        "ranks": N_RANKS,
+    }
+    return json.dumps(log, sort_keys=True)
+
+
+def main() -> int:
+    t0 = time.time()
+    log1 = _run_once()
+    log2 = _run_once()
+    assert log1 == log2, (
+        "zero1 smoke is not byte-stable across runs:\n"
+        f"run1: {log1}\nrun2: {log2}"
+    )
+    doc = json.loads(log1)
+    print(
+        f"[zero-smoke] OK in {time.time() - t0:.1f}s: "
+        f"{STEPS} streamed==posthoc zero1 steps bitwise, f32 zero1 "
+        f"tracks DP, {doc['bucket_states']} sharded bucket states, "
+        f"EF sharded+live, digest shard-aware, log byte-stable"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
