@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -16,7 +16,7 @@ test:
 # Pass 4 over the shipped train-step variants, Pass 5 over the reference
 # sharding-rule table.
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -85,4 +85,12 @@ tune-smoke:
 zero-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/zero_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke test
+# Fleet-simulator smoke (docs/simulation.md): two predict runs over
+# 256/1024/4096 ranks byte-identical, two-level strictly beating flat
+# at 1024 simulated ranks, a calibration fitted from a known-constants
+# simulated trace recovering them (replay ratios ~1), and a real 2-rank
+# traced run replayed with bounded per-hop divergence, ~30s CPU.
+sim-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/sim_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke test

@@ -222,6 +222,14 @@ def _parse_args(argv=None):
              "detail so tuner wins are attributable; a mismatch warns "
              "and runs untuned",
     )
+    parser.add_argument(
+        "--calibration", default="",
+        help="transformer: calibration.json (tools/fleet_sim.py "
+             "--calibrate; docs/simulation.md) pricing the report's "
+             "`sim` block with measured per-hop constants — without "
+             "it the block reports the prediction on generation "
+             "defaults and an honest zero divergence ratio",
+    )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.zero1 and args.model != "transformer":
@@ -308,6 +316,81 @@ def _resolve_tuned(args, params, mesh):
         "knobs": dict(cfg.knobs) if matched else None,
     }
     return (T.tuned_step_kwargs(cfg) if matched else None), detail
+
+
+def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
+               quantized_eff=False, tuned_kw=None):
+    """Fleet-simulator cross-check for the transformer report
+    (docs/simulation.md): the digital twin's predicted step time for
+    THIS program at THIS chip count next to the measured one, plus the
+    divergence ratio. Without a calibration the prediction runs on
+    coarse generation defaults, so the ratio is an honest zero with a
+    pointer at the calibration workflow rather than a fake
+    agreement number. Never raises — a sim failure must not cost a
+    bench capture."""
+    try:
+        from horovod_tpu import sim as hvdsim
+        from horovod_tpu import tune as T
+        from horovod_tpu.topo.model import detect_generation, synthetic_model
+
+        spec = T.spec_from_params("bench-transformer", params, mesh=mesh)
+        config = {}
+        if tuned_kw:
+            config = {
+                "fusion_threshold_bytes": tuned_kw["fusion_threshold_bytes"],
+                "first_bucket_bytes": tuned_kw["first_bucket_bytes"],
+            }
+        program = hvdsim.program_from_spec(spec, config)
+        calib = hvdsim.resolve_calibration(
+            getattr(args, "calibration", "") or None
+        )
+        model = hvdsim.apply_calibration(
+            synthetic_model(n_chips, generation=detect_generation()),
+            calib, where="bench",
+        )
+        res = hvdsim.simulate(
+            model, program,
+            hvdsim.SimConfig(
+                wire_dtype="int8" if quantized_eff else "f32",
+                zero1=bool(getattr(args, "zero1", False)),
+                overlap=bool(getattr(args, "overlap", False)),
+            ),
+            steps=2,
+        )
+        predicted_s = res.mean_step_us / 1e6
+        calibrated = calib is not None and model.source.endswith(
+            "+calibrated"
+        )
+        block = {
+            "predicted_step_time_s": round(predicted_s, 6),
+            "measured_step_time_s": round(float(measured_step_s), 6),
+            "scaling_efficiency": round(res.scaling_efficiency, 6),
+            "ranks": int(n_chips),
+            "calibrated": bool(calibrated),
+        }
+        if calibrated and measured_step_s > 0:
+            block["divergence_ratio"] = round(
+                predicted_s / float(measured_step_s), 6
+            )
+            from horovod_tpu import metrics as _metrics
+
+            if _metrics.ACTIVE:
+                _metrics.TAP.set(
+                    "hvd_sim_divergence_ratio",
+                    block["divergence_ratio"], hop="step",
+                )
+        else:
+            block["divergence_ratio"] = 0.0
+            block["note"] = (
+                "no calibration applied — prediction uses coarse "
+                "generation defaults; fit real constants with "
+                "tools/fleet_sim.py --calibrate (docs/simulation.md "
+                "'Calibration workflow') and pass --calibration / "
+                "HOROVOD_CALIBRATION_FILE"
+            )
+        return block
+    except Exception as e:  # noqa: BLE001 - advisory block only
+        return {"error": repr(e)}
 
 
 def _init_backend_with_retry(max_tries=4, base_sleep=15.0):
@@ -773,6 +856,12 @@ def run_lm_benchmark(args) -> int:
                 "hvd_straggler_total on the driver's /metrics)",
     }
 
+    measured_step_s = float(np.mean(iter_times)) / steps_per_iter
+    sim_block = _sim_block(
+        args, params, mesh, n_chips, measured_step_s,
+        quantized_eff=quantized_eff, tuned_kw=tuned_kw,
+    )
+
     print(json.dumps({
         "metric": "transformer_synthetic_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -815,6 +904,7 @@ def run_lm_benchmark(args) -> int:
                 } if args.zero1 else {}),
             },
             "step_skew": step_skew,
+            "sim": sim_block,
             "scan": bool(args.scan),
             "mfu": mfu,
             "flops_per_step_per_chip": (

@@ -135,6 +135,15 @@ HOROVOD_QUANTIZED_WIRE = "HOROVOD_QUANTIZED_WIRE"
 # pinned knobs IF the live step's signature matches; a mismatch warns
 # loudly and runs untuned. horovod_tpu/tune reads this directly.
 HOROVOD_TUNED_FILE = "HOROVOD_TUNED_FILE"
+# Fleet-simulation calibration (docs/simulation.md): path to a
+# ``calibration.json`` fitted by ``tools/fleet_sim.py --calibrate`` from
+# merged trace data. The simulator, the tuner's cost objectives
+# (``tune(calibration=...)``), and bench's sim block read it when their
+# ``calibration`` argument is left unset and apply the per-hop constants
+# IF the interconnect-model signature (hop ladder) matches; a mismatch
+# warns loudly and runs on generation defaults. sim/calibrate.py reads
+# this directly.
+HOROVOD_CALIBRATION_FILE = "HOROVOD_CALIBRATION_FILE"
 # Fleet tracing (docs/timeline.md "Fleet tracing"; horovod_tpu/trace
 # reads these directly, like the fault/metrics/guard knobs):
 # HOROVOD_TRACE arms the span ring + step tap + KV shipping;
@@ -299,6 +308,7 @@ class Config:
     xla_perf_preset: str = "auto"
     # Compiled-path pinned tuning file ("" = untuned; docs/autotune.md).
     tuned_file: str = ""
+    calibration_file: str = ""
     cycle_time_ms: float = 5.0
     cache_capacity: int = 1024
     cache_enabled: bool = True
@@ -352,6 +362,9 @@ class Config:
             os.environ.get(HOROVOD_XLA_PERF_PRESET, "") or cfg.xla_perf_preset
         )
         cfg.tuned_file = os.environ.get(HOROVOD_TUNED_FILE, cfg.tuned_file)
+        cfg.calibration_file = os.environ.get(
+            HOROVOD_CALIBRATION_FILE, cfg.calibration_file
+        )
         # Reference accepts cycle time in ms as float via HOROVOD_CYCLE_TIME.
         cfg.cycle_time_ms = _get_float(HOROVOD_CYCLE_TIME, cfg.cycle_time_ms)
         cfg.cache_capacity = _get_int(HOROVOD_CACHE_CAPACITY, cfg.cache_capacity)

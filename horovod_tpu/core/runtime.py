@@ -1002,12 +1002,19 @@ class Runtime:
         if _trace.ACTIVE:
             # Fleet-trace span for the fused response (the eager path's
             # step → plan → collective link; the native core's analogue
-            # carries the hvd_plan_<id> correlation id).
+            # carries the hvd_plan_<id> correlation id). nbytes rides
+            # the span so `trace_merge.py --stats` can hand the
+            # calibrator (sim/calibrate.py) per-collective
+            # (bytes, duration) samples off a real trace.
             _dur = time.perf_counter() - exec_t0
             _trace.TAP.event(
                 "hvd_response", ph="X", cat="op",
                 ts=time.time() - _dur, dur=_dur,
                 op=op_label, tensors=len(entries),
+                nbytes=sum(
+                    int(getattr(e.tensor, "nbytes", 0) or 0)
+                    for e in entries
+                ),
                 ok=bool(status.ok()),
             )
         if self.timeline.initialized:

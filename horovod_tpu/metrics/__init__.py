@@ -113,6 +113,11 @@ _CATALOG: Dict[str, str] = {
     "hvd_tuned_info": "Compiled-path tuned source (value is always 1; "
                       "source=arg/file/env/none, signature hash, "
                       "matched, where in labels)",
+    # Fleet simulation (docs/simulation.md).
+    "hvd_sim_divergence_ratio": "Replay-mode modeled-over-measured time "
+                                "per interconnect hop (hop='step' = "
+                                "whole-step scope); drift from 1 means "
+                                "the cost model is mispricing links",
     # Topology-aware collective compositor (docs/topology.md).
     "hvd_topo_plan_info": "Selected compositor lowering plan (value is "
                           "always 1; collective/algorithm/op/where in "

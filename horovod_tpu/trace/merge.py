@@ -284,6 +284,110 @@ def merge_postmortem(dumps: Dict[int, dict],
     return out
 
 
+# Schema of the --stats summary (the fleet-sim calibrator's input
+# contract, sim/calibrate.py). Bump on any shape change.
+STATS_SCHEMA_VERSION = 1
+
+# Span names that count as per-collective timing samples: the eager
+# runtime's fused-response spans, the native runtime's plan spans
+# (both carry payload bytes), and the simulator's hop-labeled stage
+# spans (exact bytes/rounds per hop).
+_COLLECTIVE_SPAN_PREFIXES = (
+    "hvd_response", "hvd_plan", "hvd_collective_stage",
+)
+
+
+def _round9(v: float) -> float:
+    return round(float(v), 9)
+
+
+def stats_summary(ranks: Dict[int, dict],
+                  driver: Optional[dict] = None) -> dict:
+    """Machine-readable per-rank, per-stage timing summary of a trace
+    directory — the calibrator's input contract (``sim/calibrate.py``).
+    Pure data reduction: identical inputs give identical output bytes
+    (floats rounded, keys sorted by the CLI's serializer), so two
+    ``--stats`` passes over one trace diff clean."""
+    out: Dict[str, Any] = {
+        "schema_version": STATS_SCHEMA_VERSION,
+        "world_size": len(ranks),
+        "ranks": {},
+    }
+    for r in sorted(ranks):
+        doc = ranks[r]
+        steps = [
+            [int(s[0]), _round9(s[1]), _round9(s[2])]
+            for s in (doc.get("steps") or [])
+            if isinstance(s, (list, tuple)) and len(s) >= 3
+        ]
+        durs = sorted(t1 - t0 for _, t0, t1 in steps)
+        gaps = sorted(
+            steps[i + 1][1] - steps[i][2] for i in range(len(steps) - 1)
+        )
+
+        def pct(xs, p):
+            if not xs:
+                return 0.0
+            return _round9(xs[min(int(p * (len(xs) - 1)), len(xs) - 1)])
+
+        collectives = []
+        for ev in doc.get("events") or []:
+            name = str(ev.get("name", ""))
+            if not name.startswith(_COLLECTIVE_SPAN_PREFIXES):
+                continue
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            args = ev.get("args") or {}
+            entry: Dict[str, Any] = {
+                "name": name,
+                "ts": _round9(ev.get("ts", 0.0)),
+                "dur_s": _round9(ev["dur"]),
+            }
+            nbytes = args.get("nbytes", args.get("bytes"))
+            if nbytes is not None:
+                entry["nbytes"] = int(nbytes)
+            for k in ("op", "hop", "rounds", "wire_dtype", "group",
+                      "plan"):
+                if k in args:
+                    entry[k] = args[k]
+            collectives.append(entry)
+        collectives.sort(key=lambda e: (e["ts"], e["name"]))
+        out["ranks"][str(r)] = {
+            "step_count": len(steps),
+            "steps": steps,
+            "step_p50_s": pct(durs, 0.50),
+            "step_p99_s": pct(durs, 0.99),
+            "gap_p50_s": pct(gaps, 0.50),
+            "plan": doc.get("plan") or {},
+            "clock": doc.get("clock") or {},
+            "collectives": collectives,
+            "events_total": len(doc.get("events") or []),
+        }
+    if driver is not None:
+        plans = []
+        for ev in driver.get("events") or []:
+            if ev.get("name") == "hvd_sim_plan":
+                plans.append(dict(ev.get("args") or {}))
+        out["driver"] = {
+            "events_total": len(driver.get("events") or []),
+            "plans": sorted(
+                plans, key=lambda p: int(p.get("group", 0))
+            ),
+        }
+    return out
+
+
+def write_stats(path: str, stats: dict) -> None:
+    """Stable serialization for the --stats artifact (same discipline
+    as :func:`write_trace`)."""
+    from ..utils.checkpoint import _atomic_write
+
+    payload = json.dumps(
+        stats, sort_keys=True, separators=(",", ":")
+    ).encode()
+    _atomic_write(path, lambda f: f.write(payload))
+
+
 def write_trace(path: str, doc: dict) -> None:
     """Stable serialization (sorted keys, fixed separators) so identical
     inputs give identical bytes."""

@@ -58,6 +58,28 @@ def _bottleneck_hop(model):
     return min(model.hops, key=lambda h: h.bandwidth_gbps)
 
 
+def calibrated_model(model, calibration, where: str = "tune"):
+    """Resolve a ``calibration`` argument (Calibration / path / dict /
+    None = ``HOROVOD_CALIBRATION_FILE``) and apply it to ``model`` with
+    the hop-ladder staleness discipline (``sim/calibrate.py``: a stale
+    signature warns loudly and keeps generation defaults). Returns
+    ``(model, info)`` where ``info`` records what was applied — the
+    provenance block ``tuned.json`` carries so a tuning pinned on
+    measured constants says so."""
+    from ..sim.calibrate import apply_calibration, resolve_calibration
+
+    calib = resolve_calibration(calibration)
+    if calib is None:
+        return model, {"applied": False, "source": "generation-defaults"}
+    patched = apply_calibration(model, calib, where=where)
+    return patched, {
+        "applied": patched is not model,
+        "source": "calibration.json",
+        "signature": calib.signature_hash,
+        "stale": patched is model,
+    }
+
+
 def plan_for_bucket(model, nbytes: int, config: Dict,
                     op: ReduceOp = ReduceOp.AVERAGE,
                     collective: str = "allreduce"):
@@ -88,7 +110,8 @@ def plan_for_bucket(model, nbytes: int, config: Dict,
 
 def free_objectives(spec: ProgramSpec, config: Dict, model,
                     op: ReduceOp = ReduceOp.AVERAGE,
-                    zero1: bool = False) -> Dict:
+                    zero1: bool = False,
+                    calibration=None) -> Dict:
     """Score ``config`` on ``spec`` over ``model`` with the two free
     cost models. Returns a plain dict (stable key order for the
     tuned.json record) whose ``score`` the GP maximizes.
@@ -98,11 +121,23 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
     backward staircase like the allreduce) plus the parameter
     all-gather of the 1/N shard (full precision — parameters; priced
     fully exposed, a conservative stand-in for next-forward overlap).
-    This is what lets ``tuned.json`` stop exempting the zero1 mode."""
+    This is what lets ``tuned.json`` stop exempting the zero1 mode.
+
+    ``calibration`` (a ``calibration.json`` path, a
+    ``sim.calibrate.Calibration``, or None = the
+    ``HOROVOD_CALIBRATION_FILE`` knob) prices hops with MEASURED
+    alpha-beta constants instead of generation defaults — the FlexLink
+    discipline applied to the tuner's objective. A stale hop-ladder
+    signature falls back loudly (``calibration.stale`` in the output)."""
     import math as _math
 
     from ..ops.fusion import plan_layer_groups
 
+    calib_info = None
+    if calibration is not None:
+        model, calib_info = calibrated_model(
+            model, calibration, where="free_objectives"
+        )
     layer_bytes = spec.layer_bytes
     total = max(spec.total_bytes, 1)
     groups = plan_layer_groups(
@@ -160,6 +195,7 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
     if zero1:
         return {
             "zero1": True,
+            **({"calibration": calib_info} if calib_info else {}),
             "n_groups": len(groups),
             "cost_us": round(cost_us, 4),
             "exposed_us": round(exposed_us, 4),
@@ -170,6 +206,7 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
             "score": round(-exposed_us, 6),
         }
     return {
+        **({"calibration": calib_info} if calib_info else {}),
         "n_groups": len(groups),
         "cost_us": round(cost_us, 4),
         "exposed_us": round(exposed_us, 4),
@@ -186,15 +223,23 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
 
 def group_plans(spec: ProgramSpec, config: Dict, model,
                 op: ReduceOp = ReduceOp.AVERAGE,
-                zero1: bool = False) -> List:
+                zero1: bool = False,
+                calibration=None) -> List:
     """The concrete compositor plans ``config`` pins for every stream
     group — the artifacts the symbolic verifier checks before the tuner
     is allowed to emit them. ``zero1=True`` yields the RS and AG plan
-    for each group (interleaved, reduction order)."""
+    for each group (interleaved, reduction order). ``calibration``
+    follows :func:`free_objectives` (calibrated constants can flip a
+    cost-selected algorithm, so the verified plans must come from the
+    same model the objective priced)."""
     import math as _math
 
     from ..ops.fusion import plan_layer_groups
 
+    if calibration is not None:
+        model, _ = calibrated_model(
+            model, calibration, where="group_plans"
+        )
     layer_bytes = spec.layer_bytes
     groups = plan_layer_groups(
         layer_bytes,

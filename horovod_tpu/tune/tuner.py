@@ -131,6 +131,7 @@ def tune(
     rounds_fn: Optional[Callable] = None,
     verify: bool = True,
     zero1: bool = False,
+    calibration=None,
 ) -> TunedConfig:
     """Search the joint compiled-path space for ``spec`` on ``model``.
 
@@ -147,7 +148,22 @@ def tune(
     admissible topology choices, and the emitted RS/AG plans are the
     ones symbolically verified before pinning — this is what lets
     ``tuned.json`` stop exempting ``--zero1``.
+
+    ``calibration`` (a ``calibration.json`` path / ``Calibration`` /
+    None = the ``HOROVOD_CALIBRATION_FILE`` knob) prices the whole
+    search — objectives, emitted plans, and the model recorded in
+    ``tuned.json`` — with measured per-hop constants
+    (``sim/calibrate.py``); a stale hop-ladder signature warns loudly
+    and the search runs on generation defaults, recorded as such in
+    ``search.calibration``.
     """
+    from .objective import calibrated_model
+
+    calib_info = {"applied": False, "source": "generation-defaults"}
+    if calibration is not None:
+        model, calib_info = calibrated_model(
+            model, calibration, where="tune"
+        )
     space = space or space_for_model(model, allow_int8=allow_int8,
                                      zero1=zero1)
     grid = space.candidate_grid()
@@ -270,6 +286,7 @@ def tune(
             "seed": int(seed),
             "objective": "measured" if measure_fn is not None else "free",
             "zero1": bool(zero1),
+            "calibration": calib_info,
             "space": {
                 "topo_choices": list(space.topo_choices),
                 "allow_int8": bool(space.allow_int8),

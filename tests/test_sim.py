@@ -1,0 +1,486 @@
+"""Calibrated fleet simulator (horovod_tpu/sim; docs/simulation.md).
+
+Covers the sim core's determinism and physics, the seeded-fault lane
+semantics, the calibration fit/staleness discipline, and the replay
+divergence loop on a synthetic trace with known constants. Everything
+here is backend-free — no jax import, no mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.fault.plan import FaultPlan
+from horovod_tpu.sim import (
+    Calibration,
+    SimConfig,
+    SimGroup,
+    SimProgram,
+    apply_calibration,
+    divergence_report,
+    fit_calibration,
+    load_calibration,
+    measured_from_stats,
+    model_signature,
+    program_from_layers,
+    save_calibration,
+    simulate,
+    straggler_sensitivity,
+)
+from horovod_tpu.topo.model import Hop, InterconnectModel, synthetic_model
+from horovod_tpu.trace import merge as tmerge
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _program(payload=1 << 20, groups=3, compute_us=500.0):
+    # Distinct group sizes: the calibration fit needs linearly
+    # independent (bytes, rounds) samples per hop.
+    return SimProgram(
+        name="t",
+        groups=tuple(
+            SimGroup(name=f"g{i}", nbytes=payload // (2 ** i),
+                     compute_us=compute_us / groups)
+            for i in range(groups)
+        ),
+        forward_us=200.0,
+        optimizer_us=50.0,
+    )
+
+
+def _exact_model(local=2, cross=0, bw=10.0, lat=0.0):
+    """A model with zero latency so costs are pure bandwidth terms —
+    the known-constants fixture the replay test inverts exactly."""
+    hops = []
+    if cross > 1:
+        hops.append(Hop("dcn", "cross", cross, bw / 4, lat))
+    hops.append(Hop("ici", "local", local, bw, lat))
+    return InterconnectModel(
+        hops=tuple(hops), generation="generic",
+        eligible=len(hops) > 1, source="test",
+    )
+
+
+# ------------------------------------------------------------ sim core
+
+
+def test_seed_determinism_byte_identical():
+    plan = FaultPlan.from_json(json.dumps({
+        "seed": 7,
+        "faults": [{"kind": "delay", "rank": 3, "site": "step",
+                    "seconds": 0.001, "frac": 0.5}],
+    }))
+    model = synthetic_model(8, cross=4)
+    prog = _program()
+    docs = []
+    for _ in range(2):
+        res = simulate(model, prog, SimConfig(), steps=5,
+                       fault_plan=plan, seed=7)
+        docs.append(json.dumps(
+            {"report": res.to_report(),
+             "windows": res.windows(max_ranks=8)},
+            sort_keys=True,
+        ))
+    assert docs[0] == docs[1]
+
+
+def test_two_runs_cli_byte_identical(tmp_path):
+    outs = []
+    for tag in ("a", "b"):
+        out = tmp_path / f"r{tag}.json"
+        rc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "fleet_sim.py"),
+             "--ranks", "256", "1024", "--program", "mlp3",
+             "--steps", "2", "-o", str(out)],
+            cwd=REPO, capture_output=True,
+        )
+        assert rc.returncode == 0, rc.stderr.decode()
+        outs.append(out.read_bytes())
+    assert outs[0] == outs[1]
+
+
+def test_scaling_efficiency_monotone_vs_payload():
+    """Fixed compute, growing payload ⇒ more wire to hide ⇒ scaling
+    efficiency non-increasing (and eventually strictly dropping)."""
+    model = synthetic_model(8, cross=32)  # 256 ranks
+    effs = []
+    for payload in (1 << 18, 1 << 20, 1 << 22, 1 << 24):
+        prog = SimProgram(
+            name="t",
+            groups=(SimGroup("g0", payload, 500.0),),
+            forward_us=200.0, optimizer_us=50.0,
+        )
+        effs.append(
+            simulate(model, prog, steps=3).scaling_efficiency
+        )
+    assert all(a >= b for a, b in zip(effs, effs[1:])), effs
+    assert effs[0] > effs[-1], effs
+
+
+def test_efficiency_drops_with_rank_count():
+    prog = _program(payload=8 << 20)
+    effs = [
+        simulate(
+            synthetic_model(8, cross=n // 8), prog, steps=2
+        ).scaling_efficiency
+        for n in (256, 1024, 4096)
+    ]
+    assert effs[0] > effs[1] > effs[2], effs
+
+
+def test_two_level_beats_flat_at_1024():
+    """The claim the CI gate rides: at 1024 simulated ranks the
+    hierarchical lowering strictly beats flat through the simulator."""
+    model = synthetic_model(8, cross=128)
+    prog = _program(payload=16 << 20)
+    flat = simulate(model, prog, SimConfig(algorithm="flat"), steps=2)
+    two = simulate(model, prog, SimConfig(algorithm="two-level"),
+                   steps=2)
+    assert two.mean_step_us < flat.mean_step_us, (
+        two.mean_step_us, flat.mean_step_us,
+    )
+
+
+def test_delay_fault_shifts_exactly_the_faulted_lane():
+    plan = FaultPlan.from_json(json.dumps({
+        "seed": 3,
+        "faults": [{"kind": "delay", "rank": 1, "site": "step",
+                    "seconds": 0.002, "at_step": 2}],
+    }))
+    model = synthetic_model(4)
+    prog = _program()
+    base = simulate(model, prog, steps=3)
+    faulted = simulate(model, prog, steps=3, fault_plan=plan)
+
+    wb = base.windows(max_ranks=4)
+    wf = faulted.windows(max_ranks=4)
+    # The delay instant appears on rank 1's lane only.
+    def fault_events(doc):
+        return [e for e in doc["events"] if e["name"] == "fault:delay"]
+
+    assert len(fault_events(wf[1])) == 1
+    for r in (0, 2, 3):
+        assert not fault_events(wf[r])
+    ev = fault_events(wf[1])[0]
+    assert ev["args"] == {"step": 1, "delay_us": 2000.0}
+    # Only rank 1's COMPUTE spans stretch (its first backward segment
+    # of step 2 carries the 2000us); every other rank's compute
+    # durations are unchanged from the fault-free run.
+    def durs(doc):
+        return [
+            round(e["dur"] * 1e6, 4) for e in doc["events"]
+            if e["cat"] == "phase"
+        ]
+
+    for r in (0, 2, 3):
+        assert durs(wf[r]) == durs(wb[r])
+    d_base, d_fault = durs(wb[1]), durs(wf[1])
+    diffs = [round(f - b, 4) for b, f in zip(d_base, d_fault)]
+    stretched = [d for d in diffs if d > 0]
+    assert stretched == [2000.0], diffs
+    # The fleet pays for it: the faulted step is longer fleet-wide.
+    assert faulted.step_times_us[1] > base.step_times_us[1]
+
+
+def test_straggler_sensitivity_bounds():
+    model = synthetic_model(8, cross=4)
+    s = straggler_sensitivity(model, _program(), probe_delay_us=500.0)
+    assert 0.0 <= s <= 1.5, s
+
+
+def test_unsupported_fault_kinds_warn(caplog):
+    plan = FaultPlan.from_json(json.dumps({
+        "seed": 1,
+        "faults": [{"kind": "kill", "rank": 0, "at_step": 1}],
+    }))
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu.sim"):
+        simulate(synthetic_model(2), _program(), steps=2,
+                 fault_plan=plan)
+    assert any("unsupported kind" in r.message for r in caplog.records)
+
+
+def test_zero1_adds_allgather_stages():
+    model = synthetic_model(8)
+    res = simulate(model, _program(), SimConfig(zero1=True), steps=1)
+    prims = {s.primitive for s in res.stage_spans}
+    assert any(p.endswith(":ag") for p in prims), prims
+    assert any("reduce_scatter" in p for p in prims), prims
+
+
+def test_program_from_layers_matches_stream_partition():
+    from horovod_tpu.ops.fusion import layer_group_bytes
+
+    layers = [3 << 20, 1 << 20, 2 << 20, 512]
+    prog = program_from_layers(
+        "p", layers, fusion_threshold_bytes=4 << 20,
+        first_bucket_bytes=1 << 20,
+    )
+    assert [g.nbytes for g in prog.groups] == layer_group_bytes(
+        layers, 4 << 20, 1 << 20
+    )
+
+
+# ---------------------------------------------------------- calibration
+
+
+def test_calibration_fit_recovers_known_constants(tmp_path):
+    """End-to-end on a synthetic trace with known constants: simulate →
+    render windows → --stats → fit → the fitted alpha-beta equals the
+    model that generated the trace (the sim's stage spans are exact
+    alpha-beta samples)."""
+    model = synthetic_model(4, cross=2)  # generic: ici 50/2, dcn 5/100
+    res = simulate(model, _program(payload=4 << 20), steps=3)
+    stats = tmerge.stats_summary(res.windows(max_ranks=8))
+    calib = fit_calibration(stats, model)
+    for h in model.hops:
+        entry = calib.hops[h.name]
+        assert entry["calibrated"], calib.hops
+        assert entry["bandwidth_gbps"] == pytest.approx(
+            h.bandwidth_gbps, rel=1e-3
+        )
+        assert entry["latency_us"] == pytest.approx(
+            h.latency_us, abs=1e-2
+        )
+    # Round trip through disk.
+    p = tmp_path / "calibration.json"
+    save_calibration(calib, str(p))
+    again = load_calibration(str(p))
+    assert again.to_json() == calib.to_json()
+    # And the fit itself is deterministic.
+    assert fit_calibration(stats, model).to_json() == calib.to_json()
+
+
+def test_calibration_staleness_fallback(caplog):
+    flat = synthetic_model(8)                 # ladder [ici]
+    two = synthetic_model(8, cross=4)         # ladder [dcn, ici]
+    calib = fit_calibration(
+        tmerge.stats_summary(
+            simulate(two, _program(), steps=2).windows()
+        ),
+        two,
+    )
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu.sim"):
+        out = apply_calibration(flat, calib, where="test")
+    assert out is flat  # unchanged — never silently applied
+    assert any(
+        "FALLING BACK" in r.message for r in caplog.records
+    ), [r.message for r in caplog.records]
+    with pytest.raises(ValueError):
+        apply_calibration(flat, calib, strict=True)
+
+
+def test_calibration_transfers_across_sizes():
+    """Per-link constants fitted at 8 ranks price the same ladder at
+    4096 — the whole point of keying on hop NAMES, not sizes."""
+    small = synthetic_model(4, cross=2)
+    calib = fit_calibration(
+        tmerge.stats_summary(
+            simulate(small, _program(), steps=2).windows()
+        ),
+        small,
+    )
+    big = synthetic_model(8, cross=512)
+    out = apply_calibration(big, calib, where="test")
+    assert out is not big and out.source.endswith("+calibrated")
+    assert model_signature(small)["hash"] == model_signature(big)["hash"]
+
+
+def test_calibration_uncovered_hop_keeps_defaults():
+    model = synthetic_model(4, cross=2)
+    stats = {
+        "schema_version": 1, "world_size": 2,
+        "ranks": {"0": {"steps": [], "collectives": [
+            {"name": "hvd_collective_stage:x", "ts": 0.0,
+             "dur_s": 0.001, "nbytes": 50000, "rounds": 1,
+             "hop": "ici"},
+            {"name": "hvd_collective_stage:x", "ts": 0.1,
+             "dur_s": 0.002, "nbytes": 100000, "rounds": 2,
+             "hop": "ici"},
+        ]}},
+    }
+    calib = fit_calibration(stats, model)
+    assert calib.hops["ici"]["calibrated"]
+    assert not calib.hops["dcn"]["calibrated"]
+    assert calib.hops["dcn"]["bandwidth_gbps"] == pytest.approx(
+        model.hop("dcn").bandwidth_gbps
+    )
+
+
+# --------------------------------------------------------------- replay
+
+
+def _replay_divergence(gen_model, replay_model, tmp_path, tag):
+    """Simulate under ``gen_model``, render a trace dir, replay via the
+    CLI under ``replay_model``'s constants, return the report."""
+    prog = _program(payload=2 << 20, groups=2)
+    res = simulate(gen_model, prog, SimConfig(algorithm="ring"),
+                   steps=3)
+    tdir = tmp_path / f"trace_{tag}"
+    tdir.mkdir()
+    for r, doc in res.windows(max_ranks=4).items():
+        (tdir / f"rank.{r}.json").write_text(
+            json.dumps(doc, sort_keys=True)
+        )
+    (tdir / "driver.json").write_text(
+        json.dumps(res.driver_window(), sort_keys=True)
+    )
+    stats = tmerge.stats_summary(*tmerge.read_dir(str(tdir)))
+    measured = measured_from_stats(stats, replay_model)
+    replayed = simulate(
+        replay_model,
+        SimProgram(
+            name="replay",
+            groups=prog.groups,
+            forward_us=0.0, optimizer_us=0.0,
+        ),
+        SimConfig(algorithm="ring"),
+        steps=3,
+    )
+    return divergence_report(
+        replayed.per_hop_busy_us(), measured["per_hop_us"],
+        modeled_step_us=replayed.mean_step_us,
+        measured_step_us=measured["step_us"],
+    )
+
+
+def test_replay_divergence_known_constants(tmp_path):
+    """Replay against the SAME constants that generated the trace ⇒
+    per-hop ratio 1; against half the bandwidth (zero latency) ⇒ the
+    model predicts exactly 2x the observed hop time."""
+    truth = _exact_model(local=4, bw=10.0)
+    same = _replay_divergence(truth, truth, tmp_path, "same")
+    # rel 1e-4: the --stats contract rounds span durations to 9
+    # decimal seconds, which is the only error source left.
+    assert same["per_hop"]["ici"]["ratio"] == pytest.approx(1.0, rel=1e-4)
+
+    slow = _exact_model(local=4, bw=5.0)  # model thinks links are 2x slower
+    drift = _replay_divergence(truth, slow, tmp_path, "drift")
+    assert drift["per_hop"]["ici"]["ratio"] == pytest.approx(2.0, rel=1e-4)
+
+
+def test_replay_cli_over_simulated_trace(tmp_path):
+    tdir = tmp_path / "t"
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_sim.py"),
+         "--ranks", "8", "--local", "4", "--program", "mlp3",
+         "--steps", "2", "--trace-out", str(tdir),
+         "-o", str(tmp_path / "r.json")],
+        cwd=REPO, capture_output=True,
+    )
+    assert rc.returncode == 0, rc.stderr.decode()
+    out = tmp_path / "replay.json"
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_sim.py"),
+         "--replay", str(tdir), "--local", "4", "-o", str(out)],
+        cwd=REPO, capture_output=True,
+    )
+    assert rc.returncode == 0, rc.stderr.decode()
+    doc = json.loads(out.read_text())
+    ratios = {
+        h: v["ratio"] for h, v in doc["divergence"]["per_hop"].items()
+    }
+    assert set(ratios) == {"dcn", "ici"}
+    for h, r in ratios.items():
+        assert r == pytest.approx(1.0, rel=1e-3), ratios
+
+
+def test_divergence_report_metrics_gauge():
+    from horovod_tpu import metrics as _metrics
+
+    _metrics.install(True)
+    try:
+        divergence_report(
+            {"ici": 100.0}, {"ici": 50.0},
+            modeled_step_us=200.0, measured_step_us=100.0,
+        )
+        fam = _metrics.snapshot().get("hvd_sim_divergence_ratio")
+        assert fam is not None and fam["type"] == "gauge"
+        vals = {
+            s["labels"].get("hop"): s["value"] for s in fam["series"]
+        }
+        assert vals.get("ici") == pytest.approx(2.0)
+        assert vals.get("step") == pytest.approx(2.0)
+    finally:
+        _metrics.reset()
+
+
+def test_divergence_honest_null_without_measurement():
+    rep = divergence_report({"dcn": 10.0}, {})
+    assert rep["per_hop"]["dcn"]["ratio"] is None
+    assert rep["step"]["ratio"] is None
+
+
+# ----------------------------------------------------- stats contract
+
+
+def test_stats_summary_byte_stable_and_versioned():
+    res = simulate(synthetic_model(4), _program(), steps=2)
+    windows = res.windows()
+    a = json.dumps(tmerge.stats_summary(windows), sort_keys=True)
+    b = json.dumps(tmerge.stats_summary(windows), sort_keys=True)
+    assert a == b
+    doc = json.loads(a)
+    assert doc["schema_version"] == tmerge.STATS_SCHEMA_VERSION
+    assert doc["world_size"] == 4
+    r0 = doc["ranks"]["0"]
+    assert r0["step_count"] == 2
+    assert r0["collectives"], "rank 0 must carry the stage samples"
+    sample = r0["collectives"][0]
+    assert {"name", "ts", "dur_s", "nbytes", "hop", "rounds"} <= set(
+        sample
+    )
+
+
+# ------------------------------------------------- tuner calibration
+
+
+def test_tune_objective_accepts_calibration(tmp_path):
+    """Satellite: free_objectives/tune accept a calibration.json; a
+    calibrated (slower-DCN) model raises the modeled cost, and the
+    provenance lands in tuned.json's search block."""
+    from horovod_tpu.tune import ProgramSpec, free_objectives, tune
+
+    model = synthetic_model(4, cross=2)
+    calib = Calibration(
+        signature=model_signature(model),
+        hops={"dcn": {"calibrated": True, "latency_us": 100.0,
+                      "bandwidth_gbps": model.hop(
+                          "dcn").bandwidth_gbps / 10.0}},
+    )
+    path = tmp_path / "calibration.json"
+    save_calibration(calib, str(path))
+    spec = ProgramSpec(
+        name="t", layers=(("l0", 4 << 20), ("l1", 4 << 20)),
+        signature={"hash": "x"},
+    )
+    config = {
+        "fusion_threshold_bytes": 64 << 20,
+        "first_bucket_bytes": 1 << 20,
+        "topo_algorithm": "flat",
+        "wire_dtype": "f32",
+    }
+    base = free_objectives(spec, config, model)
+    cal = free_objectives(spec, config, model, calibration=str(path))
+    assert cal["calibration"]["applied"] is True
+    assert cal["cost_us"] > base["cost_us"]
+
+    cfg = tune(spec, model, samples=4, calibration=str(path))
+    assert cfg.search["calibration"]["applied"] is True
+    assert cfg.search["calibration"]["signature"] == calib.signature_hash
+
+    # Stale calibration: loud fallback, recorded as such.
+    stale = Calibration(
+        signature=model_signature(synthetic_model(8)),  # [ici] ladder
+        hops={},
+    )
+    stale_path = tmp_path / "stale.json"
+    save_calibration(stale, str(stale_path))
+    cfg2 = tune(spec, model, samples=4, calibration=str(stale_path))
+    assert cfg2.search["calibration"]["applied"] is False
+    assert cfg2.search["calibration"]["stale"] is True

@@ -225,6 +225,13 @@ def main() -> int:
                          "reachable backend (free objectives still "
                          "recorded)")
     ap.add_argument("--measure-reps", type=int, default=5)
+    ap.add_argument("--calibration", default=None,
+                    help="price the search with measured per-hop "
+                         "constants from a calibration.json fitted by "
+                         "tools/fleet_sim.py --calibrate "
+                         "(docs/simulation.md); a stale hop-ladder "
+                         "signature warns loudly and the search runs "
+                         "on generation defaults")
     ap.add_argument("--zero1", action="store_true",
                     help="tune the streamed-ZeRO-1 reduction shape: "
                          "groups priced as per-bucket reduce-scatter + "
@@ -266,6 +273,7 @@ def main() -> int:
             spec, model,
             samples=args.samples, seed=args.seed, space=space,
             measure_fn=measure_fn, zero1=args.zero1,
+            calibration=args.calibration,
         )
     except T.TuneVerificationError as e:
         print(f"[autotune] {e}", file=sys.stderr)
@@ -280,6 +288,7 @@ def main() -> int:
     print(json.dumps({
         "program": spec.name,
         "zero1": bool(args.zero1),
+        "calibration": cfg.search.get("calibration"),
         "out": args.out,
         "signature": cfg.signature_hash,
         "samples": cfg.search["samples"],

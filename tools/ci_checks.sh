@@ -72,6 +72,15 @@
 # byte-identical across two runs (docs/overlap.md "Streamed ZeRO-1").
 # Budget: under 15s.
 #
+# Stage 12 (make sim-smoke; skip with HVD_CI_SKIP_SIM=1): the fleet-
+# simulator smoke — two tools/fleet_sim.py predict runs over
+# 256/1024/4096 simulated ranks byte-identical, the "two-level beats
+# flat at scale" claim asserted THROUGH the simulator at 1024 ranks, a
+# calibration fitted from a known-constants simulated trace recovering
+# those constants with replay divergence ~1, and a real 2-rank traced
+# run replayed (`--replay`) with finite, bounded per-hop divergence
+# ratios (docs/simulation.md). Budget: under 60s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -157,4 +166,11 @@ if [ "${HVD_CI_SKIP_ZERO:-0}" != "1" ]; then
     python tools/zero_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: zero smoke streamed==posthoc+sharded+byte-stable in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_SIM:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/sim_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: sim smoke deterministic+scale-gated+calibrated+replayed in ${elapsed}s"
 fi

@@ -44,6 +44,12 @@ def main(argv=None) -> int:
     ap.add_argument("--window", type=float, default=None, metavar="S",
                     help="postmortem: trim each lane to the final S "
                          "seconds before its own death")
+    ap.add_argument("--stats", action="store_true",
+                    help="emit the machine-readable per-rank/per-stage "
+                         "timing summary (byte-stable, versioned via "
+                         "schema_version; the fleet-sim calibrator's "
+                         "input contract, docs/simulation.md) instead "
+                         "of a merged trace")
     args = ap.parse_args(argv)
 
     from horovod_tpu.trace import merge as tmerge
@@ -83,6 +89,24 @@ def main(argv=None) -> int:
             "--output-dir?)", file=sys.stderr,
         )
         return 1
+
+    if args.stats:
+        stats = tmerge.stats_summary(ranks, driver)
+        out = args.output or os.path.join(
+            args.trace_dir, "trace_stats.json"
+        )
+        tmerge.write_stats(out, stats)
+        n_coll = sum(
+            len(stats["ranks"][r]["collectives"])
+            for r in stats["ranks"]
+        )
+        print(
+            f"trace_merge: stats over {len(ranks)} rank(s) "
+            f"(schema_version {stats['schema_version']}, "
+            f"{n_coll} collective samples) -> {out}"
+        )
+        return 0
+
     doc = tmerge.merge_windows(ranks, driver)
     out = args.output or os.path.join(args.trace_dir, "merged_trace.json")
     tmerge.write_trace(out, doc)
