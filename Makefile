@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -93,4 +93,15 @@ zero-smoke:
 sim-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/sim_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke test
+# Self-driving-fleet smoke (docs/fault_tolerance.md "Self-driving
+# fleet"): two seeded chronic-delay runs on 2 ranks + 1 hot spare —
+# slowness quarantine fires, the spare promotes in the re-formation
+# bump, a drift-triggered re-plan publishes and every rank adopts,
+# training converges bitwise to the uninterrupted run — with the
+# normalized decision logs byte-identical across runs and the
+# re-planned config's simulated step time strictly below the
+# incumbent's on the drifted calibration, ~45s CPU.
+selfdrive-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/selfdrive_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke test

@@ -51,6 +51,18 @@ def init(config: Optional[Config] = None) -> None:
     ``horovod/common/basics.py:33-65``): detect topology, start the
     background loop, and stand up the data plane."""
     global _runtime
+    import os as _os_mod
+
+    if _os_mod.environ.get("HOROVOD_ELASTIC_SPARE") == "1":
+        # Hot-spare gate (docs/fault_tolerance.md "Self-driving
+        # fleet"): a spare worker parks HERE — before any backend or
+        # topology detection — until a published world generation
+        # claims its slot; promotion applies the assignment env and
+        # falls through into a normal init. Deliberately outside the
+        # lock: the wait can last the whole job.
+        from .elastic import maybe_wait_as_spare
+
+        maybe_wait_as_spare()
     with _lock:
         if _runtime is not None and _runtime.running:
             return

@@ -157,6 +157,34 @@ HOROVOD_TRACE_DIR = "HOROVOD_TRACE_DIR"
 HOROVOD_TRACE_RING_EVENTS = "HOROVOD_TRACE_RING_EVENTS"
 HOROVOD_TRACE_PUSH_INTERVAL_S = "HOROVOD_TRACE_PUSH_INTERVAL_S"
 HOROVOD_TRACE_STRAGGLER_THRESHOLD_S = "HOROVOD_TRACE_STRAGGLER_THRESHOLD_S"
+# Self-driving fleet (docs/fault_tolerance.md "Self-driving fleet";
+# run/selfdrive.py reads these directly, like the trace knobs):
+# HOROVOD_QUARANTINE_STRIKES arms the slowness quarantine — a rank
+# charged the last finisher for that many of the last
+# HOROVOD_QUARANTINE_WINDOW observed steps (default 2x strikes) gets its
+# host quarantined with the blacklist cooldown/decay/relapse-doubling
+# machinery on an independent reason="slow" ledger
+# (HOROVOD_QUARANTINE_COOLDOWN_S, default = the blacklist cooldown;
+# 0 = permanent). HOROVOD_REPLAN_DIVERGENCE arms the live re-plan: when
+# the calibrated per-hop constants (HOROVOD_CALIBRATION_FILE) drift from
+# the generation defaults beyond this |ratio-1| threshold, the driver
+# re-prices the tuner's free objectives, verifies the winning plans
+# symbolically, and publishes a commit-boundary re-plan notice (checked
+# every HOROVOD_REPLAN_CHECK_S seconds; HOROVOD_REPLAN_SPEC optionally
+# pins the program priced). HOROVOD_SPARES keeps that many hot-spare
+# workers parked at the spare gate (hvdrun --spares wins). All unset =
+# the control loop is off, driver behavior unchanged.
+HOROVOD_QUARANTINE_STRIKES = "HOROVOD_QUARANTINE_STRIKES"
+HOROVOD_QUARANTINE_WINDOW = "HOROVOD_QUARANTINE_WINDOW"
+HOROVOD_QUARANTINE_COOLDOWN_S = "HOROVOD_QUARANTINE_COOLDOWN_S"
+HOROVOD_REPLAN_DIVERGENCE = "HOROVOD_REPLAN_DIVERGENCE"
+# HOROVOD_REPLAN_SKEW_S is the second trigger: a SUSTAINED mean
+# cross-rank step skew (StepSkewTracker trend over the recent window)
+# above this many seconds also re-plans, once per generation.
+HOROVOD_REPLAN_SKEW_S = "HOROVOD_REPLAN_SKEW_S"
+HOROVOD_REPLAN_CHECK_S = "HOROVOD_REPLAN_CHECK_S"
+HOROVOD_REPLAN_SPEC = "HOROVOD_REPLAN_SPEC"
+HOROVOD_SPARES = "HOROVOD_SPARES"
 
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64

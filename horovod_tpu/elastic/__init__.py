@@ -63,7 +63,10 @@ __all__ = [
     "TensorFlowState",
     "TensorFlowKerasState",
     "HostsUpdatedInterrupt",
+    "PlanUpdatedInterrupt",
     "PreemptionInterrupt",
+    "adopted_replan",
+    "adopted_step_kwargs",
 ]
 
 
@@ -71,6 +74,24 @@ class HostsUpdatedInterrupt(Exception):
     """Raised inside the training function when the driver published a new
     world generation (host added/removed). The in-memory state is kept;
     ``run`` re-rendezvouses and re-syncs it."""
+
+
+class PlanUpdatedInterrupt(Exception):
+    """Raised inside the training function — on EVERY rank, at the same
+    commit boundary (the adoption rides the host-check agreement
+    allreduce) — when the driver published a live re-plan notice
+    (docs/fault_tolerance.md "Self-driving fleet"). The world is
+    unchanged: no rollback, no re-rendezvous; ``run`` re-enters the
+    training function so it rebuilds its step from
+    :func:`adopted_step_kwargs` (a ``make_train_step`` rebuilt from
+    ``tune.tuned_step_kwargs`` — never a mid-step knob flip)."""
+
+    def __init__(self, notice: Dict[str, Any]):
+        self.notice = dict(notice)
+        super().__init__(
+            f"live re-plan #{notice.get('id')} adopted: "
+            f"{notice.get('config')}"
+        )
 
 
 # --------------------------------------------------------------- context
@@ -114,6 +135,14 @@ class _ElasticContext:
         except ValueError:
             self.lost_threshold = 3
         self._parks = 0
+        # Live re-plan bookkeeping (docs/fault_tolerance.md
+        # "Self-driving fleet"): the last ADOPTED notice id, the last
+        # EXAMINED id (so a rejected stale notice is not re-litigated
+        # every commit), and the validated doc awaiting the commit-
+        # boundary agreement.
+        self.replan_id = 0
+        self._replan_seen = 0
+        self._pending_replan: Optional[Dict[str, Any]] = None
 
     def fetch_world(self, strict: bool = False) -> Optional[Dict[str, Any]]:
         raw = self._kv.get("elastic", "world", strict=strict)
@@ -128,6 +157,89 @@ class _ElasticContext:
         if raw is None:
             return None
         return json.loads(raw.decode())
+
+    def fetch_replan(self, strict: bool = False) -> Optional[Dict[str, Any]]:
+        """The driver's live re-plan notice, if one is published."""
+        raw = self._kv.get("elastic", "replan", strict=strict)
+        if raw is None:
+            return None
+        doc = json.loads(raw.decode())
+        return doc if isinstance(doc, dict) else None
+
+    def check_replan(self) -> bool:
+        """Examine the published re-plan notice (one KV read per
+        commit). A fresh, valid notice is stashed for the commit-
+        boundary agreement; a STALE one — epoch below this worker's
+        fencing baseline (a fenced driver's plans are as untrustworthy
+        as its worlds) or a generation that is not the current one — is
+        rejected loudly, exactly once per notice id. Returns True while
+        a validated notice awaits adoption."""
+        try:
+            doc = self.fetch_replan()
+        except Exception:  # noqa: BLE001 - driver briefly unreachable
+            return self._pending_replan is not None
+        if not doc:
+            return self._pending_replan is not None
+        try:
+            nid = int(doc.get("id", 0))
+            epoch = int(doc.get("epoch", 0) or 0)
+            gen = int(doc.get("gen", -1))
+        except (TypeError, ValueError):
+            return self._pending_replan is not None
+        if nid <= self.replan_id or nid <= self._replan_seen:
+            return self._pending_replan is not None
+        if gen > self.gen:
+            # Stamped for a generation this worker has not joined yet
+            # (the driver re-stamps notices across re-formations): not
+            # stale, just early — leave it unexamined; it becomes
+            # adoptable right after the rejoin commits the new gen.
+            return self._pending_replan is not None
+        reason = None
+        if epoch < self.epoch:
+            reason = "stale-epoch"
+        elif gen < self.gen:
+            reason = "stale-generation"
+        if reason is not None:
+            self._replan_seen = nid
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_replan_rejected_total",
+                                 reason=reason)
+            logger.error(
+                "elastic: rejecting re-plan notice #%s (%s: notice "
+                "epoch %s / gen %s vs acknowledged epoch %s / current "
+                "gen %s)", nid, reason, epoch, gen, self.epoch, self.gen,
+            )
+            return self._pending_replan is not None
+        self._replan_seen = nid
+        self._pending_replan = doc
+        return True
+
+    def take_pending_replan(self) -> Dict[str, Any]:
+        """The notice to adopt after the fleet AGREED at a commit
+        boundary. A rank whose own KV read raced the publish (it got
+        the agreement bit from a peer) re-fetches here; if the notice
+        is unreachable the adoption cannot be completed consistently
+        and the caller degrades to the rollback path."""
+        doc = self._pending_replan
+        if doc is None:
+            for _ in range(3):
+                try:
+                    doc = self.fetch_replan(strict=True)
+                except Exception:  # noqa: BLE001 - retried below
+                    doc = None
+                if doc:
+                    break
+                time.sleep(0.2)
+        if doc is None:
+            import horovod_tpu as hvd
+
+            raise hvd.HorovodInternalError(
+                "elastic: the fleet agreed to adopt a re-plan notice "
+                "this rank cannot fetch; rolling back to stay consistent"
+            )
+        self._pending_replan = None
+        self.replan_id = max(self.replan_id, int(doc.get("id", 0)))
+        return doc
 
     def probe_driver(self):
         """One strict probe of the control plane for the park loop:
@@ -438,6 +550,195 @@ def _park_and_reattach(ctx: _ElasticContext, state=None) -> None:
         f"elastic: no current driver within {ctx.timeout:g}s of parking "
         f"(last known generation {ctx.gen}, epoch {ctx.epoch})"
     )
+
+
+# ------------------------------------------------------- live re-plan
+_adopted_replan: Optional[Dict[str, Any]] = None
+
+
+def _adopt_replan(ctx: _ElasticContext) -> None:
+    """Commit-boundary re-plan adoption, after the fleet AGREED via the
+    host-check allreduce: record the notice, then interrupt the training
+    function so it rebuilds its step — a generation-style state
+    transition (state kept, no rollback, no re-rendezvous), never a
+    mid-step knob flip."""
+    global _adopted_replan
+    doc = ctx.take_pending_replan()
+    _adopted_replan = doc
+    if _metrics.ACTIVE:
+        _metrics.TAP.inc("hvd_replan_adoptions_total")
+    if _trace.ACTIVE:
+        _trace.TAP.event(
+            "hvd_replan_adopt", cat="elastic",
+            id=int(doc.get("id", 0)), gen=ctx.gen,
+        )
+        # The new plan invalidates the noted correlation ids; the
+        # rebuilt step re-notes its own.
+        _trace.TAP.note_plan(
+            topo_algorithm=doc.get("config", {}).get("topo_algorithm"),
+            wire_dtype=doc.get("config", {}).get("wire_dtype"),
+        )
+    if _fault_injector.ACTIVE:
+        _fault_injector.record_event(
+            "driver", int(doc.get("id", 0)), "replan-adopt",
+            f"id={doc.get('id')}",
+        )
+    logger.warning(
+        "elastic: adopting live re-plan #%s at the commit boundary "
+        "(%s); rebuilding the train step", doc.get("id"),
+        doc.get("config"),
+    )
+    raise PlanUpdatedInterrupt(doc)
+
+
+def adopted_replan() -> Optional[Dict[str, Any]]:
+    """The last live re-plan notice this worker adopted (None before
+    any). Plain data: ``{"id", "gen", "epoch", "trigger", "config",
+    "modeled", ...}``."""
+    return dict(_adopted_replan) if _adopted_replan else None
+
+
+def adopted_step_kwargs() -> Optional[Dict[str, Any]]:
+    """The ``make_train_step`` knob values the adopted re-plan maps to,
+    via the SAME ``tune.tuned_step_kwargs`` translation a pinned
+    ``tuned.json`` uses — so a re-planned step is bitwise-identical to
+    the same knobs passed by hand. None before any adoption; training
+    loops splat it when (re)building their step:
+
+    .. code-block:: python
+
+        kwargs = hvd.elastic.adopted_step_kwargs() or {}
+        step = hvd.make_train_step(loss_fn, opt, **kwargs)
+    """
+    if _adopted_replan is None:
+        return None
+    from ..tune import TunedConfig, tuned_step_kwargs
+
+    cfg = TunedConfig(
+        knobs=dict(_adopted_replan.get("config") or {}),
+        signature={}, objectives={}, baseline={},
+        program="live-replan",
+    )
+    return tuned_step_kwargs(cfg)
+
+
+# --------------------------------------------------------- hot spares
+SPARE_POLL_S = 0.5
+
+
+def maybe_wait_as_spare() -> bool:
+    """The spare gate (docs/fault_tolerance.md "Self-driving fleet"):
+    a worker spawned with ``HOROVOD_ELASTIC_SPARE=1`` holds HERE —
+    before any backend or rank plumbing exists — heartbeating
+    ``spare.<wid>`` on the KV plane until the driver's EXPLICIT
+    ``promote.<wid>`` signal names a generation whose published world
+    assigns this worker id. (The world doc alone is not enough: in
+    respawn mode the first publish after a membership change is only
+    the drain notification — joining it would wedge the spare on a
+    doomed generation's endpoints.) Promotion applies the assignment
+    env exactly like a re-rendezvous and returns True; ``hvd.init()``
+    then proceeds as a normal member of that generation (the driver
+    counted one generation bump, not a respawn).
+
+    Exit conditions: the driver stops answering for the elastic timeout
+    (fleet gone → exit 0), or a NEWER driver epoch appears (a resumed
+    driver respawns its own spares; a stale pool must not race it for
+    slots → exit 0)."""
+    if os.environ.get("HOROVOD_ELASTIC_SPARE") != "1":
+        return False
+    from ..run.http_server import KVStoreClient
+
+    wid = os.environ["HOROVOD_ELASTIC_WORKER_ID"]
+    addr = os.environ["HOROVOD_ELASTIC_KV_ADDR"]
+    port = int(os.environ["HOROVOD_ELASTIC_KV_PORT"])
+    try:
+        spawn_epoch = int(os.environ.get("HOROVOD_DRIVER_EPOCH", "0") or 0)
+    except ValueError:
+        spawn_epoch = 0
+    try:
+        timeout = float(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+    except ValueError:
+        timeout = 600.0
+    kv = KVStoreClient(addr, port)
+    logger.warning(
+        "elastic: spare %s parked at the spare gate (awaiting "
+        "promotion)", wid,
+    )
+    beat = 0
+    last_seen = time.monotonic()
+    while True:
+        world = driver = None
+        promote_gen = None
+        try:
+            raw = kv.get("elastic", "world")
+            world = json.loads(raw.decode()) if raw else None
+            raw = kv.get("elastic", "driver")
+            driver = json.loads(raw.decode()) if raw else None
+            raw = kv.get("elastic", f"promote.{wid}")
+            if raw:
+                promote_gen = int(raw.decode())
+        except Exception:  # noqa: BLE001 - driver briefly unreachable
+            pass
+        if driver is not None:
+            last_seen = time.monotonic()
+            try:
+                epoch = int(driver.get("epoch", 0) or 0)
+            except (TypeError, ValueError):
+                epoch = 0
+            if spawn_epoch and epoch > spawn_epoch:
+                logger.warning(
+                    "elastic: spare %s superseded (driver epoch %s > "
+                    "spawn epoch %s); exiting — the resumed driver "
+                    "spawns its own pool", wid, epoch, spawn_epoch,
+                )
+                sys.exit(0)
+        elif time.monotonic() - last_seen > timeout:
+            logger.warning(
+                "elastic: spare %s saw no driver for %gs; exiting",
+                wid, timeout,
+            )
+            sys.exit(0)
+        assignments = (world or {}).get("assignments") or {}
+        if (promote_gen is not None and wid in assignments
+                and int((world or {}).get("gen", -1)) == promote_gen):
+            a = assignments[wid]
+            os.environ.update({
+                "HOROVOD_RANK": str(a["rank"]),
+                "HOROVOD_SIZE": str(world["size"]),
+                "HOROVOD_LOCAL_RANK": str(a["local_rank"]),
+                "HOROVOD_LOCAL_SIZE": str(a["local_size"]),
+                "HOROVOD_CROSS_RANK": str(a["cross_rank"]),
+                "HOROVOD_CROSS_SIZE": str(a["cross_size"]),
+                "HOROVOD_CONTROLLER_ADDR": world["controller_addr"],
+                "HOROVOD_CONTROLLER_PORT": str(world["controller_port"]),
+                "HOROVOD_JAX_COORDINATOR": world["jax_coordinator"],
+                "HOROVOD_ELASTIC_GEN": str(world["gen"]),
+                "HOROVOD_ELASTIC_SYNC_ROOT": str(
+                    world.get("sync_root", 0)
+                ),
+                "HOROVOD_DRIVER_EPOCH": str(
+                    world.get("epoch", spawn_epoch)
+                ),
+            })
+            os.environ.pop("HOROVOD_ELASTIC_SPARE", None)
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_spare_activations_total")
+            if _fault_injector.ACTIVE:
+                _fault_injector.record_event(
+                    "driver", int(world["gen"]), "spare-adopt",
+                    f"worker={wid}",
+                )
+            logger.warning(
+                "elastic: spare %s promoted into generation %s as rank "
+                "%s", wid, world["gen"], a["rank"],
+            )
+            return True
+        beat += 1
+        try:
+            kv.put("elastic", f"spare.{wid}", str(beat).encode())
+        except Exception:  # noqa: BLE001 - advisory heartbeat
+            pass
+        time.sleep(SPARE_POLL_S)
 
 
 def _jax_distributed_initialize(coord: str, num: int, pid: int) -> None:
@@ -835,6 +1136,15 @@ def _rejoin(ctx: _ElasticContext) -> None:
         try:
             hvd.init()
             ctx.gen = int(world["gen"])  # committed only on success
+            if _trace.ACTIVE:
+                # Ranks are renumbered in the new generation: restart
+                # the step ledger so the driver's skew attribution never
+                # compares step indices across a resize (a removed rank
+                # must not be charged for a stranger's steps).
+                _trace.TAP.reset_steps()
+            # A re-plan notice is generation-scoped; whatever was
+            # pending died with the old world.
+            ctx._pending_replan = None
             # A resumed driver supervising adopted workers has no
             # process handle on this rank: the attach signal (stamped
             # with the generation + acknowledged epoch) is how it learns
@@ -1002,9 +1312,13 @@ class State:
     # The decision ladder only acts on the strongest signal present, so
     # Max losing weaker bits is harmless — and unlike a weighted Sum the
     # scheme is rank-count independent (no overflow band to outgrow).
-    _UPDATED_BIT = 1
-    _PREEMPT_BIT = 2
-    _LOST_BIT = 4
+    # Ordered by severity: a pending re-plan is the WEAKEST signal (a
+    # membership change, preemption, or driver loss each makes the
+    # notice moot — the next generation re-plans on fresh evidence).
+    _REPLAN_BIT = 1
+    _UPDATED_BIT = 2
+    _PREEMPT_BIT = 4
+    _LOST_BIT = 8
 
     def check_host_updates(self) -> None:
         """Raise ``HostsUpdatedInterrupt`` on EVERY rank when any rank has
@@ -1029,12 +1343,14 @@ class State:
 
         preempted = _preemption.preemption_requested()
         updated, lost, new_epoch = ctx.commit_probe()
+        replan = ctx.check_replan()
         if new_epoch is not None and not (lost or updated or preempted):
             ctx.reattach(new_epoch)
         flag = np.asarray(
             [(self._LOST_BIT if lost else 0)
              | (self._PREEMPT_BIT if preempted else 0)
-             | (self._UPDATED_BIT if updated else 0)],
+             | (self._UPDATED_BIT if updated else 0)
+             | (self._REPLAN_BIT if replan else 0)],
             np.int32,
         )
         if hvd.size() > 1:
@@ -1054,10 +1370,12 @@ class State:
                 "a peer rank received a preemption notice; re-forming "
                 "the world"
             )
-        if agreed > 0:
+        if agreed >= self._UPDATED_BIT:
             raise HostsUpdatedInterrupt(
                 "host membership changed; re-forming the world"
             )
+        if agreed >= self._REPLAN_BIT:
+            _adopt_replan(ctx)
 
     # subclass responsibilities
     def save(self) -> None:
@@ -1492,6 +1810,18 @@ def run(func: Callable) -> Callable:
                     # resurrect into an unrelated later job on this slot.
                     _clear_persisted()
                 return result
+            except PlanUpdatedInterrupt as exc:
+                # A live re-plan is NOT a membership change: the world
+                # (and the committed state) is intact, so no rollback,
+                # no re-rendezvous, no reset callbacks — re-enter the
+                # training function so it rebuilds its step from
+                # adopted_step_kwargs(). The loop-top sync() keeps the
+                # re-entry collective (every rank adopted at the same
+                # commit boundary).
+                logger.warning(
+                    "elastic: %s; re-entering the training function", exc
+                )
+                continue
             except HostsUpdatedInterrupt:
                 if _metrics.ACTIVE:
                     _metrics.TAP.inc("hvd_elastic_host_interrupts_total")

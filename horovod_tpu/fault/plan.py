@@ -65,6 +65,15 @@ Action fields
     at that count (kill/preempt), ``after``+``count`` bound a window
     (delay/drop/duplicate), ``frac`` makes the action probabilistic inside
     its window.
+``every`` / ``until``
+    Chronic-slowness shape (``delay`` only): ``every`` fires the action
+    on every N-th in-window hit (``every: 1`` = every hit — a persistent
+    straggler; ``every: 3`` = periodic hiccups), ``until`` bounds the
+    window by an absolute hit count (an alternative to ``count``, which
+    is relative to ``after``). Both validated at parse time; the seeded
+    decision stream advances only on firing hits, so the recurring form
+    is exactly as byte-reproducible as the single-shot one, and the
+    fleet simulator (``sim/core.py``) draws the same schedule.
 ``seconds`` / ``exit_code`` / ``after_s``
     Parameters: delay duration, kill exit status, and (driver-side
     preempt) seconds after spawn at which the driver delivers the
@@ -136,6 +145,8 @@ class FaultAction:
     at_step: Optional[int] = None
     after: int = 0
     count: Optional[int] = None
+    every: Optional[int] = None    # delay: fire on every N-th in-window hit
+    until: Optional[int] = None    # delay: absolute last hit of the window
     frac: float = 1.0
     seconds: float = 0.0
     exit_code: int = 43
@@ -167,6 +178,25 @@ class FaultAction:
                 f"({'/'.join(DRIVER_KINDS)}) execute only at the "
                 "'driver' site (the elastic driver's supervision loop)"
             )
+        every = None if d.get("every") is None else int(d["every"])
+        until = None if d.get("until") is None else int(d["until"])
+        if (every is not None or until is not None) and kind != "delay":
+            raise ValueError(
+                f"fault plan action {index}: every/until describe the "
+                f"chronic-slowness shape and apply only to 'delay' "
+                f"actions, not {kind!r}"
+            )
+        if every is not None and every < 1:
+            raise ValueError(
+                f"fault plan action {index}: every must be >= 1 "
+                f"(got {every})"
+            )
+        after = int(d.get("after", 0))
+        if until is not None and until <= after:
+            raise ValueError(
+                f"fault plan action {index}: until ({until}) must be "
+                f"> after ({after}) — the window would be empty"
+            )
         return FaultAction(
             kind=kind,
             site=site,
@@ -176,8 +206,10 @@ class FaultAction:
             at_step=(
                 None if d.get("at_step") is None else int(d["at_step"])
             ),
-            after=int(d.get("after", 0)),
+            after=after,
             count=None if d.get("count") is None else int(d["count"]),
+            every=every,
+            until=until,
             frac=float(d.get("frac", 1.0)),
             seconds=float(d.get("seconds", 0.0)),
             exit_code=int(d.get(
@@ -198,8 +230,8 @@ class FaultAction:
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"kind": self.kind, "site": self.site}
-        for k in ("rank", "worker", "gen", "at_step", "count", "after_s",
-                  "element", "bit", "tensor", "epoch"):
+        for k in ("rank", "worker", "gen", "at_step", "count", "every",
+                  "until", "after_s", "element", "bit", "tensor", "epoch"):
             v = getattr(self, k)
             if v is not None:
                 out[k] = v
@@ -231,12 +263,20 @@ class FaultAction:
         return True
 
     def in_window(self, hit: int) -> bool:
-        """Window test over the site's 1-based hit counter."""
+        """Window test over the site's 1-based hit counter. ``every``
+        makes a hit in-window only on the action's period (the decision
+        stream advances only on in-window hits, so the chronic form
+        stays byte-reproducible), ``until`` closes the window at an
+        absolute hit count."""
         if self.at_step is not None:
             return hit == self.at_step
         if hit <= self.after:
             return False
+        if self.until is not None and hit > self.until:
+            return False
         if self.count is not None and hit > self.after + self.count:
+            return False
+        if self.every is not None and (hit - self.after - 1) % self.every:
             return False
         return True
 

@@ -37,6 +37,7 @@ import signal
 
 from . import journal as _journal_mod
 from . import launcher, safe_shell_exec
+from . import selfdrive as _selfdrive
 from .. import metrics as _metrics
 from .. import trace as _trace
 from ..fault import injector as _fault
@@ -156,6 +157,7 @@ class ElasticDriver:
         probed_hostset: Optional[List[str]] = None,
         blacklist_cooldown: Optional[float] = None,
         resume: bool = False,
+        spares: Optional[int] = None,
     ) -> None:
         if not hosts and not discovery_script:
             raise ValueError(
@@ -327,9 +329,15 @@ class ElasticDriver:
         # Quarantine ledger (upstream's blacklist never forgives; here a
         # host that recovers is re-admitted): host -> readmit deadline
         # (None = permanent, when cooldown == 0). Each re-blacklisting of
-        # the same host doubles its quarantine.
+        # the same host doubles its quarantine. ``_blacklist_reason``
+        # distinguishes WHY a host is out ("dead" = worker failures,
+        # "slow" = the StragglerPolicy's slowness quarantine), and the
+        # two strike ledgers decay independently: a host that crashes is
+        # not presumed slow, and vice versa.
         self._blacklist: Dict[str, Optional[float]] = {}
+        self._blacklist_reason: Dict[str, str] = {}
         self._quarantine_strikes: Dict[str, int] = {}
+        self._slow_strikes: Dict[str, int] = {}
         if blacklist_cooldown is None:
             try:
                 blacklist_cooldown = float(
@@ -338,6 +346,47 @@ class ElasticDriver:
             except ValueError:
                 blacklist_cooldown = 300.0
         self._blacklist_cooldown = blacklist_cooldown
+        try:
+            self._quarantine_cooldown = float(
+                self._env.get(_selfdrive.QUARANTINE_COOLDOWN_ENV, "")
+                or blacklist_cooldown
+            )
+        except ValueError:
+            self._quarantine_cooldown = blacklist_cooldown
+        # --- self-driving fleet (docs/fault_tolerance.md "Self-driving
+        # fleet"): the slowness-quarantine policy over straggler charges,
+        # the live re-plan coordinator, and the hot-spare pool. All three
+        # are opt-in (HOROVOD_QUARANTINE_STRIKES / HOROVOD_REPLAN_*
+        # unset and --spares 0 keep the driver exactly as before).
+        self._policy = _selfdrive.StragglerPolicy.from_env(self._env)
+        self._replan_divergence = _selfdrive._env_float(
+            self._env, _selfdrive.REPLAN_DIVERGENCE_ENV, 0.0
+        )
+        self._replan_skew_s = _selfdrive._env_float(
+            self._env, _selfdrive.REPLAN_SKEW_ENV, 0.0
+        )
+        self._replan_check_s = max(_selfdrive._env_float(
+            self._env, _selfdrive.REPLAN_CHECK_ENV, 5.0
+        ), 0.5)
+        self._last_replan_check = 0.0
+        self._replan_doc: Optional[Dict] = None
+        self._replan_calib_hash: Optional[str] = None
+        # Recent per-step cross-rank skews for the trend trigger; one
+        # skew-trend re-plan per generation (the deque clears on every
+        # publish — fresh world, fresh evidence).
+        from collections import deque as _deque
+
+        self._skew_trend: "_deque[float]" = _deque(
+            maxlen=max(self._policy.window, 8)
+        )
+        self._skew_replanned = False
+        if spares is None:
+            spares = _selfdrive._env_int(
+                self._env, _selfdrive.SPARES_ENV, 0
+            )
+        self._spares_want = max(int(spares), 0)
+        self._spares: Dict[str, _Worker] = {}
+        self._spare_slots: Dict[str, SlotInfo] = {}
         if self._resume:
             # Quarantines journaled as wall-clock deadlines + remaining
             # budget come back onto THIS process's monotonic clock,
@@ -347,13 +396,37 @@ class ElasticDriver:
             self._blacklist = _journal_mod.blacklist_from_journal(
                 prior.get("blacklist") or {}
             )
+            self._blacklist_reason = {
+                h: str(r)
+                for h, r in (prior.get("blacklist_reasons") or {}).items()
+                if h in self._blacklist
+            }
             self._quarantine_strikes = {
                 h: int(n) for h, n in (prior.get("strikes") or {}).items()
             }
+            self._slow_strikes = {
+                h: int(n)
+                for h, n in (prior.get("slow_strikes") or {}).items()
+            }
+            self._replan_doc = prior.get("replan") or None
+            if self._replan_doc:
+                self._replan_calib_hash = self._replan_doc.get("calib")
             self._failures = {
                 h: int(n) for h, n in (prior.get("failures") or {}).items()
             }
             self._seed_kv(prior)
+            if self._replan_doc:
+                # The journaled notice survives the resume, but workers
+                # reject any epoch below their fencing baseline — which
+                # just rose to THIS incarnation's. Refresh the stamp
+                # (same id: already-adopted workers keep their config,
+                # not-yet-adopted ones accept now).
+                self._replan_doc = dict(self._replan_doc)
+                self._replan_doc["epoch"] = self._epoch
+                self._kv.put(
+                    "elastic", "replan",
+                    json.dumps(self._replan_doc, sort_keys=True).encode(),
+                )
         self._finishing = False
         # Respawn mode: a world restart is queued behind the drain pool.
         self._restart_pending = False
@@ -459,9 +532,10 @@ class ElasticDriver:
             _metrics.TAP.inc("hvd_trace_collections_total")
         if self._skew is not None:
             for idx, skew, worst in self._skew.update(windows):
+                charged = skew >= self._skew.threshold_s
                 if _metrics.ACTIVE:
                     _metrics.TAP.observe("hvd_step_skew_seconds", skew)
-                if skew >= self._skew.threshold_s:
+                if charged:
                     if _metrics.ACTIVE:
                         _metrics.TAP.inc(
                             "hvd_straggler_total", rank=str(worst)
@@ -470,6 +544,13 @@ class ElasticDriver:
                         "hvd_straggler", step=idx, rank=worst,
                         skew_s=round(skew, 6),
                     )
+                # Feed the self-driving quarantine policy: every emitted
+                # step (charged or not) advances its sliding window, so
+                # a rank that recovers decays out. The same emission
+                # feeds the re-plan skew-trend window.
+                if self._policy.enabled:
+                    self._policy.observe(idx, skew, worst, charged)
+                self._skew_trend.append(skew)
         try:
             data = json.dumps(
                 _trace.TAP.window(), sort_keys=True
@@ -583,7 +664,11 @@ class ElasticDriver:
             current_ids=list(self._current_ids),
             kv=kv_snap,
             blacklist=_journal_mod.blacklist_to_journal(self._blacklist),
+            blacklist_reasons=dict(self._blacklist_reason),
             strikes=dict(self._quarantine_strikes),
+            slow_strikes=dict(self._slow_strikes),
+            replan=self._replan_doc,
+            spare_ids=sorted(self._spares),
             failures=dict(self._failures),
         )
         self._last_journaled_kv = kv_snap
@@ -859,12 +944,31 @@ class ElasticDriver:
         self._quarantine_strikes = {
             h: int(n) for h, n in (prior.get("strikes") or {}).items()
         }
+        self._slow_strikes = {
+            h: int(n) for h, n in (prior.get("slow_strikes") or {}).items()
+        }
+        self._blacklist_reason = {
+            h: str(r)
+            for h, r in (prior.get("blacklist_reasons") or {}).items()
+            if h in self._blacklist
+        }
+        self._replan_doc = prior.get("replan") or None
+        if self._replan_doc:
+            self._replan_calib_hash = self._replan_doc.get("calib")
         self._failures = {
             h: int(n) for h, n in (prior.get("failures") or {}).items()
         }
         self._kv = KVStoreServer(port=port, reclaim_wait_s=10.0)
         self._kv.start()
         self._seed_kv(prior)
+        if self._replan_doc:
+            # Same epoch refresh as a real --resume (see __init__).
+            self._replan_doc = dict(self._replan_doc)
+            self._replan_doc["epoch"] = self._epoch
+            self._kv.put(
+                "elastic", "replan",
+                json.dumps(self._replan_doc, sort_keys=True).encode(),
+            )
         world = prior.get("world")
         if world:
             world = dict(world)
@@ -909,6 +1013,7 @@ class ElasticDriver:
         for host, deadline in list(self._blacklist.items()):
             if deadline is not None and now >= deadline:
                 del self._blacklist[host]
+                reason = self._blacklist_reason.pop(host, "dead")
                 self._failures.pop(host, None)
                 self._last_failure.pop(host, None)
                 changed = True
@@ -916,9 +1021,13 @@ class ElasticDriver:
                     _metrics.TAP.inc(
                         "hvd_elastic_readmissions_total", host=host
                     )
+                strikes = (
+                    self._slow_strikes if reason == "slow"
+                    else self._quarantine_strikes
+                )
                 self._log(
-                    f"re-admitting host {host} after quarantine "
-                    f"(strike {self._quarantine_strikes.get(host, 1)})"
+                    f"re-admitting host {host} after {reason} quarantine "
+                    f"(strike {strikes.get(host, 1)})"
                 )
         if changed:
             self._journal_sync(force=True)
@@ -945,9 +1054,11 @@ class ElasticDriver:
     def _blacklist_host(self, host: str) -> None:
         strikes = self._quarantine_strikes.get(host, 0) + 1
         self._quarantine_strikes[host] = strikes
+        self._blacklist_reason[host] = "dead"
         self._trace_event("hvd_blacklist", host=host, strikes=strikes)
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_elastic_blacklists_total", host=host)
+            _metrics.TAP.inc("hvd_quarantine_total", reason="dead")
         if self._blacklist_cooldown > 0:
             quarantine = self._blacklist_cooldown * (2 ** (strikes - 1))
             self._blacklist[host] = time.monotonic() + quarantine
@@ -959,6 +1070,254 @@ class ElasticDriver:
             self._blacklist[host] = None
             self._log(f"blacklisted host {host} (permanently)")
         self._journal_sync(force=True)
+
+    # ---------------------------------------------- self-driving fleet
+    def _quarantine_slow_host(
+        self, decision: "_selfdrive.QuarantineDecision"
+    ) -> None:
+        """Quarantine ``decision.host`` for SLOWNESS: same cooldown/
+        decay/relapse-doubling machinery as the death blacklist, but on
+        the independent ``reason="slow"`` strike ledger — a chronically
+        slow host's sentence doubles per slowness relapse without its
+        crash history compounding it (and vice versa). Write-ahead
+        journaled BEFORE the membership change can publish, so a driver
+        crash between decision and publish resumes into the same
+        verdict."""
+        host = decision.host
+        strikes = self._slow_strikes.get(host, 0) + 1
+        self._slow_strikes[host] = strikes
+        self._blacklist_reason[host] = "slow"
+        self._trace_event(
+            "hvd_quarantine", host=host, rank=decision.rank,
+            strikes=strikes, charges=decision.charges,
+            window=decision.window, reason="slow",
+        )
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_quarantine_total", reason="slow")
+        if self._quarantine_cooldown > 0:
+            quarantine = self._quarantine_cooldown * (2 ** (strikes - 1))
+            self._blacklist[host] = time.monotonic() + quarantine
+            until = f"quarantined for {quarantine:g}s"
+        else:
+            self._blacklist[host] = None
+            until = "quarantined permanently"
+        if _fault.ACTIVE:
+            # Detail carries only run-invariant fields: the charge count
+            # at decision time depends on collection batching, so it
+            # stays out of the byte-diffed event log (it is in the
+            # driver log and the trace event above).
+            _fault.record_event(
+                "driver", strikes, "quarantine",
+                f"host={host} reason=slow",
+            )
+        self._log(
+            f"slowness quarantine: host {host} (rank {decision.rank} "
+            f"charged straggler {decision.charges} of the last "
+            f"{decision.window} steps; slow-strike {strikes}; {until}); "
+            "re-forming the world without it"
+        )
+        self._journal_sync(force=True)  # WAL before the publish below
+
+    def _maybe_quarantine_slow(self) -> bool:
+        """Run the StragglerPolicy against the current world: at most
+        one host per supervision beat, never below --min-np, only ranks
+        of the CURRENT generation (the policy re-keys on every publish).
+        Returns True when membership changed (caller reconciles)."""
+        if not self._policy.enabled or self._adopting:
+            return False
+        world = self._last_world or {}
+        rank_to_host = {
+            int(a["rank"]): wid.rsplit(":", 1)[0]
+            for wid, a in (world.get("assignments") or {}).items()
+        }
+        # The min-world veto counts AVAILABLE capacity (discovery minus
+        # already-blacklisted hosts) — hot spares and unused slots on
+        # healthy hosts are exactly what makes a quarantine affordable.
+        slots_by_host = dict(self._discover())
+        decision = self._policy.decide(
+            rank_to_host, slots_by_host, self._min_np
+        )
+        if decision is None:
+            return False
+        if decision.host in self._blacklist:
+            return False
+        self._quarantine_slow_host(decision)
+        return True
+
+    def _maybe_replan(self) -> None:
+        """Live re-plan check on the supervision beat
+        (docs/fault_tolerance.md "Self-driving fleet"), with two
+        triggers: (a) the calibrated per-hop constants
+        (HOROVOD_CALIBRATION_FILE — the artifact ``fleet_sim.py
+        --calibrate`` fits and ``--replay`` diffs) drift from the
+        generation defaults beyond ``HOROVOD_REPLAN_DIVERGENCE``
+        (one-shot per calibration signature), or (b) the
+        ``StepSkewTracker`` trend — mean cross-rank skew over the
+        recent window — stays above ``HOROVOD_REPLAN_SKEW_S`` (one-shot
+        per generation). Either way the tuner's free objectives are
+        re-priced on the best-available model, every implied plan is
+        verified symbolically, the notice is journaled (WAL) and then
+        published under ``elastic/replan`` for workers to adopt at
+        their next commit boundary."""
+        if self._adopting or (self._replan_divergence <= 0
+                              and self._replan_skew_s <= 0):
+            return
+        now = time.monotonic()
+        if now - self._last_replan_check < self._replan_check_s:
+            return
+        self._last_replan_check = now
+        if not self._last_world:
+            return
+        try:
+            from ..sim.calibrate import resolve_calibration
+
+            calib = resolve_calibration(None)
+        except Exception:  # noqa: BLE001 - a bad file must not kill the loop
+            calib = None
+        model = _selfdrive.model_for_world(self._last_world)
+        trigger = None
+        per_hop: Dict[str, float] = {}
+        drift = 0.0
+        priced_calib = None
+        if (self._replan_divergence > 0 and calib is not None
+                and calib.signature_hash != self._replan_calib_hash):
+            from ..tune.objective import calibrated_model
+
+            drifted, info = calibrated_model(
+                model, calib, where="driver-replan"
+            )
+            if info.get("stale"):
+                # Signature mismatch already warned loudly; don't retry
+                # every beat against the same stale file.
+                self._replan_calib_hash = calib.signature_hash
+            else:
+                ratios = _selfdrive.divergence_ratios(model, drifted)
+                d = _selfdrive.max_divergence(ratios)
+                if d >= self._replan_divergence:
+                    trigger, per_hop, drift = "divergence", ratios, d
+                    priced_calib = calib
+                else:
+                    self._replan_calib_hash = calib.signature_hash
+        if (trigger is None and self._replan_skew_s > 0
+                and not self._skew_replanned):
+            trend = _selfdrive.skew_trend(self._skew_trend)
+            if trend is not None and trend >= self._replan_skew_s:
+                trigger, drift = "skew-trend", trend
+                priced_calib = calib  # best available; None = defaults
+        if trigger is None:
+            return
+        windows = {
+            r: doc for r, doc in self._collected_windows().items()
+        }
+        try:
+            spec = _selfdrive.spec_from_windows(windows)
+        except Exception as exc:  # noqa: BLE001 - malformed override
+            self._log(f"re-plan: unusable program spec ({exc}); skipping")
+            if trigger == "divergence":
+                self._replan_calib_hash = calib.signature_hash
+            else:
+                self._skew_replanned = True
+            return
+        if spec is None:
+            return  # nothing observed to price yet; retry next beat
+        current = dict(
+            (self._replan_doc or {}).get("config") or {}
+        ) or self._current_plan_config(windows)
+        proposal = _selfdrive.propose_replan(
+            spec, model, current, priced_calib,
+            trigger=trigger, per_hop=per_hop, drift=drift,
+        )
+        if trigger == "divergence":
+            self._replan_calib_hash = calib.signature_hash
+        else:
+            self._skew_replanned = True
+        if proposal is None:
+            self._log(
+                f"re-plan ({trigger}, drift {drift:g}): the current "
+                "configuration is already optimal on the observed "
+                "model; keeping it"
+            )
+            return
+        findings = _selfdrive.verify_replan(
+            spec, proposal.config, model, priced_calib
+        )
+        if findings:
+            self._log(
+                f"re-plan REFUSED: {len(findings)} plan-verification "
+                f"finding(s) on the proposed configuration "
+                f"({findings[0].render() if findings else ''})"
+            )
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc(
+                    "hvd_replan_total", trigger="refused-verification"
+                )
+            return
+        notice_id = int((self._replan_doc or {}).get("id", 0)) + 1
+        doc = proposal.to_notice(notice_id, self._gen, self._epoch)
+        doc["calib"] = (
+            priced_calib.signature_hash if priced_calib is not None
+            else None
+        )
+        self._replan_doc = doc
+        self._journal_sync(force=True)  # WAL before workers can see it
+        self._kv.put(
+            "elastic", "replan",
+            json.dumps(doc, sort_keys=True).encode(),
+        )
+        if _metrics.ACTIVE:
+            _metrics.TAP.inc("hvd_replan_total", trigger=proposal.trigger)
+        self._trace_event(
+            "hvd_replan", id=notice_id, trigger=proposal.trigger,
+            drift=round(drift, 6), config=dict(proposal.config),
+        )
+        if _fault.ACTIVE:
+            _fault.record_event(
+                "driver", notice_id, "replan",
+                f"trigger={proposal.trigger} "
+                f"wire={proposal.config['wire_dtype']} "
+                f"topo={proposal.config['topo_algorithm']}",
+            )
+        self._log(
+            f"re-plan #{notice_id} published (trigger "
+            f"{proposal.trigger}, drift {drift:g}): "
+            f"{proposal.current} -> {proposal.config}; modeled exposed "
+            f"{proposal.current_exposed_us:g}us -> "
+            f"{proposal.replanned_exposed_us:g}us"
+        )
+
+    def _collected_windows(self) -> Dict[int, dict]:
+        """Freshest worker trace windows off the KV plane (current
+        generation only — stale-generation windows carry renumbered
+        ranks)."""
+        from ..trace import pusher as _tpush
+
+        out: Dict[int, dict] = {}
+        for key, payload in self._kv.snapshot(_trace.KV_SCOPE).items():
+            if not key.startswith("rank."):
+                continue
+            suffix = key.split(".", 1)[1]
+            if not suffix.isdigit():
+                continue
+            doc = _tpush.decode_window(payload)
+            if doc is None:
+                continue
+            if int(doc.get("gen", 0) or 0) not in (0, self._gen):
+                continue
+            out[int(suffix)] = doc
+        return out
+
+    def _current_plan_config(self, windows: Dict[int, dict]) -> Dict:
+        """The fleet's current lowering knobs as the workers reported
+        them (trace-tap ``note_plan`` correlation ids); absent fields
+        fall back to env/config defaults inside the policy layer."""
+        cfg: Dict = {}
+        for _, doc in sorted(windows.items()):
+            plan = doc.get("plan") or {}
+            for src, dst in (("topo_algorithm", "topo_algorithm"),
+                             ("wire_dtype", "wire_dtype")):
+                if plan.get(src) and dst not in cfg:
+                    cfg[dst] = plan[src]
+        return cfg
 
     def _discover(self) -> List[Tuple[str, int]]:
         self._expire_blacklist()
@@ -1231,13 +1590,50 @@ class ElasticDriver:
                 for s in slots
             },
         }
+        # A live re-plan notice outlives membership changes: it is
+        # RE-STAMPED for the new generation (fresh id, gen, epoch) so a
+        # late joiner — a promoted spare, a respawn — adopts the same
+        # plan the survivors already run; mismatched lowering knobs
+        # across ranks would break the collectives the plan configures.
+        # Survivors re-adopt idempotently (same config).
+        restamped = None
+        if self._replan_doc is not None and int(
+            self._replan_doc.get("gen", -1)
+        ) != self._gen:
+            restamped = dict(self._replan_doc)
+            restamped["id"] = int(restamped.get("id", 0)) + 1
+            restamped["gen"] = self._gen
+            restamped["epoch"] = self._epoch
+            self._replan_doc = restamped
         # Write-ahead: the journal records the generation BEFORE any
         # worker can observe it — a crash between the two replays a
         # state the fleet has not outrun.
         self._last_world = world
         self._journal_sync(force=True)
         self._kv.put("elastic", "world", json.dumps(world).encode())
+        if restamped is not None:
+            self._kv.put(
+                "elastic", "replan",
+                json.dumps(restamped, sort_keys=True).encode(),
+            )
+            if _fault.ACTIVE:
+                _fault.record_event(
+                    "driver", int(restamped["id"]), "replan-restamp",
+                    f"id={restamped['id']} gen={self._gen}",
+                )
         self._publish_driver_doc()
+        # Ranks are renumbered in the new generation: re-key the skew
+        # tracker and the quarantine policy (a parked or removed rank
+        # must never be charged for the new world's steps) and drop the
+        # old generation's pushed trace windows off the KV plane.
+        if self._skew is not None:
+            self._skew.reset_generation(self._gen)
+        self._policy.reset_generation(self._gen)
+        self._skew_trend.clear()
+        self._skew_replanned = False
+        for key in list(self._kv.snapshot(_trace.KV_SCOPE)):
+            if key.startswith("rank."):
+                self._kv.delete(_trace.KV_SCOPE, key)
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_elastic_generations_total")
             _metrics.TAP.set("hvd_elastic_generation", float(self._gen))
@@ -1352,8 +1748,60 @@ class ElasticDriver:
         draining = {w.worker_id for w, _ in self._removing}
         if draining & set(desired_ids):
             return True
+        # Hot-spare promotion (docs/fault_tolerance.md "Self-driving
+        # fleet"): a parked spare whose slot the new world claims joins
+        # IN the same generation bump — one resize instead of a
+        # respawn-from-snapshot. The spare only leaves its gate on the
+        # explicit ``promote.<wid>`` signal (never on the world doc
+        # alone), because in respawn mode the FIRST publish after a
+        # membership change is only the drain NOTIFICATION — survivors
+        # exit 79 and the world re-forms once more. Promotion therefore
+        # defers in respawn mode while old-generation workers are still
+        # live, and lands on the post-drain restart publish instead;
+        # in-process mode promotes immediately (survivors rejoin the
+        # same generation the spare enters). KV hygiene runs BEFORE the
+        # publish so the promoted spare's attach/joined signals are
+        # never clobbered.
+        defer_spares = (
+            self._rejoin_mode == "respawn" and bool(self._workers)
+        )
+        promoted = []
+        if not defer_spares:
+            for wid in desired_ids:
+                w = self._spares.get(wid)
+                if w is None:
+                    continue
+                if w.proc.poll() is None:
+                    for key in ("joined", "rejoin", "attach", "done"):
+                        self._kv.delete("elastic", f"{key}.{wid}")
+                    promoted.append(wid)
+                else:
+                    # Died unnoticed while parked: a fresh spawn takes
+                    # the slot below.
+                    self._reap_spare(wid, w)
         self._maybe_probe_nics(slots)
         endpoints = self._publish(slots)
+        for wid in promoted:
+            self._workers[wid] = self._spares.pop(wid)
+            self._spare_slots.pop(wid, None)
+            self._kv.put(
+                "elastic", f"promote.{wid}", str(self._gen).encode()
+            )
+            if _metrics.ACTIVE:
+                _metrics.TAP.inc("hvd_spare_promotions_total")
+                _metrics.TAP.set(
+                    "hvd_spare_pool_size", float(len(self._spares))
+                )
+            self._trace_event("hvd_spare_promote", worker=wid,
+                              gen=self._gen)
+            if _fault.ACTIVE:
+                _fault.record_event(
+                    "driver", self._gen, "promote", f"worker={wid}"
+                )
+            self._log(
+                f"promoted spare {wid} into generation {self._gen} "
+                "(pre-attached: no respawn)"
+            )
         # Dropped workers drain gracefully: they poll the KV store, see
         # they are not in the new generation, and exit 0 on their own —
         # SIGTERMing them here would break survivors' in-flight
@@ -1368,9 +1816,151 @@ class ElasticDriver:
                 self._log(f"removed {wid} (draining)")
         for wid, slot in desired.items():
             if wid not in self._workers:
+                if wid in self._spares:
+                    # Deferred promotion: the parked spare keeps its
+                    # claimed slot reserved until the post-drain restart
+                    # publish promotes it.
+                    continue
                 self._spawn(slot, endpoints)
         self._current_ids = desired_ids
+        self._reconcile_spares(slots)
         return True
+
+    # ------------------------------------------------------- hot spares
+    def _reconcile_spares(self, world_slots: List[SlotInfo]) -> None:
+        """Keep ``--spares`` workers spawned BEYOND the world: attached
+        to the KV plane and heartbeating, but excluded from the mesh
+        (their elastic context parks them before ``hvd.init`` until a
+        generation claims their slot — ``elastic.maybe_wait_as_spare``).
+        Spare slots are the next slots the allocator would hand out, so
+        the pool shrinks honestly when capacity is tight."""
+        if not self._spares_want and not self._spares:
+            return
+        hosts = self._discover()
+        total = sum(c for _, c in hosts)
+        want = min(self._spares_want, max(total - len(world_slots), 0))
+        spare_slots: List[SlotInfo] = []
+        if want > 0:
+            # allocate() fills hosts in order, so the first
+            # len(world_slots) entries are exactly the world allocation
+            # and the tail is the spare pool.
+            spare_slots = launcher.allocate(
+                hosts, len(world_slots) + want
+            )[len(world_slots):]
+        desired = {self._worker_id(s): s for s in spare_slots}
+        for wid in list(self._spares):
+            if wid not in desired:
+                if wid in self._current_ids:
+                    # The world claimed this spare's slot but promotion
+                    # was deferred (respawn-mode drain in flight): it
+                    # is about to be promoted, not retired.
+                    continue
+                w = self._spares.pop(wid)
+                self._spare_slots.pop(wid, None)
+                if w.proc.poll() is None:
+                    w.proc.terminate()
+                for f in w.outfiles:
+                    f.close()
+                self._log(f"retired spare {wid}")
+        for wid, slot in desired.items():
+            if wid not in self._spares:
+                self._spawn_spare(slot)
+        if _metrics.ACTIVE:
+            _metrics.TAP.set(
+                "hvd_spare_pool_size", float(len(self._spares))
+            )
+        self._journal_sync(force=True)
+
+    def _spawn_spare(self, slot: SlotInfo) -> None:
+        """Spawn one spare: the training command with the elastic KV
+        plumbing but NO rank assignment — ``HOROVOD_ELASTIC_SPARE=1``
+        makes the worker-side elastic context hold it at the spare gate
+        (heartbeating ``spare.<wid>``) until a published world claims
+        its worker id."""
+        wid = self._worker_id(slot)
+        kv_addr = (
+            "127.0.0.1" if _is_local(slot.hostname)
+            else socket.gethostname()
+        )
+        env = dict(self._env)
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_SPARE": "1",
+            "HOROVOD_ELASTIC_WORKER_ID": wid,
+            "HOROVOD_ELASTIC_GEN": "0",
+            "HOROVOD_DRIVER_EPOCH": str(self._epoch),
+            "HOROVOD_ELASTIC_SYNC_ROOT": "0",
+            "HOROVOD_ELASTIC_KV_ADDR": kv_addr,
+            "HOROVOD_ELASTIC_KV_PORT": str(self._kv.port),
+            "HOROVOD_ELASTIC_TIMEOUT": str(self._elastic_timeout),
+        })
+        if _is_local(slot.hostname):
+            cmd = self._command
+        else:
+            cmd = launcher.build_remote_command(
+                slot.hostname, env, self._command, self._ssh_port
+            )
+        stdout = stderr = None
+        outfiles: Tuple = ()
+        if self._output_dir:
+            stdout = open(
+                os.path.join(self._output_dir, f"worker.{wid}.out"), "ab"
+            )
+            stderr = open(
+                os.path.join(self._output_dir, f"worker.{wid}.err"), "ab"
+            )
+            outfiles = (stdout, stderr)
+        for key in ("joined", "rejoin", "attach", "done", "promote",
+                    "spare"):
+            self._kv.delete("elastic", f"{key}.{wid}")
+        self._spares[wid] = _Worker(
+            wid,
+            slot.hostname,
+            safe_shell_exec.ManagedProcess(
+                cmd, env=env, stdout=stdout, stderr=stderr
+            ),
+            outfiles,
+            spawned_at=time.monotonic(),
+        )
+        self._spare_slots[wid] = slot
+        self._log(f"spawned spare {wid} (parked until promoted)")
+
+    def _reap_spare(self, wid: str, w: _Worker) -> None:
+        """A spare died while parked: count it against its host (a
+        crashing spare is still a host signal) and drop it from the
+        pool; the supervision loop respawns it while the host stays
+        healthy."""
+        rc = w.proc.poll()
+        self._spares.pop(wid, None)
+        for f in w.outfiles:
+            f.close()
+        count = self._record_failure(w.host)
+        if count >= self._failure_threshold:
+            self._blacklist_host(w.host)
+        self._log(
+            f"spare {wid} died while parked (exit {rc}; host failures: "
+            f"{count})"
+        )
+        if _metrics.ACTIVE:
+            _metrics.TAP.set(
+                "hvd_spare_pool_size", float(len(self._spares))
+            )
+
+    def _poll_spares(self) -> None:
+        """Supervision-beat spare upkeep: reap dead spares and respawn
+        them while their host is still admissible."""
+        for wid, w in list(self._spares.items()):
+            if w.proc.poll() is None:
+                continue
+            slot = self._spare_slots.get(wid)
+            self._reap_spare(wid, w)
+            if (slot is not None and w.host not in self._blacklist
+                    and not self._finishing):
+                self._spawn_spare(slot)
+                if _metrics.ACTIVE:
+                    _metrics.TAP.set(
+                        "hvd_spare_pool_size", float(len(self._spares))
+                    )
 
     # -------------------------------------------------------------- loop
     def run(self) -> int:
@@ -1407,9 +1997,9 @@ class ElasticDriver:
             return rc
         finally:
             self._stop_discovery.set()
-            for w in list(self._workers.values()) + [
-                w for w, _ in self._removing
-            ]:
+            for w in (list(self._workers.values())
+                      + list(self._spares.values())
+                      + [w for w, _ in self._removing]):
                 if w.proc.poll() is None:
                     w.proc.terminate()
                 for f in w.outfiles:
@@ -1454,6 +2044,13 @@ class ElasticDriver:
                 self._publish_driver_doc()
                 self._journal_sync()
                 self._trace_collect()
+                # Self-driving fleet: spare upkeep, the slowness-
+                # quarantine decision over the charges _trace_collect
+                # just fed, and the calibration-drift re-plan check.
+                self._poll_spares()
+                if self._maybe_quarantine_slow():
+                    changed = True
+                self._maybe_replan()
             # Reap draining removed workers (exit code irrelevant);
             # terminate stragglers past the grace window.
             still_removing = []

@@ -45,7 +45,18 @@ JOURNAL_ENV = "HOROVOD_DRIVER_JOURNAL"
 
 # Journal schema version: replay refuses documents from the future so a
 # downgraded driver fails loudly instead of resuming with half a state.
-_VERSION = 1
+# v2 (self-driving fleet, docs/fault_tolerance.md "Self-driving fleet")
+# adds the slowness-quarantine ledger (``slow_strikes``,
+# ``blacklist_reasons``), the published re-plan notice (``replan``), and
+# the hot-spare pool (``spare_ids``).
+_VERSION = 2
+
+# Record keys introduced by v2. A document that CLAIMS an older version
+# while carrying them is mixed state (e.g. an operator splicing new
+# records into an old journal, or a partial downgrade-then-upgrade):
+# replay refuses it loudly rather than silently dropping — or silently
+# trusting — the new records.
+_V2_KEYS = ("slow_strikes", "blacklist_reasons", "replan", "spare_ids")
 
 
 def default_path(output_dir: Optional[str],
@@ -185,12 +196,22 @@ class DriverJournal:
             return None
         if not isinstance(doc, dict):
             return None
-        if int(doc.get("version", 0)) > _VERSION:
+        version = int(doc.get("version", 0))
+        if version > _VERSION:
             raise RuntimeError(
                 f"driver journal {self.path} is version "
                 f"{doc.get('version')} but this build understands "
                 f"<= {_VERSION}; refusing to resume with partial state"
             )
+        if version < 2:
+            present = sorted(k for k in _V2_KEYS if k in doc)
+            if present:
+                raise RuntimeError(
+                    f"driver journal {self.path} claims version "
+                    f"{version} but carries v2 records {present}; the "
+                    "document is mixed state — refusing to resume "
+                    "rather than silently dropping the newer records"
+                )
         return doc
 
     # ----------------------------------------------------------- record

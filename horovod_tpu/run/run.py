@@ -166,6 +166,13 @@ def parse_args(argv: Optional[List[str]] = None):
     parser.add_argument("--elastic-timeout", type=float, default=600.0,
                         help="Elastic: seconds a worker waits for a usable "
                              "world generation before giving up.")
+    parser.add_argument("--spares", type=int, default=None,
+                        help="Elastic: hot-spare workers to keep spawned "
+                             "beyond the world — attached to the KV plane "
+                             "and heartbeating but excluded from the mesh; "
+                             "a quarantine or death promotes one in the "
+                             "same generation bump instead of a respawn "
+                             "(default HOROVOD_SPARES or 0).")
     parser.add_argument("--resume", action="store_true",
                         help="Elastic: resume a crashed driver from its "
                              "journal (requires the original --output-dir "
@@ -382,6 +389,7 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
             probed_hostset=probed_hostset,
             blacklist_cooldown=args.blacklist_cooldown,
             resume=args.resume,
+            spares=args.spares,
         )
         try:
             return driver.run()

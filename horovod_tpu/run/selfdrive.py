@@ -1,0 +1,459 @@
+"""Self-driving fleet: the decision cores behind quarantine + re-plan.
+
+PR 10 made the straggler *nameable* (``hvd_step_skew_seconds``,
+``hvd_straggler_total{rank}``) and PR 13 made a healthy fleet's cost
+*predictable* (``hvd_sim_divergence_ratio{hop}``); this module is the
+control loop that ACTS on both signals (ROADMAP item 5, the FlexLink
+lesson applied to the whole fleet: measure, then adapt). It holds the
+pure, unit-testable decision logic; the :class:`ElasticDriver` wires it
+to the supervision beat, and ``docs/fault_tolerance.md`` ("Self-driving
+fleet") documents the resulting decision ladder:
+
+1. **Slowness quarantine** (:class:`StragglerPolicy`): consume the
+   per-step straggler charges the driver's :class:`StepSkewTracker`
+   emits; when ONE rank is charged the last-finisher above threshold for
+   ``HOROVOD_QUARANTINE_STRIKES`` of the last
+   ``HOROVOD_QUARANTINE_WINDOW`` observed steps, propose quarantining
+   its host. Vetoes are part of the policy (and of its tests): never
+   below min world size, never two hosts in one beat. The driver reuses
+   the blacklist cooldown/decay/relapse-doubling machinery with a
+   distinct ``reason="slow"`` ledger so death strikes and sloth strikes
+   decay independently.
+2. **Live re-plan** (:func:`propose_replan`): when observed per-hop cost
+   drifts from the model beyond ``HOROVOD_REPLAN_DIVERGENCE``
+   (calibrated constants vs generation defaults — the same alpha-beta
+   entries ``fleet_sim.py --replay`` diffs) or the skew trend says the
+   current plan is mispriced, re-price the tuner's free objectives on
+   the DRIFTED model and propose the best (topo algorithm, wire dtype,
+   bucket knobs) configuration — published only when it is STRICTLY
+   better than the current one and every implied plan passes the
+   symbolic verifier (:func:`verify_replan`).
+
+Everything here is jax-free (the compositor's planning layer and the
+tuner's free objectives are pure python), so the driver process never
+pays a backend import.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# --------------------------------------------------------------- knobs
+QUARANTINE_STRIKES_ENV = "HOROVOD_QUARANTINE_STRIKES"
+QUARANTINE_WINDOW_ENV = "HOROVOD_QUARANTINE_WINDOW"
+QUARANTINE_COOLDOWN_ENV = "HOROVOD_QUARANTINE_COOLDOWN_S"
+REPLAN_DIVERGENCE_ENV = "HOROVOD_REPLAN_DIVERGENCE"
+REPLAN_SKEW_ENV = "HOROVOD_REPLAN_SKEW_S"
+REPLAN_CHECK_ENV = "HOROVOD_REPLAN_CHECK_S"
+REPLAN_SPEC_ENV = "HOROVOD_REPLAN_SPEC"
+SPARES_ENV = "HOROVOD_SPARES"
+
+DEFAULT_QUARANTINE_WINDOW_FACTOR = 2  # window = factor * strikes
+
+
+def _env_int(env: Dict[str, str], name: str, default: int) -> int:
+    try:
+        return int(env.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(env: Dict[str, str], name: str, default: float) -> float:
+    try:
+        return float(env.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ------------------------------------------------- slowness quarantine
+@dataclass(frozen=True)
+class QuarantineDecision:
+    """One policy verdict: quarantine ``host`` because ``rank`` was the
+    charged straggler for ``charges`` of the last ``window`` steps."""
+
+    host: str
+    rank: int
+    charges: int
+    window: int
+
+
+class StragglerPolicy:
+    """Sliding-window strike accumulator over the driver's per-step
+    straggler charges.
+
+    ``observe()`` is fed every step the skew tracker emits (charged or
+    not — the window is "the last N steps", not "the last N charges"),
+    so a rank that stops lagging DECAYS out as healthy steps push its
+    charges off the window. ``decide()`` returns at most ONE decision
+    per call (one host per supervision beat) and applies the min-world
+    veto itself, so the safety properties are unit-testable without a
+    fleet. ``reset_generation()`` drops the ledger: ranks are renumbered
+    across a resize, so charges must never survive one.
+    """
+
+    def __init__(self, strikes: int = 0, window: Optional[int] = None):
+        self.strikes = max(int(strikes), 0)
+        if window is None:
+            window = DEFAULT_QUARANTINE_WINDOW_FACTOR * max(self.strikes, 1)
+        self.window = max(int(window), max(self.strikes, 1))
+        self._steps: "deque[Tuple[int, Optional[int]]]" = deque(
+            maxlen=self.window
+        )
+        self.generation: Optional[int] = None
+        self.vetoes = 0
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "StragglerPolicy":
+        e = env if env is not None else os.environ
+        strikes = _env_int(e, QUARANTINE_STRIKES_ENV, 0)
+        window = _env_int(e, QUARANTINE_WINDOW_ENV, 0) or None
+        return StragglerPolicy(strikes=strikes, window=window)
+
+    @property
+    def enabled(self) -> bool:
+        return self.strikes > 0
+
+    def reset_generation(self, gen: Optional[int] = None) -> None:
+        self._steps.clear()
+        self.generation = None if gen is None else int(gen)
+
+    def observe(self, step: int, skew_s: float, worst_rank: int,
+                charged: bool) -> None:
+        """Record one emitted step: ``charged`` is the driver's existing
+        straggler verdict (skew above threshold → the last finisher is
+        charged one ``hvd_straggler_total``)."""
+        self._steps.append((int(step), int(worst_rank) if charged else None))
+
+    def charges(self) -> Dict[int, int]:
+        """Charged-step count per rank inside the current window."""
+        out: Dict[int, int] = {}
+        for _, rank in self._steps:
+            if rank is not None:
+                out[rank] = out.get(rank, 0) + 1
+        return out
+
+    def decide(
+        self,
+        rank_to_host: Dict[int, str],
+        slots_by_host: Dict[str, int],
+        min_world: int,
+    ) -> Optional[QuarantineDecision]:
+        """At most one quarantine per beat: the most-charged rank at or
+        above the strike threshold, vetoed when removing its host would
+        drop the fleet below ``min_world`` (``slots_by_host`` is the
+        AVAILABLE capacity per host — spare slots on healthy hosts are
+        exactly what makes a quarantine affordable). A decision consumes
+        the offender's charges so the same evidence is never spent
+        twice."""
+        if not self.enabled:
+            return None
+        charges = self.charges()
+        ranked = sorted(
+            ((n, r) for r, n in charges.items() if n >= self.strikes),
+            key=lambda t: (-t[0], t[1]),
+        )
+        for n, rank in ranked:
+            host = rank_to_host.get(rank)
+            if host is None:
+                continue  # departed rank: stale charge, nothing to act on
+            remaining = sum(
+                c for h, c in slots_by_host.items() if h != host
+            )
+            if remaining < min_world:
+                self.vetoes += 1
+                return None  # quarantining ANY offender would kill the job
+            # Spend the evidence: drop this rank's charges from the
+            # window (healthy peers keep theirs — but only one decision
+            # leaves this call, so two hosts can never fall in one beat).
+            self._steps = deque(
+                ((s, None if r == rank else r) for s, r in self._steps),
+                maxlen=self.window,
+            )
+            return QuarantineDecision(
+                host=host, rank=rank, charges=n, window=self.window
+            )
+        return None
+
+
+# ------------------------------------------------------------- re-plan
+def divergence_ratios(default_model, calibrated_model) -> Dict[str, float]:
+    """Per-hop drift between the generation-default alpha-beta entries
+    and the calibrated ones, as a symmetric ratio >= 1 (1.0 = no drift).
+    The bandwidth and latency drifts are folded with ``max`` — either
+    constant moving means the planner priced the link wrong."""
+    out: Dict[str, float] = {}
+    calibrated = {h.name: h for h in calibrated_model.hops}
+    for h in default_model.hops:
+        c = calibrated.get(h.name)
+        if c is None:
+            continue
+        ratio = 1.0
+        if c.bandwidth_gbps > 0 and h.bandwidth_gbps > 0:
+            r = h.bandwidth_gbps / c.bandwidth_gbps
+            ratio = max(ratio, r, 1.0 / r)
+        if c.latency_us > 0 and h.latency_us > 0:
+            r = c.latency_us / h.latency_us
+            ratio = max(ratio, r, 1.0 / r)
+        out[h.name] = round(ratio, 6)
+    return out
+
+
+def max_divergence(ratios: Dict[str, float]) -> float:
+    """The drift scalar the ``HOROVOD_REPLAN_DIVERGENCE`` threshold
+    gates on: the largest per-hop |ratio - 1|."""
+    return round(
+        max((abs(r - 1.0) for r in ratios.values()), default=0.0), 6
+    )
+
+
+def skew_trend(samples, min_n: int = 8) -> Optional[float]:
+    """The ``StepSkewTracker``-trend trigger scalar: mean cross-rank
+    step skew over the recent window, or None while the evidence is
+    thinner than ``min_n`` steps (one noisy step must never re-plan a
+    fleet). Sustained skew above ``HOROVOD_REPLAN_SKEW_S`` says the
+    current plan is mispriced for the fleet as it actually behaves —
+    the re-plan then re-prices on whatever calibrated constants are
+    available (generation defaults when none are)."""
+    xs = [float(s) for s in samples]
+    if len(xs) < max(int(min_n), 1):
+        return None
+    return round(sum(xs) / len(xs), 6)
+
+
+def replay_divergence(report: Dict) -> Dict[str, float]:
+    """Per-hop modeled/measured ratios from a ``fleet_sim.py --replay``
+    report (the ``hvd_sim_divergence_ratio`` block): the OTHER drift
+    source the trigger accepts. ``null`` entries (hop never measured)
+    are skipped — absence of evidence is not drift."""
+    out: Dict[str, float] = {}
+    block = report.get("divergence") or report.get(
+        "hvd_sim_divergence_ratio") or {}
+    for hop, ratio in block.items():
+        if ratio is None:
+            continue
+        try:
+            r = float(ratio)
+        except (TypeError, ValueError):
+            continue
+        if r > 0:
+            out[str(hop)] = round(max(r, 1.0 / r), 6)
+    return out
+
+
+_DEFAULT_CONFIG_KEYS = (
+    "fusion_threshold_bytes", "first_bucket_bytes", "topo_algorithm",
+    "wire_dtype",
+)
+
+
+def _normalize_config(config: Optional[Dict]) -> Dict:
+    from ..common.env import Config
+
+    cfg = dict(config or {})
+    base = Config.from_env()
+    cfg.setdefault("fusion_threshold_bytes",
+                   int(base.fusion_threshold_bytes))
+    cfg.setdefault("first_bucket_bytes",
+                   int(base.fusion_first_bucket_bytes))
+    cfg.setdefault("topo_algorithm", "auto")
+    cfg.setdefault("wire_dtype", "f32")
+    return {k: cfg[k] for k in _DEFAULT_CONFIG_KEYS}
+
+
+def candidate_configs(model, current: Dict) -> List[Dict]:
+    """The deterministic re-plan grid: every topo choice the compositor
+    can realize on this model x both wire dtypes, over the current
+    bucket knobs plus the tuner's canonical first-bucket alternatives.
+    Small on purpose — a re-plan prices in one supervision beat; the
+    full GP search stays offline (tools/autotune_compiled.py)."""
+    topos = ["auto", "flat"]
+    if model.levels > 1:
+        topos += ["two-level", "split"]
+    first_buckets = sorted({
+        int(current["first_bucket_bytes"]), 1 << 20, 4 << 20,
+    })
+    out: List[Dict] = []
+    for topo in topos:
+        for wire in ("f32", "int8"):
+            for fb in first_buckets:
+                out.append({
+                    "fusion_threshold_bytes":
+                        int(current["fusion_threshold_bytes"]),
+                    "first_bucket_bytes": fb,
+                    "topo_algorithm": topo,
+                    "wire_dtype": wire,
+                })
+    return out
+
+
+@dataclass
+class ReplanProposal:
+    """A priced, not-yet-verified re-plan: the winning knob set, the
+    incumbent it beats, and the modeled evidence (exposed-us on the
+    drifted model) that justifies publishing it."""
+
+    config: Dict
+    current: Dict
+    current_exposed_us: float
+    replanned_exposed_us: float
+    trigger: str
+    drift: float
+    per_hop: Dict[str, float] = field(default_factory=dict)
+
+    def to_notice(self, notice_id: int, gen: int, epoch: int) -> Dict:
+        """The KV document workers adopt at a commit boundary. Stable
+        key order (the driver serializes it sort_keys) and no wall
+        clock — the notice must journal/diff deterministically."""
+        return {
+            "id": int(notice_id),
+            "gen": int(gen),
+            "epoch": int(epoch),
+            "trigger": self.trigger,
+            "drift": round(self.drift, 6),
+            "per_hop": {k: v for k, v in sorted(self.per_hop.items())},
+            "config": dict(self.config),
+            "current": dict(self.current),
+            "modeled": {
+                "current_exposed_us": round(self.current_exposed_us, 4),
+                "replanned_exposed_us": round(self.replanned_exposed_us, 4),
+            },
+        }
+
+
+def propose_replan(
+    spec,
+    model,
+    current_config: Optional[Dict],
+    calibration,
+    trigger: str = "divergence",
+    per_hop: Optional[Dict[str, float]] = None,
+    drift: float = 0.0,
+) -> Optional[ReplanProposal]:
+    """Re-price the free objectives on the CALIBRATED (drifted) model
+    and return the best configuration — or None when the incumbent is
+    already the best (a re-plan that does not strictly win modeled step
+    time is never published; the smoke gates on this)."""
+    from ..tune.objective import free_objectives
+
+    current = _normalize_config(current_config)
+    cur_obj = free_objectives(spec, current, model, calibration=calibration)
+    best_cfg, best_obj = current, cur_obj
+    for cand in candidate_configs(model, current):
+        if cand == current:
+            continue
+        obj = free_objectives(spec, cand, model, calibration=calibration)
+        if obj["exposed_us"] < best_obj["exposed_us"] or (
+            obj["exposed_us"] == best_obj["exposed_us"]
+            and obj["wire_bytes"] < best_obj["wire_bytes"]
+        ):
+            best_cfg, best_obj = cand, obj
+    if best_cfg == current:
+        return None
+    if not best_obj["exposed_us"] < cur_obj["exposed_us"]:
+        return None
+    return ReplanProposal(
+        config=best_cfg,
+        current=current,
+        current_exposed_us=float(cur_obj["exposed_us"]),
+        replanned_exposed_us=float(best_obj["exposed_us"]),
+        trigger=trigger,
+        drift=float(drift),
+        per_hop=dict(per_hop or {}),
+    )
+
+
+def verify_replan(spec, config: Dict, model, calibration) -> List:
+    """Symbolically verify every stream-group plan ``config`` implies
+    (the tuner's pre-pin gate, ``analysis/plan_verify``): a re-plan
+    notice is published only when this returns no findings — the driver
+    must never steer the fleet onto a plan the checker can refute."""
+    from ..analysis.plan_verify import verify_plan
+    from ..tune.objective import calibrated_model, group_plans
+
+    if calibration is not None:
+        model, _ = calibrated_model(model, calibration,
+                                    where="replan-verify")
+    findings: List = []
+    for plan in group_plans(spec, config, model):
+        findings.extend(verify_plan(plan, model))
+    return findings
+
+
+# --------------------------------------------- observed-program spec
+def spec_from_windows(windows: Dict[int, dict]):
+    """Reconstruct the fleet's observed program (layer name -> payload
+    bytes) from collected trace windows: the per-collective spans the
+    runtime records carry ``nbytes``, so the driver can price a re-plan
+    against what the fleet ACTUALLY reduces without any side channel.
+    ``HOROVOD_REPLAN_SPEC`` (inline JSON or a path;
+    ``{"layers": [["name", bytes], ...]}``) overrides for operators who
+    want the re-plan priced against a declared program. Returns None
+    when neither source yields a byte."""
+    from ..tune.objective import ProgramSpec
+
+    raw = os.environ.get(REPLAN_SPEC_ENV, "").strip()
+    if raw:
+        import json as _json
+
+        text = raw
+        if not raw.lstrip().startswith("{"):
+            with open(raw) as f:
+                text = f.read()
+        doc = _json.loads(text)
+        layers = tuple(
+            (str(n), int(b)) for n, b in doc.get("layers", []) if int(b) > 0
+        )
+        if layers:
+            return ProgramSpec(
+                name=str(doc.get("name", "replan-spec")), layers=layers
+            )
+    seen: Dict[str, int] = {}
+    order: List[str] = []
+    for _, doc in sorted(windows.items()):
+        for ev in doc.get("events") or []:
+            name = str(ev.get("name", ""))
+            if not name.startswith(("hvd_response", "hvd_plan")):
+                continue
+            args = ev.get("args") or {}
+            nbytes = args.get("nbytes", args.get("bytes"))
+            if not nbytes:
+                continue
+            key = str(args.get("tensor", "")) or name
+            if key not in seen:
+                order.append(key)
+            seen[key] = max(seen.get(key, 0), int(nbytes))
+    layers = tuple((k, seen[k]) for k in order if seen[k] > 0)
+    if not layers:
+        return None
+    return ProgramSpec(name="observed", layers=layers)
+
+
+def model_for_world(world: Optional[Dict], generation: Optional[str] = None):
+    """The interconnect model the driver prices re-plans on, derived
+    from the published world doc's assignment structure (local/cross
+    sizes) exactly as ``topo.model_from_topology`` derives it from a
+    live process: a homogeneous local>1 x cross>1 grid gets the
+    DCN x ICI ladder, anything else collapses to one flat ICI hop.
+    ``HOROVOD_TOPOLOGY_MODEL`` overrides apply as everywhere else."""
+    from ..topo import model as _tm
+
+    size = len((world or {}).get("assignments") or {}) or 1
+    locals_ = {
+        int(a.get("local_size", 1))
+        for a in (world or {}).get("assignments", {}).values()
+    } or {1}
+    crosses = {
+        int(a.get("cross_size", 1))
+        for a in (world or {}).get("assignments", {}).values()
+    } or {1}
+    gen = generation or _tm.detect_generation()
+    local = locals_.pop() if len(locals_) == 1 else 0
+    cross = crosses.pop() if len(crosses) == 1 else 0
+    if local > 1 and cross > 1 and local * cross == size:
+        model = _tm.synthetic_model(local, cross, generation=gen)
+    else:
+        model = _tm.synthetic_model(size, generation=gen)
+    return _tm.apply_override(model)

@@ -16,9 +16,10 @@ The model (docs/simulation.md has the full assumptions list):
   over the — optionally calibrated — interconnect model, so the
   simulator and the planner can never disagree about what a plan costs.
 - Seeded ``delay`` faults (``fault/plan.py``, site ``step``) stretch the
-  faulted rank's first backward segment of the step; every draw comes
-  from the plan's pure per-(seed, action, rank) decision streams, so a
-  simulated incident is byte-reproducible.
+  faulted rank's first backward segment of the step — including the
+  chronic-slowness shape (``every``/``until``: a persistent or periodic
+  straggler); every draw comes from the plan's pure per-(seed, action,
+  rank) decision streams, so a simulated incident is byte-reproducible.
 
 Time is simulated microseconds from 0 — no wall clock, no randomness
 outside the fault plan — and reports round every float, so a fixed seed
@@ -205,7 +206,11 @@ def _delay_matrix(
     order, like ``canonical_schedule``). Only ``delay`` actions
     simulate; other kinds are outside the model and are skipped with a
     loud note — a silently half-applied chaos plan would make the twin
-    dishonest."""
+    dishonest. Both the single-shot (``at_step``/``after``+``count``)
+    and the chronic-slowness (``every``/``until``) shapes are honored:
+    the window test and the decision-stream advance go through the same
+    ``FaultAction.in_window`` the injector uses, so a recurring
+    straggler stretches exactly the steps the live injector would."""
     delays: Dict[int, List[float]] = {}
     if plan is None:
         return delays

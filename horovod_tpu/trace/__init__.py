@@ -275,18 +275,39 @@ class TraceTap:
     # ------------------------------------------------------- shipping
     def window(self) -> Dict[str, Any]:
         """The pushable/dumpable view of this rank's recent activity —
-        plain data only, bounded by the ring capacity."""
+        plain data only, bounded by the ring capacity. The window is
+        stamped with the CURRENT elastic generation (0 outside elastic
+        runs): rank numbers are only meaningful within a generation, so
+        the driver's skew attribution must never mix windows across a
+        resize (a renumbered or departed rank would be charged for a
+        stranger's steps)."""
         with self._lock:
             events = [dict(e) for e in self._ring]
             steps = [list(s) for s in self._steps]
+        gen = os.environ.get("HOROVOD_ELASTIC_GEN", "")
         return {
             "schema": SCHEMA,
             "rank": self.rank,
+            "gen": int(gen) if gen.isdigit() else 0,
             "clock": dict(self.clock),
             "plan": self.plan_args(),
             "events": events,
             "steps": steps,
         }
+
+    def reset_steps(self) -> None:
+        """Restart the step ledger at a world re-formation boundary:
+        after an elastic resize ranks are renumbered and a freshly
+        promoted worker starts counting from 0, so carrying the old
+        cumulative step indices across the generation would misalign
+        every cross-rank comparison. The event ring is kept (history is
+        still history); only the step-index feed restarts."""
+        with self._lock:
+            self._steps.clear()
+            self._step_idx = 0
+            self._wrapped_steps = 0
+            self._last_commit_t = None
+            self._commit_idx = 0
 
     def set_clock(self, offset_s: float, rtt_s: float) -> None:
         self.clock = {
@@ -376,6 +397,9 @@ class _NullTraceTap:
 
     def window(self) -> Dict[str, Any]:
         return {}
+
+    def reset_steps(self) -> None:
+        pass
 
     def set_clock(self, offset_s: float, rtt_s: float) -> None:
         pass

@@ -180,9 +180,30 @@ class StepSkewTracker:
         # Keep the charged-set bounded for long runs: indices below the
         # watermark are implicitly done.
         self._watermark = -1
+        # Generation the charged-set is keyed to: after an elastic
+        # resize ranks are renumbered (and workers restart their step
+        # ledgers), so cumulative windows from the old world must never
+        # charge the new world's ranks. ``None`` = ungated (non-elastic
+        # callers feed windows with no ``gen`` stamp).
+        self.generation: Optional[int] = None
+
+    def reset_generation(self, gen: Optional[int] = None) -> None:
+        """Re-key the tracker for a new world generation: drop every
+        charged index and only consume windows stamped with ``gen``
+        from now on. Charges from the OLD generation die with it — a
+        parked or removed rank is never charged for steps it did not
+        run (tests/test_selfdrive.py locks this)."""
+        self._done = set()
+        self._watermark = -1
+        self.generation = None if gen is None else int(gen)
 
     def update(self, windows: Dict[int, Dict[str, Any]]
                ) -> List[Tuple[int, float, int]]:
+        if self.generation is not None:
+            windows = {
+                r: doc for r, doc in windows.items()
+                if int(doc.get("gen", 0) or 0) == self.generation
+            }
         if len(windows) < 2:
             return []
         per_rank: Dict[int, Dict[int, float]] = {}

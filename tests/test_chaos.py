@@ -548,9 +548,12 @@ def _bare_driver(threshold=3, cooldown=0.2):
     drv._failures = {}
     drv._last_failure = {}
     drv._blacklist = {}
+    drv._blacklist_reason = {}
     drv._quarantine_strikes = {}
+    drv._slow_strikes = {}
     drv._failure_threshold = threshold
     drv._blacklist_cooldown = cooldown
+    drv._quarantine_cooldown = cooldown
     drv._output_dir = None
     drv._verbose = False
     return drv

@@ -81,6 +81,18 @@
 # run replayed (`--replay`) with finite, bounded per-hop divergence
 # ratios (docs/simulation.md). Budget: under 60s.
 #
+# Stage 13 (make selfdrive-smoke; skip with HVD_CI_SKIP_SELFDRIVE=1):
+# the self-driving-fleet smoke — two seeded chronic-delay runs on 2
+# ranks + 1 hot spare: the slowness quarantine fires on the charged
+# straggler's host, the parked spare promotes in the re-formation bump,
+# the calibration-drift re-plan publishes (symbolically verified) and
+# every rank adopts at a commit boundary, training converges BITWISE to
+# the uninterrupted run's params, the normalized decision logs are
+# byte-identical across the two runs, and the re-planned config's
+# simulated step time is strictly below the incumbent's on the drifted
+# calibration (docs/fault_tolerance.md "Self-driving fleet"). Budget:
+# under 60s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -173,4 +185,11 @@ if [ "${HVD_CI_SKIP_SIM:-0}" != "1" ]; then
     python tools/sim_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: sim smoke deterministic+scale-gated+calibrated+replayed in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_SELFDRIVE:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/selfdrive_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: selfdrive smoke quarantined+replanned+promoted+byte-stable in ${elapsed}s"
 fi

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Self-driving-fleet CI smoke (``make selfdrive-smoke``): the seeded
+quarantine→re-plan→promote→recover scenario on CPU, twice, asserting
+byte-identical normalized decision logs plus the sim-gated benefit.
+Budget: ~2x20 s wall.
+
+Each run (scenario shared with ``tests/test_selfdrive.py``):
+
+- 2 ranks over two "hosts" (``localhost`` + ``127.0.0.1`` — both local,
+  no ssh) plus ``--spares 1``; a seeded CHRONIC ``delay`` fault (the
+  ``every``/``until`` recurring shape) makes rank 0's host the sloth.
+- The driver's StragglerPolicy charges the last finisher per step and
+  quarantines ``localhost`` (``reason="slow"``) at the strike
+  threshold; the world re-forms WITHOUT the offender in one generation
+  bump that simultaneously PROMOTES the parked spare.
+- A drifted ``calibration.json`` (HOROVOD_CALIBRATION_FILE) trips the
+  ``HOROVOD_REPLAN_DIVERGENCE`` trigger: the driver prices the tuner's
+  free objectives on the drifted model, verifies the winning plans
+  symbolically, and publishes a re-plan notice every rank adopts at a
+  commit boundary (and re-adopts after the resize via the re-stamp).
+- Training converges to the uninterrupted run's params BITWISE.
+
+Across runs: the normalized decision logs (quarantine / re-plan /
+adopt / promote events) are byte-identical. Finally the SIM GATE: the
+re-planned configuration's modeled step time via ``tools/fleet_sim.py``
+on the drifted calibration is STRICTLY below the pre-re-plan plan's.
+
+Exit 0 = all assertions hold. Wired as tools/ci_checks.sh stage 13
+(skip: HVD_CI_SKIP_SELFDRIVE=1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _sim_gate() -> dict:
+    """The acceptance gate: on the SAME drifted calibration the driver
+    re-planned against, ``fleet_sim.py`` must price the re-planned
+    configuration (int8 wire) strictly below the incumbent (f32)."""
+    from test_selfdrive import write_drifted_calibration
+
+    with tempfile.TemporaryDirectory() as td:
+        calib = write_drifted_calibration(
+            os.path.join(td, "calibration.json")
+        )
+        out = {}
+        for wire in ("f32", "int8"):
+            p = os.path.join(td, f"sim.{wire}.json")
+            rc = subprocess.call(
+                [sys.executable, os.path.join(_REPO, "tools",
+                                              "fleet_sim.py"),
+                 "--ranks", "2", "--local", "2",
+                 "--program", "layers", "--layer-bytes", str(1 << 20),
+                 "--wire", wire, "--calibration", calib,
+                 "--steps", "2", "-o", p],
+                cwd=_REPO,
+            )
+            assert rc == 0, f"fleet_sim predict ({wire}) failed rc={rc}"
+            with open(p) as f:
+                doc = json.load(f)
+            out[wire] = doc["results"][0]["step_time_us"]
+    assert out["int8"] < out["f32"], (
+        "sim gate FAILED: the re-planned (int8) configuration's modeled "
+        f"step time {out['int8']}us is not strictly below the "
+        f"pre-re-plan (f32) plan's {out['f32']}us on the drifted "
+        "calibration"
+    )
+    return out
+
+
+def main() -> int:
+    from horovod_tpu.fault.plan import FaultPlan
+
+    from test_selfdrive import (
+        SELFDRIVE_SEED,
+        assert_selfdrive_recovery,
+        run_selfdrive_job,
+        selfdrive_fault_plan,
+    )
+
+    t0 = time.time()
+    text = json.dumps(selfdrive_fault_plan())
+    s1 = FaultPlan.from_json(text).canonical_schedule()
+    s2 = FaultPlan.from_json(text).canonical_schedule()
+    assert s1 == s2, "chronic-delay schedule resolution is not deterministic"
+
+    proc_a, outs_a, dec_a = run_selfdrive_job()
+    assert_selfdrive_recovery(proc_a, outs_a, dec_a)
+    proc_b, outs_b, dec_b = run_selfdrive_job()
+    assert_selfdrive_recovery(proc_b, outs_b, dec_b)
+    assert dec_a == dec_b, (
+        "two runs of the same seeded self-driving scenario produced "
+        f"different decision logs:\n{dec_a}\nvs\n{dec_b}"
+    )
+
+    gate = _sim_gate()
+    print(
+        f"[selfdrive-smoke] OK in {time.time() - t0:.1f}s (seed "
+        f"{SELFDRIVE_SEED}): quarantine -> re-plan -> promote -> "
+        f"recover; {len(dec_a)} decision events byte-identical across "
+        f"runs; sim gate int8 {gate['int8']}us < f32 {gate['f32']}us "
+        "on the drifted calibration"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
