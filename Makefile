@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -16,7 +16,7 @@ test:
 # Pass 4 over the shipped train-step variants, Pass 5 over the reference
 # sharding-rule table.
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 HVD_CI_SKIP_SELFDRIVE=1 HVD_CI_SKIP_LLM=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -104,4 +104,13 @@ sim-smoke:
 selfdrive-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/selfdrive_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke test
+# Composed DP x TP smoke (docs/parallelism.md "Composed DP x TP fast
+# path"): the shipped GPT rule table preflights clean against the real
+# transformer tree on a 2x2 mesh, the composed step trains with
+# streamed ZeRO-1 + int8 wire on the DP axis, per-axis wire bytes are
+# nonzero on BOTH axes (model = plain psums only), and the normalized
+# event log is byte-identical across two runs, <30s CPU.
+llm-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/llm_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke test

@@ -230,12 +230,35 @@ def _parse_args(argv=None):
              "it the block reports the prediction on generation "
              "defaults and an honest zero divergence ratio",
     )
+    parser.add_argument(
+        "--tp", type=int, default=0,
+        help="transformer: composed DP x TP (docs/parallelism.md "
+             "'Composed DP x TP fast path') — shard the model N ways "
+             "over a 'model' mesh axis via the sharding-rules engine "
+             "(make_train_step(rules=...)), one Megatron psum per "
+             "half-block, with --overlap/--quantized/--zero1 scoped to "
+             "the data axis only; the wire block then splits DP vs TP "
+             "bytes",
+    )
+    parser.add_argument(
+        "--rules", default="", choices=["", "gpt"],
+        help="sharding-rules table for --tp (default: gpt, the shipped "
+             "models/transformer.py table)",
+    )
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.zero1 and args.model != "transformer":
         parser.error("--zero1 is implemented for --model transformer only")
     if args.quantized and args.model != "transformer":
         parser.error("--quantized applies to --model transformer only")
+    if args.tp and args.model != "transformer":
+        parser.error("--tp applies to --model transformer only")
+    if args.rules and not args.tp:
+        parser.error("--rules needs --tp N (the composed DP x TP mode)")
+    if args.tp and args.tp < 2:
+        parser.error("--tp needs a model-axis degree >= 2")
+    if args.tp and not args.rules:
+        args.rules = "gpt"
     return args
 
 
@@ -306,6 +329,30 @@ def _resolve_tuned(args, params, mesh):
     live = T.step_signature(params, mesh=mesh)
     matched = T.signatures_match(cfg.signature, live)
     if not matched:
+        # Say WHY: a mismatch is either a different program (params
+        # half) or the same program pinned on a DIFFERENT MESH.
+        tuned_mesh = T.mesh_axes_hash(cfg.signature)
+        live_mesh = T.mesh_axes_hash(live)
+        if getattr(args, "quantized", False) and tuned_mesh != live_mesh:
+            # The int8-wire verdict is a function of the mesh's hop
+            # ladder — a tuning pinned on another mesh cannot vouch for
+            # this wire, so --quantized --tuned across meshes is a hard
+            # error, not a silent untuned fallback.
+            raise SystemExit(
+                f"bench: refusing --quantized with --tuned "
+                f"{args.tuned}: the tuning was pinned on mesh-axes "
+                f"hash {tuned_mesh} but this run's mesh axes hash to "
+                f"{live_mesh} — re-run tools/autotune_compiled.py on "
+                f"THIS mesh (or drop --quantized/--tuned)"
+            )
+        why = (
+            f"mesh-axes hash {tuned_mesh} (pinned) vs {live_mesh} "
+            f"(live)" + ("; params half matches"
+                         if T.params_match(cfg.signature, live)
+                         else "; params half differs too")
+        )
+        print(f"[bench] tuned signature mismatch: {why}",
+              file=sys.stderr, flush=True)
         T.warn_signature_mismatch(cfg, live.get("hash", "?"), "bench")
     T.note_applied("file", cfg.signature_hash, matched, "bench")
     detail = {
@@ -319,7 +366,8 @@ def _resolve_tuned(args, params, mesh):
 
 
 def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
-               quantized_eff=False, tuned_kw=None):
+               quantized_eff=False, tuned_kw=None, tp=0,
+               tp_psum_bytes=0, tp_psums=0, local_params=None):
     """Fleet-simulator cross-check for the transformer report
     (docs/simulation.md): the digital twin's predicted step time for
     THIS program at THIS chip count next to the measured one, plus the
@@ -333,20 +381,32 @@ def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
         from horovod_tpu import tune as T
         from horovod_tpu.topo.model import detect_generation, synthetic_model
 
-        spec = T.spec_from_params("bench-transformer", params, mesh=mesh)
+        spec = T.spec_from_params(
+            "bench-transformer", local_params or params, mesh=mesh
+        )
         config = {}
         if tuned_kw:
             config = {
                 "fusion_threshold_bytes": tuned_kw["fusion_threshold_bytes"],
                 "first_bucket_bytes": tuned_kw["first_bucket_bytes"],
             }
-        program = hvdsim.program_from_spec(spec, config)
         calib = hvdsim.resolve_calibration(
             getattr(args, "calibration", "") or None
         )
         model = hvdsim.apply_calibration(
             synthetic_model(n_chips, generation=detect_generation()),
             calib, where="bench",
+        )
+        fixed_comm_us = 0.0
+        if tp and tp > 1:
+            # The composed TP psums as a fixed per-step ICI term
+            # alongside the DP staircase (docs/parallelism.md).
+            fixed_comm_us = hvdsim.tp_fixed_comm_us(
+                model, int(tp_psum_bytes), int(tp),
+                psums_per_step=int(tp_psums),
+            )
+        program = hvdsim.program_from_spec(
+            spec, config, fixed_comm_us=fixed_comm_us
         )
         res = hvdsim.simulate(
             model, program,
@@ -367,6 +427,10 @@ def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
             "scaling_efficiency": round(res.scaling_efficiency, 6),
             "ranks": int(n_chips),
             "calibrated": bool(calibrated),
+            **({"tp": {
+                "degree": int(tp),
+                "fixed_comm_us": round(float(fixed_comm_us), 4),
+            }} if tp and tp > 1 else {}),
         }
         if calibrated and measured_step_s > 0:
             block["divergence_ratio"] = round(
@@ -577,8 +641,19 @@ def run_lm_benchmark(args) -> int:
     if args.devices > 0:
         devices = devices[:args.devices]
     n_chips = len(devices)
-    mesh = build_mesh({"data": n_chips}, devices=devices)
-    global_batch = args.batch_size * n_chips
+    tp = int(args.tp or 0)
+    if tp:
+        if n_chips % tp:
+            raise SystemExit(
+                f"bench: --tp {tp} does not divide {n_chips} devices"
+            )
+        dp = n_chips // tp
+        mesh = build_mesh({"data": dp, "model": tp}, devices=devices)
+        global_batch = args.batch_size * dp
+    else:
+        dp = n_chips
+        mesh = build_mesh({"data": n_chips}, devices=devices)
+        global_batch = args.batch_size * n_chips
     T = args.seq_len
 
     model = TransformerLM(
@@ -622,7 +697,44 @@ def run_lm_benchmark(args) -> int:
             logits, lab
         ).mean()
 
-    if args.zero1 and args.overlap:
+    if tp:
+        # Composed DP x TP fast path (docs/parallelism.md): the
+        # sharding-rules engine places the param tree on the
+        # (data, model) mesh, the loss runs tp_apply's Megatron layers
+        # (one psum per half-block, Pallas flash attention on the local
+        # heads), and --overlap/--quantized/--zero1 apply to the DATA
+        # axis only.
+        from horovod_tpu.models.transformer import make_gpt_loss_fn
+
+        composed_loss = make_gpt_loss_fn(
+            dims["n_heads"], model_axis="model"
+        )
+        czk = dict(
+            threshold_bytes=(
+                tuned_kw["fusion_threshold_bytes"] if tuned_kw else None
+            ),
+            first_bucket_bytes=(
+                tuned_kw["first_bucket_bytes"] if tuned_kw else None
+            ),
+        )
+        if args.zero1:
+            opt_state = hvdj.init_composed_zero1_state(
+                tx, params, args.rules, mesh,
+                quantized=quantized_eff, **czk,
+            )
+        else:
+            opt_state = tx.init(params)
+        composed_step = hvdj.make_train_step(
+            composed_loss, tx, mesh, rules=args.rules,
+            overlap=bool(args.overlap), quantized=quantized_eff,
+            zero1=bool(args.zero1),
+            fusion_threshold_bytes=czk["threshold_bytes"],
+            first_bucket_bytes=czk["first_bucket_bytes"],
+        )
+
+        def step(p, s, tok, lab):
+            return composed_step(p, s, (tok, lab))
+    elif args.zero1 and args.overlap:
         # Streamed ZeRO-1 (docs/overlap.md "Streamed ZeRO-1"): each
         # stream_param_groups bucket reduce-scatters INSIDE the backward
         # (int8 ring with --quantized), the shard-local update runs
@@ -735,7 +847,17 @@ def run_lm_benchmark(args) -> int:
             donate_argnums=(0, 1),
         )
 
-    if args.scan:
+    if tp:
+        # The composed dispatch builds (preflights the rules, matches
+        # placement) on its first call — no AOT lowering to analyze;
+        # MFU is reported null rather than guessed (the TP duplicate
+        # compute of replicated layers would skew any analytic count).
+        if args.scan:
+            print("[bench] --tp: on-device scan disabled (the composed "
+                  "step builds on first call)", file=sys.stderr)
+            args.scan = False
+        fn, flops_per_step = step, None
+    elif args.scan:
         flops_per_step = _step_flops(
             _jit(step), params, opt_state, tokens, labels
         )
@@ -782,8 +904,10 @@ def run_lm_benchmark(args) -> int:
     per_chip = total / n_chips
     flops_per_step, flops_source = _reconcile_flops(
         flops_per_step,
-        _analytic_flops_lm(n_params, dims["n_layers"], dims["d_model"],
-                           args.batch_size, T),
+        None if tp else _analytic_flops_lm(
+            n_params, dims["n_layers"], dims["d_model"],
+            args.batch_size, T,
+        ),
         devices[0].platform,
     )
     mfu = _mfu(flops_per_step, steps_per_iter, min(iter_times), devices[0])
@@ -793,11 +917,33 @@ def run_lm_benchmark(args) -> int:
     # ring moves 2(n-1)/n of the payload; --quantized shrinks the
     # payload to int8+scales (common/quant.py byte math, the same
     # accounting the topo plans and the structural profiler use).
+    # Composed (--tp): the DP ring runs over the data axis on each
+    # rank's LOCAL gradient bytes (sharded kernels are 1/tp), and the
+    # TP psums are accounted separately under per_axis.
     from horovod_tpu.common.quant import int8_wire_bytes
 
     grad_bytes = 4 * n_params
-    ring_factor = 2 * (n_chips - 1) / max(n_chips, 1)
-    rs_factor = (n_chips - 1) / max(n_chips, 1)
+    tp_axis_block = None
+    if tp:
+        from horovod_tpu.parallel import rules as RUL
+
+        specs = RUL.match_partition_rules(args.rules, params)
+        local = RUL.local_shard_tree(params, specs, {"model": (0, tp)})
+        grad_bytes = 4 * sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(local)
+        )
+        psum_payload = args.batch_size * T * dims["d_model"] * 2  # bf16
+        tp_psums = 4 * dims["n_layers"]  # fwd psums + bwd conjugates
+        tp_axis_block = {
+            "psum_payload_bytes": int(psum_payload),
+            "psums_per_step": int(tp_psums),
+            "bytes_on_wire_per_step_per_chip": int(
+                tp_psums * 2 * (tp - 1) / tp * psum_payload
+            ),
+            "wire_dtype": "bf16 (never quantized, never re-planned)",
+        }
+    ring_factor = 2 * (dp - 1) / max(dp, 1)
+    rs_factor = (dp - 1) / max(dp, 1)
     full_wire = int(grad_bytes * ring_factor)
     rs_bytes = ag_bytes = None
     if args.zero1:
@@ -824,6 +970,8 @@ def run_lm_benchmark(args) -> int:
     )
     if args.zero1:
         mode += "+zero1"
+    if tp:
+        mode += f"+tp{tp}"
     if tuned_kw:
         mode += "+tuned"
 
@@ -858,8 +1006,16 @@ def run_lm_benchmark(args) -> int:
 
     measured_step_s = float(np.mean(iter_times)) / steps_per_iter
     sim_block = _sim_block(
-        args, params, mesh, n_chips, measured_step_s,
+        args, params, mesh, dp, measured_step_s,
         quantized_eff=quantized_eff, tuned_kw=tuned_kw,
+        tp=tp,
+        tp_psum_bytes=(
+            tp_axis_block["psum_payload_bytes"] if tp_axis_block else 0
+        ),
+        tp_psums=(
+            tp_axis_block["psums_per_step"] if tp_axis_block else 0
+        ),
+        local_params=(local if tp else None),
     )
 
     print(json.dumps({
@@ -870,6 +1026,8 @@ def run_lm_benchmark(args) -> int:
         "detail": {
             "total_tokens_per_sec": round(total, 1),
             "n_chips": n_chips,
+            **({"mesh": {"data": dp, "model": tp},
+                "rules": args.rules} if tp else {}),
             "batch_per_chip": args.batch_size,
             "seq_len": T,
             "n_params": n_params,
@@ -902,6 +1060,19 @@ def run_lm_benchmark(args) -> int:
                         if full_wire else 0.0
                     ),
                 } if args.zero1 else {}),
+                **({
+                    # Composed DP x TP: the split the
+                    # hvd_axis_wire_bytes_total{axis,collective} metric
+                    # reports live (docs/parallelism.md).
+                    "per_axis": {
+                        "data": {
+                            "bytes_on_wire_per_step_per_chip": wire_bytes,
+                            "local_gradient_bytes": grad_bytes,
+                            "dp_degree": dp,
+                        },
+                        "model": dict(tp_axis_block, tp_degree=tp),
+                    },
+                } if tp_axis_block else {}),
             },
             "step_skew": step_skew,
             "sim": sim_block,

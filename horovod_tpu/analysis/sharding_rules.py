@@ -288,42 +288,74 @@ def validate_sharding_rules(
 
 # --- reference DP x TP table (the CLI `sharding` target + tests) -------------
 #
-# A GPT-class param tree on a {"data": D, "model": T} mesh: embeddings
-# and attention/MLP kernels shard their feature dim over "model",
-# norms/biases replicate, scalars replicate implicitly. This is the
-# shape item 4's engine will ship; the validator accepting it (and
-# rejecting its seeded corruptions) is the acceptance gate.
+# The SHIPPED GPT table: the exact table `parallel/rules.py` exports as
+# GPT_RULES and `make_train_step(rules="gpt")` trains the real
+# `models/transformer.py` TransformerLM with on a {"data": D, "model":
+# T} mesh. Attention q/k/v and the MLP up-projection are column-parallel
+# (feature dim over "model"; a contiguous feature slice is whole heads),
+# the attention out- and MLP down-projections are row-parallel (ONE psum
+# per Megatron half-block; their biases shard with the output and are
+# scattered inside the reduction — parallel/tp.py), norms replicate, and
+# the embeddings + lm head replicate deliberately: the lookup stays
+# local and the vocab softmax needs full logits (Megatron's
+# vocab-parallel embedding is a different schedule with its own
+# collective). The validator accepting this pair — and rejecting its
+# seeded corruptions — is the acceptance gate; `example_gpt_params`
+# mirrors the REAL flax param tree (locked by a parity test against
+# `TransformerLM.init`, tests/test_rules.py).
 
 EXAMPLE_GPT_MESH: Dict[str, int] = {"data": 4, "model": 2}
 
 EXAMPLE_GPT_RULES: Tuple[Rule, ...] = (
-    (r"embeddings/embedding$", (None, "model")),
+    # Anchored with (^|/): a bare search for "embeddings/embedding$"
+    # would also hit "pos_embeddings/embedding" (over-match — harmless
+    # here since both replicate, but the anchor keeps the table honest
+    # as a first-match-wins example).
+    (r"(^|/)embeddings/embedding$", None),
+    (r"(^|/)pos_embeddings/embedding$", None),
     (r"attention/(query|key|value)/kernel$", (None, "model")),
     (r"attention/out/kernel$", ("model", None)),
     (r"mlp/up/kernel$", (None, "model")),
+    (r"mlp/up/bias$", ("model",)),
     (r"mlp/down/kernel$", ("model", None)),
+    (r"mlp/down/bias$", ("model",)),
     (r"(ln|layernorm|norm)[^/]*/(scale|bias)$", None),
+    (r"lm_head/kernel$", None),
     (r"bias$", None),
     (r".*", None),  # catch-all: replicate
 )
 
 
 def example_gpt_params(
-    d_model: int = 128, d_ff: int = 512, vocab: int = 384
+    d_model: int = 128, n_heads: int = 4, n_layers: int = 2,
+    vocab: int = 384, max_len: int = 128, mlp_ratio: int = 4,
 ) -> Dict[str, Tuple[int, ...]]:
-    """A representative GPT-class param-shape table (name -> shape) the
-    reference rule table must place cleanly."""
-    return {
+    """The REAL ``models/transformer.py`` param-shape table (name ->
+    shape, flax names) the reference rule table must place cleanly.
+    Pure python (the linter imports no jax); a parity test asserts it
+    matches ``TransformerLM.init``'s actual tree leaf for leaf."""
+    d_ff = mlp_ratio * d_model
+    out: Dict[str, Tuple[int, ...]] = {
         "embeddings/embedding": (vocab, d_model),
-        "layer_0/attention/query/kernel": (d_model, d_model),
-        "layer_0/attention/key/kernel": (d_model, d_model),
-        "layer_0/attention/value/kernel": (d_model, d_model),
-        "layer_0/attention/out/kernel": (d_model, d_model),
-        "layer_0/attention/out/bias": (d_model,),
-        "layer_0/mlp/up/kernel": (d_model, d_ff),
-        "layer_0/mlp/down/kernel": (d_ff, d_model),
-        "layer_0/ln_1/scale": (d_model,),
-        "layer_0/ln_1/bias": (d_model,),
-        "final_norm/scale": (d_model,),
-        "step": (),
+        "pos_embeddings/embedding": (max_len, d_model),
+        "ln_f/scale": (d_model,),
+        "ln_f/bias": (d_model,),
+        "lm_head/kernel": (d_model, vocab),
     }
+    for i in range(n_layers):
+        b = f"block_{i}"
+        out.update({
+            f"{b}/ln_1/scale": (d_model,),
+            f"{b}/ln_1/bias": (d_model,),
+            f"{b}/attention/query/kernel": (d_model, d_model),
+            f"{b}/attention/key/kernel": (d_model, d_model),
+            f"{b}/attention/value/kernel": (d_model, d_model),
+            f"{b}/attention/out/kernel": (d_model, d_model),
+            f"{b}/ln_2/scale": (d_model,),
+            f"{b}/ln_2/bias": (d_model,),
+            f"{b}/mlp/up/kernel": (d_model, d_ff),
+            f"{b}/mlp/up/bias": (d_ff,),
+            f"{b}/mlp/down/kernel": (d_ff, d_model),
+            f"{b}/mlp/down/bias": (d_model,),
+        })
+    return out

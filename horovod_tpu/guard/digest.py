@@ -25,7 +25,8 @@ import pickle
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 
-def strip_rank_local(tree: Any) -> Any:
+def strip_rank_local(tree: Any, specs: Any = None,
+                     shard_axes: Sequence[str] = ("model",)) -> Any:
     """Drop tracked-but-RANK-LOCAL subtrees before digesting: the
     error-feedback residual of the quantized wire
     (``ops/quantized.EFState.residual``) legitimately differs across
@@ -41,11 +42,55 @@ def strip_rank_local(tree: Any) -> Any:
     ranks. The digest keeps the shard LAYOUT (dtype/shape headers per
     leaf — identical across ranks exactly when the partition is) and
     drops the bytes; a rank whose shard layout drifted still mismatches
-    loudly."""
+    loudly.
+
+    ``specs`` (docs/parallelism.md "Composed DP x TP fast path") is an
+    optional PartitionSpec tree mirroring ``tree``: a leaf whose spec
+    shards a dim over one of ``shard_axes`` is TENSOR-PARALLEL-sharded —
+    each model rank legitimately holds a different shard — so its bytes
+    are replaced with a layout token (dtype+shape+spec) and only the
+    LAYOUT must agree across ranks. Without this, a composed mesh would
+    false-positive a divergence heal on every digest check. A specs tree
+    whose leaf count does not match ``tree``'s raises (a stale spec must
+    never silently digest the wrong leaves)."""
     import jax
 
     from ..ops.quantized import EFState
     from ..parallel.zero import Zero1State
+
+    if specs is not None:
+        from ..analysis.sharding_rules import normalize_spec
+        from jax.sharding import PartitionSpec as P
+
+        leaves, treedef = jax.tree.flatten(tree)
+        spec_leaves = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        if len(spec_leaves) != len(leaves):
+            raise ValueError(
+                f"sharding_specs tree has {len(spec_leaves)} leaves but "
+                f"the tracked state has {len(leaves)} — stale spec; "
+                f"rebuild it from the live step (step.sharding_specs)"
+            )
+
+        def tp_sharded(spec) -> bool:
+            norm = normalize_spec(spec) or ()
+            want = set(shard_axes)
+            return any(bool(want.intersection(e)) for e in norm)
+
+        import numpy as np
+
+        out = []
+        for leaf, spec in zip(leaves, spec_leaves):
+            if tp_sharded(spec) and hasattr(leaf, "shape"):
+                out.append(
+                    f"tp-shard-layout:"
+                    f"{np.dtype(getattr(leaf, 'dtype', type(leaf)))}"
+                    f"{tuple(leaf.shape)}:{spec}"
+                )
+            else:
+                out.append(leaf)
+        tree = jax.tree.unflatten(treedef, out)
 
     def is_rank_local(node):
         return isinstance(node, (EFState, Zero1State))
@@ -89,14 +134,21 @@ def tree_digest(tree: Any, _h=None) -> str:
 def state_digest(state: Any, tracked: Optional[Sequence[str]] = None) -> str:
     """Digest an elastic ``State``'s tracked attributes: array-leaf
     pytrees hash by raw bytes, everything else by pickle (deterministic
-    for the plain counters/containers states track)."""
+    for the plain counters/containers states track).
+
+    Composed DP x TP states set ``state.sharding_specs`` — a mapping of
+    tracked-attr name to its PartitionSpec tree (the composed step's
+    ``step.sharding_specs``) — so TP-sharded leaves digest per-shard
+    (layout tracked, bytes not compared across the model axis)."""
     import jax
 
     keys = list(tracked if tracked is not None
                 else getattr(state, "_tracked", []))
+    spec_map = getattr(state, "sharding_specs", None) or {}
     h = hashlib.sha256()
     for k in sorted(keys):
-        v = strip_rank_local(getattr(state, k, None))
+        v = strip_rank_local(getattr(state, k, None),
+                             specs=spec_map.get(k))
         h.update(k.encode())
         leaves = jax.tree.leaves(v)
         if leaves and all(hasattr(l, "shape") and hasattr(l, "dtype")

@@ -112,6 +112,21 @@ reduce_in_backward = _fusion.reduce_in_backward
 stream_scan_body = _fusion.stream_scan_body
 stream_param_groups = _fusion.stream_param_groups
 
+# Composed-parallelism sharding-rules engine (parallel/rules.py;
+# docs/parallelism.md "Composed DP x TP fast path"): regex ->
+# PartitionSpec tables drive mesh placement + gather/shard fns,
+# preflighted by the Pass 5 validator. GPT_RULES is the shipped DP x TP
+# table for models/transformer.py.
+from ..parallel.rules import (  # noqa: E402
+    GPT_RULES,
+    gather_tree,
+    local_shard_tree,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    preflight_rules,
+    shard_tree,
+)
+
 
 def collective_plan(collective: str = "allreduce",
                     nbytes: int = 4 * 1024 * 1024,
@@ -312,6 +327,9 @@ def allreduce_gradients(
         axis_name=axis_name,
         threshold_bytes=fusion_threshold_bytes,
         reduce_fn=reduce_fn,
+        wire_dtype=(
+            "int8" if quantized and not hierarchical else "f32"
+        ),
     )
     if compression is not Compression.none:
         leaves, treedef = jax.tree.flatten(reduced)
@@ -1331,6 +1349,376 @@ def _build_zero1_train_step(
     return _maybe_trace(aborting_step)
 
 
+# --- composed DP x TP fast path ----------------------------------------------
+#
+# docs/parallelism.md "Composed DP x TP fast path": a sharding-rules
+# table (parallel/rules.py, regex -> PartitionSpec, first-match-wins)
+# places the param tree on a (data, model) mesh; the loss runs on local
+# shards calling parallel/tp.py layers bound to the model axis (ONE
+# forward psum per Megatron half-block, its backward conjugate handled
+# by tp_block_input/psum_replicated_grad); and the ENTIRE PR-4/9/12
+# reduction stack — streamed per-bucket reduce-scatter ZeRO-1, the int8
+# wire, bucket fusion — runs scoped to the DATA axis only. TP psums are
+# never bucketized, never quantized, never re-planned onto DCN.
+
+
+def init_composed_zero1_state(
+    optimizer,
+    params,
+    rules: Any,
+    mesh: Mesh,
+    *,
+    model_axis: str = "model",
+    axis_name: Any = DATA_AXIS,
+    threshold_bytes: Optional[int] = None,
+    first_bucket_bytes: Optional[int] = None,
+    quantized: bool = False,
+):
+    """:class:`Zero1State` for ``make_train_step(rules=..., zero1=True)``:
+    per MODEL rank, the streamed per-bucket state of that rank's local
+    param shards (``parallel/rules.local_shard_tree`` slices them), with
+    the per-bucket stacks laid out ``[n_data, n_model, ...]`` — shard
+    the leading two axes ``P(data, model)``; the step indexes its
+    ``[0, 0]`` cell. The bucket partition is over each model rank's
+    LOCAL leaves, so it round-trips bitwise with the in-step update.
+    Composed mode carries no EF residual (the sharded-EF side channel is
+    a single-axis feature); the int8 wire still applies per DP bucket."""
+    from ..parallel import rules as _rules
+
+    from ..parallel import zero as _zero
+
+    rules = _rules.resolve_rules(rules)
+    specs = _rules.match_partition_rules(rules, params)
+    n_model = int(mesh.shape[model_axis])
+    n_data = 1
+    for ax in (tuple(axis_name) if isinstance(axis_name, (tuple, list))
+               else (axis_name,)):
+        n_data *= int(mesh.shape[ax])
+    states = []
+    for m in range(n_model):
+        local = _rules.local_shard_tree(
+            params, specs, {model_axis: (m, n_model)}
+        )
+        states.append(_zero.init_zero1_stream_state(
+            optimizer, local, n_data,
+            threshold_bytes=threshold_bytes,
+            first_bucket_bytes=first_bucket_bytes,
+            quantized=quantized, error_feedback=False,
+        ))
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *states)
+
+
+def _build_composed_train_step(
+    loss_fn: Callable[..., jax.Array],
+    optimizer,
+    mesh: Mesh,
+    *,
+    rules: Any,
+    model_axis: str,
+    axis_name: str = DATA_AXIS,
+    op: ReduceOp = Average,
+    fusion_threshold_bytes: Optional[int] = None,
+    compression=Compression.none,
+    hierarchical: Any = False,
+    quantized: Optional[bool] = None,
+    error_feedback: Optional[bool] = None,
+    donate: bool = True,
+    has_aux: bool = False,
+    overlap: bool = False,
+    first_bucket_bytes: Optional[int] = None,
+    nonfinite: Optional[str] = None,
+    topo_algorithm: Optional[str] = None,
+    zero1: bool = False,
+    tuned_cfg: Any = None,
+    tuned_source: str = "none",
+):
+    """The composed step: ``step(params, opt_state, batch)`` with params
+    placed by the rule table (sharded leaves enter as local shards),
+    batch sharded over the data axis, and gradient reduction scoped to
+    the data axis only. Replicated-leaf gradients come out of the
+    backward already FULL and model-identical — ``parallel/tp.py``'s
+    f/g conjugate psums (``tp_block_input`` + ``row_parallel``) reduce
+    the cotangents at every replicated->sharded boundary — so the DP
+    reduction is the only gradient collective this step adds.
+
+    The build is deferred to the first call: the live params decide the
+    spec tree (validated by the Pass 5 preflight ALWAYS — not gated on
+    HOROVOD_TPU_STATIC_CHECKS) and the optimizer state's placement is
+    matched by the same rule table (optax trees embed the param names).
+    """
+    import optax
+
+    from ..common.compat import needs_explicit_grad_reduce
+    from ..parallel import rules as _rules
+    from ..parallel import zero as _zero
+    from .. import tune as _tune
+
+    rules = _rules.resolve_rules(rules)
+    # The DP scope may itself be hierarchical — an explicit
+    # ("cross", "local") axis TUPLE runs the zero1 RS/AG through the
+    # compositor's two-level lowerings, still strictly on the data
+    # axes. The model axis stays a single flat ICI axis.
+    dp_axes = (
+        tuple(axis_name) if isinstance(axis_name, (tuple, list))
+        else (axis_name,)
+    )
+    for ax in dp_axes + (model_axis,):
+        if ax not in mesh.axis_names:
+            raise ValueError(
+                f"composed mode needs mesh axes ({axis_name!r}, "
+                f"{model_axis!r}); mesh has {tuple(mesh.axis_names)}"
+            )
+    if model_axis in dp_axes:
+        raise ValueError(
+            f"model_axis {model_axis!r} cannot also be a data axis"
+        )
+    axis_name = dp_axes[0] if len(dp_axes) == 1 else dp_axes
+    if hierarchical == "auto":
+        hierarchical = False  # the explicit axis tuple IS the hierarchy
+    if hierarchical:
+        raise ValueError(
+            "composed rules= mode scopes hierarchy to the DP axes "
+            "EXPLICITLY: pass axis_name=('cross', 'local') for a "
+            "two-level DP scope instead of hierarchical=True — the TP "
+            "psums must never be re-planned onto DCN, so the knob that "
+            "re-plans the whole step is rejected"
+        )
+    if topo_algorithm is not None:
+        raise ValueError(
+            "topo_algorithm pins a compositor plan; the composed DP "
+            "axis lowers flat and TP psums are never re-planned — drop "
+            "topo_algorithm"
+        )
+    if compression is not Compression.none:
+        raise ValueError(
+            "composed mode rejects cast compression; use "
+            "quantized=True for the DP-axis int8 wire"
+        )
+    if error_feedback:
+        raise ValueError(
+            "error feedback rides the single-axis streamed side "
+            "channel; composed mode runs the int8 wire EF-off"
+        )
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError(
+            f"composed mode reduces SUM/AVERAGE over the data axis; "
+            f"got {ReduceOp(op).name}"
+        )
+    quantized = _resolve_quantized(quantized)
+    _check_overlap_rejections(overlap, quantized, op)
+    if quantized and len(dp_axes) > 1:
+        raise ValueError(
+            "quantized composed DP runs the flat int8 ring over ONE "
+            "data axis; the two-level DP scope has no int8 RS+AG form "
+            "— drop quantized or the axis tuple"
+        )
+    nonfinite_policy = _resolve_nonfinite(nonfinite)
+    n_model = int(mesh.shape[model_axis])
+    n_data = 1
+    for ax in dp_axes:
+        n_data *= int(mesh.shape[ax])
+    # Old jax: the custom_vjp conjugate psums carry transpose
+    # correctness and check_rep only constrains; new jax (vma): the
+    # checker IS the transpose machinery and must be on.
+    check = not needs_explicit_grad_reduce()
+
+    built: dict = {}
+
+    def _build(params, opt_state):
+        threshold = fusion_threshold_bytes
+        first = first_bucket_bytes
+        if tuned_cfg is not None:
+            live = _tune.step_signature(params, mesh=mesh)
+            matched = _tune.signatures_match(tuned_cfg.signature, live)
+            if matched:
+                tk = _tune.tuned_step_kwargs(tuned_cfg)
+                if threshold is None:
+                    threshold = tk["fusion_threshold_bytes"]
+                if first is None:
+                    first = tk["first_bucket_bytes"]
+            else:
+                _tune.warn_signature_mismatch(
+                    tuned_cfg, live.get("hash", "?"),
+                    "make_train_step(rules=...)",
+                )
+            _tune.note_applied(
+                tuned_source, tuned_cfg.signature_hash, matched,
+                "make_train_step(rules=...)",
+            )
+        # Pass 5 preflight — ALWAYS enforced for the composed path.
+        _rules.preflight_rules(rules, mesh, params)
+        specs = _rules.match_partition_rules(rules, params)
+        if zero1:
+            if not isinstance(opt_state, Zero1State):
+                raise TypeError(
+                    "composed zero1=True expects the Zero1State from "
+                    "hvd.init_composed_zero1_state(optimizer, params, "
+                    f"rules, mesh, ...); got {type(opt_state).__name__}"
+                )
+            state_spec: Any = P(
+                dp_axes if len(dp_axes) > 1 else dp_axes[0], model_axis
+            )
+        else:
+            state_spec = _rules.match_partition_rules(rules, opt_state)
+        knobs = dict(threshold_bytes=threshold, first_bucket_bytes=first)
+
+        def step(params, opt_state, batch):
+            if zero1:
+                state = jax.tree.map(lambda s: s[0, 0], opt_state)
+
+            def local_loss(p, b):
+                if overlap:
+                    p = _fusion.stream_param_groups(
+                        p, op=op, axis_name=axis_name,
+                        quantized=quantized, nonfinite=nonfinite_policy,
+                        zero1=zero1, **knobs,
+                    )
+                return loss_fn(p, b)
+
+            grad_fn = jax.value_and_grad(local_loss, has_aux=has_aux)
+            if has_aux:
+                (loss, aux), grads = grad_fn(params, batch)
+            else:
+                loss, grads = grad_fn(params, batch)
+                aux = None
+            flag = None
+            if overlap:
+                _fusion.take_stream_registrations()
+            else:
+                if nonfinite_policy in ("skip", "abort"):
+                    flag = _nf.local_flag(grads)
+                if nonfinite_policy == "zero":
+                    grads = _nf.sanitize(grads)
+                if zero1:
+                    grads, _ = _zero.zero1_posthoc_reduce(
+                        grads, op=op, axis_name=axis_name,
+                        quantized=quantized, **knobs,
+                    )
+                else:
+                    grads = _fusion.fused_allreduce(
+                        grads, op=op, axis_name=axis_name,
+                        threshold_bytes=threshold,
+                        reduce_fn=(
+                            _q.quantized_reduce_fn("flat")
+                            if quantized else None
+                        ),
+                        label="composed-posthoc",
+                        wire_dtype="int8" if quantized else "f32",
+                    )
+            if nonfinite_policy in ("skip", "abort"):
+                post = _nf.local_flag(grads)
+                flag = post if flag is None else jnp.maximum(flag, post)
+                # Agreement over EVERY axis: a model rank's NaN must
+                # skip the step on every rank of the whole mesh.
+                flag = _nf.agree_flag(flag, dp_axes + (model_axis,))
+                _nf.note_detection(nonfinite_policy, "composed")(flag)
+            elif nonfinite_policy == "warn":
+                _nf.note_detection("warn", "composed")(
+                    _nf.local_flag(grads)
+                )
+            loss = lax.pmean(lax.pmean(loss, axis_name), model_axis)
+            if zero1:
+                new_params, new_opt = _zero.zero1_stream_update(
+                    optimizer, params, state.opt, grads,
+                    axis_name=axis_name, n_shards=n_data,
+                    quantized=quantized, **knobs,
+                )
+                if flag is not None:
+                    new_params = _nf.select_on_flag(
+                        flag, params, new_params
+                    )
+                    new_opt = _nf.select_on_flag(flag, state.opt, new_opt)
+                new_state = jax.tree.map(
+                    lambda s: s[None, None],
+                    Zero1State(opt=new_opt, ef=state.ef),
+                )
+            else:
+                updates, new_opt = optimizer.update(
+                    grads, opt_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
+                if flag is not None:
+                    new_params = _nf.select_on_flag(
+                        flag, params, new_params
+                    )
+                    new_opt = _nf.select_on_flag(flag, opt_state, new_opt)
+                new_state = new_opt
+            outs = [new_params, new_state, loss]
+            if has_aux:
+                outs.append(jax.tree.map(
+                    lambda a: lax.pmean(
+                        lax.pmean(a, axis_name), model_axis
+                    ),
+                    aux,
+                ))
+            if nonfinite_policy == "abort":
+                outs.append(flag)
+            return tuple(outs)
+
+        extra = (1 if has_aux else 0) + (
+            1 if nonfinite_policy == "abort" else 0
+        )
+        fn = _shard_map(
+            step, mesh, check=check,
+            in_specs=(specs, state_spec, P(axis_name)),
+            out_specs=(specs, state_spec, P()) + (P(),) * extra,
+        )
+        jitted = jax.jit(fn, donate_argnums=(0, 1) if donate else ())
+
+        def _maybe_trace(step_fn):
+            return _trace.wrap_step(
+                step_fn,
+                composed=True, tp=n_model, dp=n_data,
+                overlap=overlap, quantized=quantized, zero1=zero1,
+                wire_dtype="int8" if quantized else "f32",
+                op=ReduceOp(op).name, nonfinite=nonfinite_policy,
+            )
+
+        if nonfinite_policy != "abort":
+            return _maybe_trace(jitted), jitted, specs, state_spec
+
+        def aborting_step(params, opt_state, batch):
+            import numpy as np
+
+            out = jitted(params, opt_state, batch)
+            flag = out[-1]
+            if float(np.asarray(flag)) > 0:
+                from .. import HorovodInternalError
+
+                if _trace.ACTIVE:
+                    _trace.TAP.flight_dump("guard-abort")
+                raise HorovodInternalError(
+                    "non-finite gradient guard (policy abort): a rank "
+                    "produced NaN/Inf gradients this step; the composed "
+                    "update was not applied on any rank (cross-rank "
+                    "agreed over data AND model axes)"
+                )
+            return out[:-1]
+
+        return _maybe_trace(aborting_step), jitted, specs, state_spec
+
+    def dispatch(params, opt_state, batch):
+        if "step" not in built:
+            step, jitted, specs, state_spec = _build(params, opt_state)
+            built["step"] = step
+            # The inner jax.jit step — HLO inspection (tests assert the
+            # one-psum-per-block TP structure off it).
+            dispatch.jitted = jitted
+            # Digest integration (guard/digest.strip_rank_local): the
+            # spec trees mark which leaves are TP-sharded — attach as
+            # State.sharding_specs so cross-rank digests hash their
+            # LAYOUT, never their (legitimately divergent) bytes.
+            dispatch.sharding_specs = {
+                "params": specs,
+                **({} if zero1 else {"opt_state": state_spec}),
+            }
+        return built["step"](params, opt_state, batch)
+
+    dispatch.sharding_specs = None
+    dispatch.jitted = None
+    return dispatch
+
+
 def make_train_step(
     loss_fn: Callable[..., jax.Array],
     optimizer,
@@ -1351,10 +1739,26 @@ def make_train_step(
     tuned: Any = None,
     topo_algorithm: Optional[str] = None,
     zero1: bool = False,
+    rules: Any = None,
+    model_axis: str = "model",
 ):
     """See :func:`_build_train_step` for the core semantics — this public
     wrapper adds pinned offline tuning (docs/autotune.md "Compiled-path
     offline tuning").
+
+    ``rules`` (docs/parallelism.md "Composed DP x TP fast path") switches
+    to the composed builder: a sharding-rules table (a ``(regex,
+    PartitionSpec)`` sequence or a shipped name like ``"gpt"`` —
+    ``parallel/rules.py``) places params and optimizer state on the
+    ``(axis_name, model_axis)`` mesh, ``loss_fn`` runs on the LOCAL
+    shards calling ``parallel/tp.py`` layers bound to ``model_axis``
+    (e.g. ``models.transformer.tp_apply``), and the whole
+    overlap/quantized/zero1 reduction stack applies to the DATA axis
+    only — TP psums are never bucketized, quantized, or re-planned.
+    ``zero1=True`` then takes the state from
+    :func:`init_composed_zero1_state`. The returned step exposes
+    ``step.sharding_specs`` (after the first call) for the guard's
+    digest agreement (``guard/digest.strip_rank_local``).
 
     ``zero1=True`` (docs/overlap.md "Streamed ZeRO-1") shards the
     optimizer state per streamed bucket over the data axis: the step
@@ -1396,6 +1800,11 @@ def make_train_step(
         topo_algorithm=topo_algorithm, zero1=zero1,
     )
     tuned_cfg, tuned_source = _tune.resolve_tuned(tuned)
+    if rules is not None:
+        return _build_composed_train_step(
+            loss_fn, optimizer, mesh, rules=rules, model_axis=model_axis,
+            tuned_cfg=tuned_cfg, tuned_source=tuned_source, **kwargs,
+        )
     if tuned_cfg is None:
         return _build_train_step(loss_fn, optimizer, mesh, **kwargs)
 

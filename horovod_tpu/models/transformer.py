@@ -5,6 +5,16 @@ attention, or Ulysses (``horovod_tpu.parallel.ring_attention``), letting the
 same module run single-chip or sequence-parallel inside a shard_map without
 code changes. bfloat16 compute with fp32 logits; positions are passed in so
 sequence-sharded shards can feed their global offsets.
+
+Every submodule is EXPLICITLY named (``block_0/attention/query/kernel``,
+``mlp/up/bias``, ``ln_f/scale``, ...) so the param tree is a stable,
+meaningful namespace the sharding-rules engine can place by regex
+(``parallel/rules.py``; the shipped DP x TP table is
+``analysis.sharding_rules.EXAMPLE_GPT_RULES``). :func:`tp_apply` is the
+tensor-parallel functional forward of the SAME tree: it consumes the
+leaves as (possibly TP-local) shards through ``parallel/tp.py``'s
+column-/row-parallel layers — one psum per Megatron half-block — with
+attention on the local heads through the Pallas flash kernel.
 """
 
 from __future__ import annotations
@@ -19,10 +29,13 @@ from flax import linen as nn
 from ..ops.pallas_attention import flash_attention_bthd
 
 
-class Block(nn.Module):
-    d_model: int
+class Attention(nn.Module):
+    """Multi-head self-attention with separate q/k/v projections — the
+    layout the TP rules shard: a contiguous feature slice of one
+    projection is whole heads, so ``P(None, "model")`` on each kernel is
+    exactly Megatron head sharding."""
+
     n_heads: int
-    mlp_ratio: int = 4
     dtype: Any = jnp.bfloat16
     attn_fn: Optional[Callable] = None
 
@@ -32,23 +45,48 @@ class Block(nn.Module):
         H = self.n_heads
         D = C // H
         # Default attention is the fused Pallas flash kernel (interpret
-        # mode off-TPU); callers plug ring/Ulysses attention in via attn_fn
-        # for sequence parallelism.
+        # mode off-TPU); callers plug ring/Ulysses attention in via
+        # attn_fn for sequence parallelism.
         attn = self.attn_fn or partial(flash_attention_bthd, causal=True)
+        q = nn.Dense(C, use_bias=False, dtype=self.dtype, name="query")(x)
+        k = nn.Dense(C, use_bias=False, dtype=self.dtype, name="key")(x)
+        v = nn.Dense(C, use_bias=False, dtype=self.dtype, name="value")(x)
+        shape = (B, T, H, D)
+        a = attn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        a = a.reshape(B, T, C)
+        return nn.Dense(C, use_bias=False, dtype=self.dtype, name="out")(a)
 
-        h = nn.LayerNorm(dtype=self.dtype)(x)
-        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype)(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, D)
-        k = k.reshape(B, T, H, D)
-        v = v.reshape(B, T, H, D)
-        a = attn(q, k, v).reshape(B, T, C)
-        x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(a)
 
-        h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype)(h)
+class Mlp(nn.Module):
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype, name="up")(x)
         h = nn.gelu(h)
-        x = x + nn.Dense(C, dtype=self.dtype)(h)
+        return nn.Dense(C, dtype=self.dtype, name="down")(h)
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        x = x + Attention(
+            n_heads=self.n_heads, dtype=self.dtype, attn_fn=self.attn_fn,
+            name="attention",
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        x = x + Mlp(
+            mlp_ratio=self.mlp_ratio, dtype=self.dtype, name="mlp"
+        )(h)
         return x
 
 
@@ -70,19 +108,161 @@ class TransformerLM(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T), (B, T))
         tok_emb = nn.Embed(self.vocab_size, self.d_model,
-                           dtype=self.dtype)(tokens)
+                           dtype=self.dtype, name="embeddings")(tokens)
         pos_emb = nn.Embed(self.max_len, self.d_model,
-                           dtype=self.dtype)(positions)
+                           dtype=self.dtype, name="pos_embeddings")(positions)
         x = tok_emb + pos_emb
         block = Block
         if self.remat:
             block = nn.remat(Block)
-        for _ in range(self.n_layers):
+        for i in range(self.n_layers):
             x = block(
                 d_model=self.d_model, n_heads=self.n_heads,
                 dtype=self.dtype, attn_fn=self.attn_fn,
+                name=f"block_{i}",
             )(x)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab_size, use_bias=False,
-                          dtype=jnp.float32)(x)
+                          dtype=jnp.float32, name="lm_head")(x)
         return logits
+
+
+# --- tensor-parallel functional forward --------------------------------------
+#
+# The composed DP x TP fast path (docs/parallelism.md) cannot run the
+# flax module on TP-local shards — flax shape-checks every param against
+# the module's declared (full) feature sizes. tp_apply is the functional
+# twin: same param NAMES, same math, but each leaf is consumed at
+# whatever (local) shape the sharding rules left it, and the two
+# row-parallel projections reduce with ONE psum each over the model
+# axis (parallel/tp.py). With model_axis=None it is the dense reference
+# the composed parity tests compare against.
+
+
+def _layer_norm(x, p, dtype):
+    """nn.LayerNorm parity (eps 1e-6, f32 statistics) on a raw
+    {"scale","bias"} param dict."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def transformer_n_layers(params) -> int:
+    return sum(1 for k in params if str(k).startswith("block_"))
+
+
+def tp_apply(
+    params,
+    tokens,
+    *,
+    n_heads: int,
+    model_axis: Optional[str] = None,
+    positions=None,
+    dtype: Any = jnp.bfloat16,
+    causal: bool = True,
+):
+    """Functional forward of the :class:`TransformerLM` param tree on
+    (possibly TP-local) shards.
+
+    ``n_heads`` is the GLOBAL head count (the head dim derives from the
+    replicated ``d_model``); with ``model_axis`` bound each rank runs
+    its local ``H/n`` heads and ``F/n`` MLP columns through
+    ``parallel/tp.py`` — q/k/v and the MLP up-projection are
+    column-parallel (no communication), attention-out and MLP-down are
+    row-parallel (ONE psum each, biases scattered inside the reduction).
+    Embeddings, norms, and the lm head consume replicated leaves. With
+    ``model_axis=None`` every shard is full-size and the function is the
+    dense single-chip reference (bitwise the same interpretation of the
+    same tree)."""
+    from ..parallel.tp import column_parallel, row_parallel, tp_block_input
+
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    emb = params["embeddings"]["embedding"]
+    pos = params["pos_embeddings"]["embedding"]
+    x = (emb[tokens] + pos[positions]).astype(dtype)
+    C = emb.shape[-1]
+    if C % n_heads:
+        raise ValueError(f"d_model {C} not divisible by n_heads {n_heads}")
+    head_dim = C // n_heads
+
+    def f(y):
+        # Megatron's `f`: marks the replicated block input feeding
+        # column-parallel shards (identity fwd, cotangent psum bwd).
+        return y if model_axis is None else tp_block_input(
+            y, axis_name=model_axis
+        )
+
+    def row(y, w, b=None):
+        if model_axis is None:
+            out = y @ w
+            return out + b if b is not None else out
+        return row_parallel(y, w, b, axis_name=model_axis)
+
+    for i in range(transformer_n_layers(params)):
+        bp = params[f"block_{i}"]
+        h = f(_layer_norm(x, bp["ln_1"], dtype))
+        att = bp["attention"]
+        q = column_parallel(h, att["query"]["kernel"].astype(dtype))
+        k = column_parallel(h, att["key"]["kernel"].astype(dtype))
+        v = column_parallel(h, att["value"]["kernel"].astype(dtype))
+        if q.shape[-1] % head_dim:
+            raise ValueError(
+                f"local q/k/v width {q.shape[-1]} is not whole heads of "
+                f"dim {head_dim} — n_heads must divide by the model-axis "
+                f"size"
+            )
+        hl = q.shape[-1] // head_dim
+        shape = (B, T, hl, head_dim)
+        a = flash_attention_bthd(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            causal=causal,
+        )
+        a = a.reshape(B, T, hl * head_dim)
+        x = x + row(a, att["out"]["kernel"].astype(dtype))
+        h = f(_layer_norm(x, bp["ln_2"], dtype))
+        mlp = bp["mlp"]
+        u = jax.nn.gelu(column_parallel(
+            h, mlp["up"]["kernel"].astype(dtype),
+            mlp["up"]["bias"].astype(dtype),
+        ))
+        x = x + row(
+            u, mlp["down"]["kernel"].astype(dtype),
+            mlp["down"]["bias"].astype(dtype),
+        )
+    x = _layer_norm(x, params["ln_f"], dtype)
+    w = params["lm_head"]["kernel"].astype(jnp.float32)
+    return x.astype(jnp.float32) @ w
+
+
+def lm_loss(logits, labels):
+    """Mean next-token cross entropy (no optax dependency)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def make_gpt_loss_fn(
+    n_heads: int,
+    *,
+    model_axis: Optional[str] = None,
+    dtype: Any = jnp.bfloat16,
+):
+    """``loss_fn(params, (tokens, labels))`` over :func:`tp_apply` — the
+    loss the composed ``make_train_step(rules=...)`` trains and the
+    dense reference (``model_axis=None``) the parity tests compare
+    against."""
+
+    def loss_fn(params, batch):
+        tokens, labels = batch
+        logits = tp_apply(
+            params, tokens, n_heads=n_heads, model_axis=model_axis,
+            dtype=dtype,
+        )
+        return lm_loss(logits, labels)
+
+    return loss_fn

@@ -123,6 +123,56 @@ def lax_slice(buf: jax.Array, offset: int, length: int) -> jax.Array:
     return jax.lax.slice_in_dim(buf, offset, offset + length, axis=0)
 
 
+def axis_label(axis_name) -> str:
+    """The stable ``axis`` label of one reduction axis (or axis tuple)
+    for per-axis attribution: ``"data"``, ``"model"``, ``"cross+local"``."""
+    return "+".join(str(a) for a in _axes_of(axis_name))
+
+
+def record_axis_wire_bytes(
+    payload_bytes: int,
+    axis_name,
+    collective: str,
+    wire_dtype: str = "f32",
+) -> None:
+    """Trace-time per-axis bytes-on-wire attribution (one emission per
+    compile, the ``hvd_quantized_*`` discipline): ring accounting of
+    what ONE step moves over the named axis per chip —
+    ``hvd_axis_wire_bytes_total{axis,collective}`` (docs/metrics.md) plus
+    a trace-tap plan note so step spans carry the split. This is what
+    lets a composed DP x TP program report its DP and TP wire bytes
+    SEPARATELY (docs/parallelism.md "Per-axis attribution"). Must be
+    called inside the axis-binding trace (the axis size is read off the
+    live binding); no-op when neither metrics nor tracing is armed."""
+    if not (_metrics.ACTIVE or _trace.ACTIVE):
+        return
+    n = _axis_size_of(
+        tuple(_axes_of(axis_name)) if isinstance(axis_name, (tuple, list))
+        else axis_name
+    )
+    if n <= 1:
+        return
+    payload = int(payload_bytes)
+    if wire_dtype == "int8":
+        from ..common.quant import int8_wire_bytes
+
+        payload = int8_wire_bytes(payload)
+    if collective in ("allreduce", "psum"):
+        onwire = 2 * (n - 1) * payload // n
+    else:  # reduce_scatter / all_gather: one ring pass
+        onwire = (n - 1) * payload // n
+    label = axis_label(axis_name)
+    if _metrics.ACTIVE:
+        _metrics.TAP.inc(
+            "hvd_axis_wire_bytes_total", float(onwire),
+            axis=label, collective=collective,
+        )
+    if _trace.ACTIVE:
+        _trace.TAP.note_plan(
+            **{f"axis_wire_bytes:{label}:{collective}": int(onwire)}
+        )
+
+
 def fused_allreduce(
     tree: Any,
     *,
@@ -133,6 +183,7 @@ def fused_allreduce(
     postscale_factor: float = 1.0,
     reduce_fn: Callable[..., jax.Array] | None = None,
     label: str = "posthoc",
+    wire_dtype: str = "f32",
 ) -> Any:
     """Allreduce every leaf of a pytree with bucket fusion.
 
@@ -146,6 +197,10 @@ def fused_allreduce(
     if not leaves:
         return tree
     buckets = plan_buckets(leaves, threshold_bytes)
+    record_axis_wire_bytes(
+        sum(l.size * dtype_size(dtype_from_array(l)) for l in leaves),
+        axis_name, "allreduce", wire_dtype,
+    )
     if _trace.ACTIVE:
         # Correlation ids for the fleet-trace step spans (trace-time,
         # one note per compile): which fusion path reduced how many
@@ -443,6 +498,11 @@ def fused_reduce_scatter(
         return tree, ef
     n = _axis_size_of(axes if len(axes) > 1 else axes[0])
     buckets = plan_buckets(leaves, threshold_bytes)
+    record_axis_wire_bytes(
+        sum(l.size * dtype_size(dtype_from_array(l)) for l in leaves),
+        axis_name, "reduce_scatter",
+        "int8" if quantized else "f32",
+    )
     if _trace.ACTIVE:
         _trace.TAP.note_plan(
             fusion_path=label, fusion_buckets=len(buckets),
@@ -592,6 +652,12 @@ def _reduce_stream_group(cfg: StreamConfig, ct: Any) -> Any:
         threshold_bytes=cfg.threshold_bytes,
         reduce_fn=reduce_fn,
         label=cfg.label,
+        # Attribution only: hierarchical/planned wires compress at most
+        # the DCN hop, so the flat-int8 accounting would overstate.
+        wire_dtype=(
+            "int8" if cfg.quantized and not (cfg.planned or cfg.hierarchical)
+            else "f32"
+        ),
     )
     if compression is not None:
         leaves, treedef = jax.tree.flatten(reduced)
@@ -671,6 +737,10 @@ def quantized_ef_allreduce(
     if not leaves:
         return tree, ef
     buckets = plan_buckets(leaves, threshold_bytes)
+    record_axis_wire_bytes(
+        sum(l.size * dtype_size(dtype_from_array(l)) for l in leaves),
+        axis_name, "allreduce", "int8",
+    )
     if _trace.ACTIVE:
         # Correlation ids for the fleet-trace step spans (trace-time):
         # the EF int8 wire reduced this many buckets under this label.

@@ -1,0 +1,293 @@
+"""Declarative sharding-rules engine: regex -> PartitionSpec tables.
+
+The composed-parallelism fast path (docs/parallelism.md "Composed DP x TP
+fast path") places every parameter of a model by TABLE, not by hand: an
+ordered sequence of ``(regex, PartitionSpec)`` rules is matched against
+each leaf's ``/``-joined tree path and the FIRST hit decides the leaf's
+mesh placement (the ``match_partition_rules`` shape from the reference
+repos in SNIPPETS.md). Scalars always replicate; a non-scalar leaf no
+rule matches is an error, not a silent default — and the whole table is
+preflighted by the Pass 5 static validator (``analysis/sharding_rules``)
+against the mesh AND the concrete shape table before anything is traced,
+so a typo'd axis or a non-divisible dim fails at build time with a named
+finding instead of deep inside pjit.
+
+The same table places optimizer state: optax state trees embed the param
+tree (``0/mu/block_0/attention/query/kernel``), and ``re.search`` keyed
+rules hit the embedded name, so one table drives params, Adam moments,
+and anything else shaped like the model.
+
+``make_shard_and_gather_fns`` turns a spec tree into per-leaf jitted
+placement/collection functions (shard -> gather round-trips bitwise);
+``local_shard_tree`` is the host-side view of ONE mesh coordinate's
+shards (what the composed ZeRO-1 state init and the digest tests slice).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..analysis.sharding_rules import (
+    EXAMPLE_GPT_RULES,
+    Rule,
+    normalize_spec,
+)
+
+__all__ = [
+    "GPT_RULES",
+    "NAMED_RULES",
+    "gather_tree",
+    "local_shard_tree",
+    "make_shard_and_gather_fns",
+    "match_partition_rules",
+    "named_tree_paths",
+    "preflight_rules",
+    "resolve_rules",
+    "shard_tree",
+    "spec_mentions",
+    "tree_shape_table",
+]
+
+# The reference DP x TP GPT table — validated against the REAL
+# models/transformer.py param tree by Pass 5 (tools/collective_lint.py
+# sharding) and trained by the composed fast path.
+GPT_RULES: Tuple[Rule, ...] = EXAMPLE_GPT_RULES
+
+NAMED_RULES: Dict[str, Tuple[Rule, ...]] = {"gpt": GPT_RULES}
+
+
+def resolve_rules(rules: Any) -> Sequence[Rule]:
+    """A rule table, or the name of a shipped one (``"gpt"``)."""
+    if isinstance(rules, str):
+        try:
+            return NAMED_RULES[rules]
+        except KeyError:
+            raise ValueError(
+                f"unknown named rule table {rules!r}; shipped tables: "
+                f"{sorted(NAMED_RULES)}"
+            ) from None
+    return rules
+
+
+def _key_name(key: Any) -> str:
+    """Render one tree-path key the way flax renders param names."""
+    for attr in ("key", "idx", "name"):
+        v = getattr(key, attr, None)
+        if v is not None:
+            return str(v)
+    return str(key)
+
+
+def named_tree_paths(tree: Any) -> List[Tuple[str, Any]]:
+    """``[(/-joined path, leaf)]`` in flatten order — the names the rule
+    regexes match (flax params: ``block_0/attention/query/kernel``)."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [
+        ("/".join(_key_name(k) for k in path), leaf)
+        for path, leaf in flat
+    ]
+
+
+def tree_shape_table(tree: Any) -> Dict[str, Tuple[int, ...]]:
+    """``{name: shape}`` for the Pass 5 validator (arrays or avals)."""
+    return {
+        name: tuple(int(d) for d in getattr(leaf, "shape", ()))
+        for name, leaf in named_tree_paths(tree)
+    }
+
+
+def _is_scalar(leaf: Any) -> bool:
+    shape = tuple(getattr(leaf, "shape", ()))
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return len(shape) == 0 or n == 1
+
+
+def match_partition_rules(rules: Any, tree: Any) -> Any:
+    """First-match-wins placement: a pytree of ``PartitionSpec`` leaves
+    mirroring ``tree``. Scalars replicate unconditionally; a non-scalar
+    leaf no rule matches raises (add a catch-all ``(".*", None)`` to
+    replicate by default). PartitionSpec-shaped specs (None / axis name /
+    tuples) are normalized through the Pass 5 grammar."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    rules = resolve_rules(rules)
+    compiled = []
+    for pattern, spec in rules:
+        norm = normalize_spec(spec)
+        if norm is None:
+            raise ValueError(
+                f"rule {pattern!r} spec {spec!r} is not "
+                f"PartitionSpec-shaped"
+            )
+        compiled.append((re.compile(pattern), norm))
+
+    def to_spec(norm: Tuple[Tuple[str, ...], ...]) -> Any:
+        return P(*(
+            (None if not axes else (axes[0] if len(axes) == 1
+                                    else tuple(axes)))
+            for axes in norm
+        ))
+
+    names = iter(named_tree_paths(tree))
+
+    def place(leaf):
+        name, _ = next(names)
+        if _is_scalar(leaf):
+            return P()
+        for rx, norm in compiled:
+            if rx.search(name) is not None:
+                return to_spec(norm)
+        raise ValueError(
+            f"no sharding rule matches param {name!r} (shape "
+            f"{tuple(getattr(leaf, 'shape', ()))}); add a rule or a "
+            f"catch-all ('.*', None)"
+        )
+
+    return jax.tree.map(place, tree)
+
+
+def spec_leaves(specs: Any) -> List[Any]:
+    """Flatten a spec tree treating ``PartitionSpec`` (a tuple subclass)
+    as a LEAF."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def spec_mentions(spec: Any, axes: Sequence[str]) -> bool:
+    """Whether a PartitionSpec shards any dim over one of ``axes``."""
+    norm = normalize_spec(spec)
+    if not norm:
+        return False
+    want = set(axes)
+    return any(bool(want.intersection(entry)) for entry in norm)
+
+
+def preflight_rules(rules: Any, mesh: Any, tree: Any,
+                    *, suppress: Optional[Sequence[str]] = None) -> None:
+    """Pass 5 preflight of ``(rules, mesh, tree)`` — ALWAYS enforced for
+    the composed path (not gated on HOROVOD_TPU_STATIC_CHECKS): error
+    findings raise :class:`~horovod_tpu.analysis.CollectiveSafetyError`
+    naming the rule/param, warnings are logged."""
+    import logging
+
+    from ..analysis import CollectiveSafetyError
+    from ..analysis.sharding_rules import validate_sharding_rules
+
+    rules = resolve_rules(rules)
+    axes = mesh
+    shape = getattr(mesh, "shape", None)
+    if shape is not None:
+        axes = {str(k): int(v) for k, v in dict(shape).items()}
+    findings = validate_sharding_rules(
+        rules, axes, tree_shape_table(tree), suppress=suppress
+    )
+    errors = [f for f in findings if f.severity == "error"]
+    if errors:
+        raise CollectiveSafetyError(errors)
+    for f in findings:
+        logging.getLogger("horovod_tpu").warning("%s", f.render())
+
+
+def make_shard_and_gather_fns(
+    specs: Any, mesh: Any
+) -> Tuple[Any, Any]:
+    """Per-leaf jitted placement functions from a spec tree (the
+    SNIPPETS.md ``make_shard_and_gather_fns`` shape): ``shard_fns[leaf]``
+    constrains the leaf onto its ``NamedSharding(mesh, spec)``;
+    ``gather_fns[leaf]`` collects it back fully replicated. Shard →
+    gather round-trips BITWISE (pure data movement, tested)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    leaves, treedef = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    shard_leaves, gather_leaves = [], []
+    for spec in leaves:
+        sharded = NamedSharding(mesh, spec)
+        replicated = NamedSharding(mesh, P())
+        shard_leaves.append(jax.jit(lambda x: x, out_shardings=sharded))
+        gather_leaves.append(jax.jit(lambda x: x, out_shardings=replicated))
+    return (
+        jax.tree.unflatten(treedef, shard_leaves),
+        jax.tree.unflatten(treedef, gather_leaves),
+    )
+
+
+def shard_tree(tree: Any, specs: Any, mesh: Any) -> Any:
+    """Place every leaf of ``tree`` per its spec (device placement only;
+    values unchanged)."""
+    import jax
+
+    shard_fns, _ = make_shard_and_gather_fns(specs, mesh)
+    return jax.tree.map(lambda f, x: f(x), shard_fns, tree)
+
+
+def gather_tree(tree: Any, specs: Any, mesh: Any) -> Any:
+    """Collect every leaf back fully replicated (bitwise inverse of
+    :func:`shard_tree`)."""
+    import jax
+
+    _, gather_fns = make_shard_and_gather_fns(specs, mesh)
+    return jax.tree.map(lambda f, x: f(x), gather_fns, tree)
+
+
+def local_shard_tree(
+    tree: Any,
+    specs: Any,
+    coords: Mapping[str, Tuple[int, int]],
+) -> Any:
+    """The host-side view of ONE mesh coordinate's shards: for each leaf,
+    slice every dim its spec shards over an axis named in ``coords``
+    (``{axis: (index, size)}``) to that coordinate's chunk; dims sharded
+    over axes NOT in ``coords`` (and replicated leaves) pass through.
+    This is what the composed ZeRO-1 state init uses to build each model
+    rank's bucket states, and what the digest tests slice. A dim sharded
+    over a mix of named and unnamed axes is rejected (ambiguous chunk)."""
+    import jax
+
+    names = iter(named_tree_paths(tree))
+    s_leaves = iter(spec_leaves(specs))
+
+    def slice_leaf(leaf):
+        name, _ = next(names)
+        spec = next(s_leaves)
+        norm = normalize_spec(spec) or ()
+        out = leaf
+        for dim, dim_axes in enumerate(norm):
+            hit = [a for a in dim_axes if a in coords]
+            if not hit:
+                continue
+            if len(hit) != len(dim_axes):
+                raise ValueError(
+                    f"{name!r} dim {dim} shards over {dim_axes} — a mix "
+                    f"of sliced ({hit}) and unsliced axes has no "
+                    f"well-defined local chunk"
+                )
+            idx = 0
+            total = 1
+            for a in dim_axes:
+                i, sz = coords[a]
+                idx = idx * sz + int(i)
+                total *= int(sz)
+            size = int(leaf.shape[dim])
+            if size % total:
+                raise ValueError(
+                    f"{name!r} dim {dim} (size {size}) is not divisible "
+                    f"by {total}"
+                )
+            k = size // total
+            sl = [slice(None)] * leaf.ndim
+            sl[dim] = slice(idx * k, (idx + 1) * k)
+            out = out[tuple(sl)]
+        return out
+
+    return jax.tree.map(slice_leaf, tree)

@@ -34,6 +34,55 @@ from .mesh import DATA_AXIS
 MODEL_AXIS = "model"
 
 
+def _make_block_input_psum_bwd():
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def f(x, axis_name):
+        return x
+
+    def fwd(x, axis_name):
+        return x, None
+
+    def bwd(axis_name, _res, ct):
+        from ..ops import fusion as _fusion
+
+        # The conjugate psum moves the same activation bytes the forward
+        # g-psum moves — charge the model axis (trace-time).
+        _fusion.record_axis_wire_bytes(
+            ct.size * ct.dtype.itemsize, axis_name, "psum"
+        )
+        return (lax.psum(ct, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_block_input_psum_bwd = None
+
+
+def tp_block_input(x: jax.Array, *, axis_name: str = MODEL_AXIS) -> jax.Array:
+    """Megatron's ``f`` operator — identity forward, cotangent psum over
+    the model axis in the backward: the conjugate of the row-parallel
+    ``g`` psum. Apply to a REPLICATED block input right before it feeds
+    column-parallel shards; without it, each rank's cotangent for the
+    block input carries only its OWN shard's partial, so everything
+    upstream (earlier blocks' sharded weights, embeddings) differentiates
+    wrong in multi-block stacks.
+
+    On new jax (vma shard_map, ``check_vma=True``) the replication
+    tracker inserts exactly this transpose itself and this function is
+    the identity — an explicit psum there would double-count."""
+    from ..common.compat import needs_explicit_grad_reduce
+
+    if not needs_explicit_grad_reduce():
+        return x
+    global _block_input_psum_bwd
+    if _block_input_psum_bwd is None:
+        _block_input_psum_bwd = _make_block_input_psum_bwd()
+    return _block_input_psum_bwd(x, axis_name)
+
+
 def column_parallel(x: jax.Array, w_shard: jax.Array,
                     b_shard=None) -> jax.Array:
     """y = x @ W[:, shard] (+ b[shard]): output is feature-sharded; no
@@ -69,6 +118,15 @@ def row_parallel(x_shard: jax.Array, w_shard: jax.Array, b_shard=None, *,
         full = jnp.zeros((w_shard.shape[-1],), b_shard.dtype)
         full = lax.dynamic_update_slice(full, b_shard, (i * f,))
         y = y + full
+    # Per-axis attribution (trace-time, docs/parallelism.md): the one
+    # Megatron psum of this half-block, charged to the MODEL axis so a
+    # composed DP x TP program's wire split stays honest. Never
+    # bucketized/quantized/re-planned — a plain psum XLA lays onto ICI.
+    from ..ops import fusion as _fusion
+
+    _fusion.record_axis_wire_bytes(
+        y.size * y.dtype.itemsize, axis_name, "psum"
+    )
     # Replicated-cotangent psum: the block output feeds an SPMD-identical
     # loss, so the transpose must be the identity (see compat).
     return psum_replicated_grad(y, axis_name)

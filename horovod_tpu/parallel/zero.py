@@ -382,6 +382,7 @@ def zero1_stream_update(
     g_items, _ = _zero1_groups(grads, threshold_bytes, first_bucket_bytes)
     threshold = F.default_threshold_bytes(threshold_bytes)
     idx = F.zero1_axis_rank(axes if len(axes) > 1 else axes[0])
+    ag_payload = 0
     new_subs: Dict[str, Any] = {}
     new_opt: Dict[str, Dict[str, Any]] = {}
     for (glabel, sub_p), (_, sub_g) in zip(items, g_items):
@@ -425,6 +426,7 @@ def zero1_stream_update(
                 )
             else:
                 full = lax.all_gather(new_p_shard, axes[0], tiled=True)
+            ag_payload += n_shards * k * np.dtype(packed_p.dtype).itemsize
             unpacked = F.unpack_bucket(
                 full[:total], [p_leaves[i].shape for i in bucket]
             )
@@ -440,4 +442,8 @@ def zero1_stream_update(
             )
         new_subs[glabel] = jax.tree.unflatten(treedef, results)
         new_opt[glabel] = g_opt
+    if ag_payload:
+        # Per-axis attribution (trace-time): the parameter all-gather is
+        # always full precision — replicas must stay exact.
+        F.record_axis_wire_bytes(ag_payload, axis_name, "all_gather")
     return finish(new_subs), new_opt

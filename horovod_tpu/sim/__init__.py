@@ -69,4 +69,5 @@ from .core import (  # noqa: F401
     program_from_spec,
     simulate,
     straggler_sensitivity,
+    tp_fixed_comm_us,
 )

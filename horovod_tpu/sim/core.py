@@ -82,6 +82,12 @@ class SimProgram:
     forward_us: float = 0.0
     optimizer_us: float = 0.0
     source: str = "layers"
+    # Fixed per-step SYNCHRONOUS communication outside the DP staircase
+    # — the composed DP x TP program's in-block psums, priced on the
+    # innermost (ICI) hop (:func:`tp_fixed_comm_us`). Counts as exposed
+    # communication (never as compute), so scaling efficiency stays
+    # honest for the composed shape.
+    fixed_comm_us: float = 0.0
 
     @property
     def total_bytes(self) -> int:
@@ -103,6 +109,7 @@ class SimProgram:
             "source": self.source,
             "forward_us": round(float(self.forward_us), 4),
             "optimizer_us": round(float(self.optimizer_us), 4),
+            "fixed_comm_us": round(float(self.fixed_comm_us), 4),
             "total_bytes": int(self.total_bytes),
             "groups": [g.to_dict() for g in self.groups],
         }
@@ -141,6 +148,7 @@ def program_from_layers(
     forward_fraction: float = 0.5,
     optimizer_us_per_mib: float = 4.0,
     source: str = "layers",
+    fixed_comm_us: float = 0.0,
 ) -> SimProgram:
     """Build the program from per-layer gradient bytes (forward order)
     using the EXACT ``plan_layer_groups`` partition the streamed path
@@ -170,7 +178,33 @@ def program_from_layers(
         forward_us=backward_us * float(forward_fraction),
         optimizer_us=(total / _MIB) * float(optimizer_us_per_mib),
         source=source,
+        fixed_comm_us=float(fixed_comm_us),
     )
+
+
+def tp_fixed_comm_us(
+    model: "InterconnectModel",
+    psum_bytes: int,
+    tp_degree: int,
+    psums_per_step: int = 1,
+) -> float:
+    """Price the composed program's per-step tensor-parallel term: the
+    in-block activation psums ride the INNERMOST (fastest, ICI) hop as
+    plain ring allreduces over ``tp_degree`` neighbours — never
+    bucketized, never re-planned onto DCN (docs/parallelism.md). The
+    returned microseconds feed ``SimProgram.fixed_comm_us`` (and
+    ``tune(fixed_comm_us=...)``) as a constant every step pays, so the
+    simulator's scale predictions and the tuner's knob costs stay honest
+    for the composed shape. ``psums_per_step`` counts forward AND
+    backward conjugates (2 per Megatron half-block per direction)."""
+    tp = int(tp_degree)
+    if tp <= 1 or psum_bytes <= 0 or psums_per_step <= 0:
+        return 0.0
+    hop = model.hops[-1]
+    rounds = 2 * (tp - 1)
+    onwire = 2 * (tp - 1) * int(psum_bytes) / tp
+    one = hop.latency_us * rounds + onwire / (hop.bandwidth_gbps * 1e3)
+    return round(float(psums_per_step) * one, 4)
 
 
 def program_from_spec(
@@ -534,11 +568,17 @@ def simulate(
 
     for s in range(steps):
         t_begin = {r: clock[r] for r in tracked}
-        # Forward.
+        # Forward (+ the composed program's fixed TP-psum term: ICI
+        # time every rank spends synchronously, outside the staircase).
         for r in tracked:
             t0 = clock[r]
             clock[r] = t0 + program.forward_us
             compute_spans[r].append((f"sim_forward:{s}", t0, clock[r]))
+        if program.fixed_comm_us > 0.0:
+            for r in tracked:
+                t0 = clock[r]
+                clock[r] = t0 + program.fixed_comm_us
+                compute_spans[r].append((f"sim_tp_comm:{s}", t0, clock[r]))
         # Backward segments; a step's injected delay stretches the
         # FIRST segment (the straggler model: the rank falls behind as
         # the backward starts).

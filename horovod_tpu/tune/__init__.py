@@ -32,6 +32,8 @@ from .objective import (  # noqa: F401
     plan_for_bucket,
 )
 from .signature import (  # noqa: F401
+    mesh_axes_hash,
+    params_match,
     signature_hash,
     signatures_match,
     step_signature,

@@ -111,7 +111,8 @@ def plan_for_bucket(model, nbytes: int, config: Dict,
 def free_objectives(spec: ProgramSpec, config: Dict, model,
                     op: ReduceOp = ReduceOp.AVERAGE,
                     zero1: bool = False,
-                    calibration=None) -> Dict:
+                    calibration=None,
+                    fixed_comm_us: float = 0.0) -> Dict:
     """Score ``config`` on ``spec`` over ``model`` with the two free
     cost models. Returns a plain dict (stable key order for the
     tuned.json record) whose ``score`` the GP maximizes.
@@ -128,7 +129,14 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
     ``HOROVOD_CALIBRATION_FILE`` knob) prices hops with MEASURED
     alpha-beta constants instead of generation defaults — the FlexLink
     discipline applied to the tuner's objective. A stale hop-ladder
-    signature falls back loudly (``calibration.stale`` in the output)."""
+    signature falls back loudly (``calibration.stale`` in the output).
+
+    ``fixed_comm_us`` is the composed program's constant per-step
+    communication term OUTSIDE the DP staircase — the tensor-parallel
+    in-block psums (``sim.tp_fixed_comm_us``). It shifts every config's
+    cost/exposed time identically (the argmax is knob-invariant by
+    construction — TP psums are never re-planned), but keeps the
+    recorded costs honest for the composed shape."""
     import math as _math
 
     from ..ops.fusion import plan_layer_groups
@@ -192,10 +200,14 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
             entry["ag_algorithm"] = ag_plan.algorithm
             entry["ag_cost_us"] = round(ag_plan.cost_us, 4)
         per_group.append(entry)
+    fixed = max(float(fixed_comm_us), 0.0)
+    cost_us += fixed
+    exposed_us += fixed
     if zero1:
         return {
             "zero1": True,
             **({"calibration": calib_info} if calib_info else {}),
+            **({"fixed_comm_us": round(fixed, 4)} if fixed else {}),
             "n_groups": len(groups),
             "cost_us": round(cost_us, 4),
             "exposed_us": round(exposed_us, 4),
@@ -207,6 +219,7 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
         }
     return {
         **({"calibration": calib_info} if calib_info else {}),
+        **({"fixed_comm_us": round(fixed, 4)} if fixed else {}),
         "n_groups": len(groups),
         "cost_us": round(cost_us, 4),
         "exposed_us": round(exposed_us, 4),

@@ -70,6 +70,22 @@ def signature_hash(sig: Dict) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+def mesh_axes_hash(sig: Optional[Dict]) -> str:
+    """16-hex key over ONLY the mesh component of a signature — what
+    lets a consumer say WHY a match failed: same program pinned on a
+    different mesh (axes hash differs) vs a different program entirely.
+    ``bench.py`` refuses ``--quantized --tuned`` when this half differs
+    (the wire-dtype verdict is a function of the mesh's hop ladder)."""
+    body = (sig or {}).get("mesh") or {}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def params_match(tuned_sig: Optional[Dict], live_sig: Dict) -> bool:
+    """Whether only the params half (treedef + leaves) agrees."""
+    return signatures_match(tuned_sig, live_sig, require_mesh=False)
+
+
 def signatures_match(tuned_sig: Optional[Dict], live_sig: Dict,
                      require_mesh: bool = True) -> bool:
     """Whether a pinned signature covers the live program. Hash equality

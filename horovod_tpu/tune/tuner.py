@@ -132,6 +132,7 @@ def tune(
     verify: bool = True,
     zero1: bool = False,
     calibration=None,
+    fixed_comm_us: float = 0.0,
 ) -> TunedConfig:
     """Search the joint compiled-path space for ``spec`` on ``model``.
 
@@ -156,6 +157,12 @@ def tune(
     (``sim/calibrate.py``); a stale hop-ladder signature warns loudly
     and the search runs on generation defaults, recorded as such in
     ``search.calibration``.
+
+    ``fixed_comm_us`` prices the composed DP x TP shape's constant
+    per-step TP-psum term (``sim.tp_fixed_comm_us``) into every
+    objective — knob-invariant by construction (TP psums are never
+    re-planned), but the emitted evidence then carries the composed
+    program's true exposed time, recorded in ``search.fixed_comm_us``.
     """
     from .objective import calibrated_model
 
@@ -171,7 +178,8 @@ def tune(
     samples = max(int(samples), 1)
 
     def evaluate(config: Dict) -> Tuple[Dict, float]:
-        obj = free_objectives(spec, config, model, op=op, zero1=zero1)
+        obj = free_objectives(spec, config, model, op=op, zero1=zero1,
+                              fixed_comm_us=fixed_comm_us)
         score = obj["score"]
         if measure_fn is not None:
             measured_s = float(measure_fn(config))
@@ -287,6 +295,7 @@ def tune(
             "objective": "measured" if measure_fn is not None else "free",
             "zero1": bool(zero1),
             "calibration": calib_info,
+            "fixed_comm_us": round(max(float(fixed_comm_us), 0.0), 4),
             "space": {
                 "topo_choices": list(space.topo_choices),
                 "allow_int8": bool(space.allow_int8),

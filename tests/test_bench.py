@@ -119,3 +119,67 @@ def test_overlap_schedule_parser():
     assert stats["compute_ops_overlapped_per_pair"] == [2], stats
     assert stats["pairs_with_overlap"] == 1, stats
     assert stats["sync_all_reduce_count"] == 1, stats
+
+
+def test_tp_flag_validation():
+    """--tp / --rules parser contract: transformer-only, degree >= 2,
+    --rules needs --tp, and --tp defaults its table to gpt."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    args = bench._parse_args(
+        ["--model", "transformer", "--tp", "2", "--_worker"]
+    )
+    assert args.rules == "gpt"
+    for bad in (
+        ["--model", "resnet18", "--tp", "2"],
+        ["--model", "transformer", "--tp", "1"],
+        ["--model", "transformer", "--rules", "gpt"],
+    ):
+        with pytest.raises(SystemExit):
+            bench._parse_args(bad + ["--_worker"])
+
+
+def test_tuned_mesh_hash_rejection(tmp_path):
+    """--quantized --tuned with a tuning pinned on a DIFFERENT mesh-axes
+    hash is a hard error naming BOTH hashes; a params-half mismatch
+    alone still falls back with the loud warning."""
+    import argparse
+    import importlib.util
+
+    import jax.numpy as jnp
+
+    from horovod_tpu import tune as T
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    params = {"w": jnp.ones((8, 8))}
+    pinned_sig = T.step_signature(params, mesh={"data": 8})
+    cfg = T.TunedConfig(
+        knobs={"fusion_threshold_bytes": 1 << 20,
+               "first_bucket_bytes": 1 << 18,
+               "wire_dtype": "int8", "topo_algorithm": None},
+        signature=pinned_sig, objectives={}, baseline={},
+        program="unit",
+    )
+    path = str(tmp_path / "tuned.json")
+    T.save_tuned(cfg, path)
+
+    live_mesh = {"data": 4, "model": 2}
+    args = argparse.Namespace(tuned=path, quantized=True)
+    with pytest.raises(SystemExit) as e:
+        bench._resolve_tuned(args, params, live_mesh)
+    msg = str(e.value)
+    assert T.mesh_axes_hash(pinned_sig) in msg
+    assert T.mesh_axes_hash(T.step_signature(params, mesh=live_mesh)) \
+        in msg
+    # Without --quantized the same mismatch falls back (no exception),
+    # reporting matched=False.
+    args = argparse.Namespace(tuned=path, quantized=False)
+    kw, detail = bench._resolve_tuned(args, params, live_mesh)
+    assert kw is None and detail["matched"] is False

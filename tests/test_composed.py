@@ -1,0 +1,397 @@
+"""Composed DP x TP fast path (docs/parallelism.md): parity against the
+single-axis DP reference, one-psum-per-block HLO structure, streamed
+ZeRO-1 + int8 wire scoped to the data axis, spec-aware digest agreement,
+and per-axis wire attribution — on 4 of the 8 virtual CPU devices."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu.jax as hvdj
+from horovod_tpu.common.types import ReduceOp
+from horovod_tpu.models.transformer import (
+    TransformerLM,
+    make_gpt_loss_fn,
+    tp_apply,
+)
+from horovod_tpu.parallel import rules as R
+from horovod_tpu.parallel.mesh import build_mesh
+from horovod_tpu.parallel.zero import Zero1State
+
+VOCAB, D, HEADS, LAYERS, T = 128, 64, 4, 2, 16
+
+
+def _params():
+    model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                          n_layers=LAYERS, max_len=T)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+
+
+def _batch(global_b=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(rng.randint(0, VOCAB, (global_b, T)), jnp.int32),
+        jnp.asarray(rng.randint(0, VOCAB, (global_b, T)), jnp.int32),
+    )
+
+
+def _mesh22(devices):
+    return build_mesh({"data": 2, "model": 2}, devices=devices[:4])
+
+
+def _mesh4(devices):
+    return build_mesh({"data": 4}, devices=devices[:4])
+
+
+LOSS_TP = make_gpt_loss_fn(HEADS, model_axis="model", dtype=jnp.float32)
+LOSS_DP = make_gpt_loss_fn(HEADS, model_axis=None, dtype=jnp.float32)
+
+
+def _run(step, params, state, batch, steps=3):
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, batch)
+        losses.append(float(loss))
+    return params, state, losses
+
+
+# ---------------------------------------------------------------------------
+# Parity: DP x TP (x zero1) == single-axis DP reference
+# ---------------------------------------------------------------------------
+
+def test_composed_matches_dp_reference(devices):
+    params = _params()
+    tx = optax.adamw(1e-3)
+    batch = _batch()
+    step = hvdj.make_train_step(
+        LOSS_TP, tx, _mesh22(devices), rules="gpt", donate=False
+    )
+    _, _, losses = _run(step, params, tx.init(params), batch)
+    ref = hvdj.make_train_step(
+        LOSS_DP, tx, _mesh4(devices), donate=False
+    )
+    _, _, ref_losses = _run(ref, params, tx.init(params), batch)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    assert losses[-1] < losses[0]
+
+
+def test_composed_overlap_matches_posthoc(devices):
+    params = _params()
+    tx = optax.sgd(0.05)
+    batch = _batch(seed=1)
+    mesh = _mesh22(devices)
+    s1 = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                              overlap=True, donate=False)
+    s2 = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                              donate=False)
+    _, _, l1 = _run(s1, params, tx.init(params), batch)
+    _, _, l2 = _run(s2, params, tx.init(params), batch)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_composed_zero1_matches_composed_plain(devices):
+    params = _params()
+    tx = optax.adamw(1e-3)
+    batch = _batch(seed=2)
+    mesh = _mesh22(devices)
+    zstate = hvdj.init_composed_zero1_state(tx, params, "gpt", mesh)
+    sz = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                              overlap=True, zero1=True, donate=False)
+    sp = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                              donate=False)
+    _, zs, lz = _run(sz, params, zstate, batch)
+    _, _, lp = _run(sp, params, tx.init(params), batch)
+    np.testing.assert_allclose(lz, lp, rtol=1e-4)
+    # The state is genuinely bucket-sharded [n_data, n_model, ...].
+    some = [l for l in jax.tree.leaves(zs) if getattr(l, "ndim", 0) >= 2]
+    assert some and all(l.shape[:2] == (2, 2) for l in some)
+
+
+def test_composed_zero1_int8_trains(devices):
+    params = _params()
+    tx = optax.adamw(1e-3)
+    batch = _batch(seed=3)
+    mesh = _mesh22(devices)
+    zstate = hvdj.init_composed_zero1_state(
+        tx, params, "gpt", mesh, quantized=True
+    )
+    step = hvdj.make_train_step(
+        LOSS_TP, tx, mesh, rules="gpt", overlap=True, zero1=True,
+        quantized=True, donate=False,
+    )
+    _, _, losses = _run(step, params, zstate, batch, steps=5)
+    assert losses[-1] < losses[0]
+    # int8 noise stays a perturbation, not a divergence, vs f32 zero1.
+    zf = hvdj.init_composed_zero1_state(tx, params, "gpt", mesh)
+    sf = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                              overlap=True, zero1=True, donate=False)
+    _, _, ref = _run(sf, params, zf, batch, steps=5)
+    assert abs(losses[-1] - ref[-1]) < 0.1 * max(abs(ref[-1]), 1e-3)
+
+
+def test_composed_hierarchical_dp_scope(devices):
+    """The DP scope itself may be two-level — an EXPLICIT
+    ("cross", "local") axis tuple: the zero1 RS/AG runs through the
+    compositor's two-level lowerings STRICTLY on the data axes, the TP
+    psums stay on the flat model axis, and the trajectory matches the
+    flat composed reference."""
+    params = _params()
+    tx = optax.adamw(1e-3)
+    batch = _batch(seed=4)
+    hmesh = build_mesh({"cross": 2, "local": 2, "model": 2})
+    mesh = build_mesh({"data": 4, "model": 2})
+    zh = hvdj.init_composed_zero1_state(
+        tx, params, "gpt", hmesh, axis_name=("cross", "local")
+    )
+    sh = hvdj.make_train_step(
+        LOSS_TP, tx, hmesh, rules="gpt", overlap=True, zero1=True,
+        axis_name=("cross", "local"), donate=False,
+    )
+    _, _, lh = _run(sh, params, zh, batch)
+    zf = hvdj.init_composed_zero1_state(tx, params, "gpt", mesh)
+    sf = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                              overlap=True, zero1=True, donate=False)
+    _, _, lf = _run(sf, params, zf, batch)
+    np.testing.assert_allclose(lh, lf, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# HLO structure
+# ---------------------------------------------------------------------------
+
+def _model_axis_allreduces(hlo):
+    ar = [ln for ln in hlo.splitlines()
+          if re.search(r"\ball-reduce(-start)?\(", ln)]
+    return [ln for ln in ar
+            if "replica_groups={{0,1},{2,3}}" in ln
+            or re.search(r"replica_groups=\[2,2\]<=\[4\]\b", ln)]
+
+
+def test_forward_hlo_one_psum_per_tp_block(devices):
+    """Exactly one model-axis all-reduce per Megatron half-block in the
+    FORWARD (2 per transformer layer: attention-out + mlp-down), on the
+    model-axis replica groups — nothing bucketized, nothing else."""
+    params = _params()
+    mesh = _mesh22(devices)
+    specs = R.match_partition_rules("gpt", params)
+    fwd = jax.jit(hvdj._shard_map(
+        LOSS_TP, mesh, in_specs=(specs, P("data")), out_specs=P()
+    ))
+    hlo = fwd.lower(params, _batch()).compiler_ir(
+        dialect="hlo"
+    ).as_hlo_text()
+    model_ar = _model_axis_allreduces(hlo)
+    assert len(model_ar) == 2 * LAYERS, hlo.count("all-reduce")
+
+
+def test_step_hlo_inner_axis_reduce_scatter_under_zero1(devices):
+    """The composed zero1 step's HLO carries reduce-scatter
+    instructions on the DATA-axis replica groups ({{0,2},{1,3}} on the
+    2x2 mesh) — the streamed RS runs on the inner DP axis only."""
+    params = _params()
+    tx = optax.sgd(0.05)
+    mesh = _mesh22(devices)
+    zstate = hvdj.init_composed_zero1_state(tx, params, "gpt", mesh)
+    step = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                                overlap=True, zero1=True, donate=False)
+    batch = _batch()
+    step(params, zstate, batch)  # first call builds + exposes .jitted
+    hlo = step.jitted.lower(params, zstate, batch).compiler_ir(
+        dialect="hlo"
+    ).as_hlo_text()
+    rs = [ln for ln in hlo.splitlines()
+          if re.search(r"\breduce-scatter(-start)?\(", ln)]
+    data_rs = [ln for ln in rs
+               if "replica_groups={{0,2},{1,3}}" in ln]
+    assert data_rs, rs[:5] or hlo[:500]
+    # And no reduce-scatter ever rides the model axis.
+    model_rs = [ln for ln in rs
+                if "replica_groups={{0,1},{2,3}}" in ln]
+    assert not model_rs, model_rs
+
+
+# ---------------------------------------------------------------------------
+# Rejections + surface contract
+# ---------------------------------------------------------------------------
+
+def test_composed_rejections(devices):
+    tx = optax.sgd(0.1)
+    mesh = _mesh22(devices)
+    with pytest.raises(ValueError, match="re-plans the whole step"):
+        hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                             hierarchical=True)
+    with pytest.raises(ValueError, match="flat int8 ring"):
+        hvdj.make_train_step(
+            LOSS_TP, tx,
+            build_mesh({"cross": 1, "local": 2, "model": 2},
+                       devices=jax.devices()[:4]),
+            rules="gpt", axis_name=("cross", "local"), quantized=True,
+        )
+    with pytest.raises(ValueError, match="cannot also be a data axis"):
+        hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                             axis_name=("data", "model"))
+    with pytest.raises(ValueError, match="topo_algorithm"):
+        hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                             topo_algorithm="two-level")
+    with pytest.raises(ValueError, match="EF-off"):
+        hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                             quantized=True, error_feedback=True)
+    with pytest.raises(ValueError, match="SUM/AVERAGE"):
+        hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                             op=ReduceOp.MIN)
+    from horovod_tpu.common.compression import Compression
+
+    with pytest.raises(ValueError, match="cast compression"):
+        hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                             compression=Compression.fp16)
+    with pytest.raises(ValueError, match="mesh axes"):
+        hvdj.make_train_step(
+            LOSS_TP, tx, build_mesh({"data": 4}, devices=devices[:4]),
+            rules="gpt",
+        )
+
+
+def test_composed_zero1_needs_composed_state(devices):
+    params = _params()
+    tx = optax.sgd(0.1)
+    mesh = _mesh22(devices)
+    step = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                                zero1=True, donate=False)
+    with pytest.raises(TypeError, match="init_composed_zero1_state"):
+        step(params, tx.init(params), _batch())
+
+
+def test_composed_preflight_rejects_indivisible(devices):
+    """Pass 5 preflight fires at build even without
+    HOROVOD_TPU_STATIC_CHECKS: a mesh the table cannot divide fails
+    loudly before anything traces."""
+    from horovod_tpu.analysis import CollectiveSafetyError
+
+    model = TransformerLM(vocab_size=VOCAB, d_model=66, n_heads=6,
+                          n_layers=1, max_len=T)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    tx = optax.sgd(0.1)
+    mesh = build_mesh({"data": 1, "model": 4}, devices=jax.devices()[:4])
+    step = hvdj.make_train_step(
+        make_gpt_loss_fn(6, model_axis="model"), tx, mesh, rules="gpt",
+        donate=False,
+    )
+    with pytest.raises(CollectiveSafetyError):
+        step(params, tx.init(params), _batch())
+
+
+def test_sharding_specs_exposed_after_first_call(devices):
+    params = _params()
+    tx = optax.adam(1e-3)
+    mesh = _mesh22(devices)
+    step = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                                donate=False)
+    assert step.sharding_specs is None
+    step(params, tx.init(params), _batch())
+    specs = step.sharding_specs
+    assert specs is not None and set(specs) == {"params", "opt_state"}
+    assert specs["params"]["block_0"]["attention"]["query"]["kernel"] \
+        == P(None, "model")
+
+
+# ---------------------------------------------------------------------------
+# Digest agreement on a composed mesh (guard satellite)
+# ---------------------------------------------------------------------------
+
+def test_digest_tp_sharded_leaves_layout_only(devices):
+    """At 2x2: two model ranks hold DIFFERENT shard bytes of the same
+    layout — spec-aware digests must AGREE (no false heal); a drifted
+    shard LAYOUT must still mismatch loudly."""
+    from horovod_tpu.guard.digest import strip_rank_local, tree_digest
+
+    params = _params()
+    specs = R.match_partition_rules("gpt", params)
+    rank0 = R.local_shard_tree(params, specs, {"model": (0, 2)})
+    rank1 = R.local_shard_tree(params, specs, {"model": (1, 2)})
+    d0 = tree_digest(strip_rank_local(rank0, specs=specs))
+    d1 = tree_digest(strip_rank_local(rank1, specs=specs))
+    assert d0 == d1
+    # WITHOUT the specs the same pair false-positives — the failure
+    # mode this satellite closes.
+    assert tree_digest(strip_rank_local(rank0)) != tree_digest(
+        strip_rank_local(rank1)
+    )
+    # Replicated-leaf divergence is still caught...
+    bad = jax.tree.map(lambda x: x, rank1)
+    bad["ln_f"]["scale"] = bad["ln_f"]["scale"] + 1.0
+    assert tree_digest(strip_rank_local(bad, specs=specs)) != d0
+    # ...and so is a drifted shard layout.
+    drift = jax.tree.map(lambda x: x, rank1)
+    drift["block_0"]["mlp"]["up"]["kernel"] = jnp.zeros((D, D))
+    assert tree_digest(strip_rank_local(drift, specs=specs)) != d0
+
+
+def test_state_digest_consults_sharding_specs(devices):
+    from horovod_tpu.guard.digest import state_digest
+
+    params = _params()
+    specs = R.match_partition_rules("gpt", params)
+
+    class S:
+        _tracked = ["params"]
+
+        def __init__(self, p, sp=None):
+            self.params = p
+            if sp is not None:
+                self.sharding_specs = sp
+
+    r0 = R.local_shard_tree(params, specs, {"model": (0, 2)})
+    r1 = R.local_shard_tree(params, specs, {"model": (1, 2)})
+    sp = {"params": specs}
+    assert state_digest(S(r0, sp)) == state_digest(S(r1, sp))
+    assert state_digest(S(r0)) != state_digest(S(r1))
+
+
+def test_stale_specs_raise():
+    from horovod_tpu.guard.digest import strip_rank_local
+
+    params = _params()
+    specs = R.match_partition_rules("gpt", params)
+    with pytest.raises(ValueError, match="stale spec"):
+        strip_rank_local({"just": jnp.ones((2,))}, specs=specs)
+
+
+# ---------------------------------------------------------------------------
+# Per-axis wire attribution
+# ---------------------------------------------------------------------------
+
+def test_axis_wire_bytes_split(devices):
+    import horovod_tpu.metrics as metrics
+
+    params = _params()
+    tx = optax.sgd(0.05)
+    mesh = _mesh22(devices)
+    metrics.install(True)
+    try:
+        step = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                                    overlap=True, donate=False)
+        step(params, tx.init(params), _batch())
+        flat = metrics.flat()
+        axis = {k: v for k, v in flat.items()
+                if "hvd_axis_wire_bytes_total" in k}
+        data_b = sum(v for k, v in axis.items() if 'axis="data"' in k)
+        model_b = sum(v for k, v in axis.items() if 'axis="model"' in k)
+        assert data_b > 0 and model_b > 0, axis
+        # TP bytes come ONLY from plain psums — never from a bucketized
+        # or reduce-scattered collective.
+        assert all(
+            'collective="psum"' in k
+            for k in axis if 'axis="model"' in k
+        ), axis
+    finally:
+        metrics.install(False)

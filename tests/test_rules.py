@@ -1,0 +1,210 @@
+"""Sharding-rules engine units (parallel/rules.py): first-match-wins
+precedence, placement round-trips, Pass 5 preflight, host-side shard
+slicing, and parity of the pure-python reference shape table with the
+REAL flax transformer tree (docs/parallelism.md)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.analysis import CollectiveSafetyError
+from horovod_tpu.analysis.sharding_rules import (
+    EXAMPLE_GPT_RULES,
+    example_gpt_params,
+)
+from horovod_tpu.parallel import rules as R
+from horovod_tpu.parallel.mesh import build_mesh
+
+
+def _params():
+    return {
+        "block_0": {
+            "attention": {"query": {"kernel": jnp.ones((8, 8))}},
+            "mlp": {"up": {"kernel": jnp.ones((8, 32)),
+                           "bias": jnp.zeros((32,))}},
+        },
+        "ln_f": {"scale": jnp.ones((8,)), "bias": jnp.zeros((8,))},
+        "step": jnp.zeros(()),
+    }
+
+
+def test_named_tree_paths_flax_shape():
+    names = [n for n, _ in R.named_tree_paths(_params())]
+    assert "block_0/attention/query/kernel" in names
+    assert "block_0/mlp/up/bias" in names
+    assert "ln_f/scale" in names
+    assert "step" in names
+
+
+def test_first_match_wins_precedence():
+    rules = (
+        (r"query/kernel$", (None, "model")),
+        (r"kernel$", None),
+        (r".*", None),
+    )
+    specs = R.match_partition_rules(rules, _params())
+    assert specs["block_0"]["attention"]["query"]["kernel"] == P(
+        None, "model"
+    )
+    # The later generic rule would replicate — the earlier specific one
+    # must win; swap the order and the same leaf replicates.
+    swapped = (rules[1], rules[0], rules[2])
+    specs2 = R.match_partition_rules(swapped, _params())
+    assert specs2["block_0"]["attention"]["query"]["kernel"] == P()
+
+
+def test_scalars_always_replicate():
+    specs = R.match_partition_rules(
+        ((r".*", ("model",)),), {"s": jnp.zeros(()), "w": jnp.ones((4,))}
+    )
+    assert specs["s"] == P()
+    assert specs["w"] == P("model")
+
+
+def test_unmatched_nonscalar_raises():
+    with pytest.raises(ValueError, match="no sharding rule matches"):
+        R.match_partition_rules(
+            ((r"kernel$", None),), {"w": jnp.ones((4, 4))}
+        )
+
+
+def test_preflight_raises_on_unmatched_nonscalar():
+    with pytest.raises(CollectiveSafetyError, match="matches no rule"):
+        R.preflight_rules(
+            ((r"kernel$", None),), {"data": 4, "model": 2},
+            {"w": jnp.ones((4, 4))},
+        )
+
+
+def test_preflight_raises_on_unknown_axis_and_indivisible():
+    with pytest.raises(CollectiveSafetyError):
+        R.preflight_rules(
+            ((r".*", (None, "tensor")),), {"data": 4, "model": 2},
+            _params(),
+        )
+    with pytest.raises(CollectiveSafetyError):
+        R.preflight_rules(
+            ((r".*", ("model", None)),), {"data": 4, "model": 3},
+            {"w": jnp.ones((8, 8))},
+        )
+
+
+def test_preflight_accepts_shipped_pair():
+    R.preflight_rules(R.GPT_RULES, {"data": 4, "model": 2},
+                      jax.tree.map(
+                          lambda s: jnp.zeros(s),
+                          example_gpt_params(),
+                          is_leaf=lambda x: isinstance(x, tuple),
+                      ))
+
+
+def test_resolve_rules_named_and_unknown():
+    assert R.resolve_rules("gpt") is R.GPT_RULES
+    assert R.resolve_rules(EXAMPLE_GPT_RULES) is EXAMPLE_GPT_RULES
+    with pytest.raises(ValueError, match="unknown named rule table"):
+        R.resolve_rules("nope")
+
+
+def test_spec_mentions():
+    assert R.spec_mentions(P(None, "model"), ("model",))
+    assert not R.spec_mentions(P("data"), ("model",))
+    assert not R.spec_mentions(P(), ("model",))
+    assert R.spec_mentions((("data", "model"), None), ("model",))
+
+
+def test_shard_gather_round_trip_bitwise(devices):
+    mesh = build_mesh({"data": 4, "model": 2})
+    rng = np.random.RandomState(0)
+    tree = {
+        "w": jnp.asarray(rng.randn(8, 6).astype(np.float32)),
+        "b": jnp.asarray(rng.randn(6).astype(np.float32)),
+        "s": jnp.float32(3.5),
+    }
+    rules = ((r"^w$", (None, "model")), (r".*", None))
+    specs = R.match_partition_rules(rules, tree)
+    sharded = R.shard_tree(tree, specs, mesh)
+    back = R.gather_tree(sharded, specs, mesh)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rules_place_optimizer_state_via_embedded_names():
+    import optax
+
+    params = _params()
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    rules = (
+        (r"query/kernel$", (None, "model")),
+        (r"mlp/up/kernel$", (None, "model")),
+        (r"mlp/up/bias$", ("model",)),
+        (r".*", None),
+    )
+    specs = R.match_partition_rules(rules, opt_state)
+    flat = dict(zip(
+        [n for n, _ in R.named_tree_paths(opt_state)],
+        R.spec_leaves(specs),
+    ))
+    mu_q = [v for k, v in flat.items()
+            if "mu" in k and "query/kernel" in k]
+    assert mu_q and all(s == P(None, "model") for s in mu_q)
+    counts = [v for k, v in flat.items() if k.endswith("count")]
+    assert counts and all(s == P() for s in counts)
+
+
+def test_local_shard_tree_slices():
+    tree = {"w": jnp.arange(32, dtype=jnp.float32).reshape(4, 8),
+            "b": jnp.arange(8, dtype=jnp.float32),
+            "n": jnp.ones((3,))}
+    rules = ((r"^w$", (None, "model")), (r"^b$", ("model",)),
+             (r".*", None))
+    specs = R.match_partition_rules(rules, tree)
+    local = R.local_shard_tree(tree, specs, {"model": (1, 2)})
+    np.testing.assert_array_equal(
+        np.asarray(local["w"]), np.asarray(tree["w"][:, 4:])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(local["b"]), np.asarray(tree["b"][4:])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(local["n"]), np.asarray(tree["n"])
+    )
+
+
+def test_example_gpt_params_matches_real_flax_tree():
+    """The pure-python linter table must mirror TransformerLM.init leaf
+    for leaf (names AND shapes) — the guarantee that lets
+    `tools/collective_lint.py sharding` lint the SHIPPED pair with no
+    jax import."""
+    from horovod_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=384, d_model=128, n_heads=4,
+                          n_layers=2, max_len=128)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    assert R.tree_shape_table(params) == example_gpt_params()
+
+
+def test_shipped_rules_have_no_overmatch_on_real_tree():
+    """Every rule that SHARDS must only hit the leaves it names: the
+    embeddings rules are (^|/)-anchored so 'pos_embeddings' is not
+    captured by the 'embeddings' rule, and the catch-all replicates the
+    rest."""
+    import re
+
+    params = example_gpt_params()
+    for name in params:
+        hits = [i for i, (pat, _) in enumerate(EXAMPLE_GPT_RULES)
+                if re.search(pat, name)]
+        assert hits, name
+    # pos_embeddings must match ITS anchored rule (index 1), not the
+    # tok-embeddings rule (index 0).
+    first = next(
+        i for i, (pat, _) in enumerate(EXAMPLE_GPT_RULES)
+        if re.search(pat, "pos_embeddings/embedding")
+    )
+    assert first == 1

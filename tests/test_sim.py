@@ -484,3 +484,67 @@ def test_tune_objective_accepts_calibration(tmp_path):
     cfg2 = tune(spec, model, samples=4, calibration=str(stale_path))
     assert cfg2.search["calibration"]["applied"] is False
     assert cfg2.search["calibration"]["stale"] is True
+
+
+# ------------------------------------------------- composed DP x TP term
+
+
+def test_tp_fixed_comm_prices_innermost_hop():
+    from horovod_tpu.sim import tp_fixed_comm_us
+
+    model = _exact_model(local=4, cross=4, bw=10.0)
+    # Ring allreduce of 1 MB over tp=4 on the ici hop (10 GB/s, no
+    # latency): 2*(4-1)/4 * 1e6 bytes / (10*1e3 B/us) = 150 us/psum.
+    one = tp_fixed_comm_us(model, 1_000_000, 4, psums_per_step=1)
+    assert one == pytest.approx(150.0, abs=0.01)
+    assert tp_fixed_comm_us(model, 1_000_000, 4, psums_per_step=3) \
+        == pytest.approx(3 * one, abs=0.05)
+    # Degenerate shapes price zero.
+    assert tp_fixed_comm_us(model, 0, 4) == 0.0
+    assert tp_fixed_comm_us(model, 1_000_000, 1) == 0.0
+
+
+def test_fixed_comm_exposed_not_compute():
+    """The TP term stretches every simulated step but never the ideal
+    (communication-free) step — scaling efficiency reflects it."""
+    model = _exact_model(local=8, bw=100.0)
+    base = program_from_layers("p", [1 << 20] * 4)
+    composed = program_from_layers("p", [1 << 20] * 4,
+                                   fixed_comm_us=500.0)
+    assert composed.compute_us == base.compute_us
+    r0 = simulate(model, base, steps=2)
+    r1 = simulate(model, composed, steps=2)
+    assert r1.mean_step_us == pytest.approx(
+        r0.mean_step_us + 500.0, abs=0.01
+    )
+    assert r1.scaling_efficiency < r0.scaling_efficiency
+    assert composed.to_dict()["fixed_comm_us"] == 500.0
+
+
+def test_fleet_sim_cli_tp_block(tmp_path):
+    """--tp N: the report carries the tp block, the step time includes
+    the fixed term, and the DP staircase shrinks (sharded kernels)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out1 = tmp_path / "tp.json"
+    out2 = tmp_path / "flat.json"
+    base = [
+        sys.executable,
+        os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                     "fleet_sim.py"),
+        "--ranks", "64", "--steps", "2", "--layers", "2",
+        "--d-model", "256", "--vocab", "1024", "--seq-len", "128",
+    ]
+    subprocess.run(base + ["--tp", "4", "-o", str(out1)],
+                   check=True, env=env, capture_output=True, timeout=120)
+    subprocess.run(base + ["-o", str(out2)],
+                   check=True, env=env, capture_output=True, timeout=120)
+    tp_doc = json.loads(out1.read_text())
+    flat_doc = json.loads(out2.read_text())
+    assert tp_doc["tp"]["degree"] == 4
+    assert tp_doc["tp"]["fixed_comm_us"] > 0
+    assert tp_doc["program"]["fixed_comm_us"] == \
+        tp_doc["tp"]["fixed_comm_us"]
+    assert "tp" not in flat_doc
+    # Sharded kernels: the composed program's gradient bytes shrink.
+    assert tp_doc["program"]["total_bytes"] < \
+        flat_doc["program"]["total_bytes"]

@@ -496,3 +496,33 @@ def test_tuned_step_kwargs_mapping():
     assert kw["quantized"] is True and kw["topo_algorithm"] is None
     assert kw["fusion_threshold_bytes"] == 123
     assert kw["first_bucket_bytes"] == 7
+
+
+def test_free_objectives_fixed_comm_constant_shift():
+    """The composed TP term shifts every config's cost identically —
+    the argmax is knob-invariant but the recorded costs carry it."""
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    spec = _toy_spec()
+    space = T.SearchSpace()
+    cfg = space.default_config()
+    base = T.free_objectives(spec, cfg, model)
+    shifted = T.free_objectives(spec, cfg, model, fixed_comm_us=250.0)
+    assert shifted["fixed_comm_us"] == 250.0
+    assert shifted["cost_us"] == pytest.approx(
+        base["cost_us"] + 250.0, abs=0.01
+    )
+    assert shifted["exposed_us"] == pytest.approx(
+        base["exposed_us"] + 250.0, abs=0.01
+    )
+    assert "fixed_comm_us" not in base
+
+
+def test_tune_records_fixed_comm_and_keeps_winner():
+    model = synthetic_model(local=4, cross=2, generation="v5e")
+    spec = _toy_spec()
+    plain = T.tune(spec, model, samples=4, verify=False)
+    composed = T.tune(spec, model, samples=4, verify=False,
+                      fixed_comm_us=123.4)
+    assert composed.search["fixed_comm_us"] == 123.4
+    # A constant term cannot flip the knob choice.
+    assert composed.knobs == plain.knobs

@@ -238,6 +238,13 @@ def main() -> int:
                          "parameter all-gather, 'split' dropped from "
                          "the topo choices, RS+AG plans verified "
                          "before pinning (docs/overlap.md)")
+    ap.add_argument("--fixed-comm-us", type=float, default=0.0,
+                    help="constant per-step communication OUTSIDE the "
+                         "DP staircase — the composed DP x TP psum "
+                         "term (sim.tp_fixed_comm_us; "
+                         "docs/parallelism.md) — priced into every "
+                         "objective so the emitted costs stay honest "
+                         "for the composed shape")
     args = ap.parse_args()
 
     # Planning never needs an accelerator; pin CPU so a dead TPU tunnel
@@ -274,6 +281,7 @@ def main() -> int:
             samples=args.samples, seed=args.seed, space=space,
             measure_fn=measure_fn, zero1=args.zero1,
             calibration=args.calibration,
+            fixed_comm_us=args.fixed_comm_us,
         )
     except T.TuneVerificationError as e:
         print(f"[autotune] {e}", file=sys.stderr)

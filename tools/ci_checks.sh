@@ -93,6 +93,16 @@
 # calibration (docs/fault_tolerance.md "Self-driving fleet"). Budget:
 # under 60s.
 #
+# Stage 14 (make llm-smoke; skip with HVD_CI_SKIP_LLM=1): the composed
+# DP x TP smoke — the shipped GPT sharding-rule table preflights clean
+# against the REAL models/transformer.py tree on a 2x2 mesh, the
+# composed step (make_train_step(rules="gpt")) trains with streamed
+# ZeRO-1 + int8 wire scoped to the DP axis, the f32 composed zero1
+# trajectory matches the plain composed step, per-axis wire bytes are
+# nonzero on BOTH axes with the model axis carried by plain psums only,
+# and the normalized event log is byte-identical across two runs
+# (docs/parallelism.md "Composed DP x TP fast path"). Budget: under 30s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -192,4 +202,11 @@ if [ "${HVD_CI_SKIP_SELFDRIVE:-0}" != "1" ]; then
     python tools/selfdrive_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: selfdrive smoke quarantined+replanned+promoted+byte-stable in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_LLM:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/llm_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: llm smoke composed+preflighted+attributed+byte-stable in ${elapsed}s"
 fi

@@ -61,7 +61,11 @@ def _analytic_layers(args):
         return [4 * (d * d + d)] * 3
     if args.program == "transformer":
         d, v, s = args.d_model, args.vocab, args.seq_len
-        block = 4 * (12 * d * d + 9 * d)
+        tp = max(int(getattr(args, "tp", 1)), 1)
+        # Composed DP x TP: the 12d^2 block kernels shard 1/tp per rank
+        # (the DP staircase reduces each rank's SHARD gradients); the
+        # 9d norm/bias tail and the embeddings replicate.
+        block = 4 * (12 * d * d // tp + 9 * d)
         return (
             [4 * (v * d + s * d)]
             + [block] * args.layers
@@ -146,13 +150,42 @@ def run_predict(args) -> int:
     )
 
     calib = resolve_calibration(args.calibration)
+    tp = max(int(getattr(args, "tp", 1)), 1)
+    tp_block = None
+    fixed_comm_us = 0.0
+    if tp > 1:
+        from horovod_tpu.sim import tp_fixed_comm_us
+
+        if args.program != "transformer":
+            raise SystemExit(
+                "fleet_sim: --tp prices the composed transformer shape "
+                "only (use --program transformer)"
+            )
+        psum_bytes = int(args.tp_psum_bytes) or (
+            int(args.tp_batch) * int(args.seq_len)
+            * int(args.d_model) * 2  # bf16 activations
+        )
+        # 2 forward psums per layer (attention-out + mlp-down) plus
+        # their backward conjugates (parallel/tp.py tp_block_input).
+        psums = 4 * int(args.layers)
+        model0, _ = _model_for(args.ranks[0], args, calib)
+        fixed_comm_us = tp_fixed_comm_us(model0, psum_bytes, tp, psums)
+        tp_block = {
+            "degree": tp,
+            "psum_bytes": int(psum_bytes),
+            "psums_per_step": int(psums),
+            "fixed_comm_us": fixed_comm_us,
+            "hop": model0.hops[-1].name,
+        }
     program = program_from_layers(
         args.program,
         _analytic_layers(args),
         fusion_threshold_bytes=args.fusion_threshold,
         first_bucket_bytes=args.first_bucket,
         compute_us_per_mib=args.compute_us_per_mib,
-        source=f"analytic:{args.program}",
+        source=f"analytic:{args.program}"
+               + (f":tp{tp}" if tp > 1 else ""),
+        fixed_comm_us=fixed_comm_us,
     )
     config = SimConfig(
         algorithm=args.algorithm,
@@ -202,6 +235,7 @@ def run_predict(args) -> int:
             "generation": args.generation,
             "local": int(args.local),
         },
+        **({"tp": tp_block} if tp_block else {}),
         "results": results,
     }
     payload = json.dumps(report, sort_keys=True, indent=1) + "\n"
@@ -414,6 +448,20 @@ def main(argv=None) -> int:
     ap.add_argument("--layer-bytes", type=int, nargs="+", default=[],
                     help="--program layers: explicit per-layer gradient "
                          "bytes, forward order")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="composed DP x TP shape: each simulated rank "
+                         "holds 1/N of the sharded kernels (the DP "
+                         "staircase shrinks) and pays the in-block TP "
+                         "psums as a fixed per-step ICI term "
+                         "(docs/parallelism.md 'Composed DP x TP fast "
+                         "path'); transformer program only")
+    ap.add_argument("--tp-batch", type=int, default=8,
+                    help="per-rank batch for the TP activation-psum "
+                         "payload (--tp > 1)")
+    ap.add_argument("--tp-psum-bytes", type=int, default=0,
+                    help="override the per-psum activation payload "
+                         "bytes (default: derived as batch x seq x "
+                         "d_model x 2 bf16 bytes)")
     ap.add_argument("--algorithm", default="auto",
                     choices=["auto", "flat", "ring", "two-level",
                              "split", "recursive-halving"],

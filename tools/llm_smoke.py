@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Composed DP x TP CI smoke (docs/parallelism.md "Composed DP x TP
+fast path").
+
+One process, a 2x2 virtual CPU mesh, <30s:
+
+1. RULES PREFLIGHT CLEAN — the shipped GPT table places the REAL
+   ``models/transformer.py`` param tree on the (data=2, model=2) mesh
+   with zero Pass 5 findings (``parallel/rules.preflight_rules``).
+2. COMPOSED STEP TRAINS — ``make_train_step(rules="gpt", overlap=True,
+   zero1=True, quantized=True)``: streamed per-bucket reduce-scatter +
+   int8 wire live on the DP axis, Megatron psums on the model axis,
+   loss strictly decreasing over the smoke steps; the f32 composed
+   zero1 trajectory matches the plain composed step to tolerance.
+3. PER-AXIS WIRE BYTES — ``hvd_axis_wire_bytes_total{axis,collective}``
+   reports NONZERO bytes on BOTH axes, with the model axis carried by
+   plain psums only (never a bucketized/reduce-scattered collective).
+4. BYTE-STABLE LOG — per-step losses + final param digests + the
+   per-axis wire counters serialize to a normalized JSON log; the run
+   executes TWICE and the logs must be byte-identical.
+
+Exit 0 = all assertions hold. Wired as ``tools/ci_checks.sh`` stage 14
+(skip: HVD_CI_SKIP_LLM=1) and ``make llm-smoke``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 2x2 virtual mesh; must precede the first jax backend touch.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+VOCAB, D, HEADS, LAYERS, T = 128, 32, 2, 2, 16
+STEPS = 4
+
+
+def _digest(tree) -> str:
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.device_get(jax.tree.leaves(tree)):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def run_once(parity: bool = True) -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu.jax as hvdj
+    import horovod_tpu.metrics as metrics
+    from horovod_tpu.models.transformer import (
+        TransformerLM, make_gpt_loss_fn,
+    )
+    from horovod_tpu.parallel import rules as R
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    metrics.install(True)
+    mesh = build_mesh({"data": 2, "model": 2})
+    model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                          n_layers=LAYERS, max_len=T)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+
+    # 1. Preflight: the shipped pair lints clean against THIS mesh.
+    R.preflight_rules("gpt", mesh, params)
+
+    rng = np.random.RandomState(7)
+    batch = (
+        jnp.asarray(rng.randint(0, VOCAB, (4, T)), jnp.int32),
+        jnp.asarray(rng.randint(0, VOCAB, (4, T)), jnp.int32),
+    )
+    loss_fn = make_gpt_loss_fn(HEADS, model_axis="model",
+                               dtype=jnp.float32)
+    tx = optax.adamw(1e-3)
+
+    # 2a. The full composed stack: streamed zero1 + int8 on DP.
+    zq = hvdj.init_composed_zero1_state(tx, params, "gpt", mesh,
+                                        quantized=True)
+    step_q = hvdj.make_train_step(
+        loss_fn, tx, mesh, rules="gpt", overlap=True, zero1=True,
+        quantized=True, donate=False,
+    )
+    pq, sq, losses_q = params, zq, []
+    for _ in range(STEPS):
+        pq, sq, loss = step_q(pq, sq, batch)
+        losses_q.append(round(float(loss), 6))
+    assert losses_q[-1] < losses_q[0], losses_q
+
+    # 3. Per-axis attribution (captured NOW, scoped to the full-stack
+    # build — the optional parity builds below emit their own counters):
+    # nonzero on both axes; model axis is plain psums only.
+    flat = metrics.flat()
+    axis = {k: round(v, 1) for k, v in sorted(flat.items())
+            if "hvd_axis_wire_bytes_total" in k}
+    data_b = sum(v for k, v in axis.items() if 'axis="data"' in k)
+    model_b = sum(v for k, v in axis.items() if 'axis="model"' in k)
+    assert data_b > 0 and model_b > 0, axis
+    assert all('collective="psum"' in k
+               for k in axis if 'axis="model"' in k), axis
+    metrics.install(False)
+
+    # 2b. f32 composed zero1 == plain composed (tolerance; run 1 only —
+    # the byte-stability rerun re-exercises the full stack, not the
+    # reference pair).
+    if parity:
+        zf = hvdj.init_composed_zero1_state(tx, params, "gpt", mesh)
+        step_f = hvdj.make_train_step(
+            loss_fn, tx, mesh, rules="gpt", overlap=True, zero1=True,
+            donate=False,
+        )
+        step_p = hvdj.make_train_step(
+            loss_fn, tx, mesh, rules="gpt", donate=False,
+        )
+        pf, sf = params, zf
+        pp, sp = params, tx.init(params)
+        for _ in range(STEPS):
+            pf, sf, lf = step_f(pf, sf, batch)
+            pp, sp, lp = step_p(pp, sp, batch)
+        assert abs(float(lf) - float(lp)) < 1e-3 * max(
+            abs(float(lp)), 1.0
+        ), (float(lf), float(lp))
+
+    return {
+        "schema": 1,
+        "losses_int8_zero1": losses_q,
+        "final_params_digest": _digest(pq),
+        "zero1_state_digest": _digest(sq),
+        "axis_wire_bytes": axis,
+    }
+
+
+def main() -> int:
+    t0 = time.time()
+    log1 = json.dumps(run_once(parity=True), sort_keys=True)
+    log2 = json.dumps(run_once(parity=False), sort_keys=True)
+    assert log1 == log2, "normalized event logs differ between runs:\n" \
+        f"{log1}\n{log2}"
+    print(f"llm_smoke: OK in {time.time() - t0:.1f}s — {log1}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
