@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -113,4 +113,14 @@ selfdrive-smoke:
 llm-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/llm_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke test
+# Elastic-reshard chaos smoke (docs/fault_tolerance.md "Elastic
+# resharding"): f32 and int8 zero1 runs on a 4-rank virtual mesh each
+# survive a quarantine shrink to 2 ranks and a spare-promotion grow
+# back to 4 — gathered state bitwise-identical across every reshard
+# edge, f32 finals bitwise vs the uninterrupted reference, int8 within
+# quantization tolerance with live EF, hvd_reshard_* metered, event
+# log byte-identical across two runs, <25s CPU.
+reshard-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/reshard_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke test

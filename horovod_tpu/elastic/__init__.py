@@ -67,6 +67,7 @@ __all__ = [
     "PreemptionInterrupt",
     "adopted_replan",
     "adopted_step_kwargs",
+    "note_zero1_layout",
 ]
 
 
@@ -1003,6 +1004,11 @@ def _maybe_restore_persisted(state: "State") -> bool:
         if _metrics.ACTIVE:
             _metrics.TAP.inc("hvd_elastic_snapshot_quarantined_total")
         return False
+    # Layout preflight BEFORE any state is applied: a world-size change
+    # between save and restore either reshards the snapshot's sharded
+    # zero1 state here or fails with an error naming both layouts —
+    # never the deep zero.py axis-size ValueError mid-step.
+    payload = _preflight_snapshot_layout(state, payload, path)
     _apply_payload(state, payload)
     state.restore()
     logger.info("elastic: restored persisted state from %s", path)
@@ -1074,10 +1080,168 @@ def _persist_payload(state: "State") -> Dict[str, Any]:
     ``_saved_model``/``_saved_opt``, TensorFlowState ``_saved_vars``,
     TensorFlowKerasState ``_saved_weights``/``_saved_opt_vars``) — an
     allowlist here would silently drop any of them and a respawn would
-    resume with reinitialized weights under a restored step counter."""
-    return {
+    resume with reinitialized weights under a restored step counter.
+
+    The snapshot is stamped with its world layout (``__layout__``: the
+    saving world size plus any attached ZeRO-1 bucket layouts) so a
+    restore at a DIFFERENT world size can preflight the mismatch and
+    route sharded state through ``parallel/reshard`` instead of dying
+    at the zero.py axis-size raise mid-step. Older readers ignore the
+    key (``_apply_payload`` only consumes ``_saved*``)."""
+    payload = {
         k: v for k, v in vars(state).items() if k.startswith("_saved")
     }
+    payload["__layout__"] = _snapshot_layout_stamp(state)
+    return payload
+
+
+def _zero1_shard_dims(payload: Dict[str, Any]) -> Dict[str, int]:
+    """``{payload_key/tree_path: leading shard count}`` for every
+    Zero1State found inside the ``_saved*`` snapshot values."""
+    try:
+        from ..parallel.zero import Zero1State
+    except Exception:  # noqa: BLE001 - jax-free install
+        return {}
+
+    dims: Dict[str, int] = {}
+
+    def scan(prefix: str, node: Any) -> None:
+        if isinstance(node, Zero1State):
+            for leaf in _tree_leaves(node.opt):
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 1:
+                    dims[prefix] = int(shape[0])
+                    return
+            dims[prefix] = 0
+            return
+        if isinstance(node, dict):
+            for k, v in node.items():
+                scan(f"{prefix}/{k}" if prefix else str(k), v)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                scan(f"{prefix}/{i}" if prefix else str(i), v)
+
+    for key, value in payload.items():
+        if key.startswith("_saved"):
+            scan(key, value)
+    return dims
+
+
+def _tree_leaves(node: Any):
+    import jax
+
+    return jax.tree.leaves(node)
+
+
+def _snapshot_layout_stamp(state: "State") -> Dict[str, Any]:
+    try:
+        world = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+    except ValueError:
+        world = 1
+    layouts = getattr(state, "zero1_layout", None) or {}
+    serialized = {}
+    for attr, lay in dict(layouts).items():
+        serialized[str(attr)] = (
+            lay.to_dict() if hasattr(lay, "to_dict") else dict(lay)
+        )
+    return {"world": world, "zero1_layout": serialized}
+
+
+def note_zero1_layout(state: "State", attr: str, layout: Any) -> None:
+    """Attach the ZeRO-1 bucket layout of tracked attribute ``attr``
+    (from ``parallel/reshard.zero1_layout_from_params``) to ``state`` so
+    elastic snapshots and in-process resizes can reshard it across a
+    world-shape change. Without a layout, a resize with sharded state
+    refuses loudly instead of silently corrupting shard offsets."""
+    layouts = getattr(state, "zero1_layout", None)
+    if layouts is None:
+        layouts = {}
+        state.zero1_layout = layouts
+    layouts[str(attr)] = layout
+
+
+def _preflight_snapshot_layout(state: "State",
+                               payload: Dict[str, Any],
+                               path: str) -> Dict[str, Any]:
+    """Respawn-mode layout preflight: a snapshot persisted at one world
+    size restoring into a DIFFERENT one used to surface as a deep
+    ``zero.py`` ValueError ("optimizer state is sharded N ways...") on
+    the first post-restore step. Instead: compare the snapshot's
+    recorded layout against the new generation here, reshard every
+    Zero1State through ``parallel/reshard`` when a bucket layout is
+    available, and otherwise raise an error naming BOTH layouts."""
+    dims = _zero1_shard_dims(payload)
+    stamp = payload.get("__layout__") or {}
+    snap_world = stamp.get("world")
+    try:
+        cur = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+    except ValueError:
+        cur = 1
+    if not dims:
+        return payload  # replicated snapshot: any world size fits
+    mismatched = {k: n for k, n in dims.items() if n != cur}
+    if not mismatched:
+        return payload
+    layouts = dict(stamp.get("zero1_layout") or {})
+    if not layouts:
+        raise RuntimeError(
+            f"elastic: snapshot {path} holds ZeRO-1 state sharded for "
+            f"a different world: snapshot layout (world="
+            f"{snap_world if snap_world is not None else '?'}, shards "
+            f"{dims}) vs new generation layout (world={cur}) — and no "
+            f"bucket layout was recorded to reshard it. Attach one with "
+            f"hvd.elastic.note_zero1_layout(state, attr, "
+            f"zero1_layout_from_params(...)) before the first commit, "
+            f"or restore from a sharded checkpoint "
+            f"(docs/fault_tolerance.md 'Elastic resharding')."
+        )
+    from ..parallel import reshard as _reshard
+
+    out = dict(payload)
+    for key in list(out):
+        if not key.startswith("_saved"):
+            continue
+        value = out[key]
+        if not isinstance(value, dict):
+            continue
+        new_value = dict(value)
+        for attr, sub in value.items():
+            attr_dims = _zero1_shard_dims({"_saved": {attr: sub}})
+            if not attr_dims:
+                continue
+            lay = layouts.get(str(attr))
+            if lay is None:
+                raise RuntimeError(
+                    f"elastic: snapshot {path} attr {attr!r} holds "
+                    f"ZeRO-1 state sharded {sorted(set(attr_dims.values()))}"
+                    f" ways (snapshot world="
+                    f"{snap_world if snap_world is not None else '?'}) "
+                    f"but the new generation has world={cur} and no "
+                    f"bucket layout was recorded for {attr!r} "
+                    f"(known: {sorted(layouts)}) — attach one with "
+                    f"hvd.elastic.note_zero1_layout."
+                )
+            resharded, reports = _reshard.reshard_zero1_tree(
+                sub, cur, layouts={"": lay}, trigger="snapshot-restore",
+            )
+            new_value[attr] = resharded
+            for rep in reports:
+                logger.info(
+                    "elastic: resharded snapshot attr %r zero1 state "
+                    "%d->%d shards (%d bytes)", attr, rep["n_old"],
+                    rep["n_new"], rep["moved_bytes"],
+                )
+        out[key] = new_value
+    # Re-stamp for the world we just resharded into.
+    new_layouts = {
+        a: _reshard.Zero1Layout.from_dict(l).relayout(cur).to_dict()
+        for a, l in layouts.items()
+    }
+    out["__layout__"] = {"world": cur, "zero1_layout": new_layouts}
+    state.zero1_layout = {
+        a: _reshard.Zero1Layout.from_dict(l) for a, l in new_layouts.items()
+    }
+    return out
 
 
 def _apply_payload(state: "State", payload: Dict[str, Any]) -> None:
@@ -1498,6 +1662,45 @@ class ObjectState(State):
         self.save()
 
 
+def _broadcast_skipping_rank_local(hvd, tree: Any, root: int) -> Any:
+    """Broadcast an array pytree from ``root`` WITHOUT clobbering
+    rank-local nodes: Zero1State shard rows and EF residuals are
+    distinct per rank by construction (the same leaves
+    ``guard/digest.strip_rank_local`` excludes from cross-rank
+    agreement), so a whole-tree broadcast would overwrite every rank's
+    shards with the root's. Replicated leaves broadcast as before; an
+    EFState's ``inner`` (cross-rank optimizer state) still syncs, only
+    its ``residual`` stays local."""
+    import jax
+
+    try:
+        from ..ops.quantized import EFState
+        from ..parallel.zero import Zero1State
+    except Exception:  # noqa: BLE001 - partial install
+        return hvd.broadcast_variables(tree, root_rank=root)
+
+    def is_rank_local(n: Any) -> bool:
+        return isinstance(n, (Zero1State, EFState))
+
+    leaves, treedef = jax.tree.flatten(tree, is_leaf=is_rank_local)
+    if not any(is_rank_local(l) for l in leaves):
+        return hvd.broadcast_variables(tree, root_rank=root)
+    plain_idx = [i for i, l in enumerate(leaves) if not is_rank_local(l)]
+    if plain_idx:
+        synced = hvd.broadcast_variables(
+            [leaves[i] for i in plain_idx], root_rank=root
+        )
+        for i, v in zip(plain_idx, synced):
+            leaves[i] = v
+    for i, l in enumerate(leaves):
+        if isinstance(l, EFState) and l.inner is not None:
+            leaves[i] = EFState(
+                inner=_broadcast_skipping_rank_local(hvd, l.inner, root),
+                residual=l.residual,
+            )
+    return jax.tree.unflatten(treedef, leaves)
+
+
 class JaxState(ObjectState):
     """State whose attributes are JAX pytrees (params, opt_state, plus
     plain counters). Array-leaf pytrees sync with fused tensor broadcasts
@@ -1532,7 +1735,9 @@ class JaxState(ObjectState):
             for k in sorted(arrays):
                 setattr(
                     self, k,
-                    hvd.broadcast_variables(arrays[k], root_rank=root),
+                    _broadcast_skipping_rank_local(
+                        hvd, arrays[k], root
+                    ),
                 )
             if objects:
                 synced = hvd.broadcast_object(
@@ -1854,7 +2059,81 @@ def run(func: Callable) -> Callable:
                 state.restore()
             if mode == "respawn":
                 _persist_state_and_exit(state, ctx)  # never returns
+            try:
+                old_size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+            except ValueError:
+                old_size = 1
             _rejoin(ctx)
+            try:
+                new_size = int(os.environ.get("HOROVOD_SIZE", "1") or 1)
+            except ValueError:
+                new_size = 1
+            if new_size != old_size:
+                _reshard_state_for_world(state, old_size, new_size)
             state.on_reset()
 
     return wrapper
+
+
+def _reshard_state_for_world(state: State, old_size: int,
+                             new_size: int) -> None:
+    """In-process resize (quarantine shrink, spare-promotion grow,
+    scale-in/out): re-stack every tracked Zero1State attribute — and its
+    host snapshot — onto the new world size via ``parallel/reshard``,
+    instead of letting the first post-resize step die at the zero.py
+    axis-size raise. Needs the bucket layouts attached via
+    :func:`note_zero1_layout`; sharded state without one refuses loudly
+    naming both layouts."""
+    try:
+        from ..parallel.zero import Zero1State  # noqa: F401 - probe
+    except Exception:  # noqa: BLE001 - jax-free install: nothing sharded
+        return
+
+    tracked = list(getattr(state, "_tracked", []))
+    sharded = []
+    for attr in tracked:
+        dims = _zero1_shard_dims({"_saved": {attr: getattr(state, attr)}})
+        if any(n != new_size for n in dims.values()):
+            sharded.append(attr)
+    if not sharded:
+        return
+    layouts = dict(getattr(state, "zero1_layout", None) or {})
+    missing = [a for a in sharded if str(a) not in layouts]
+    if missing:
+        raise RuntimeError(
+            f"elastic: world resized {old_size}->{new_size} but tracked "
+            f"state {missing} holds ZeRO-1 shards laid out for "
+            f"{old_size} ranks and no bucket layout was attached to "
+            f"reshard them — call hvd.elastic.note_zero1_layout(state, "
+            f"attr, zero1_layout_from_params(...)) at setup "
+            f"(docs/fault_tolerance.md 'Elastic resharding')."
+        )
+    from ..parallel import reshard as _reshard
+
+    for attr in sharded:
+        lay = layouts[str(attr)]
+        if not hasattr(lay, "relayout"):
+            lay = _reshard.Zero1Layout.from_dict(lay)
+        if lay.n_shards != old_size:
+            # The layout tracks the last reshard, not necessarily the
+            # last generation — trust the state's actual leading dims.
+            lay = lay.relayout(old_size)
+        new_value, reports = _reshard.reshard_zero1_tree(
+            getattr(state, attr), new_size, layouts={"": lay},
+            trigger="resize",
+        )
+        setattr(state, attr, new_value)
+        saved = getattr(state, "_saved", None)
+        if isinstance(saved, dict) and attr in saved:
+            saved[attr], _ = _reshard.reshard_zero1_tree(
+                saved[attr], new_size, layouts={"": lay},
+                trigger="resize",
+            )
+        layouts[str(attr)] = lay.relayout(new_size)
+        for rep in reports:
+            logger.info(
+                "elastic: resharded %r zero1 state %d->%d shards for "
+                "the new generation (%d bytes)", attr, rep["n_old"],
+                rep["n_new"], rep["moved_bytes"],
+            )
+    state.zero1_layout = layouts

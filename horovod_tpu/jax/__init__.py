@@ -46,6 +46,13 @@ from ..parallel.zero import (
     zero1_posthoc_reduce,
     zero1_stream_update,
 )
+from ..parallel.reshard import (  # noqa: F401 (re-exported API)
+    LayoutManifest,
+    Zero1Layout,
+    build_manifest,
+    reshard_zero1_state,
+    zero1_layout_from_params,
+)
 from ..parallel.mesh import (
     CROSS_AXIS,
     DATA_AXIS,

@@ -92,6 +92,15 @@ _CATALOG: Dict[str, str] = {
                                    "failure (worker)",
     "hvd_elastic_snapshot_quarantined_total":
         "Unreadable persisted snapshots quarantined to *.corrupt",
+    # Elastic resharding (docs/fault_tolerance.md "Elastic resharding").
+    "hvd_reshard_total": "Sharded-state reshard executions (labeled by "
+                         "trigger: resize/checkpoint/snapshot-restore/"
+                         "manual)",
+    "hvd_reshard_bytes_total": "Bytes redistributed across ranks by "
+                               "reshards (labeled by mesh axis)",
+    "hvd_reshard_ef_dropped_elements_total":
+        "Error-feedback residual elements dropped or zeroed across a "
+        "reshard (labeled by policy; never silent)",
     # Data-plane integrity guard (docs/fault_tolerance.md).
     "hvd_guard_nonfinite_total": "Non-finite gradient detections "
                                  "(labeled by policy and path)",

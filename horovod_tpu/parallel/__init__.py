@@ -1,0 +1,10 @@
+"""Parallelism toolbox: mesh construction (``mesh``), DP x TP sharding
+rules (``rules``), ZeRO-1 optimizer-state sharding (``zero``), expert
+parallelism (``ep``), and elastic resharding across world-shape changes
+(``reshard``). Submodules import jax lazily where they can — the reshard
+planning half and this package root stay importable on a jax-free host
+(fleet simulator, capacity tooling)."""
+
+from . import reshard  # noqa: F401 (jax-free planning half)
+
+__all__ = ["reshard"]

@@ -1,0 +1,876 @@
+"""Elastic resharding: sharded fast-path state survives world-shape changes.
+
+Every robustness mechanism that changes the world shape (slowness
+quarantine, hot-spare promotion, elastic scale-in/out) used to assume
+state is replicated — ``parallel/zero.py`` raises loudly on any
+axis-size mismatch and checkpoints carried no layout metadata. This
+module closes that gap: given an old layout (mesh axes/sizes + a
+sharding-spec tree or a :class:`Zero1Layout` bucket layout) and a new
+one, it computes and executes the redistribution —
+
+- re-partitioning ZeRO-1 bucket shards ``[n_old, k_old] -> [n_new,
+  k_new]`` across a changed data-axis size,
+- re-slicing TP-sharded leaves per the rules engine's specs on the new
+  mesh (checkpoint restore assembles global leaves from per-rank shard
+  payloads via the same interval math),
+- folding-or-zeroing error-feedback residuals with an explicit counter
+  and a warning — never silent loss.
+
+The module has two halves:
+
+PLANNING (pure, no jax import at module scope): shard-interval
+arithmetic (:func:`shard_intervals`, :func:`transfer_plan`),
+redistribution bytes-on-wire accounting (:func:`plan_bytes`,
+:func:`resize_redistribution`), layout descriptions
+(:class:`BucketLayout`, :class:`Zero1Layout`, :class:`LayoutManifest`)
+and rank-coordinate / leaf-slice math (:func:`rank_coords`,
+:func:`leaf_slices`) mirroring ``parallel/rules.local_shard_tree``
+host-side. Everything here runs on a laptop or inside the fleet
+simulator with no accelerator runtime.
+
+EXECUTION (imports jax lazily): :func:`zero1_layout_from_params`
+derives the live bucket layout from the SAME planners the streamed step
+uses (``ops/fusion``), and :func:`reshard_zero1_state` re-stacks a host
+:class:`~horovod_tpu.parallel.zero.Zero1State` onto a new shard count —
+property: ``gather(reshard(state)) == gather(state)`` bitwise for every
+exact dtype (the payload bytes are moved, never recomputed).
+
+Observability: each executed reshard increments
+``hvd_reshard_total{trigger=...}`` and ``hvd_reshard_bytes_total
+{axis=...}`` and emits an ``hvd_reshard`` span on the trace lanes
+(docs/fault_tolerance.md "Elastic resharding").
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+logger = logging.getLogger("horovod_tpu.reshard")
+
+__all__ = [
+    "BucketLayout",
+    "LayoutManifest",
+    "ReshardPlan",
+    "ShardMove",
+    "Zero1Layout",
+    "leaf_slices",
+    "plan_bytes",
+    "plan_zero1_reshard",
+    "rank_coords",
+    "reshard_zero1_state",
+    "reshard_zero1_tree",
+    "resize_redistribution",
+    "shard_intervals",
+    "shard_len",
+    "transfer_plan",
+    "zero1_layout_from_params",
+]
+
+# Mirrors ops/quantized.BLOCK without importing the jax-side module: the
+# int8 wire scales per 256-element block, so quantized shard lengths are
+# BLOCK-aligned (cross-checked against ops/fusion.zero1_shard_len in
+# tests/test_reshard.py).
+_BLOCK = 256
+
+MANIFEST_SCHEMA = 1
+
+
+def shard_len(total: int, n_shards: int, quantized: bool = False) -> int:
+    """Per-shard length for a ``total``-element vector split ``n_shards``
+    ways — the pure mirror of ``ops/fusion.zero1_shard_len`` (ceil
+    division, BLOCK-aligned when the bucket rides the quantized wire)."""
+    if n_shards <= 0:
+        raise ValueError(f"n_shards must be positive, got {n_shards}")
+    k = -(-max(int(total), 1) // int(n_shards))
+    if quantized:
+        k = -(-k // _BLOCK) * _BLOCK
+    return k
+
+
+def shard_intervals(total: int, n_shards: int, k: int) -> List[Tuple[int, int]]:
+    """Half-open global intervals ``[start, end)`` of REAL (un-padded)
+    elements each shard row holds: row ``r`` covers ``[r*k, r*k+k)``
+    clipped to ``[0, total)``. Rows past the data are empty intervals."""
+    out = []
+    for r in range(int(n_shards)):
+        start = min(r * k, total)
+        out.append((start, min(start + k, total)))
+    return out
+
+
+@dataclass(frozen=True)
+class ShardMove:
+    """One contiguous slice movement in a reshard: ``length`` elements
+    starting at global offset ``start`` travel from row ``src`` (local
+    offset ``src_off``) to row ``dst`` (local offset ``dst_off``)."""
+
+    src: int
+    dst: int
+    src_off: int
+    dst_off: int
+    start: int
+    length: int
+
+
+def transfer_plan(total: int, n_old: int, k_old: int,
+                  n_new: int, k_new: int) -> List[ShardMove]:
+    """The slice-level redistribution plan from an ``[n_old, k_old]``
+    row layout to ``[n_new, k_new]``: for every new row, the old-row
+    slices that cover its global interval, in global order. The plan is
+    exhaustive and disjoint — every real element moves exactly once —
+    which the property tests assert by executing it."""
+    old_iv = shard_intervals(total, n_old, k_old)
+    moves: List[ShardMove] = []
+    for dst, (ds, de) in enumerate(shard_intervals(total, n_new, k_new)):
+        if ds >= de:
+            continue
+        for src, (ss, se) in enumerate(old_iv):
+            lo, hi = max(ds, ss), min(de, se)
+            if lo >= hi:
+                continue
+            moves.append(ShardMove(
+                src=src, dst=dst, src_off=lo - ss, dst_off=lo - ds,
+                start=lo, length=hi - lo,
+            ))
+    return moves
+
+
+def plan_bytes(moves: Sequence[ShardMove], itemsize: int) -> Tuple[int, int]:
+    """``(moved_bytes, local_bytes)`` for a transfer plan: elements whose
+    source and destination row differ cross the wire on a real fleet;
+    same-row elements are local copies (possibly at a shifted offset)."""
+    moved = sum(m.length for m in moves if m.src != m.dst) * int(itemsize)
+    local = sum(m.length for m in moves if m.src == m.dst) * int(itemsize)
+    return moved, local
+
+
+@dataclass(frozen=True)
+class BucketLayout:
+    """Shard layout of ONE fusion bucket: ``total`` real elements of
+    ``dtype``, held as ``n_shards`` rows of ``k`` (``n*k - total`` pad)."""
+
+    total: int
+    k: int
+    dtype: str
+
+    def to_dict(self) -> dict:
+        return {"total": self.total, "k": self.k, "dtype": self.dtype}
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "BucketLayout":
+        return cls(total=int(d["total"]), k=int(d["k"]),
+                   dtype=str(d["dtype"]))
+
+
+@dataclass
+class Zero1Layout:
+    """The full streamed-ZeRO-1 shard layout: which fusion bucket holds
+    how many elements of what dtype at which per-row length. Derived
+    from the live params by :func:`zero1_layout_from_params` (execution
+    half) and carried in checkpoints / elastic snapshots so a restore at
+    a DIFFERENT world size can plan the redistribution without the
+    original params in hand."""
+
+    n_shards: int
+    quantized: bool
+    buckets: Dict[str, Dict[str, BucketLayout]] = field(default_factory=dict)
+
+    def bucket_items(self) -> List[Tuple[str, str, BucketLayout]]:
+        out = []
+        for g in sorted(self.buckets):
+            for b in sorted(self.buckets[g]):
+                out.append((g, b, self.buckets[g][b]))
+        return out
+
+    def total_elements(self) -> int:
+        return sum(bl.total for _, _, bl in self.bucket_items())
+
+    def relayout(self, n_new: int) -> "Zero1Layout":
+        """Same buckets/totals/dtypes on a new shard count: each
+        bucket's row length is re-derived by the SAME rule the streamed
+        step will apply at the new world size."""
+        return Zero1Layout(
+            n_shards=int(n_new), quantized=self.quantized,
+            buckets={
+                g: {
+                    b: BucketLayout(
+                        total=bl.total,
+                        k=shard_len(bl.total, n_new, self.quantized),
+                        dtype=bl.dtype,
+                    )
+                    for b, bl in sub.items()
+                }
+                for g, sub in self.buckets.items()
+            },
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "n_shards": self.n_shards,
+            "quantized": self.quantized,
+            "buckets": {
+                g: {b: bl.to_dict() for b, bl in sub.items()}
+                for g, sub in self.buckets.items()
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Zero1Layout":
+        return cls(
+            n_shards=int(d["n_shards"]),
+            quantized=bool(d["quantized"]),
+            buckets={
+                g: {b: BucketLayout.from_dict(bl) for b, bl in sub.items()}
+                for g, sub in dict(d["buckets"]).items()
+            },
+        )
+
+    def describe(self) -> str:
+        n_buckets = len(self.bucket_items())
+        return (
+            f"zero1[n_shards={self.n_shards}, quantized={self.quantized}, "
+            f"{n_buckets} buckets, {self.total_elements()} elements]"
+        )
+
+
+@dataclass
+class ReshardPlan:
+    """The executable redistribution from one :class:`Zero1Layout` to
+    another: per-bucket slice moves plus the bytes-on-wire accounting
+    the fleet simulator prices (one state copy per optimizer slot rides
+    the same plan)."""
+
+    old: Zero1Layout
+    new: Zero1Layout
+    moves: Dict[Tuple[str, str], List[ShardMove]]
+    moved_bytes: int
+    local_bytes: int
+
+    def summary(self) -> dict:
+        return {
+            "n_old": self.old.n_shards,
+            "n_new": self.new.n_shards,
+            "buckets": len(self.moves),
+            "elements": self.old.total_elements(),
+            "moved_bytes": self.moved_bytes,
+            "local_bytes": self.local_bytes,
+        }
+
+
+def _dtype_itemsize(dtype: str) -> int:
+    sizes = {
+        "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+        "float32": 4, "int32": 4, "uint32": 4,
+        "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+        "int8": 1, "uint8": 1, "bool": 1,
+    }
+    try:
+        return sizes[str(dtype)]
+    except KeyError:
+        raise ValueError(f"unknown dtype {dtype!r} in bucket layout")
+
+
+def plan_zero1_reshard(old: Zero1Layout, new: Zero1Layout) -> ReshardPlan:
+    """Plan the redistribution between two ZeRO-1 layouts. The layouts
+    must describe the SAME parameter partition (identical group/bucket
+    keys, totals, and dtypes) — a mismatch means the two worlds bucketed
+    different params and no byte-moving plan can reconcile them."""
+    if bool(old.quantized) != bool(new.quantized):
+        raise ValueError(
+            f"cannot reshard across wire formats: old layout "
+            f"quantized={old.quantized}, new quantized={new.quantized} — "
+            f"shard lengths are BLOCK-aligned only on the quantized wire"
+        )
+    old_keys = [(g, b) for g, b, _ in old.bucket_items()]
+    new_keys = [(g, b) for g, b, _ in new.bucket_items()]
+    if old_keys != new_keys:
+        raise ValueError(
+            f"bucket partitions differ: old has {old_keys}, new has "
+            f"{new_keys} — the layouts were built for different params"
+        )
+    moves: Dict[Tuple[str, str], List[ShardMove]] = {}
+    moved = local = 0
+    for g, b, obl in old.bucket_items():
+        nbl = new.buckets[g][b]
+        if obl.total != nbl.total or obl.dtype != nbl.dtype:
+            raise ValueError(
+                f"bucket {g}/{b} mismatch: old total={obl.total} "
+                f"dtype={obl.dtype}, new total={nbl.total} "
+                f"dtype={nbl.dtype} — the layouts were built for "
+                f"different params"
+            )
+        plan = transfer_plan(
+            obl.total, old.n_shards, obl.k, new.n_shards, nbl.k
+        )
+        moves[(g, b)] = plan
+        m, l = plan_bytes(plan, _dtype_itemsize(obl.dtype))
+        moved += m
+        local += l
+    return ReshardPlan(
+        old=old, new=new, moves=moves, moved_bytes=moved, local_bytes=local
+    )
+
+
+def resize_redistribution(elements: int, itemsize: int, n_old: int,
+                          n_new: int, *, quantized: bool = False,
+                          copies: int = 1) -> dict:
+    """Bytes-on-wire accounting for resizing one sharded vector of
+    ``elements`` items from ``n_old`` to ``n_new`` rows — the pure
+    pricing primitive the fleet simulator and the selfdrive re-plan
+    ladder use (``copies`` = number of state vectors riding the same
+    layout: e.g. Adam's mu+nu+EF ride the param partition 3x)."""
+    k_old = shard_len(elements, n_old, quantized)
+    k_new = shard_len(elements, n_new, quantized)
+    plan = transfer_plan(elements, n_old, k_old, n_new, k_new)
+    moved, local = plan_bytes(plan, itemsize)
+    return {
+        "elements": int(elements),
+        "n_old": int(n_old),
+        "n_new": int(n_new),
+        "k_old": k_old,
+        "k_new": k_new,
+        "copies": int(copies),
+        "moved_bytes": moved * int(copies),
+        "local_bytes": local * int(copies),
+        "total_bytes": int(elements) * int(itemsize) * int(copies),
+    }
+
+
+# --- rank-coordinate / leaf-slice math (pure mirror of rules engine) --------
+
+
+def rank_coords(mesh_axes: Sequence[Tuple[str, int]], rank: int
+                ) -> Dict[str, int]:
+    """Axis coordinates of flat ``rank`` on a row-major mesh described
+    as an ordered ``[(axis, size), ...]`` list — the pure mirror of
+    ``Mesh.devices`` indexing for checkpoint shard assembly."""
+    world = 1
+    for _, size in mesh_axes:
+        world *= int(size)
+    if not 0 <= rank < world:
+        raise ValueError(f"rank {rank} out of range for mesh {mesh_axes}")
+    coords: Dict[str, int] = {}
+    rem = int(rank)
+    for axis, size in reversed(list(mesh_axes)):
+        coords[axis] = rem % int(size)
+        rem //= int(size)
+    return coords
+
+
+def _spec_dims(spec: Any) -> List[Tuple[str, ...]]:
+    """Normalize a per-leaf spec (as serialized in the manifest: a list
+    with one entry per array dim, each entry None, an axis name, or a
+    list of axis names) to a tuple-of-axis-tuples."""
+    dims: List[Tuple[str, ...]] = []
+    for entry in (spec or []):
+        if entry is None:
+            dims.append(())
+        elif isinstance(entry, str):
+            dims.append((entry,))
+        else:
+            dims.append(tuple(entry))
+    return dims
+
+
+def leaf_slices(spec: Any, shape: Sequence[int],
+                mesh_sizes: Mapping[str, int],
+                coords: Mapping[str, int]) -> Tuple[slice, ...]:
+    """The index slices of one rank's shard of a leaf with global
+    ``shape`` under ``spec`` — the jax-free mirror of
+    ``parallel/rules.local_shard_tree`` (axes absent from ``mesh_sizes``
+    contribute size 1, i.e. replicated)."""
+    dims = _spec_dims(spec)
+    out: List[slice] = []
+    for d, dim_size in enumerate(shape):
+        axes = dims[d] if d < len(dims) else ()
+        idx, sz = 0, 1
+        for a in axes:
+            a_sz = int(mesh_sizes.get(a, 1))
+            idx = idx * a_sz + (int(coords.get(a, 0)) % a_sz)
+            sz *= a_sz
+        if sz == 1:
+            out.append(slice(0, dim_size))
+            continue
+        if dim_size % sz:
+            raise ValueError(
+                f"dim {d} of shape {tuple(shape)} not divisible by "
+                f"mesh extent {sz} for spec {spec!r}"
+            )
+        shard = dim_size // sz
+        out.append(slice(idx * shard, (idx + 1) * shard))
+    return tuple(out)
+
+
+# --- the layout manifest (checkpoint metadata) ------------------------------
+
+
+@dataclass
+class LayoutManifest:
+    """Mesh/layout metadata written next to a sharded checkpoint so a
+    restore at a DIFFERENT world shape can plan the redistribution: the
+    ordered mesh axes, the rules-table id that produced the specs, one
+    entry per (non-zero1) leaf with its global shape/dtype/spec, and the
+    :class:`Zero1Layout` of every Zero1State node keyed by tree path.
+    ``axes_hash`` fingerprints (mesh, rules) so mismatches are named,
+    not guessed."""
+
+    mesh_axes: List[Tuple[str, int]]
+    leaves: List[dict]
+    zero1: Dict[str, dict] = field(default_factory=dict)
+    rules_id: Optional[str] = None
+    step: int = 0
+    schema: int = MANIFEST_SCHEMA
+
+    @property
+    def world(self) -> int:
+        w = 1
+        for _, size in self.mesh_axes:
+            w *= int(size)
+        return w
+
+    @property
+    def axes_hash(self) -> str:
+        blob = json.dumps(
+            {"mesh_axes": [[a, int(s)] for a, s in self.mesh_axes],
+             "rules_id": self.rules_id},
+            sort_keys=True,
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()[:16]
+
+    def describe(self) -> str:
+        axes = ", ".join(f"{a}={s}" for a, s in self.mesh_axes)
+        return (
+            f"mesh({axes}) rules={self.rules_id or '-'} "
+            f"hash={self.axes_hash} leaves={len(self.leaves)} "
+            f"zero1_nodes={len(self.zero1)}"
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "schema": self.schema,
+                "mesh_axes": [[a, int(s)] for a, s in self.mesh_axes],
+                "rules_id": self.rules_id,
+                "axes_hash": self.axes_hash,
+                "step": self.step,
+                "leaves": self.leaves,
+                "zero1": self.zero1,
+            },
+            sort_keys=True, indent=1,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "LayoutManifest":
+        doc = json.loads(text)
+        schema = int(doc.get("schema", -1))
+        if schema != MANIFEST_SCHEMA:
+            raise ValueError(
+                f"checkpoint layout manifest schema {schema} is not the "
+                f"supported schema {MANIFEST_SCHEMA}"
+            )
+        man = cls(
+            mesh_axes=[(str(a), int(s)) for a, s in doc["mesh_axes"]],
+            leaves=list(doc["leaves"]),
+            zero1={str(k): dict(v) for k, v in doc.get("zero1", {}).items()},
+            rules_id=doc.get("rules_id"),
+            step=int(doc.get("step", 0)),
+        )
+        recorded = doc.get("axes_hash")
+        if recorded and recorded != man.axes_hash:
+            raise ValueError(
+                f"checkpoint layout manifest axes_hash {recorded} does "
+                f"not match its own mesh/rules content ({man.axes_hash}) "
+                f"— the manifest is torn or hand-edited"
+            )
+        return man
+
+
+def spec_to_list(spec: Any) -> Optional[List[Any]]:
+    """Serialize a ``PartitionSpec``-like per-leaf spec to the manifest
+    form: one entry per array dim — ``None``, an axis name, or a list of
+    axis names. ``None`` spec means replicated."""
+    if spec is None:
+        return None
+    out: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+def build_manifest(tree: Any, mesh_axes: Sequence[Tuple[str, int]], *,
+                   specs: Optional[Mapping[str, Any]] = None,
+                   zero1_layouts: Optional[Mapping[str, Any]] = None,
+                   zero1_axis: str = "data",
+                   rules_id: Optional[str] = None,
+                   step: int = 0) -> LayoutManifest:
+    """Build the :class:`LayoutManifest` for a sharded checkpoint of
+    ``tree``: one entry per non-zero1 leaf (flatten order, Zero1State
+    nodes stop the flatten) with its global shape/dtype and sharding
+    spec (``specs`` maps tree path -> PartitionSpec; unlisted leaves are
+    replicated), plus the :class:`Zero1Layout` of every Zero1State node
+    (``zero1_layouts`` maps path -> layout; a bare layout is accepted
+    when the tree holds exactly one node)."""
+    import jax
+
+    import numpy as np
+
+    from .rules import _key_name
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=_is_zero1_state
+    )[0]
+    leaves: List[dict] = []
+    zero1: Dict[str, dict] = {}
+    for path, leaf in flat:
+        name = "/".join(_key_name(k) for k in path)
+        if _is_zero1_state(leaf):
+            if isinstance(zero1_layouts, Zero1Layout):
+                layout = zero1_layouts
+            elif zero1_layouts is not None:
+                layout = zero1_layouts.get(name)
+            else:
+                layout = None
+            if layout is None:
+                raise ValueError(
+                    f"tree holds a Zero1State at {name!r} but no layout "
+                    f"was provided for it — derive one with "
+                    f"zero1_layout_from_params(...) and pass "
+                    f"zero1_layouts={{{name!r}: layout}}"
+                )
+            if isinstance(layout, Zero1Layout):
+                layout = layout.to_dict()
+            else:
+                layout = dict(layout)
+            layout["axis"] = zero1_axis
+            zero1[name] = layout
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        spec = specs.get(name) if specs else None
+        leaves.append({
+            "path": name,
+            "dtype": str(arr.dtype),
+            "shape": [int(d) for d in arr.shape],
+            "spec": spec_to_list(spec),
+        })
+    return LayoutManifest(
+        mesh_axes=[(str(a), int(s)) for a, s in mesh_axes],
+        leaves=leaves, zero1=zero1, rules_id=rules_id, step=int(step),
+    )
+
+
+# --- execution half (lazy jax/numpy) ----------------------------------------
+
+
+def zero1_layout_from_params(params: Any, n_shards: int, *,
+                             threshold_bytes: Optional[int] = None,
+                             first_bucket_bytes: Optional[int] = None,
+                             quantized: bool = False) -> Zero1Layout:
+    """Derive the live :class:`Zero1Layout` from the params via the SAME
+    planners ``init_zero1_stream_state`` walks (``ops/fusion``): group
+    partition, per-group fusion buckets, per-bucket totals/dtypes, and
+    the per-row shard length at ``n_shards``. Buckets that carry no
+    optimizer state (zero-length or non-float) are skipped, exactly as
+    the init skips them."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import fusion as F
+    from .zero import _zero1_groups
+
+    items, _ = _zero1_groups(params, threshold_bytes, first_bucket_bytes)
+    threshold = F.default_threshold_bytes(threshold_bytes)
+    layout = Zero1Layout(n_shards=int(n_shards), quantized=bool(quantized))
+    for label, sub in items:
+        leaves = jax.tree.leaves(sub)
+        buckets: Dict[str, BucketLayout] = {}
+        for bi, bucket in enumerate(F.plan_buckets(leaves, threshold)):
+            total = sum(int(leaves[i].size) for i in bucket)
+            dtype = jnp.result_type(*(leaves[i] for i in bucket)) \
+                if bucket else jnp.float32
+            if total == 0 or not jnp.issubdtype(dtype, jnp.floating):
+                continue
+            buckets[f"b{bi}"] = BucketLayout(
+                total=total,
+                k=shard_len(total, n_shards, quantized),
+                dtype=str(jnp.dtype(dtype)),
+            )
+        layout.buckets[label] = buckets
+    return layout
+
+
+def _resplit_rows(rows, total: int, n_new: int, k_new: int,
+                  moves: Sequence[ShardMove]):
+    """Execute a transfer plan on a host ``[n_old, k_old]`` array:
+    returns ``[n_new, k_new]`` with every real element placed per the
+    plan and the pad region zeroed. Bitwise — bytes move, nothing is
+    recomputed."""
+    import numpy as np
+
+    rows = np.asarray(rows)
+    out = np.zeros((int(n_new), int(k_new)), dtype=rows.dtype)
+    for m in moves:
+        out[m.dst, m.dst_off:m.dst_off + m.length] = \
+            rows[m.src, m.src_off:m.src_off + m.length]
+    return out
+
+
+def _is_zero1_state(node: Any) -> bool:
+    from .zero import Zero1State
+
+    return isinstance(node, Zero1State)
+
+
+def reshard_zero1_state(state: Any, n_new: int, *,
+                        layout: Optional[Zero1Layout] = None,
+                        params: Any = None,
+                        threshold_bytes: Optional[int] = None,
+                        first_bucket_bytes: Optional[int] = None,
+                        quantized: Optional[bool] = None,
+                        ef_policy: str = "fold",
+                        trigger: str = "manual",
+                        axis: str = "data") -> Tuple[Any, dict]:
+    """Re-stack a host :class:`~horovod_tpu.parallel.zero.Zero1State`
+    from its current shard count onto ``n_new`` shards. Returns
+    ``(new_state, report)``.
+
+    The bucket layout comes from ``layout`` (e.g. deserialized from a
+    checkpoint manifest or elastic snapshot) or is derived live from
+    ``params`` via :func:`zero1_layout_from_params`. Per-bucket optax
+    leaves move by the transfer plan: ``[n_old, k_old]`` vector leaves
+    are re-split bitwise, per-shard scalar leaves (e.g. Adam's step
+    count, identical across rows by construction) are re-tiled, and
+    anything else raises naming the leaf. Error-feedback residuals
+    follow ``ef_policy``: ``"fold"`` moves each residual element with
+    its parameter (pad-region mass, zero by construction, is counted
+    and warned about if nonzero); ``"zero"`` resets the residuals and
+    reports the discarded mass loudly. Either way the report carries
+    ``ef_dropped_elements`` / ``ef_dropped_mass`` — never silent loss."""
+    import numpy as np
+
+    import jax
+
+    from .. import metrics as _metrics
+    from .. import trace as _trace
+    from .zero import Zero1State
+
+    if not _is_zero1_state(state):
+        raise TypeError(
+            f"reshard_zero1_state expects a Zero1State, got "
+            f"{type(state).__name__}"
+        )
+    if ef_policy not in ("fold", "zero"):
+        raise ValueError(
+            f"ef_policy must be 'fold' or 'zero', got {ef_policy!r}"
+        )
+    if layout is None:
+        if params is None:
+            raise ValueError(
+                "reshard_zero1_state needs the bucket layout: pass "
+                "layout= (from zero1_layout_from_params / a checkpoint "
+                "manifest / an elastic snapshot) or params= to derive it"
+            )
+        layout = zero1_layout_from_params(
+            params, _state_n_shards(state),
+            threshold_bytes=threshold_bytes,
+            first_bucket_bytes=first_bucket_bytes,
+            quantized=bool(quantized) if quantized is not None
+            else state.ef is not None,
+        )
+    elif isinstance(layout, Mapping):
+        layout = Zero1Layout.from_dict(layout)
+
+    n_old = layout.n_shards
+    live_n = _state_n_shards(state)
+    if live_n is not None and live_n != n_old:
+        raise ValueError(
+            f"layout says n_shards={n_old} but the state's leading axis "
+            f"is {live_n} — the layout describes a different world"
+        )
+    new_layout = layout.relayout(n_new)
+    plan = plan_zero1_reshard(layout, new_layout)
+
+    report = dict(plan.summary())
+    report.update({
+        "trigger": trigger, "axis": axis, "ef_policy": ef_policy,
+        "ef_dropped_elements": 0, "ef_dropped_mass": 0.0,
+    })
+
+    def _reshard_bucket_opt(g: str, b: str, node):
+        bl, nbl = layout.buckets[g][b], new_layout.buckets[g][b]
+        moves = plan.moves[(g, b)]
+
+        def one(leaf):
+            arr = np.asarray(jax.device_get(leaf))
+            if arr.ndim >= 1 and arr.shape[0] == n_old:
+                if arr.ndim == 2 and arr.shape[1] == bl.k:
+                    return _resplit_rows(arr, bl.total, n_new, nbl.k, moves)
+                if arr.ndim == 1:
+                    # Per-shard scalar state (optax count etc.): every
+                    # row saw the same number of updates, so re-tiling
+                    # row 0 is exact — verified, not assumed.
+                    if arr.size and not (arr == arr[0]).all():
+                        raise ValueError(
+                            f"bucket {g}/{b}: per-shard scalar state "
+                            f"rows disagree ({arr!r}); cannot reshard"
+                        )
+                    return np.broadcast_to(
+                        arr[:1], (int(n_new),)
+                    ).copy() if arr.size else arr
+            raise ValueError(
+                f"bucket {g}/{b}: optimizer-state leaf of shape "
+                f"{arr.shape} is neither an [n_shards, k={bl.k}] vector "
+                f"nor an [n_shards] scalar stack — this transform's "
+                f"state has no defined reshard"
+            )
+
+        return jax.tree.map(one, node)
+
+    ef_dropped_elems = 0
+    ef_dropped_mass = 0.0
+
+    def _reshard_bucket_ef(g: str, b: str, rows):
+        nonlocal ef_dropped_elems, ef_dropped_mass
+        bl, nbl = layout.buckets[g][b], new_layout.buckets[g][b]
+        arr = np.asarray(jax.device_get(rows))
+        flat = arr.reshape(-1)
+        pad = flat[bl.total:]
+        pad_nonzero = int(np.count_nonzero(pad))
+        if ef_policy == "zero":
+            nz = int(np.count_nonzero(flat[:bl.total])) + pad_nonzero
+            ef_dropped_elems += nz
+            ef_dropped_mass += float(np.abs(flat).sum())
+            return np.zeros((int(n_new), nbl.k), dtype=arr.dtype)
+        if pad_nonzero:
+            # Pad-region residual mass has no parameter to ride with —
+            # count it, warn, and drop it explicitly.
+            ef_dropped_elems += pad_nonzero
+            ef_dropped_mass += float(np.abs(pad).sum())
+        return _resplit_rows(arr, bl.total, n_new, nbl.k,
+                             plan.moves[(g, b)])
+
+    new_opt: Dict[str, Dict[str, Any]] = {}
+    for g in state.opt:
+        new_opt[g] = {}
+        for b in state.opt[g]:
+            if g not in layout.buckets or b not in layout.buckets[g]:
+                raise ValueError(
+                    f"state holds bucket {g}/{b} but the layout does "
+                    f"not describe it ({layout.describe()}) — the "
+                    f"layout was built for different params"
+                )
+            new_opt[g][b] = _reshard_bucket_opt(g, b, state.opt[g][b])
+    new_ef = None
+    if state.ef is not None:
+        new_ef = {
+            g: {b: _reshard_bucket_ef(g, b, state.ef[g][b])
+                for b in state.ef[g]}
+            for g in state.ef
+        }
+
+    report["ef_dropped_elements"] = ef_dropped_elems
+    report["ef_dropped_mass"] = ef_dropped_mass
+    if ef_dropped_elems:
+        logger.warning(
+            "reshard %s->%s shards (trigger=%s): %d EF residual "
+            "elements (L1 mass %.3e) could not ride a parameter and "
+            "were %s — the next quantized steps re-accumulate the "
+            "error from scratch",
+            n_old, n_new, trigger, ef_dropped_elems, ef_dropped_mass,
+            "zeroed" if ef_policy == "zero" else "dropped",
+        )
+    if _metrics.ACTIVE:
+        _metrics.TAP.inc("hvd_reshard_total", trigger=str(trigger))
+        _metrics.TAP.inc("hvd_reshard_bytes_total",
+                         value=float(plan.moved_bytes), axis=str(axis))
+        if ef_dropped_elems:
+            _metrics.TAP.inc("hvd_reshard_ef_dropped_elements_total",
+                             value=float(ef_dropped_elems),
+                             policy=ef_policy)
+    if _trace.ACTIVE:
+        _trace.TAP.event(
+            "hvd_reshard", cat="elastic", trigger=str(trigger),
+            axis=str(axis), n_old=n_old, n_new=int(n_new),
+            moved_bytes=plan.moved_bytes,
+            ef_dropped_elements=ef_dropped_elems,
+        )
+    logger.info(
+        "resharded zero1 state %d->%d shards (trigger=%s, axis=%s): "
+        "%d buckets, %d bytes on the wire, %d local",
+        n_old, n_new, trigger, axis, len(plan.moves),
+        plan.moved_bytes, plan.local_bytes,
+    )
+    return Zero1State(opt=new_opt, ef=new_ef), report
+
+
+def _state_n_shards(state: Any) -> Optional[int]:
+    """Leading-axis shard count of a host Zero1State (None if the state
+    carries no array leaves)."""
+    import jax
+
+    for leaf in jax.tree.leaves(state.opt):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1:
+            return int(shape[0])
+    return None
+
+
+def reshard_zero1_tree(tree: Any, n_new: int,
+                       layouts: Optional[Mapping[str, Any]] = None,
+                       **kw) -> Tuple[Any, List[dict]]:
+    """Reshard every :class:`Zero1State` node inside an arbitrary
+    pytree (e.g. an elastic snapshot payload) to ``n_new`` shards.
+    ``layouts`` maps the node's tree path (``named_tree_paths`` form) to
+    its :class:`Zero1Layout` (or dict); a single-node tree accepts a
+    bare layout under the empty path. Returns the rebuilt tree and the
+    per-node reshard reports."""
+    import jax
+
+    from .rules import _key_name
+
+    reports: List[dict] = []
+    flat = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=_is_zero1_state
+    )[0]
+    named = [
+        ("/".join(_key_name(k) for k in path), leaf)
+        for path, leaf in flat
+    ]
+    replacements: Dict[str, Any] = {}
+    for path, node in named:
+        if not _is_zero1_state(node):
+            continue
+        layout = None
+        if layouts is not None:
+            layout = layouts.get(path)
+            if layout is None and len(layouts) == 1 and "" in layouts:
+                layout = layouts[""]
+        if layout is None and layouts is not None:
+            raise ValueError(
+                f"no layout recorded for Zero1State at {path!r}; "
+                f"known paths: {sorted(layouts)}"
+            )
+        new_node, report = reshard_zero1_state(
+            node, n_new, layout=layout, **kw
+        )
+        report["path"] = path
+        reports.append(report)
+        replacements[path] = new_node
+
+    if not replacements:
+        return tree, reports
+
+    leaves, treedef = jax.tree.flatten(tree, is_leaf=_is_zero1_state)
+    paths = [p for p, _ in named]
+    out = [
+        replacements.get(paths[i], leaf) for i, leaf in enumerate(leaves)
+    ]
+    return jax.tree.unflatten(treedef, out), reports

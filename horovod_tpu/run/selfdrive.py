@@ -365,6 +365,43 @@ def propose_replan(
     )
 
 
+def price_resize(param_bytes: int, n_old: int, n_new: int, model=None, *,
+                 opt_slots: int = 2, quantized: bool = False,
+                 itemsize: int = 4) -> Dict:
+    """Price the sharded-state redistribution of a world resize
+    (quarantine shrink, spare-promotion grow, scale-in/out) so the
+    re-plan ladder can weigh "resize now" against its wire cost: the
+    ZeRO-1 optimizer state (``opt_slots`` f32 vectors per parameter —
+    Adam 2, momentum 1 — plus the EF residual on the int8 wire) is
+    sharded 1/N and must re-partition when N changes
+    (``parallel/reshard`` executes the move this prices).
+
+    ``model`` (an ``InterconnectModel``) turns bytes into a modeled
+    time over its OUTERMOST hop — a resize re-forms the world, so the
+    redistribution crosses the slowest fabric; ranks move their slices
+    in parallel, so the serialized bytes are ``moved / min(n)``."""
+    from ..parallel.reshard import resize_redistribution
+
+    elements = max(int(param_bytes) // 4, 0)  # f32 master elements
+    copies = int(opt_slots) + (1 if quantized else 0)
+    out = resize_redistribution(
+        elements, itemsize, int(n_old), int(n_new),
+        quantized=quantized, copies=copies,
+    )
+    out["param_bytes"] = int(param_bytes)
+    out["opt_slots"] = int(opt_slots)
+    out["quantized"] = bool(quantized)
+    if model is not None:
+        hop = model.hops[0]
+        per_rank = out["moved_bytes"] / max(min(int(n_old), int(n_new)), 1)
+        bytes_per_us = float(hop.bandwidth_gbps) * 1000.0
+        out["modeled_time_us"] = round(
+            float(hop.latency_us) + per_rank / bytes_per_us, 4
+        )
+        out["hop"] = hop.name
+    return out
+
+
 def verify_replan(spec, config: Dict, model, calibration) -> List:
     """Symbolically verify every stream-group plan ``config`` implies
     (the tuner's pre-pin gate, ``analysis/plan_verify``): a re-plan

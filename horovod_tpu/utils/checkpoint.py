@@ -6,15 +6,33 @@ convention; SURVEY.md §5 "Checkpoint / resume"). This module packages that
 pattern for JAX pytrees: orbax-backed when available, npz fallback, with
 ``restore_checkpoint(..., broadcast=True)`` ensuring every rank resumes
 from identical state.
+
+Sharded checkpoints (docs/fault_tolerance.md "Elastic resharding"): when
+``save_checkpoint`` is given a :class:`~horovod_tpu.parallel.reshard.
+LayoutManifest`, every rank writes its OWN shard payload
+(``step_{N}.rank{r}.npz`` — TP-sharded leaves sliced per the rules
+engine's specs, Zero1State rows per the bucket layout) and rank 0 writes
+the layout manifest LAST (``manifest_step_{N}.json``, same tmp+fsync+
+replace discipline), so a reader either sees a complete checkpoint or no
+manifest at all. ``restore_checkpoint`` then assembles the GLOBAL leaves
+from the shard payloads and, when the target's Zero1State carries a
+different shard count than the manifest, routes the state through the
+reshard planner — a checkpoint taken at one world shape restores onto a
+different one. Manifest-less legacy checkpoints restore replicated
+exactly as before; a torn manifest refuses loudly rather than falling
+back silently.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("horovod_tpu.checkpoint")
 
 
 def _flatten(tree: Any):
@@ -44,16 +62,65 @@ def _atomic_write(final_path: str, write_fn) -> None:
                 pass
 
 
-def save_checkpoint(path: str, tree: Any, step: int = 0,
-                    use_orbax: Optional[bool] = None) -> str:
-    """Save a pytree. Call from rank 0 only (the reference convention:
-    'save only on rank 0').
+def _reshard_mod():
+    from ..parallel import reshard
 
-    Writes are ATOMIC (temp file + ``os.replace``, fsynced) for both the
-    npz payload and the ``latest.json`` pointer — a kill mid-save leaves
-    the previous checkpoint fully restorable instead of a torn "latest"
-    (the orbax path is already atomic via its own finalize rename). The
-    pointer is written LAST, after the payload it names is durable."""
+    return reshard
+
+
+def _is_zero1(node: Any) -> bool:
+    from ..parallel.zero import Zero1State
+
+    return isinstance(node, Zero1State)
+
+
+def _rank_local_paths(tree: Any) -> List[str]:
+    """Tree paths of rank-local nodes (Zero1State shard stacks, EF
+    residuals) — the leaves ``guard/digest.strip_rank_local`` excludes
+    from cross-rank agreement and a broadcast must never clobber."""
+    import jax
+
+    from ..ops.quantized import EFState
+    from ..parallel.rules import _key_name
+    from ..parallel.zero import Zero1State
+
+    def stop(n):
+        return isinstance(n, (Zero1State, EFState))
+
+    flat = jax.tree_util.tree_flatten_with_path(tree, is_leaf=stop)[0]
+    return [
+        "/".join(_key_name(k) for k in path)
+        for path, leaf in flat if stop(leaf)
+    ]
+
+
+def _sharded_flatten(tree: Any):
+    """Flatten with Zero1State nodes as leaves — the unit the manifest
+    and the per-rank payloads are keyed by."""
+    import jax
+
+    return jax.tree.flatten(tree, is_leaf=_is_zero1)
+
+
+def save_checkpoint(path: str, tree: Any, step: int = 0,
+                    use_orbax: Optional[bool] = None, *,
+                    manifest: Any = None, rank: int = 0) -> str:
+    """Save a pytree. Without ``manifest``: the legacy replicated form —
+    call from rank 0 only (the reference convention: 'save only on
+    rank 0'). With ``manifest`` (a ``parallel/reshard.LayoutManifest``
+    from ``build_manifest``): the sharded form — EVERY rank calls with
+    its own ``rank`` and writes only its shard payload; rank 0
+    additionally writes the manifest and the ``latest.json`` pointer,
+    LAST, so it must save after the other ranks' payloads are durable
+    (after a barrier on a real fleet; last in the loop for in-process
+    virtual meshes).
+
+    Writes are ATOMIC (temp file + ``os.replace``, fsynced) for every
+    payload, the manifest, and the pointer — a kill mid-save leaves the
+    previous checkpoint fully restorable instead of a torn "latest"
+    (the orbax path is already atomic via its own finalize rename)."""
+    if manifest is not None:
+        return _save_sharded(path, tree, step, manifest, rank)
     if use_orbax is None:
         try:
             import orbax.checkpoint  # noqa: F401
@@ -84,6 +151,93 @@ def save_checkpoint(path: str, tree: Any, step: int = 0,
     return path
 
 
+def _zero1_row_index(manifest, entry: dict, rank: int) -> int:
+    R = _reshard_mod()
+    axis = entry.get("axis", "data")
+    coords = R.rank_coords(manifest.mesh_axes, rank)
+    if axis not in coords:
+        raise ValueError(
+            f"zero1 layout is scoped to axis {axis!r} but the manifest "
+            f"mesh {manifest.mesh_axes} has no such axis"
+        )
+    return coords[axis]
+
+
+def _save_sharded(path: str, tree: Any, step: int, manifest: Any,
+                  rank: int) -> str:
+    import jax
+
+    R = _reshard_mod()
+    os.makedirs(path, exist_ok=True)
+    from ..parallel.rules import _key_name
+
+    mesh_sizes = dict(manifest.mesh_axes)
+    coords = R.rank_coords(manifest.mesh_axes, rank)
+    flat = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=_is_zero1
+    )[0]
+    payload = {}
+    li = zi = 0
+    for key_path, leaf in flat:
+        name = "/".join(_key_name(k) for k in key_path)
+        if _is_zero1(leaf):
+            entry = manifest.zero1.get(name)
+            if entry is None:
+                raise ValueError(
+                    f"tree holds a Zero1State at {name!r} but the "
+                    f"manifest records none there (known: "
+                    f"{sorted(manifest.zero1)}) — rebuild the manifest"
+                )
+            row = _zero1_row_index(manifest, entry, rank)
+            for j, arr in enumerate(jax.tree.leaves(leaf)):
+                payload[f"z{zi}_{j}"] = np.asarray(
+                    jax.device_get(arr)
+                )[row]
+            zi += 1
+            continue
+        entry = manifest.leaves[li]
+        arr = np.asarray(jax.device_get(leaf))
+        if list(arr.shape) != list(entry["shape"]):
+            raise ValueError(
+                f"leaf {entry['path']} has shape {arr.shape} but the "
+                f"manifest records {entry['shape']} — save_checkpoint "
+                f"expects the GLOBAL (host-view) leaf; rebuild the "
+                f"manifest for this tree"
+            )
+        sl = R.leaf_slices(entry["spec"], arr.shape, mesh_sizes, coords)
+        payload[f"leaf_{li}"] = arr[sl]
+        li += 1
+    if li != len(manifest.leaves) or zi != len(manifest.zero1):
+        raise ValueError(
+            f"tree/manifest mismatch: tree has {li} leaves + {zi} "
+            f"zero1 nodes, manifest records {len(manifest.leaves)} + "
+            f"{len(manifest.zero1)} — rebuild the manifest for this tree"
+        )
+    _atomic_write(
+        os.path.join(path, f"step_{step}.rank{rank}.npz"),
+        lambda f: np.savez(f, **payload),
+    )
+    if rank == 0:
+        man = R.LayoutManifest(
+            mesh_axes=manifest.mesh_axes, leaves=manifest.leaves,
+            zero1=manifest.zero1, rules_id=manifest.rules_id,
+            step=int(step),
+        )
+        blob = man.to_json().encode()
+        _atomic_write(
+            os.path.join(path, f"manifest_step_{step}.json"),
+            lambda f: f.write(blob),
+        )
+        meta = json.dumps({
+            "step": int(step), "orbax": False, "sharded": True,
+            "world": man.world,
+        }).encode()
+        _atomic_write(
+            os.path.join(path, "latest.json"), lambda f: f.write(meta)
+        )
+    return path
+
+
 def latest_step(path: str) -> Optional[int]:
     meta = os.path.join(path, "latest.json")
     if not os.path.exists(meta):
@@ -93,18 +247,40 @@ def latest_step(path: str) -> Optional[int]:
 
 
 def restore_checkpoint(path: str, target: Any, step: Optional[int] = None,
-                       broadcast: bool = True, root_rank: int = 0) -> Any:
-    """Restore a pytree saved by ``save_checkpoint``. With
-    ``broadcast=True`` (default) the restored state is broadcast from
-    ``root_rank`` so ranks that resumed from stale/missing files still end
-    up consistent — the reference's restart pattern."""
+                       broadcast: bool = True, root_rank: int = 0,
+                       ef_policy: str = "fold") -> Any:
+    """Restore a pytree saved by ``save_checkpoint``.
+
+    Sharded checkpoints (a ``manifest_step_{N}.json`` next to per-rank
+    payloads) are assembled to GLOBAL leaves from every rank's shard; a
+    Zero1State in ``target`` whose leading shard count differs from the
+    manifest's layout is routed through the reshard planner
+    (``parallel/reshard``), so a checkpoint saved at one world shape
+    restores onto a different one. A torn manifest (unparsable, or
+    missing rank payloads) refuses loudly — it never silently falls
+    back to the legacy path. ``ef_policy`` ("fold"/"zero") governs
+    error-feedback residuals across a shard-count change.
+
+    Legacy manifest-less checkpoints restore replicated exactly as
+    before. With ``broadcast=True`` (default) the restored state is
+    broadcast from ``root_rank`` so ranks that resumed from stale or
+    missing files still end up consistent — unless the tree contains
+    RANK-LOCAL leaves (Zero1State shards, EF residuals), which a
+    broadcast would clobber with rank 0's rows: that refuses loudly,
+    naming the offending paths (use a sharded checkpoint, or
+    broadcast=False)."""
     meta_path = os.path.join(path, "latest.json")
     tree = target
+    restored_sharded = False
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
         step = meta["step"] if step is None else step
-        if meta.get("orbax"):
+        man_path = os.path.join(path, f"manifest_step_{step}.json")
+        if meta.get("sharded") or os.path.exists(man_path):
+            tree = _restore_sharded(path, target, step, ef_policy)
+            restored_sharded = True
+        elif meta.get("orbax"):
             import orbax.checkpoint as ocp
 
             ckptr = ocp.PyTreeCheckpointer()
@@ -119,9 +295,150 @@ def restore_checkpoint(path: str, target: Any, step: Optional[int] = None,
             leaves, treedef = _flatten(target)
             restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
             tree = jax.tree.unflatten(treedef, restored)
-    if broadcast:
+    if broadcast and not restored_sharded:
         import horovod_tpu as hvd
 
         if hvd.is_initialized() and hvd.size() > 1:
+            offending = _rank_local_paths(tree)
+            if offending:
+                raise ValueError(
+                    "restore_checkpoint(broadcast=True) would overwrite "
+                    "RANK-LOCAL state with rank "
+                    f"{root_rank}'s rows at: {offending} — Zero1State "
+                    "shards and EF residuals are distinct per rank by "
+                    "construction. Save a sharded checkpoint "
+                    "(save_checkpoint(..., manifest=build_manifest(...)))"
+                    " or pass broadcast=False and restore each rank's "
+                    "own payload (docs/fault_tolerance.md 'Elastic "
+                    "resharding')."
+                )
             tree = hvd.broadcast_variables(tree, root_rank=root_rank)
     return tree
+
+
+def _restore_sharded(path: str, target: Any, step: int,
+                     ef_policy: str) -> Any:
+    import jax
+
+    R = _reshard_mod()
+    man_path = os.path.join(path, f"manifest_step_{step}.json")
+    try:
+        with open(man_path) as f:
+            manifest = R.LayoutManifest.from_json(f.read())
+    except FileNotFoundError:
+        raise RuntimeError(
+            f"checkpoint at {path} step {step} is marked sharded but "
+            f"{os.path.basename(man_path)} is missing — the save was "
+            f"torn before the manifest landed; refusing to guess a "
+            f"layout (restore an older step)"
+        )
+    except (json.JSONDecodeError, ValueError, KeyError) as e:
+        raise RuntimeError(
+            f"checkpoint layout manifest {man_path} is torn or invalid "
+            f"({e}); refusing to restore from a checkpoint whose layout "
+            f"cannot be trusted (restore an older step)"
+        )
+    payloads = []
+    for r in range(manifest.world):
+        p = os.path.join(path, f"step_{step}.rank{r}.npz")
+        if not os.path.exists(p):
+            raise RuntimeError(
+                f"sharded checkpoint at {path} step {step} is missing "
+                f"the rank-{r} payload {os.path.basename(p)} (manifest "
+                f"says world={manifest.world}) — the save was torn; "
+                f"refusing partial restore"
+            )
+        payloads.append(np.load(p))
+
+    mesh_sizes = dict(manifest.mesh_axes)
+    leaves, treedef = _sharded_flatten(target)
+    n_z_target = sum(1 for l in leaves if _is_zero1(l))
+    if len(leaves) - n_z_target != len(manifest.leaves) or \
+            n_z_target != len(manifest.zero1):
+        raise ValueError(
+            f"restore target has {len(leaves) - n_z_target} leaves + "
+            f"{n_z_target} Zero1State nodes but the checkpoint records "
+            f"{len(manifest.leaves)} + {len(manifest.zero1)} — the "
+            f"target tree does not match what was saved"
+        )
+    zero1_paths = sorted(manifest.zero1)
+    out = []
+    li = zi = 0
+    for leaf in leaves:
+        if _is_zero1(leaf):
+            entry = manifest.zero1[zero1_paths[zi]]
+            out.append(_restore_zero1(
+                R, manifest, entry, payloads, zi, leaf, ef_policy
+            ))
+            zi += 1
+            continue
+        entry = manifest.leaves[li]
+        out.append(_assemble_leaf(R, entry, payloads, li, mesh_sizes,
+                                  manifest.mesh_axes))
+        li += 1
+    return jax.tree.unflatten(treedef, out)
+
+
+def _assemble_leaf(R, entry: dict, payloads, li: int, mesh_sizes,
+                   mesh_axes) -> np.ndarray:
+    shape = tuple(int(d) for d in entry["shape"])
+    first = payloads[0][f"leaf_{li}"]
+    if entry.get("spec") is None:
+        return first
+    out = np.zeros(shape, dtype=first.dtype)
+    for r, data in enumerate(payloads):
+        coords = R.rank_coords(mesh_axes, r)
+        sl = R.leaf_slices(entry["spec"], shape, mesh_sizes, coords)
+        out[sl] = data[f"leaf_{li}"]
+    return out
+
+
+def _restore_zero1(R, manifest, entry: dict, payloads, zi: int,
+                   target_node: Any, ef_policy: str) -> Any:
+    import jax
+
+    layout = R.Zero1Layout.from_dict(entry)
+    axis = entry.get("axis", "data")
+    for a, size in manifest.mesh_axes:
+        if a != axis and int(size) != 1:
+            raise ValueError(
+                f"sharded checkpoint holds Zero1State scoped to axis "
+                f"{axis!r} on mesh {manifest.mesh_axes}: restoring "
+                f"zero1 state saved with a non-trivial {a!r} axis "
+                f"needs a re-init from the gathered params (the rows "
+                f"differ per {a!r} coordinate) — docs/fault_tolerance.md"
+                f" 'Elastic resharding'"
+            )
+    # One payload row per data-axis coordinate, stacked in row order.
+    rows_by_idx = {}
+    for r in range(manifest.world):
+        idx = _zero1_row_index(manifest, entry, r)
+        rows_by_idx.setdefault(idx, r)
+    if sorted(rows_by_idx) != list(range(layout.n_shards)):
+        raise ValueError(
+            f"manifest mesh {manifest.mesh_axes} yields zero1 rows "
+            f"{sorted(rows_by_idx)} but the layout has "
+            f"{layout.n_shards} shards — the manifest is inconsistent"
+        )
+    t_leaves, t_def = jax.tree.flatten(target_node)
+    stacked = []
+    for j in range(len(t_leaves)):
+        rows = [
+            payloads[rows_by_idx[i]][f"z{zi}_{j}"]
+            for i in range(layout.n_shards)
+        ]
+        stacked.append(np.stack(rows))
+    old_state = jax.tree.unflatten(t_def, stacked)
+    target_n = R._state_n_shards(target_node)
+    if target_n is None or target_n == layout.n_shards:
+        return old_state
+    new_state, report = R.reshard_zero1_state(
+        old_state, target_n, layout=layout, ef_policy=ef_policy,
+        trigger="checkpoint", axis=axis,
+    )
+    logger.info(
+        "checkpoint restore resharded zero1 state %d->%d shards "
+        "(%d bytes moved)", layout.n_shards, target_n,
+        report["moved_bytes"],
+    )
+    return new_state

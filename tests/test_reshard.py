@@ -1,0 +1,779 @@
+"""Elastic resharding (docs/fault_tolerance.md "Elastic resharding").
+
+Covers the planning half (pure interval/transfer arithmetic, layouts,
+manifests — importable without jax), the execution half (Zero1State
+re-stacking with bitwise gather parity, EF policies, metrics), the
+mesh-aware checkpoint path (cross-world-shape round-trips, torn-manifest
+refusal, the broadcast/rank-local guard, legacy compatibility), the
+elastic snapshot/resize preflights, and the capacity-pricing helpers
+(``selfdrive.price_resize``, ``fleet_sim --resize``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu.jax as hvdj
+from horovod_tpu import metrics as _metrics
+from horovod_tpu.parallel import reshard as R
+from horovod_tpu.parallel.zero import Zero1State
+from horovod_tpu.utils import checkpoint as ckpt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Planning half: intervals, transfer plans, layouts, manifests
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_module_is_jax_free_at_import():
+    """The planning half must import on a jax-free host (fleet sim)."""
+    code = (
+        "import sys\n"
+        "sys.path.insert(0, %r)\n"
+        "import builtins\n"
+        "real = builtins.__import__\n"
+        "def guard(name, *a, **k):\n"
+        "    if name == 'jax' or name.startswith('jax.'):\n"
+        "        raise ImportError('jax blocked')\n"
+        "    return real(name, *a, **k)\n"
+        "builtins.__import__ = guard\n"
+        "from horovod_tpu.parallel import reshard\n"
+        "print(reshard.shard_len(100, 3))\n" % REPO
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "34"
+
+
+@pytest.mark.parametrize("quantized", [False, True])
+def test_shard_intervals_cover_and_disjoint(quantized):
+    rng = np.random.RandomState(7)
+    for _ in range(60):
+        total = int(rng.randint(1, 5000))
+        n = int(rng.randint(1, 9))
+        k = R.shard_len(total, n, quantized=quantized)
+        if quantized:
+            assert k % R._BLOCK == 0 or n * k >= total
+        ivs = R.shard_intervals(total, n, k)
+        assert len(ivs) == n
+        covered = 0
+        for i, (s, e) in enumerate(ivs):
+            assert 0 <= s <= e <= total
+            assert s == min(i * k, total)
+            covered += e - s
+        assert covered == total
+
+
+def test_transfer_plan_moves_every_element_once():
+    rng = np.random.RandomState(3)
+    for _ in range(60):
+        total = int(rng.randint(1, 3000))
+        n_old, n_new = int(rng.randint(1, 7)), int(rng.randint(1, 7))
+        k_old = R.shard_len(total, n_old)
+        k_new = R.shard_len(total, n_new)
+        moves = R.transfer_plan(total, n_old, k_old, n_new, k_new)
+        seen = np.zeros(total, dtype=bool)
+        for m in moves:
+            assert m.length > 0
+            assert 0 <= m.src < n_old and 0 <= m.dst < n_new
+            assert m.src_off + m.length <= k_old
+            assert m.dst_off + m.length <= k_new
+            span = slice(m.start, m.start + m.length)
+            assert not seen[span].any(), "element moved twice"
+            seen[span] = True
+            # Offsets agree with the global interval arithmetic.
+            assert m.start == m.src * k_old + m.src_off
+            assert m.start == m.dst * k_new + m.dst_off
+        assert seen.all(), "element never moved"
+        moved, local = R.plan_bytes(moves, 4)
+        assert moved + local == total * 4
+        if n_old == n_new:
+            assert moved == 0
+
+
+def test_layout_roundtrip_relayout_and_mismatch():
+    lay = R.Zero1Layout(
+        n_shards=4, quantized=False,
+        buckets={
+            "g0": {"b0": R.BucketLayout(1000, R.shard_len(1000, 4),
+                                        "float32")},
+            "g1": {"b0": R.BucketLayout(17, R.shard_len(17, 4),
+                                        "float32")},
+        },
+    )
+    back = R.Zero1Layout.from_dict(lay.to_dict())
+    assert back.to_dict() == lay.to_dict()
+    lay2 = lay.relayout(2)
+    assert lay2.n_shards == 2
+    assert lay2.total_elements() == lay.total_elements()
+    plan = R.plan_zero1_reshard(lay, lay2)
+    s = plan.summary()
+    assert s["n_old"] == 4 and s["n_new"] == 2
+    assert s["moved_bytes"] + s["local_bytes"] == 1017 * 4
+
+    qlay = R.Zero1Layout(n_shards=4, quantized=True,
+                         buckets=lay.buckets)
+    with pytest.raises(ValueError, match="quantized"):
+        R.plan_zero1_reshard(lay, qlay.relayout(2))
+
+
+def test_resize_redistribution_identity_and_scaling():
+    same = R.resize_redistribution(10_000, 4, 8, 8)
+    assert same["moved_bytes"] == 0
+    assert same["total_bytes"] == 10_000 * 4
+
+    one = R.resize_redistribution(10_000, 4, 8, 4, copies=1)
+    three = R.resize_redistribution(10_000, 4, 8, 4, copies=3)
+    assert three["moved_bytes"] == 3 * one["moved_bytes"]
+    q = R.resize_redistribution(10_000, 4, 8, 4, quantized=True)
+    assert q["k_old"] % R._BLOCK == 0
+
+
+def test_rank_coords_row_major():
+    axes = [("data", 2), ("model", 2)]
+    coords = [R.rank_coords(axes, r) for r in range(4)]
+    assert coords == [
+        {"data": 0, "model": 0}, {"data": 0, "model": 1},
+        {"data": 1, "model": 0}, {"data": 1, "model": 1},
+    ]
+
+
+def test_leaf_slices_match_manual_slicing():
+    mesh = {"data": 2, "model": 2}
+    arr = np.arange(8 * 6).reshape(8, 6)
+    spec = [["data"], ["model"]]
+    parts = {}
+    for r in range(4):
+        coords = R.rank_coords([("data", 2), ("model", 2)], r)
+        sl = R.leaf_slices(spec, arr.shape, mesh, coords)
+        parts[(coords["data"], coords["model"])] = arr[sl]
+    assert parts[(0, 0)].shape == (4, 3)
+    np.testing.assert_array_equal(parts[(1, 1)], arr[4:, 3:])
+    with pytest.raises(ValueError, match="divisible"):
+        R.leaf_slices(spec, (7, 6), mesh, {"data": 0, "model": 0})
+
+
+def test_manifest_json_roundtrip_and_torn_refusal():
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    man = R.build_manifest(params, [("data", 2)], step=3)
+    text = man.to_json()
+    back = R.LayoutManifest.from_json(text)
+    assert back.mesh_axes == [("data", 2)]
+    assert back.step == 3
+    assert back.world == 2
+    assert len(back.leaves) == 2
+
+    doc = json.loads(text)
+    doc["mesh_axes"] = [["data", 4]]  # tamper without re-hashing
+    with pytest.raises(ValueError, match="torn or hand-edited"):
+        R.LayoutManifest.from_json(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# Execution half: Zero1State resharding
+# ---------------------------------------------------------------------------
+
+
+def _params(d=12, seed=5):
+    rng = np.random.RandomState(seed)
+    return {
+        "a": {"w": jnp.asarray(rng.randn(d, d).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(d).astype(np.float32))},
+        "c": jnp.asarray(rng.randn(d, 3).astype(np.float32)),
+    }
+
+
+def _filled_state(tx, params, n, quantized=False, seed=9):
+    """An init state with deterministic, shard-layout-respecting fills:
+    [n, k] leaves carry a global vector split per the layout (pad stays
+    zero), [n] scalar stacks carry equal rows."""
+    state = hvdj.init_zero1_stream_state(
+        tx, params, n, threshold_bytes=1, first_bucket_bytes=1,
+        quantized=quantized,
+    )
+    layout = R.zero1_layout_from_params(
+        params, n, threshold_bytes=1, first_bucket_bytes=1,
+        quantized=quantized,
+    )
+    rng = np.random.RandomState(seed)
+
+    def rows(bl, dtype):
+        vec = rng.randn(bl.total).astype(dtype)
+        out = np.zeros((n, bl.k), dtype)
+        for i, (s, e) in enumerate(
+            R.shard_intervals(bl.total, n, bl.k)
+        ):
+            out[i, : e - s] = vec[s:e]
+        return out
+
+    def fill(node, bl):
+        def f(x):
+            a = np.asarray(x)
+            if a.ndim >= 2:
+                return jnp.asarray(rows(bl, a.dtype))
+            if a.ndim == 1:
+                return jnp.full(a.shape, float(rng.randint(1, 9)),
+                                a.dtype)
+            return x
+        return jax.tree.map(f, node)
+
+    opt = {
+        g: {b: fill(state.opt[g][b], layout.buckets[g][b])
+            for b in state.opt[g]}
+        for g in state.opt
+    }
+    ef = None
+    if state.ef is not None:
+        ef = {
+            g: {b: fill(state.ef[g][b], layout.buckets[g][b])
+                for b in state.ef[g]}
+            for g in state.ef
+        }
+    return Zero1State(opt=opt, ef=ef), layout
+
+
+def _gather(state, layout):
+    out = []
+    for g, b, bl in layout.bucket_items():
+        nodes = [state.opt[g][b]]
+        if state.ef is not None:
+            nodes.append(state.ef[g][b])
+        for node in nodes:
+            for leaf in jax.tree.leaves(node):
+                a = np.asarray(jax.device_get(leaf))
+                if a.ndim >= 2:
+                    out.append(a.reshape(-1)[: bl.total])
+                elif a.ndim == 1:
+                    assert (a == a[0]).all()
+                    out.append(a[:1])
+                else:
+                    out.append(a.reshape(1))
+    return out
+
+
+@pytest.mark.parametrize("opt_name", ["sgdm", "adam"])
+@pytest.mark.parametrize("quantized", [False, True])
+@pytest.mark.parametrize("n_mid", [2, 3, 6])
+def test_reshard_gather_parity_roundtrip(opt_name, quantized, n_mid):
+    tx = (optax.sgd(0.05, momentum=0.9) if opt_name == "sgdm"
+          else optax.adam(1e-3))
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4, quantized=quantized)
+    ref = _gather(state, lay4)
+
+    mid, rep = R.reshard_zero1_state(state, n_mid, layout=lay4)
+    lay_mid = lay4.relayout(n_mid)
+    for a, b in zip(ref, _gather(mid, lay_mid)):
+        np.testing.assert_array_equal(a, b)
+    assert rep["ef_dropped_elements"] == 0
+    assert rep["n_old"] == 4 and rep["n_new"] == n_mid
+
+    back, _ = R.reshard_zero1_state(mid, 4, layout=lay_mid)
+    for a, b in zip(ref, _gather(back, lay4)):
+        np.testing.assert_array_equal(a, b)
+    # Identical shard geometry again: stacked leaves match bitwise too.
+    for x, y in zip(jax.tree.leaves(state), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_reshard_derives_layout_from_params():
+    tx = optax.adam(1e-3)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4)
+    new, rep = R.reshard_zero1_state(
+        state, 2, params=params, threshold_bytes=1, first_bucket_bytes=1,
+        quantized=False,
+    )
+    for a, b in zip(_gather(state, lay4), _gather(new, lay4.relayout(2))):
+        np.testing.assert_array_equal(a, b)
+    assert rep["n_new"] == 2
+
+
+def test_reshard_scalar_rows_must_agree():
+    tx = optax.adam(1e-3)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4)
+    g = sorted(state.opt)[0]
+    b = sorted(state.opt[g])[0]
+
+    def corrupt(x):
+        a = np.asarray(x)
+        if a.ndim == 1:
+            a = a.copy()
+            a[0] += 1
+            return jnp.asarray(a)
+        return x
+
+    bad_opt = {k: dict(v) for k, v in state.opt.items()}
+    bad_opt[g][b] = jax.tree.map(corrupt, state.opt[g][b])
+    bad = Zero1State(opt=bad_opt, ef=state.ef)
+    with pytest.raises(ValueError, match=f"{g}/{b}"):
+        R.reshard_zero1_state(bad, 2, layout=lay4)
+
+
+def test_reshard_layout_world_mismatch_raises():
+    tx = optax.sgd(0.1, momentum=0.9)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4)
+    with pytest.raises(ValueError, match="different world"):
+        R.reshard_zero1_state(state, 2, layout=lay4.relayout(3))
+
+
+def test_reshard_ef_zero_policy_reports_dropped_mass():
+    tx = optax.sgd(0.05, momentum=0.9)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4, quantized=True)
+    nonzero = sum(
+        int((np.asarray(x) != 0).sum()) for x in jax.tree.leaves(state.ef)
+    )
+    assert nonzero > 0
+    new, rep = R.reshard_zero1_state(
+        state, 2, layout=lay4, ef_policy="zero"
+    )
+    assert rep["ef_dropped_elements"] == nonzero
+    assert rep["ef_dropped_mass"] > 0
+    for x in jax.tree.leaves(new.ef):
+        assert not np.asarray(x).any()
+
+
+def test_reshard_ef_fold_counts_pad_mass(caplog):
+    """Pad-region EF mass has no global position: fold drops it with a
+    warning and a nonzero counter — never silently."""
+    import logging
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4, quantized=True)
+
+    def poison_pad(rows, bl):
+        a = np.asarray(rows).copy()
+        ivs = R.shard_intervals(bl.total, 4, bl.k)
+        poisoned = 0
+        for i, (s, e) in enumerate(ivs):
+            if e - s < bl.k:
+                a[i, e - s:] = 0.25
+                poisoned += bl.k - (e - s)
+        return jnp.asarray(a), poisoned
+
+    total_poisoned = 0
+    ef = {}
+    for g in state.ef:
+        ef[g] = {}
+        for b in state.ef[g]:
+            ef[g][b], p = poison_pad(state.ef[g][b],
+                                     lay4.buckets[g][b])
+            total_poisoned += p
+    assert total_poisoned > 0
+    bad = Zero1State(opt=state.opt, ef=ef)
+    with caplog.at_level(logging.WARNING, logger="horovod_tpu.reshard"):
+        _, rep = R.reshard_zero1_state(bad, 2, layout=lay4)
+    assert rep["ef_dropped_elements"] == total_poisoned
+    assert any("dropped" in r.message for r in caplog.records)
+
+
+def test_reshard_invalid_ef_policy_and_type():
+    tx = optax.sgd(0.1)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4)
+    with pytest.raises(ValueError, match="ef_policy"):
+        R.reshard_zero1_state(state, 2, layout=lay4, ef_policy="drop")
+    with pytest.raises(TypeError, match="Zero1State"):
+        R.reshard_zero1_state({"not": "a state"}, 2, layout=lay4)
+
+
+def test_reshard_tree_multi_node():
+    tx = optax.sgd(0.05, momentum=0.9)
+    params = _params()
+    s1, lay1 = _filled_state(tx, params, 4, seed=1)
+    s2, lay2 = _filled_state(tx, params, 4, seed=2)
+    tree = {"x": s1, "y": {"z": s2}}
+    new_tree, reports = R.reshard_zero1_tree(
+        tree, 2, layouts={"x": lay1, "y/z": lay2}
+    )
+    assert sorted(rep["path"] for rep in reports) == ["x", "y/z"]
+    for a, b in zip(_gather(s1, lay1),
+                    _gather(new_tree["x"], lay1.relayout(2))):
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError, match="no layout recorded"):
+        R.reshard_zero1_tree(tree, 2, layouts={"x": lay1})
+
+
+def test_reshard_emits_metrics_and_counts_bytes():
+    tx = optax.sgd(0.05, momentum=0.9)
+    params = _params()
+    state, lay4 = _filled_state(tx, params, 4)
+    _metrics.install(True)
+    try:
+        _, rep = R.reshard_zero1_state(
+            state, 2, layout=lay4, trigger="quarantine"
+        )
+        flat = _metrics.flat()
+        assert flat['hvd_reshard_total{trigger="quarantine"}'] == 1.0
+        assert flat['hvd_reshard_bytes_total{axis="data"}'] == float(
+            rep["moved_bytes"]
+        )
+        assert rep["moved_bytes"] > 0
+    finally:
+        _metrics.install(False)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-aware checkpoints
+# ---------------------------------------------------------------------------
+
+
+def _save_all_ranks(path, tree, manifest, step=1):
+    """Rank 0 last, matching the real barrier discipline."""
+    ranks = list(range(manifest.world))
+    for r in ranks[1:] + [0]:
+        ckpt.save_checkpoint(path, tree, step=step, manifest=manifest,
+                             rank=r)
+
+
+def _ckpt_tree(tx, n, quantized=False, seed=9):
+    params = _params(seed=seed)
+    state, layout = _filled_state(tx, params, n, quantized=quantized,
+                                  seed=seed)
+    return {"params": params, "opt": state}, params, layout
+
+
+@pytest.mark.parametrize("n_from,n_to", [(4, 2), (2, 4)])
+def test_checkpoint_cross_world_roundtrip(tmp_path, n_from, n_to):
+    tx = optax.sgd(0.05, momentum=0.9)
+    tree, params, lay_from = _ckpt_tree(tx, n_from, quantized=True)
+    man = R.build_manifest(
+        tree, [("data", n_from)],
+        specs={"params/a/w": jax.sharding.PartitionSpec("data")},
+        zero1_layouts={"opt": lay_from},
+    )
+    _save_all_ranks(str(tmp_path), tree, man)
+
+    target_state = hvdj.init_zero1_stream_state(
+        tx, params, n_to, threshold_bytes=1, first_bucket_bytes=1,
+        quantized=True,
+    )
+    target = {"params": jax.tree.map(jnp.zeros_like, params),
+              "opt": target_state}
+    restored = ckpt.restore_checkpoint(str(tmp_path), target)
+    for a, b in zip(jax.tree.leaves(restored["params"]),
+                    jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    lay_to = lay_from.relayout(n_to)
+    for a, b in zip(_gather(restored["opt"], lay_to),
+                    _gather(tree["opt"], lay_from)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_same_world_restore_is_bitwise(tmp_path):
+    tx = optax.adam(1e-3)
+    tree, params, lay = _ckpt_tree(tx, 2)
+    man = R.build_manifest(tree, [("data", 2)], zero1_layouts={"opt": lay})
+    _save_all_ranks(str(tmp_path), tree, man)
+    target = {
+        "params": jax.tree.map(jnp.zeros_like, params),
+        "opt": hvdj.init_zero1_stream_state(
+            tx, params, 2, threshold_bytes=1, first_bucket_bytes=1,
+        ),
+    }
+    restored = ckpt.restore_checkpoint(str(tmp_path), target)
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_dp_tp_to_wider_dp(tmp_path):
+    """(data=2, model=2) params-only checkpoint restores onto
+    (data=4, model=1): the TP-sharded leaves reassemble from the rank
+    slices and the restored globals match the originals exactly."""
+    rng = np.random.RandomState(2)
+    params = {
+        "wq": jnp.asarray(rng.randn(8, 6).astype(np.float32)),
+        "wo": jnp.asarray(rng.randn(6, 8).astype(np.float32)),
+        "ln": jnp.asarray(rng.randn(8).astype(np.float32)),
+    }
+    P = jax.sharding.PartitionSpec
+    man = R.build_manifest(
+        params, [("data", 2), ("model", 2)],
+        specs={"wq": P(None, "model"), "wo": P("model")},
+    )
+    _save_all_ranks(str(tmp_path), params, man)
+
+    target = jax.tree.map(jnp.zeros_like, params)
+    restored = ckpt.restore_checkpoint(str(tmp_path), target)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(restored[k]), np.asarray(params[k])
+        )
+
+
+def test_checkpoint_legacy_replicated_path_unchanged(tmp_path):
+    tree = {"w": jnp.arange(12.0).reshape(3, 4), "step": jnp.asarray(7)}
+    ckpt.save_checkpoint(str(tmp_path), tree, step=2, use_orbax=False)
+    assert not any(
+        f.startswith("manifest") for f in os.listdir(tmp_path)
+    )
+    restored = ckpt.restore_checkpoint(
+        str(tmp_path), jax.tree.map(jnp.zeros_like, tree),
+        broadcast=False,
+    )
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_torn_manifest_refuses(tmp_path):
+    tx = optax.sgd(0.1)
+    tree, params, lay = _ckpt_tree(tx, 2)
+    man = R.build_manifest(tree, [("data", 2)], zero1_layouts={"opt": lay})
+    _save_all_ranks(str(tmp_path), tree, man)
+    target = {
+        "params": params,
+        "opt": hvdj.init_zero1_stream_state(
+            tx, params, 2, threshold_bytes=1, first_bucket_bytes=1,
+        ),
+    }
+
+    man_file = tmp_path / "manifest_step_1.json"
+    blob = man_file.read_text()
+    man_file.write_text(blob[: len(blob) // 2])  # torn mid-write
+    with pytest.raises(RuntimeError, match="torn or invalid"):
+        ckpt.restore_checkpoint(str(tmp_path), target)
+
+    man_file.unlink()  # manifest never landed
+    with pytest.raises(RuntimeError, match="torn"):
+        ckpt.restore_checkpoint(str(tmp_path), target)
+
+    man_file.write_text(blob)
+    (tmp_path / "step_1.rank1.npz").unlink()  # payload missing
+    with pytest.raises(RuntimeError, match="rank-1 payload"):
+        ckpt.restore_checkpoint(str(tmp_path), target)
+
+
+def test_restore_broadcast_refuses_rank_local(tmp_path, monkeypatch):
+    import horovod_tpu as hvd
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    tree, params, lay = _ckpt_tree(tx, 2, quantized=True)
+    ckpt.save_checkpoint(str(tmp_path), tree, step=0, use_orbax=False)
+
+    monkeypatch.setattr(hvd, "is_initialized", lambda: True)
+    monkeypatch.setattr(hvd, "size", lambda: 2)
+    called = []
+    monkeypatch.setattr(
+        hvd, "broadcast_variables",
+        lambda t, root_rank=0: called.append(1) or t,
+    )
+    with pytest.raises(ValueError, match="RANK-LOCAL") as ei:
+        ckpt.restore_checkpoint(str(tmp_path), tree, broadcast=True)
+    assert "opt" in str(ei.value)
+    assert not called, "broadcast ran despite rank-local state"
+
+    # Replicated trees still broadcast as before.
+    ckpt.save_checkpoint(str(tmp_path), params, step=1, use_orbax=False)
+    ckpt.restore_checkpoint(str(tmp_path), params, broadcast=True)
+    assert called
+
+
+# ---------------------------------------------------------------------------
+# Elastic snapshot / in-process resize preflights
+# ---------------------------------------------------------------------------
+
+
+def _elastic_state(tx, n, with_layout=True):
+    from horovod_tpu import elastic
+
+    params = _params()
+    z, lay = _filled_state(tx, params, n)
+    state = types.SimpleNamespace(
+        opt_state=z, _tracked=["opt_state"],
+        _saved={"opt_state": z},
+    )
+    if with_layout:
+        elastic.note_zero1_layout(state, "opt_state", lay)
+    return state, z, lay
+
+
+def test_persist_payload_stamps_layout(monkeypatch):
+    from horovod_tpu import elastic
+
+    monkeypatch.setenv("HOROVOD_SIZE", "4")
+    tx = optax.sgd(0.05, momentum=0.9)
+    state, _, lay = _elastic_state(tx, 4)
+    payload = elastic._persist_payload(state)
+    stamp = payload["__layout__"]
+    assert stamp["world"] == 4
+    assert stamp["zero1_layout"]["opt_state"]["n_shards"] == 4
+    assert "_saved" in payload
+
+
+def test_snapshot_preflight_reshards_across_worlds(monkeypatch):
+    from horovod_tpu import elastic
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    monkeypatch.setenv("HOROVOD_SIZE", "4")
+    state, z4, lay4 = _elastic_state(tx, 4)
+    payload = elastic._persist_payload(state)
+
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    out = elastic._preflight_snapshot_layout(state, payload, "snap.pkl")
+    z2 = out["_saved"]["opt_state"]
+    assert R._state_n_shards(z2) == 2
+    for a, b in zip(_gather(z4, lay4), _gather(z2, lay4.relayout(2))):
+        np.testing.assert_array_equal(a, b)
+    assert out["__layout__"]["world"] == 2
+    assert state.zero1_layout["opt_state"].n_shards == 2
+
+
+def test_snapshot_preflight_without_layout_names_both(monkeypatch):
+    from horovod_tpu import elastic
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    monkeypatch.setenv("HOROVOD_SIZE", "4")
+    state, _, _ = _elastic_state(tx, 4, with_layout=False)
+    payload = elastic._persist_payload(state)
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    with pytest.raises(RuntimeError) as ei:
+        elastic._preflight_snapshot_layout(state, payload, "snap.pkl")
+    msg = str(ei.value)
+    assert "world=4" in msg and "world=2" in msg
+    assert "note_zero1_layout" in msg
+
+
+def test_snapshot_preflight_replicated_passthrough(monkeypatch):
+    from horovod_tpu import elastic
+
+    monkeypatch.setenv("HOROVOD_SIZE", "2")
+    state = types.SimpleNamespace(_saved={"w": np.ones(3)})
+    payload = {"_saved": {"w": np.ones(3)},
+               "__layout__": {"world": 4, "zero1_layout": {}}}
+    out = elastic._preflight_snapshot_layout(state, payload, "snap.pkl")
+    assert out is payload
+
+
+def test_reshard_state_for_world_live_and_saved():
+    from horovod_tpu import elastic
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    state, z4, lay4 = _elastic_state(tx, 4)
+    elastic._reshard_state_for_world(state, 4, 2)
+    assert R._state_n_shards(state.opt_state) == 2
+    assert R._state_n_shards(state._saved["opt_state"]) == 2
+    for a, b in zip(_gather(z4, lay4),
+                    _gather(state.opt_state, lay4.relayout(2))):
+        np.testing.assert_array_equal(a, b)
+    assert state.zero1_layout["opt_state"].n_shards == 2
+
+
+def test_digest_agreement_survives_resize():
+    """The first post-resize digest beat must never false-positive a
+    heal: each beat recomputes the digest from the live (resharded)
+    state, zero1 shard BYTES are rank-local and stripped (intentional
+    divergence never mismatches), and only the shard LAYOUT headers are
+    compared — so ranks that resharded together agree on the new
+    layout, while a rank that missed the reshard mismatches loudly."""
+    from horovod_tpu import elastic
+    from horovod_tpu.guard import digest as _digest
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    state_a, _, _ = _elastic_state(tx, 4)
+    state_b, _, _ = _elastic_state(tx, 4)
+
+    # Divergent shard bytes (each rank owns its own rows) digest equal.
+    state_b.opt_state = jax.tree.map(
+        lambda x: x + 1.0, state_b.opt_state)
+    assert _digest.state_digest(state_a) == _digest.state_digest(state_b)
+
+    # Both ranks reshard 4 -> 2: digests agree on the new layout.
+    elastic._reshard_state_for_world(state_a, 4, 2)
+    elastic._reshard_state_for_world(state_b, 4, 2)
+    d_a = _digest.state_digest(state_a)
+    d_b = _digest.state_digest(state_b)
+    assert d_a == d_b
+
+    # A rank still holding the old layout mismatches — loudly, as an
+    # outlier the quorum heals — never a silent false agreement.
+    state_c, _, _ = _elastic_state(tx, 4)
+    d_c = _digest.state_digest(state_c)
+    assert d_c != d_a
+    ok, ref, outliers = _digest.find_quorum([d_a, d_b, d_c])
+    assert not ok and ref == 0 and outliers == [2]
+
+    # Recorded sharding_specs re-key cleanly after the resize: a data
+    # axis resize changes shard shapes but not the leaf structure, so a
+    # spec tree recorded before the resize still mirrors the state.
+    from jax.sharding import PartitionSpec as P
+
+    state_a.sharding_specs = {
+        "opt_state": jax.tree.map(lambda _: P(), state_a.opt_state)}
+    assert _digest.state_digest(state_a)  # must not raise
+
+
+def test_reshard_state_for_world_missing_layout_raises():
+    from horovod_tpu import elastic
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    state, _, _ = _elastic_state(tx, 4, with_layout=False)
+    with pytest.raises(RuntimeError, match="note_zero1_layout"):
+        elastic._reshard_state_for_world(state, 4, 2)
+
+
+# ---------------------------------------------------------------------------
+# Capacity pricing: selfdrive.price_resize + fleet_sim --resize
+# ---------------------------------------------------------------------------
+
+
+def test_price_resize_bytes_and_model():
+    from horovod_tpu.run.selfdrive import price_resize
+    from horovod_tpu.topo.model import synthetic_model
+
+    bare = price_resize(1 << 20, 8, 4)
+    assert bare["moved_bytes"] > 0
+    assert "modeled_time_us" not in bare
+    assert bare["copies"] == 2
+    q = price_resize(1 << 20, 8, 4, quantized=True)
+    assert q["copies"] == 3
+
+    model = synthetic_model(8)
+    priced = price_resize(1 << 20, 8, 4, model=model)
+    assert priced["modeled_time_us"] > 0
+    assert priced["hop"] in {h.name for h in model.hops}
+
+    same = price_resize(1 << 20, 8, 8)
+    assert same["moved_bytes"] == 0
+
+
+def _fleet_sim(*extra):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleet_sim.py"),
+         "--ranks", "16", "--steps", "2", *extra],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout)
+
+
+def test_fleet_sim_resize_honest_zero_without_zero1():
+    doc = _fleet_sim("--resize", "16,8")
+    blk = doc["resize"]
+    assert blk["redistribution_bytes"] == 0
+    assert "fault_tolerance.md" in blk["note"]
+
+
+def test_fleet_sim_resize_prices_zero1_state():
+    doc = _fleet_sim("--resize", "16,8", "--zero1", "--wire", "int8")
+    blk = doc["resize"]
+    assert blk["moved_bytes"] > 0
+    assert blk["quantized"] is True
+    assert blk["copies"] == 3
+    assert blk["modeled_time_us"] > 0

@@ -103,6 +103,16 @@
 # and the normalized event log is byte-identical across two runs
 # (docs/parallelism.md "Composed DP x TP fast path"). Budget: under 30s.
 #
+# Stage 15 (make reshard-smoke; skip with HVD_CI_SKIP_RESHARD=1): the
+# elastic-reshard chaos smoke — f32 and int8 zero1 runs on a 4-rank
+# virtual mesh each survive a quarantine shrink to 2 ranks and a
+# spare-promotion grow back to 4: gathered optimizer state + EF
+# bitwise-identical across every reshard edge, f32 finals bitwise vs
+# the uninterrupted 4-rank reference, int8 within quantization
+# tolerance with live EF, hvd_reshard_total/hvd_reshard_bytes_total
+# metered exactly, normalized event log byte-identical across two runs
+# (docs/fault_tolerance.md "Elastic resharding"). Budget: under 25s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -209,4 +219,11 @@ if [ "${HVD_CI_SKIP_LLM:-0}" != "1" ]; then
     python tools/llm_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: llm smoke composed+preflighted+attributed+byte-stable in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_RESHARD:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/reshard_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: reshard smoke shrunk+grown+parity+byte-stable in ${elapsed}s"
 fi

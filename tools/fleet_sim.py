@@ -139,6 +139,44 @@ def _calibration_block(calib, path):
     }
 
 
+def _resize_block(args, calib):
+    """Price a ``--resize FROM,TO`` world-shape change: how many bytes
+    of sharded ZeRO-1 state cross ranks and how long the outermost hop
+    takes to carry them. Honest zero when ``--zero1`` is off — without
+    sharded fast-path state there is nothing to redistribute."""
+    try:
+        n_old, n_new = (int(x) for x in args.resize.split(","))
+    except ValueError:
+        raise SystemExit(
+            f"fleet_sim: --resize wants FROM,TO ranks, got {args.resize!r}"
+        )
+    if n_old < 1 or n_new < 1:
+        raise SystemExit("fleet_sim: --resize ranks must be >= 1")
+    if not args.zero1:
+        return {
+            "from": n_old,
+            "to": n_new,
+            "redistribution_bytes": 0,
+            "note": (
+                "no sharded fast-path state configured (--zero1); "
+                "nothing to reshard — replicated state survives any "
+                "world shape (docs/fault_tolerance.md 'Elastic "
+                "resharding')"
+            ),
+        }
+    from horovod_tpu.run.selfdrive import price_resize
+
+    model, _ = _model_for(max(n_old, n_new), args, calib)
+    return price_resize(
+        sum(_analytic_layers(args)),
+        n_old,
+        n_new,
+        model=model,
+        opt_slots=args.opt_slots,
+        quantized=(args.wire == "int8"),
+    )
+
+
 def run_predict(args) -> int:
     from horovod_tpu.fault.plan import FaultPlan
     from horovod_tpu.sim import (
@@ -236,6 +274,7 @@ def run_predict(args) -> int:
             "local": int(args.local),
         },
         **({"tp": tp_block} if tp_block else {}),
+        **({"resize": _resize_block(args, calib)} if args.resize else {}),
         "results": results,
     }
     payload = json.dumps(report, sort_keys=True, indent=1) + "\n"
@@ -483,6 +522,18 @@ def main(argv=None) -> int:
                          "simulated stragglers")
     ap.add_argument("--probe-delay-us", type=float, default=1000.0,
                     help="straggler-sensitivity probe delay")
+    ap.add_argument("--resize", default=None, metavar="FROM,TO",
+                    help="price a world-resize event (quarantine "
+                         "shrink / spare-promotion grow): the "
+                         "redistribution bytes and modeled reshard "
+                         "time of re-partitioning the sharded ZeRO-1 "
+                         "state FROM->TO ranks (--zero1; honest zero "
+                         "otherwise — docs/fault_tolerance.md "
+                         "'Elastic resharding')")
+    ap.add_argument("--opt-slots", type=int, default=2,
+                    help="sharded f32 state vectors per parameter for "
+                         "--resize pricing (Adam 2, momentum 1); the "
+                         "int8 wire adds its EF residual on top")
     ap.add_argument("--fusion-threshold", type=int, default=64 << 20)
     ap.add_argument("--first-bucket", type=int, default=1 << 20)
     ap.add_argument("--compute-us-per-mib", type=float, default=120.0,

@@ -1,0 +1,367 @@
+#!/usr/bin/env python
+"""Elastic-reshard chaos smoke (docs/fault_tolerance.md "Elastic
+resharding").
+
+One process, a 4-rank virtual CPU mesh, <25s, two training variants
+(f32 zero1 and zero1+int8) each driven through the same chaos path:
+
+1. CHAOS SHRINK/GROW — a seeded run trains 3 steps at world 4, a
+   quarantine event shrinks it to world 2
+   (``reshard_zero1_state(trigger="quarantine")``), training continues
+   on the 2-rank mesh, then a spare promotion grows it back to 4
+   (``trigger="spare-promotion"``) and training finishes there.
+2. GATHER PARITY — at BOTH reshard edges the gathered optimizer state
+   and EF residual are bitwise-identical before and after the move:
+   ``gather(reshard(state)) == gather(state)``.
+3. FINALS MATCH THE UNINTERRUPTED REFERENCE — every rank sees the same
+   local batch, so every cross-rank reduction combines identical values
+   and the trajectory is world-shape independent where the reduction is
+   exact. The f32 variant's reduction IS exact, so its final params,
+   gathered optimizer state, and per-step losses must match an
+   uninterrupted 4-rank reference BITWISE. The int8 wire requantizes
+   partial sums per ring hop, so the world shape perturbs its rounding:
+   the int8 finals track the reference to quantization tolerance (and
+   its bitwise guarantees live at the reshard edges, point 2).
+4. OBSERVABILITY — ``hvd_reshard_total{trigger=...}`` ticks once per
+   trigger per variant and ``hvd_reshard_bytes_total{axis=data}``
+   carries the planner's moved-byte count exactly.
+5. BYTE-STABLE EVENT LOG — losses + digests + reshard reports + metric
+   counters serialize to a normalized JSON log; the chaos run executes
+   TWICE and the logs must be byte-identical.
+
+Exit 0 = all assertions hold. Wired as ``tools/ci_checks.sh`` stage 15
+(skip: HVD_CI_SKIP_RESHARD=1) and ``make reshard-smoke``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 4-rank virtual mesh; must precede the first jax backend touch.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+D = 16
+N_FULL = 4
+N_SHRUNK = 2
+STEPS_PRE = 3     # world 4, before the quarantine shrink
+STEPS_SHRUNK = 3  # world 2
+STEPS_POST = 2    # world 4 again, after spare promotion
+
+
+def _build():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(23)
+    params = {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(D, D).astype(np.float32) * 0.3),
+            "b": jnp.zeros((D,), jnp.float32),
+        }
+        for i in range(3)
+    }
+    # One per-rank block, tiled to each world size: every rank computes
+    # on identical data, so the reduction combines identical values and
+    # the trajectory is independent of the world shape (exactly so for
+    # the f32 wire).
+    block = (
+        rng.randn(4, D).astype(np.float32),
+        rng.randn(4, D).astype(np.float32),
+    )
+    batches = {
+        n: tuple(jnp.asarray(np.tile(b, (n, 1))) for b in block)
+        for n in (N_FULL, N_SHRUNK)
+    }
+    return params, batches
+
+
+def _loss_fn(params, batch):
+    import jax.numpy as jnp
+
+    x, y = batch
+    h = x
+    for k in sorted(params):
+        h = jnp.tanh(h @ params[k]["w"] + params[k]["b"])
+    return jnp.mean((h - y) ** 2)
+
+
+def _digest(tree) -> str:
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.device_get(jax.tree.leaves(tree)):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _host(tree):
+    """Pull a tree off its mesh: uncommitted host copies re-place onto
+    whichever mesh the next step runs on (worlds 4 and 2 disagree)."""
+    import jax
+
+    return jax.device_get(tree)
+
+
+def _gather_state(state, layout):
+    """Flatten a ``Zero1State`` to its gathered (world-shape free) form:
+    every ``[n, k]`` leaf becomes the concatenated first ``total``
+    elements, every ``[n]`` scalar stack its (verified-equal) row."""
+    import numpy as np
+
+    import jax
+
+    out = []
+    for g, b, bl in layout.bucket_items():
+        nodes = [state.opt[g][b]]
+        if state.ef is not None:
+            nodes.append(state.ef[g][b])
+        for node in nodes:
+            for leaf in jax.tree.leaves(node):
+                a = np.asarray(jax.device_get(leaf))
+                if a.ndim >= 2:
+                    out.append(a.reshape(-1)[: bl.total])
+                elif a.ndim == 1:
+                    assert (a == a[0]).all(), f"rows diverged in {g}/{b}"
+                    out.append(a[:1])
+                else:
+                    out.append(a.reshape(1))
+    return out
+
+
+def _run_chaos(variant):
+    """One chaos pass: train, quarantine-shrink, continue, promote a
+    spare, finish. Returns (params, state, events, reshard reports)."""
+    import numpy as np
+
+    from horovod_tpu.parallel.reshard import reshard_zero1_state
+
+    step4, step2 = variant["step4"], variant["step2"]
+    batches, layout4 = variant["batches"], variant["layout4"]
+    events = []
+    p, s = variant["params"], variant["init_state"]()
+    for i in range(STEPS_PRE):
+        p, s, loss = step4(p, s, batches[N_FULL])
+        events.append({
+            "step": i, "world": N_FULL, "loss": f"{float(loss):.9e}",
+        })
+
+    # Quarantine shrinks the world: 4 -> 2. Gather parity must hold
+    # bitwise across the move.
+    p, s = _host(p), _host(s)
+    before = _gather_state(s, layout4)
+    s, rep_shrink = reshard_zero1_state(
+        s, N_SHRUNK, layout=layout4, trigger="quarantine"
+    )
+    layout2 = layout4.relayout(N_SHRUNK)
+    after = _gather_state(s, layout2)
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    assert rep_shrink["ef_dropped_elements"] == 0, rep_shrink
+
+    for i in range(STEPS_SHRUNK):
+        p, s, loss = step2(p, s, batches[N_SHRUNK])
+        events.append({
+            "step": STEPS_PRE + i, "world": N_SHRUNK,
+            "loss": f"{float(loss):.9e}",
+        })
+
+    # Spare promotion grows it back: 2 -> 4.
+    p, s = _host(p), _host(s)
+    before = _gather_state(s, layout2)
+    s, rep_grow = reshard_zero1_state(
+        s, N_FULL, layout=layout2, trigger="spare-promotion"
+    )
+    after = _gather_state(s, layout4)
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    assert rep_grow["ef_dropped_elements"] == 0, rep_grow
+
+    for i in range(STEPS_POST):
+        p, s, loss = step4(p, s, batches[N_FULL])
+        events.append({
+            "step": STEPS_PRE + STEPS_SHRUNK + i, "world": N_FULL,
+            "loss": f"{float(loss):.9e}",
+        })
+    return p, s, events, [rep_shrink, rep_grow]
+
+
+def _run_reference(variant):
+    """Uninterrupted 4-rank run of the same seed: no reshards."""
+    p, s = variant["params"], variant["init_state"]()
+    losses = []
+    for _ in range(STEPS_PRE + STEPS_SHRUNK + STEPS_POST):
+        p, s, loss = variant["step4"](p, s, variant["batches"][N_FULL])
+        losses.append(f"{float(loss):.9e}")
+    return p, s, losses
+
+
+def _run_once(variants) -> str:
+    """One full smoke pass over both variants; returns the normalized
+    event log."""
+    import numpy as np
+
+    import jax
+
+    from horovod_tpu import metrics as _metrics
+
+    _metrics.install(True)
+    try:
+        log = {"ranks": N_FULL, "variants": {}}
+        all_reports = []
+        for name, variant in variants.items():
+            p_c, s_c, events, reports = _run_chaos(variant)
+            p_r, s_r, ref_losses = _run_reference(variant)
+            all_reports.extend(reports)
+            layout4 = variant["layout4"]
+
+            if name == "f32":
+                # Exact reduction -> the chaos trajectory IS the
+                # uninterrupted one, bit for bit.
+                for a, b in zip(jax.tree.leaves(p_c), jax.tree.leaves(p_r)):
+                    np.testing.assert_array_equal(
+                        np.asarray(a), np.asarray(b)
+                    )
+                for a, b in zip(
+                    _gather_state(s_c, layout4),
+                    _gather_state(s_r, layout4),
+                ):
+                    np.testing.assert_array_equal(a, b)
+                assert [e["loss"] for e in events] == ref_losses, (
+                    [e["loss"] for e in events], ref_losses,
+                )
+                comparison = "bitwise"
+            else:
+                # The int8 ring requantizes partial sums per hop, so
+                # the world shape perturbs wire rounding: finals track
+                # the reference to quantization tolerance only.
+                for a, b in zip(jax.tree.leaves(p_c), jax.tree.leaves(p_r)):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b), rtol=0, atol=5e-4
+                    )
+                # EF must be alive (the int8 wire is real).
+                res_l1 = sum(
+                    float(abs(np.asarray(x)).sum())
+                    for x in jax.tree.leaves(s_c.ef)
+                )
+                assert res_l1 > 0, "sharded EF residual stayed zero"
+                comparison = "quantization-tolerance"
+
+            log["variants"][name] = {
+                "events": events,
+                "comparison": comparison,
+                "params_digest": _digest(p_c),
+                "state_digest": _digest(_gather_state(s_c, layout4)),
+                "reshards": [
+                    {k: rep[k] for k in ("trigger", "n_old", "n_new",
+                                         "moved_bytes",
+                                         "ef_dropped_elements")}
+                    for rep in reports
+                ],
+            }
+
+        # Observability: each trigger ticked once per variant, moved
+        # bytes match the planner exactly.
+        flat = _metrics.flat()
+        for trig in ("quarantine", "spare-promotion"):
+            key = f'hvd_reshard_total{{trigger="{trig}"}}'
+            assert flat.get(key) == float(len(variants)), (key, flat)
+        bkey = 'hvd_reshard_bytes_total{axis="data"}'
+        want = float(sum(r["moved_bytes"] for r in all_reports))
+        assert flat.get(bkey) == want, (bkey, flat.get(bkey), want)
+        log["metrics"] = {
+            k: v for k, v in sorted(flat.items())
+            if k.startswith("hvd_reshard")
+        }
+        return json.dumps(log, sort_keys=True)
+    finally:
+        _metrics.install(False)
+
+
+def _setup():
+    import optax
+
+    import jax
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.parallel.mesh import build_mesh
+    from horovod_tpu.parallel.reshard import zero1_layout_from_params
+
+    params, batches = _build()
+    tx = optax.sgd(0.05, momentum=0.9)
+    kw = dict(fusion_threshold_bytes=1, first_bucket_bytes=1)
+    mesh4 = build_mesh({"data": N_FULL})
+    mesh2 = build_mesh(
+        {"data": N_SHRUNK}, devices=jax.devices()[:N_SHRUNK]
+    )
+
+    variants = {}
+    for name, quantized in (("f32", False), ("int8", True)):
+        qkw = dict(quantized=True) if quantized else {}
+        variants[name] = {
+            "params": params,
+            "batches": batches,
+            "step4": hvdj.make_train_step(
+                _loss_fn, tx, mesh4, donate=False, overlap=True,
+                zero1=True, **qkw, **kw,
+            ),
+            "step2": hvdj.make_train_step(
+                _loss_fn, tx, mesh2, donate=False, overlap=True,
+                zero1=True, **qkw, **kw,
+            ),
+            "init_state": (
+                lambda q=quantized: hvdj.init_zero1_stream_state(
+                    tx, params, N_FULL, threshold_bytes=1,
+                    first_bucket_bytes=1, quantized=q,
+                )
+            ),
+            "layout4": zero1_layout_from_params(
+                params, N_FULL, threshold_bytes=1, first_bucket_bytes=1,
+                quantized=quantized,
+            ),
+        }
+    return variants
+
+
+def main() -> int:
+    t0 = time.time()
+    variants = _setup()
+    log1 = _run_once(variants)
+    log2 = _run_once(variants)
+    assert log1 == log2, (
+        "reshard smoke is not byte-stable across runs:\n"
+        f"run1: {log1}\nrun2: {log2}"
+    )
+    doc = json.loads(log1)
+    n_steps = STEPS_PRE + STEPS_SHRUNK + STEPS_POST
+    moved = int(sum(
+        r["moved_bytes"]
+        for v in doc["variants"].values() for r in v["reshards"]
+    ))
+    print(
+        f"[reshard-smoke] OK in {time.time() - t0:.1f}s: {n_steps} "
+        f"zero1 steps x2 variants across a 4->2->4 quarantine/spare "
+        f"chaos path, gather parity bitwise at every edge, f32 finals "
+        f"bitwise vs uninterrupted reference, int8 within quantization "
+        f"tolerance with live EF, 4 reshards metered ({moved} bytes "
+        f"moved), log byte-stable"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
