@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke serve-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -16,7 +16,7 @@ test:
 # Pass 4 over the shipped train-step variants, Pass 5 over the reference
 # sharding-rule table.
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 HVD_CI_SKIP_SELFDRIVE=1 HVD_CI_SKIP_LLM=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 HVD_CI_SKIP_SELFDRIVE=1 HVD_CI_SKIP_LLM=1 HVD_CI_SKIP_RESHARD=1 HVD_CI_SKIP_SERVE=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -123,4 +123,13 @@ llm-smoke:
 reshard-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/reshard_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke test
+# Serving chaos smoke (docs/serving.md): a 2-replica CPU serving job
+# (TP-sharded across 2 virtual devices) under a seeded mid-batch
+# kill_replica + request drop — every request answered exactly once
+# (in-flight batch re-queued), normalized request logs byte-identical
+# across two seeded runs, hvd_request_latency_seconds/queue-depth
+# nonzero, request spans rendered via tools/trace_merge.py, <30s CPU.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/serve_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke serve-smoke test

@@ -245,8 +245,51 @@ def _parse_args(argv=None):
         help="sharding-rules table for --tp (default: gpt, the shipped "
              "models/transformer.py table)",
     )
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="closed-loop benchmark of the hvd.serve() continuous-"
+             "batching engine (docs/serving.md): N clients each keep "
+             "one request in flight; p50/p99 request latency, tokens/s "
+             "and mean batch occupancy land in the detail block",
+    )
+    parser.add_argument("--serve-clients", type=int, default=8,
+                        help="--serve: concurrent closed-loop clients")
+    parser.add_argument("--serve-requests", type=int, default=64,
+                        help="--serve: total requests across clients")
+    parser.add_argument("--serve-max-batch", type=int, default=8,
+                        help="--serve: engine max batch size")
+    parser.add_argument("--serve-max-wait-us", type=int, default=2000,
+                        help="--serve: batcher head deadline")
+    parser.add_argument("--serve-max-tokens", type=int, default=16,
+                        help="--serve: tokens generated per request")
+    parser.add_argument("--serve-replicas", type=int, default=1,
+                        help="--serve: DP serving replicas")
     parser.add_argument("--_worker", action="store_true", help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
+    if args.serve and args.zero1:
+        parser.error(
+            "--serve benchmarks the inference decode path: --zero1 "
+            "shards OPTIMIZER state across data-parallel gradient "
+            "updates (parallel/zero.py) and serving has no optimizer "
+            "or gradients — drop --zero1"
+        )
+    if args.serve and args.overlap:
+        parser.error(
+            "--serve benchmarks the inference decode path: --overlap "
+            "streams gradient reduce-scatter behind BACKWARD compute "
+            "(docs/overlap.md) and serving runs no backward pass — "
+            "drop --overlap"
+        )
+    if args.serve and args.quantized:
+        parser.error(
+            "--serve benchmarks the inference decode path: --quantized "
+            "compresses the GRADIENT wire (ops/quantized.py) and "
+            "serving moves no gradients — drop --quantized"
+        )
+    if args.serve:
+        # Serving decodes the transformer LM; --model selects training
+        # benchmark bodies and is ignored here.
+        args.model = "transformer"
     if args.zero1 and args.model != "transformer":
         parser.error("--zero1 is implemented for --model transformer only")
     if args.quantized and args.model != "transformer":
@@ -1241,7 +1284,143 @@ def run_moe_benchmark(args) -> int:
     return 0
 
 
+def run_serve_benchmark(args) -> int:
+    """Closed-loop serving benchmark (docs/serving.md "Capacity
+    planning"): ``--serve-clients`` threads each keep exactly one
+    request in flight against a live :class:`ServeEngine`, so measured
+    latency includes queueing + batching + decode — the lab twin of the
+    open-loop ``tools/fleet_sim.py --serve`` sweep."""
+    _force_platform(args.platform, args.cpu_devices)
+    devices, init_s, init_attempts = _init_backend_with_retry()
+
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.jax import make_decode_step
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.parallel.mesh import build_mesh
+    from horovod_tpu.serve import ServeEngine
+
+    if args.devices > 0:
+        devices = devices[:args.devices]
+
+    vocab, d_model, n_heads, n_layers, max_len = 256, 128, 4, 2, 128
+    if args.smoke:
+        vocab, d_model, n_heads, n_layers, max_len = 64, 32, 2, 1, 64
+        args.serve_clients = min(args.serve_clients, 4)
+        args.serve_requests = min(args.serve_requests, 16)
+
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_heads=n_heads, n_layers=n_layers,
+                          max_len=max_len)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, max_len), jnp.int32)
+    )["params"]
+
+    tp = int(args.tp or 0)
+    mesh = rules = None
+    if tp:
+        if len(devices) < tp:
+            _fail_json(args, f"--tp {tp} needs {tp} devices, have "
+                             f"{len(devices)}")
+            return 1
+        mesh = build_mesh({"model": tp}, devices=devices[:tp])
+        rules = args.rules or "gpt"
+    step = make_decode_step(n_heads=n_heads, mesh=mesh, rules=rules,
+                            dtype=jnp.float32)
+
+    engine = ServeEngine(
+        params, step,
+        n_layers=n_layers, n_heads=n_heads, head_dim=d_model // n_heads,
+        num_pages=max(64, 8 * args.serve_max_batch), page_size=8,
+        max_batch_size=args.serve_max_batch,
+        max_wait_us=args.serve_max_wait_us,
+        max_context=max_len, replicas=args.serve_replicas,
+        cache_dtype=jnp.float32,
+    )
+
+    n_clients = max(1, args.serve_clients)
+    per_client = max(1, args.serve_requests // n_clients)
+    results, res_lock = [], threading.Lock()
+
+    def client(cid):
+        rng = np.random.RandomState(1000 + cid)
+        for j in range(per_client):
+            prompt = [int(t) for t in
+                      rng.randint(0, vocab, size=1 + rng.randint(8))]
+            rid = engine.submit(prompt, max_tokens=args.serve_max_tokens,
+                                request_id=f"c{cid}.{j}")
+            comp = engine.result(rid, timeout=300.0)
+            with res_lock:
+                results.append(comp)
+
+    with engine:
+        # Warmup outside the timed window: the decode step compiles
+        # once (batch padded to max_batch_size).
+        warm = engine.submit([1, 2, 3], max_tokens=2, request_id="warmup")
+        engine.result(warm, timeout=300.0)
+        warm_batches = engine.batches
+        t0 = time.time()
+        threads = [threading.Thread(target=client, args=(c,),
+                                    name=f"bench-client-{c}")
+                   for c in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        batches = engine.batches - warm_batches
+        occupancy = (
+            (engine.batched_requests - 1) / batches if batches else 0.0
+        )
+
+    ok = [c for c in results if c is not None and c.outcome == "ok"]
+    if not ok:
+        _fail_json(args, "serving benchmark completed no requests")
+        return 1
+    lat_ms = np.sort([c.latency_s * 1e3 for c in ok])
+    total_tokens = int(sum(len(c.tokens) for c in ok))
+    tokens_per_s = total_tokens / wall if wall > 0 else 0.0
+
+    print(json.dumps({
+        "metric": "serve_decode_tokens_per_sec",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": {
+            "requests": len(ok),
+            "clients": n_clients,
+            "replicas": args.serve_replicas,
+            "latency_ms": {
+                "p50": round(float(np.percentile(lat_ms, 50)), 3),
+                "p99": round(float(np.percentile(lat_ms, 99)), 3),
+                "mean": round(float(np.mean(lat_ms)), 3),
+                "max": round(float(lat_ms[-1]), 3),
+            },
+            "requests_per_sec": round(len(ok) / wall, 2) if wall else 0.0,
+            "batch_occupancy_mean": round(float(occupancy), 3),
+            "batches": batches,
+            "max_batch_size": args.serve_max_batch,
+            "max_wait_us": args.serve_max_wait_us,
+            "max_tokens": args.serve_max_tokens,
+            "model": {"vocab": vocab, "d_model": d_model,
+                      "n_heads": n_heads, "n_layers": n_layers,
+                      "max_len": max_len},
+            **({"mesh": {"model": tp}, "rules": rules} if tp else {}),
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "init_s": round(init_s, 1),
+            "init_attempts": init_attempts,
+        },
+    }))
+    return 0
+
+
 def run_benchmark(args) -> int:
+    if args.serve:
+        return run_serve_benchmark(args)
     if args.model == "transformer":
         return run_lm_benchmark(args)
     if args.model == "moe":

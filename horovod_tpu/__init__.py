@@ -1296,6 +1296,7 @@ __all__ = [
     "elastic",
     "metrics",
     "metrics_snapshot",
+    "serve",
     "trace",
 ]
 
@@ -1306,3 +1307,6 @@ from . import metrics  # noqa: E402, F401
 # hvd.trace is the fleet-tracing subpackage (docs/timeline.md "Fleet
 # tracing"): step tap, flight recorder, KV trace shipping.
 from . import trace  # noqa: E402, F401
+# hvd.serve() stands up the inference-serving engine (docs/serving.md);
+# the subpackage stays importable as horovod_tpu.serve.
+from .serve import serve  # noqa: E402, F401

@@ -186,6 +186,42 @@ HOROVOD_REPLAN_CHECK_S = "HOROVOD_REPLAN_CHECK_S"
 HOROVOD_REPLAN_SPEC = "HOROVOD_REPLAN_SPEC"
 HOROVOD_SPARES = "HOROVOD_SPARES"
 
+# --- distributed inference serving (docs/serving.md) ---
+# HOROVOD_SERVE=1 switches a launched worker into serving mode (set by
+# `hvdrun --serve`); HOROVOD_SERVE_PORT pins the HTTP frontend.
+# HOROVOD_SERVE_REPLICAS is the number of DP serving replicas the engine
+# runs; HOROVOD_SERVE_MAX_BATCH x HOROVOD_SERVE_MAX_WAIT_US shape the
+# continuous batcher (a batch dispatches when full OR when its oldest
+# request has waited max-wait — the starvation-freedom bound);
+# HOROVOD_SERVE_QUEUE_BOUND caps admission (beyond it requests are
+# refused loudly, never queued unboundedly). HOROVOD_SERVE_SLO_MS is the
+# latency SLO target the selfdrive scale loop burns against;
+# HOROVOD_SERVE_MAX_TOKENS bounds tokens generated per request.
+# HOROVOD_SERVE_KV_PAGES x HOROVOD_SERVE_PAGE_SIZE size the paged
+# decode-state (KV-cache) pool, allocated/freed per request slot.
+HOROVOD_SERVE = "HOROVOD_SERVE"
+HOROVOD_SERVE_PORT = "HOROVOD_SERVE_PORT"
+HOROVOD_SERVE_REPLICAS = "HOROVOD_SERVE_REPLICAS"
+HOROVOD_SERVE_MAX_BATCH = "HOROVOD_SERVE_MAX_BATCH"
+HOROVOD_SERVE_MAX_WAIT_US = "HOROVOD_SERVE_MAX_WAIT_US"
+HOROVOD_SERVE_QUEUE_BOUND = "HOROVOD_SERVE_QUEUE_BOUND"
+HOROVOD_SERVE_SLO_MS = "HOROVOD_SERVE_SLO_MS"
+HOROVOD_SERVE_MAX_TOKENS = "HOROVOD_SERVE_MAX_TOKENS"
+HOROVOD_SERVE_KV_PAGES = "HOROVOD_SERVE_KV_PAGES"
+HOROVOD_SERVE_PAGE_SIZE = "HOROVOD_SERVE_PAGE_SIZE"
+# Queue-depth/SLO-burn scale triggers (run/selfdrive.ServeScalePolicy —
+# the PR 14 "Remaining" hook): sustained mean queue depth above
+# SCALE_OUT_DEPTH or an SLO-violation fraction above SLO_BURN proposes a
+# DP scale-out (spare promotion); sustained depth below SCALE_IN_DEPTH
+# with zero burn proposes a scale-in (quarantine-shrink). WINDOW is the
+# sliding observation window in supervision beats, COOLDOWN the minimum
+# beats between decisions (hysteresis).
+HOROVOD_SERVE_SCALE_OUT_DEPTH = "HOROVOD_SERVE_SCALE_OUT_DEPTH"
+HOROVOD_SERVE_SCALE_IN_DEPTH = "HOROVOD_SERVE_SCALE_IN_DEPTH"
+HOROVOD_SERVE_SLO_BURN = "HOROVOD_SERVE_SLO_BURN"
+HOROVOD_SERVE_SCALE_WINDOW = "HOROVOD_SERVE_SCALE_WINDOW"
+HOROVOD_SERVE_SCALE_COOLDOWN = "HOROVOD_SERVE_SCALE_COOLDOWN"
+
 # Fusion buffer rounding unit: reference common.h:94 FUSION_BUFFER_ATOMIC_UNIT=64.
 FUSION_BUFFER_ATOMIC_UNIT = 64
 
@@ -375,6 +411,20 @@ class Config:
     # Run the collective-safety static analyzers as a pre-flight on
     # DistributedOptimizer/allreduce setup (analysis/preflight.py).
     static_checks: bool = False
+    # Distributed inference serving (docs/serving.md): serve=True flips
+    # a launched worker into `hvd.serve()` mode; the remaining fields
+    # shape the continuous batcher, the paged KV-cache pool, and the
+    # SLO target the selfdrive scale loop burns against.
+    serve: bool = False
+    serve_port: int = 0
+    serve_replicas: int = 1
+    serve_max_batch: int = 8
+    serve_max_wait_us: int = 2000
+    serve_queue_bound: int = 1024
+    serve_slo_ms: float = 500.0
+    serve_max_tokens: int = 32
+    serve_kv_pages: int = 256
+    serve_page_size: int = 16
     extra: dict = field(default_factory=dict)
 
     @staticmethod
@@ -440,4 +490,28 @@ class Config:
         cfg.eager_backend = os.environ.get(HOROVOD_TPU_EAGER_BACKEND, cfg.eager_backend)
         cfg.mesh_axes = os.environ.get(HOROVOD_TPU_MESH_AXES, cfg.mesh_axes)
         cfg.static_checks = _get_bool(HOROVOD_TPU_STATIC_CHECKS)
+        cfg.serve = _get_bool(HOROVOD_SERVE)
+        cfg.serve_port = _get_int(HOROVOD_SERVE_PORT, cfg.serve_port)
+        cfg.serve_replicas = _get_int(
+            HOROVOD_SERVE_REPLICAS, cfg.serve_replicas
+        )
+        cfg.serve_max_batch = _get_int(
+            HOROVOD_SERVE_MAX_BATCH, cfg.serve_max_batch
+        )
+        cfg.serve_max_wait_us = _get_int(
+            HOROVOD_SERVE_MAX_WAIT_US, cfg.serve_max_wait_us
+        )
+        cfg.serve_queue_bound = _get_int(
+            HOROVOD_SERVE_QUEUE_BOUND, cfg.serve_queue_bound
+        )
+        cfg.serve_slo_ms = _get_float(HOROVOD_SERVE_SLO_MS, cfg.serve_slo_ms)
+        cfg.serve_max_tokens = _get_int(
+            HOROVOD_SERVE_MAX_TOKENS, cfg.serve_max_tokens
+        )
+        cfg.serve_kv_pages = _get_int(
+            HOROVOD_SERVE_KV_PAGES, cfg.serve_kv_pages
+        )
+        cfg.serve_page_size = _get_int(
+            HOROVOD_SERVE_PAGE_SIZE, cfg.serve_page_size
+        )
         return cfg

@@ -67,6 +67,7 @@ __all__ = [
     "PreemptionInterrupt",
     "adopted_replan",
     "adopted_step_kwargs",
+    "apply_serve_scale",
     "note_zero1_layout",
 ]
 
@@ -740,6 +741,41 @@ def maybe_wait_as_spare() -> bool:
         except Exception:  # noqa: BLE001 - advisory heartbeat
             pass
         time.sleep(SPARE_POLL_S)
+
+
+def apply_serve_scale(engine, decision):
+    """Apply a serving autoscale verdict with the elastic verbs
+    (docs/serving.md "Autoscale"): scale-out is the spare-promotion
+    verb — a fresh DP serving replica joins the fleet — and scale-in
+    the quarantine-shrink verb — the newest replica drains its current
+    batch and retires. Event-logged through the fault injector's
+    deterministic ledger like every other membership change, so a chaos
+    diff sees serving resizes next to kills and promotions.
+
+    Returns the replica index added/retired, or None when the engine
+    refused (e.g. retiring the last replica)."""
+    if decision is None:
+        return None
+    if decision.action == "scale-out":
+        idx = engine.add_replica()
+        verb = "serve-promote"
+    else:
+        idx = engine.retire_replica()
+        verb = "serve-retire"
+    if idx is None:
+        return None
+    if _fault_injector.ACTIVE:
+        _fault_injector.record_event(
+            "replica", idx, verb,
+            f"reason={decision.reason} depth={decision.depth:.1f} "
+            f"burn={decision.slo_burn:.3f}",
+        )
+    logger.warning(
+        "elastic: serving %s replica %s (%s: depth=%.1f burn=%.3f)",
+        "scale-out to" if verb == "serve-promote" else "scale-in of",
+        idx, decision.reason, decision.depth, decision.slo_burn,
+    )
+    return idx
 
 
 def _jax_distributed_initialize(coord: str, num: int, pid: int) -> None:

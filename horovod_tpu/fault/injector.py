@@ -40,6 +40,14 @@ class InjectedFault(ConnectionError):
     retry/backoff paths treat it exactly like a real transport failure."""
 
 
+class ReplicaKilled(InjectedFault):
+    """A ``kill_replica`` fault: the serving replica that hit the
+    ``replica`` tap mid-batch must abort. The serve engine catches this
+    at the replica loop boundary, re-queues every in-flight request of
+    the aborted batch, and retires the replica — the exactly-once
+    invariant the chaos harness asserts (docs/serving.md)."""
+
+
 ACTIVE = False
 
 _lock = threading.Lock()
@@ -145,6 +153,11 @@ def _execute(action: FaultAction, site: str, hit: int,
 
         preemption.request_preemption("fault plan: simulated maintenance")
         return None
+    if action.kind == "kill_replica":
+        record_event(site, hit, "kill_replica", detail)
+        raise ReplicaKilled(
+            f"injected fault: replica killed mid-batch ({site} hit {hit})"
+        )
     if action.kind == "kill":
         record_event(site, hit, "kill", f"exit={action.exit_code}")
         # Flush anything buffered — the event log write above already

@@ -32,7 +32,12 @@ Action fields
 
 ``kind``
     ``kill`` | ``delay`` | ``drop`` | ``duplicate`` | ``preempt`` |
-    ``corrupt`` | ``nan`` | ``kill_driver`` | ``restart_driver``.
+    ``corrupt`` | ``nan`` | ``kill_driver`` | ``restart_driver`` |
+    ``kill_replica``.
+    ``kill_replica`` is a *serving-plane* fault (docs/serving.md): it
+    aborts a serving replica in the middle of a batch dispatch (site
+    ``replica``), exercising the engine's exactly-once re-queue of
+    every in-flight request.
     ``corrupt``/``nan`` are *payload* faults exercising the data-plane
     integrity guard (docs/fault_tolerance.md): ``corrupt`` bit-flips one
     element of a tensor payload (silent data corruption), ``nan``
@@ -52,9 +57,13 @@ Action fields
     ``payload`` (a collective's INPUT tensor at submission — where a
     ``nan`` models a diverged kernel) and ``output`` (a collective's
     result on THIS rank only — where a ``corrupt`` models SDC that makes
-    replicas silently diverge).
+    replicas silently diverge). Serving adds ``request`` (one inference
+    request at admission; carries only ``drop``/``delay``) and
+    ``replica`` (one batch dispatch on a serving replica; carries only
+    ``kill_replica``).
     Defaults: kill/preempt → ``step``, delay → ``enqueue``,
-    drop/duplicate → ``rpc``, nan → ``payload``, corrupt → ``output``.
+    drop/duplicate → ``rpc``, nan → ``payload``, corrupt → ``output``,
+    kill_replica → ``replica``.
 ``rank`` / ``worker`` / ``gen``
     Selectors; omitted means "any". ``rank`` matches ``HOROVOD_RANK``,
     ``worker`` matches ``HOROVOD_ELASTIC_WORKER_ID``, ``gen`` matches
@@ -101,9 +110,9 @@ from typing import Any, Dict, List, Optional
 FAULT_PLAN_ENV = "HOROVOD_FAULT_PLAN"
 
 _KINDS = ("kill", "delay", "drop", "duplicate", "preempt", "corrupt", "nan",
-          "kill_driver", "restart_driver")
+          "kill_driver", "restart_driver", "kill_replica")
 _SITES = ("step", "enqueue", "response", "rpc", "kv", "spawn",
-          "payload", "output", "driver")
+          "payload", "output", "driver", "request", "replica")
 # Payload faults mutate tensors; only these sites carry one.
 PAYLOAD_KINDS = ("corrupt", "nan")
 PAYLOAD_SITES = ("payload", "output")
@@ -118,6 +127,16 @@ PAYLOAD_SITES = ("payload", "output")
 # only) so a resumed driver does not re-execute its own death.
 DRIVER_KINDS = ("kill_driver", "restart_driver")
 DRIVER_KILL_EXIT_CODE = 67
+# Serving-plane faults (docs/serving.md "Chaos semantics"): the
+# ``request`` site taps one inference request at admission (``drop`` =
+# the request is discarded and answered as dropped, ``delay`` = queueing
+# latency injected before batching), and the ``replica`` site taps one
+# batch dispatch on a serving replica — ``kill_replica`` aborts the
+# replica mid-batch, exercising the engine's exactly-once re-queue of
+# every in-flight request. Validated kind<->site like driver faults so a
+# plan cannot silently schedule a serving fault at a training tap.
+REQUEST_KINDS = ("drop", "delay")
+REPLICA_KINDS = ("kill_replica",)
 _DEFAULT_SITE = {
     "kill": "step",
     "preempt": "step",
@@ -128,6 +147,7 @@ _DEFAULT_SITE = {
     "nan": "payload",
     "kill_driver": "driver",
     "restart_driver": "driver",
+    "kill_replica": "replica",
 }
 # How many leading decisions of each probabilistic stream the canonical
 # schedule materializes (enough to make drop bursts diffable without
@@ -177,6 +197,20 @@ class FaultAction:
                 f"{site!r} do not match — driver faults "
                 f"({'/'.join(DRIVER_KINDS)}) execute only at the "
                 "'driver' site (the elastic driver's supervision loop)"
+            )
+        if (kind in REPLICA_KINDS) != (site == "replica"):
+            raise ValueError(
+                f"fault plan action {index}: kind {kind!r} and site "
+                f"{site!r} do not match — replica faults "
+                f"({'/'.join(REPLICA_KINDS)}) execute only at the "
+                "'replica' site (a serving replica's batch dispatch)"
+            )
+        if site == "request" and kind not in REQUEST_KINDS:
+            raise ValueError(
+                f"fault plan action {index}: kind {kind!r} is not a "
+                f"request fault — the 'request' site (one inference "
+                f"request at admission) carries only "
+                f"{'/'.join(REQUEST_KINDS)}"
             )
         every = None if d.get("every") is None else int(d["every"])
         until = None if d.get("until") is None else int(d["until"])

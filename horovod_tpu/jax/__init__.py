@@ -1856,6 +1856,105 @@ def make_train_step(
     return dispatch
 
 
+def make_decode_step(
+    *,
+    n_heads: int,
+    mesh: Optional[Mesh] = None,
+    rules: Any = None,
+    cache_rules: Any = None,
+    model_axis: str = "model",
+    dtype: Any = jnp.float32,
+):
+    """Build the compiled batched one-token greedy-decode step for
+    ``hvd.serve()`` (docs/serving.md):
+
+        step(params, cache, tokens, positions, page_table)
+            -> (next_tokens [B] int32, new_cache)
+
+    ``cache`` is the paged decode-state pytree
+    (``serve/kvcache.make_decode_state``) and ``page_table`` the [B,
+    max_pages] slot→page map; the forward is
+    ``models/transformer.tp_decode_apply`` — the same param tree the
+    composed train step shards, consumed as TP-local shards with ONE
+    psum per Megatron half-block.
+
+    With ``mesh`` + ``rules`` the step is shard_mapped: params placed by
+    the rule table and the cache by ``cache_rules`` (default
+    ``parallel/rules.GPT_CACHE_RULES`` — head dim over ``model_axis``),
+    BOTH preflighted by the Pass 5 validator against the live trees
+    before anything is traced, the composed-path discipline.
+    tokens/positions/page_table replicate: data parallelism in serving
+    is ENGINE-level (each DP replica runs its own step on its own
+    batches — ``serve/engine.py``), not a mesh axis of the decode step.
+    With ``mesh=None`` it is the dense single-chip reference the parity
+    tests compare against the full-recompute :func:`tp_apply`.
+
+    The build is deferred to the first call: the live params + cache
+    decide the spec trees.
+    """
+    from ..common.compat import needs_explicit_grad_reduce
+    from ..models.transformer import tp_decode_apply
+    from ..parallel import rules as _rules
+
+    if (mesh is None) != (rules is None):
+        raise ValueError(
+            "make_decode_step shards by TABLE: pass mesh= and rules= "
+            "together (or neither for the dense reference)"
+        )
+    if mesh is not None and model_axis not in mesh.axis_names:
+        raise ValueError(
+            f"decode mesh needs axis {model_axis!r}; mesh has "
+            f"{tuple(mesh.axis_names)}"
+        )
+
+    built: dict = {}
+
+    def _build(params, cache):
+        if mesh is None:
+            def step(params, cache, tokens, positions, page_table):
+                logits, new_cache = tp_decode_apply(
+                    params, tokens, positions, cache, page_table,
+                    n_heads=n_heads, model_axis=None, dtype=dtype,
+                )
+                next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return next_tokens, new_cache
+
+            return jax.jit(step)
+
+        crules = (
+            _rules.GPT_CACHE_RULES if cache_rules is None
+            else _rules.resolve_rules(cache_rules)
+        )
+        resolved = _rules.resolve_rules(rules)
+        # Pass 5 preflight over BOTH tables — always enforced.
+        _rules.preflight_rules(resolved, mesh, params)
+        _rules.preflight_rules(crules, mesh, cache)
+        specs = _rules.match_partition_rules(resolved, params)
+        cache_specs = _rules.match_partition_rules(crules, cache)
+
+        def step(params, cache, tokens, positions, page_table):
+            logits, new_cache = tp_decode_apply(
+                params, tokens, positions, cache, page_table,
+                n_heads=n_heads, model_axis=model_axis, dtype=dtype,
+            )
+            next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return next_tokens, new_cache
+
+        fn = _shard_map(
+            step, mesh, check=not needs_explicit_grad_reduce(),
+            in_specs=(specs, cache_specs, P(), P(), P()),
+            out_specs=(P(), cache_specs),
+        )
+        return jax.jit(fn)
+
+    def dispatch(params, cache, tokens, positions, page_table):
+        if "step" not in built:
+            built["step"] = _build(params, cache)
+        return built["step"](params, cache, tokens, positions, page_table)
+
+    return dispatch
+
+
 class GradientAccumulator:
     """Local gradient accumulation helper — parity with
     ``backward_passes_per_step`` (``horovod/torch/__init__.py:110-150``):

@@ -154,6 +154,26 @@ _CATALOG: Dict[str, str] = {
                                       "clock offset vs the driver "
                                       "(KV ping RTT/2; recorded, never "
                                       "applied)",
+    # Inference serving (docs/serving.md, docs/metrics.md "Serving").
+    "hvd_request_latency_seconds": "End-to-end request latency, "
+                                   "admission to completion (the SLO "
+                                   "histogram)",
+    "hvd_request_total": "Requests finished, labeled by outcome "
+                         "(ok/dropped/rejected)",
+    "hvd_serve_queue_depth": "Requests waiting in the continuous "
+                             "batcher's admission queue",
+    "hvd_serve_batch_occupancy": "Live requests in the most recent "
+                                 "dispatched batch (padding excluded)",
+    "hvd_serve_kv_pages_in_use": "KV-cache pages currently granted to "
+                                 "live requests",
+    "hvd_serve_replicas": "DP serving replicas currently running",
+    "hvd_serve_tokens_total": "Tokens generated across all requests",
+    "hvd_serve_requeues_total": "In-flight requests re-queued after a "
+                                "replica died mid-batch (each is still "
+                                "answered exactly once)",
+    "hvd_serve_scale_decisions_total": "Serving autoscale verdicts "
+                                       "(labeled by action: "
+                                       "scale-out/scale-in)",
 }
 
 _BUCKET_OVERRIDES = {

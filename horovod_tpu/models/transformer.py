@@ -239,6 +239,128 @@ def tp_apply(
     return x.astype(jnp.float32) @ w
 
 
+def tp_decode_apply(
+    params,
+    tokens,
+    positions,
+    cache,
+    page_table,
+    *,
+    n_heads: int,
+    model_axis: Optional[str] = None,
+    dtype: Any = jnp.bfloat16,
+):
+    """One incremental decode step of the SAME param tree over a paged
+    KV cache (``serve/kvcache.py``; docs/serving.md).
+
+    ``tokens``/``positions``: [B] current token ids and their global
+    positions. ``cache``: the decode-state pytree — per layer,
+    ``block_i/attention/cache_k``/``cache_v`` of shape [num_pages,
+    page_size, H(local), head_dim]. ``page_table``: [B, max_pages] int32
+    page ids mapping each slot's logical positions onto physical pages
+    (slot position p lives in page ``page_table[b, p // page_size]`` at
+    offset ``p % page_size``); padded slots point every entry at the
+    reserved scratch page 0, which the attention mask keeps them from
+    ever reading meaningfully.
+
+    Tensor parallelism mirrors :func:`tp_apply` exactly: q/k/v and the
+    MLP up-projection are column-parallel (whole local heads — the k/v
+    written to the cache are the LOCAL heads, which is why the cache
+    rule shards the head dim over "model"), attention-out and MLP-down
+    are row-parallel with ONE psum each. With ``model_axis=None`` it is
+    the dense single-chip decode the parity tests compare against the
+    full-recompute :func:`tp_apply` reference.
+
+    Returns ``(logits [B, vocab] f32, new_cache)``. The new token's k/v
+    are written BEFORE attention reads, so position p attends over
+    [0..p] inclusive — identical coverage to the causal full recompute.
+    """
+    from ..parallel.tp import column_parallel, row_parallel, tp_block_input
+
+    B = tokens.shape[0]
+    page_size = None
+    emb = params["embeddings"]["embedding"]
+    pos = params["pos_embeddings"]["embedding"]
+    x = (emb[tokens] + pos[positions]).astype(dtype)  # [B, C]
+    C = emb.shape[-1]
+    if C % n_heads:
+        raise ValueError(f"d_model {C} not divisible by n_heads {n_heads}")
+    head_dim = C // n_heads
+
+    def f(y):
+        return y if model_axis is None else tp_block_input(
+            y, axis_name=model_axis
+        )
+
+    def row(y, w, b=None):
+        if model_axis is None:
+            out = y @ w
+            return out + b if b is not None else out
+        return row_parallel(y, w, b, axis_name=model_axis)
+
+    new_cache = {k: dict(v) for k, v in cache.items()}
+    batch_ix = jnp.arange(B)
+    for i in range(transformer_n_layers(params)):
+        bp = params[f"block_{i}"]
+        ck = cache[f"block_{i}"]["attention"]["cache_k"]
+        cv = cache[f"block_{i}"]["attention"]["cache_v"]
+        page_size = ck.shape[1]
+        h = f(_layer_norm(x, bp["ln_1"], dtype))
+        att = bp["attention"]
+        q = column_parallel(h, att["query"]["kernel"].astype(dtype))
+        k = column_parallel(h, att["key"]["kernel"].astype(dtype))
+        v = column_parallel(h, att["value"]["kernel"].astype(dtype))
+        if q.shape[-1] % head_dim:
+            raise ValueError(
+                f"local q/k/v width {q.shape[-1]} is not whole heads of "
+                f"dim {head_dim} — n_heads must divide by the model-axis "
+                f"size"
+            )
+        hl = q.shape[-1] // head_dim
+        q = q.reshape(B, hl, head_dim)
+        k = k.reshape(B, hl, head_dim).astype(ck.dtype)
+        v = v.reshape(B, hl, head_dim).astype(cv.dtype)
+        # Write this position's k/v into its page BEFORE reading.
+        page = page_table[batch_ix, positions // page_size]
+        off = positions % page_size
+        ck = ck.at[page, off].set(k)
+        cv = cv.at[page, off].set(v)
+        new_cache[f"block_{i}"] = {
+            "attention": {"cache_k": ck, "cache_v": cv}
+        }
+        # Gather each slot's logical cache view through its page table
+        # and attend over [0..position].
+        keys = ck[page_table]    # [B, MP, page_size, hl, D]
+        vals = cv[page_table]
+        T = keys.shape[1] * keys.shape[2]
+        keys = keys.reshape(B, T, hl, head_dim)
+        vals = vals.reshape(B, T, hl, head_dim)
+        valid = jnp.arange(T)[None, :] <= positions[:, None]  # [B, T]
+        scores = jnp.einsum(
+            "bhd,bthd->bth", q.astype(jnp.float32),
+            keys.astype(jnp.float32),
+        ) / jnp.sqrt(jnp.float32(head_dim))
+        scores = jnp.where(valid[:, :, None], scores, jnp.float32(-1e30))
+        p = jax.nn.softmax(scores, axis=1)
+        a = jnp.einsum(
+            "bth,bthd->bhd", p, vals.astype(jnp.float32)
+        ).astype(dtype).reshape(B, hl * head_dim)
+        x = x + row(a, att["out"]["kernel"].astype(dtype))
+        h = f(_layer_norm(x, bp["ln_2"], dtype))
+        mlp = bp["mlp"]
+        u = jax.nn.gelu(column_parallel(
+            h, mlp["up"]["kernel"].astype(dtype),
+            mlp["up"]["bias"].astype(dtype),
+        ))
+        x = x + row(
+            u, mlp["down"]["kernel"].astype(dtype),
+            mlp["down"]["bias"].astype(dtype),
+        )
+    x = _layer_norm(x, params["ln_f"], dtype)
+    w = params["lm_head"]["kernel"].astype(jnp.float32)
+    return x.astype(jnp.float32) @ w, new_cache
+
+
 def lm_loss(logits, labels):
     """Mean next-token cross entropy (no optax dependency)."""
     logp = jax.nn.log_softmax(logits, axis=-1)

@@ -35,7 +35,9 @@ from ..analysis.sharding_rules import (
 )
 
 __all__ = [
+    "GPT_CACHE_RULES",
     "GPT_RULES",
+    "NAMED_CACHE_RULES",
     "NAMED_RULES",
     "gather_tree",
     "local_shard_tree",
@@ -55,6 +57,21 @@ __all__ = [
 GPT_RULES: Tuple[Rule, ...] = EXAMPLE_GPT_RULES
 
 NAMED_RULES: Dict[str, Tuple[Rule, ...]] = {"gpt": GPT_RULES}
+
+# Serving decode-state (paged KV-cache) placement, same engine and same
+# mesh as the param table (docs/serving.md): cache leaves are named
+# ``block_i/attention/cache_k`` / ``cache_v`` with shape
+# ``[num_pages, page_size, n_heads, head_dim]``; sharding the HEAD dim
+# over "model" makes each TP rank hold exactly the pages of its local
+# heads — the decode step's column-parallel q/k/v writes land on the
+# local shard with no communication, mirroring Megatron head sharding of
+# the q/k/v kernels. Preflighted by Pass 5 against the concrete cache
+# tree before the decode step is built (serve/kvcache.py).
+GPT_CACHE_RULES: Tuple[Rule, ...] = (
+    (r"attention/cache_[kv]$", (None, None, "model", None)),
+)
+
+NAMED_CACHE_RULES: Dict[str, Tuple[Rule, ...]] = {"gpt": GPT_CACHE_RULES}
 
 
 def resolve_rules(rules: Any) -> Sequence[Rule]:

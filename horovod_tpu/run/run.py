@@ -193,6 +193,11 @@ def parse_args(argv: Optional[List[str]] = None):
                              "plane; skips the automatic ring probe.")
     parser.add_argument("--mesh-axes", default=None,
                         help='Compiled-mode mesh spec, e.g. "data:4,model:2".')
+    parser.add_argument("--serve", action="store_true",
+                        help="Inference-serving mode (docs/serving.md): "
+                             "sets HOROVOD_SERVE=1 for every rank; with "
+                             "no command, runs the built-in HTTP serving "
+                             "entry point (python -m horovod_tpu.serve).")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="Training command to run on every rank.")
     args = parser.parse_args(argv)
@@ -313,6 +318,9 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     command = list(args.command)
     if command and command[0] == "--":
         command = command[1:]
+    if not command and args.serve:
+        # Serving mode's default workload: the built-in HTTP entry point.
+        command = [sys.executable, "-m", "horovod_tpu.serve"]
     if not command:
         print("hvdrun: no training command given", file=sys.stderr)
         return 2
@@ -326,6 +334,10 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     config_parser.set_env_from_args(env, args)
     if args.network_interfaces:
         env["HOROVOD_IFACE"] = args.network_interfaces
+    if args.serve:
+        from ..common import env as _env_names
+
+        env[_env_names.HOROVOD_SERVE] = "1"
 
     # Elastic mode: any elastic flag routes supervision to ElasticDriver
     # (generation-based re-rendezvous) instead of the fixed fan-out.

@@ -178,6 +178,130 @@ class StragglerPolicy:
         return None
 
 
+# ---------------------------------------------------- serving autoscale
+@dataclass(frozen=True)
+class ScaleDecision:
+    """One serving-autoscale verdict (docs/serving.md "Autoscale"):
+    ``action`` is ``"scale-out"`` (promote a spare into a new DP serving
+    replica) or ``"scale-in"`` (quarantine-shrink one replica away);
+    ``reason`` names the triggering signal."""
+
+    action: str
+    reason: str
+    depth: float
+    slo_burn: float
+
+
+class ServeScalePolicy:
+    """Queue-depth / SLO-burn autoscaler for ``hvd.serve()`` — the same
+    pure-policy discipline as :class:`StragglerPolicy`: no clock, no
+    threads; the engine feeds one :meth:`observe` beat per autoscale
+    tick (queue depth, SLO violations and completions since the last
+    beat) and :meth:`decide` returns at most one verdict per call.
+
+    Triggers over the sliding ``window`` of beats:
+
+    - **scale-out** when mean queue depth >= ``scale_out_depth`` OR the
+      SLO burn fraction (violations / completions) >= ``slo_burn`` —
+      the serving analogue of spare promotion.
+    - **scale-in** when mean depth <= ``scale_in_depth`` AND burn is
+      under half the threshold — the quarantine-shrink verb.
+
+    Vetoes are the policy's own: never below ``min_replicas``, never
+    above ``max_replicas``, and a ``cooldown`` of beats after any
+    decision so one burst cannot thrash the fleet both ways.
+    """
+
+    def __init__(self, scale_out_depth: float = 16.0,
+                 scale_in_depth: float = 1.0, slo_burn: float = 0.1,
+                 window: int = 8, cooldown: int = 4,
+                 min_replicas: int = 1, max_replicas: int = 8):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}..{max_replicas}"
+            )
+        self.scale_out_depth = float(scale_out_depth)
+        self.scale_in_depth = float(scale_in_depth)
+        self.slo_burn = float(slo_burn)
+        self.window = int(window)
+        self.cooldown = max(int(cooldown), 0)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        # (queue_depth, slo_violations, completions) per beat.
+        self._beats: "deque[Tuple[float, int, int]]" = deque(
+            maxlen=self.window
+        )
+        self._beat = 0
+        self._last_decision_beat: Optional[int] = None
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None,
+                 *, min_replicas: int = 1,
+                 max_replicas: int = 8) -> "ServeScalePolicy":
+        from ..common import env as _env
+
+        e = env if env is not None else os.environ
+        return ServeScalePolicy(
+            scale_out_depth=_env_float(
+                e, _env.HOROVOD_SERVE_SCALE_OUT_DEPTH, 16.0
+            ),
+            scale_in_depth=_env_float(
+                e, _env.HOROVOD_SERVE_SCALE_IN_DEPTH, 1.0
+            ),
+            slo_burn=_env_float(e, _env.HOROVOD_SERVE_SLO_BURN, 0.1),
+            window=_env_int(e, _env.HOROVOD_SERVE_SCALE_WINDOW, 8),
+            cooldown=_env_int(e, _env.HOROVOD_SERVE_SCALE_COOLDOWN, 4),
+            min_replicas=min_replicas,
+            max_replicas=max_replicas,
+        )
+
+    def observe(self, queue_depth: float, slo_violations: int,
+                completions: int) -> None:
+        """One autoscale beat: instantaneous queue depth plus the SLO
+        violations and completed requests SINCE the previous beat."""
+        self._beats.append(
+            (float(queue_depth), int(slo_violations), int(completions))
+        )
+        self._beat += 1
+
+    def burn(self) -> float:
+        """SLO-violation fraction over the window (0 with no traffic —
+        an idle fleet is not burning its SLO)."""
+        viol = sum(v for _, v, _ in self._beats)
+        done = sum(c for _, _, c in self._beats)
+        return (viol / done) if done else 0.0
+
+    def mean_depth(self) -> float:
+        if not self._beats:
+            return 0.0
+        return sum(d for d, _, _ in self._beats) / len(self._beats)
+
+    def decide(self, replicas: int) -> Optional[ScaleDecision]:
+        """At most one verdict per call, None inside the cooldown or
+        before the window has filled (no decisions on a cold start)."""
+        if len(self._beats) < self.window:
+            return None
+        if (self._last_decision_beat is not None
+                and self._beat - self._last_decision_beat <= self.cooldown):
+            return None
+        depth = self.mean_depth()
+        burn = self.burn()
+        if ((depth >= self.scale_out_depth or burn >= self.slo_burn)
+                and replicas < self.max_replicas):
+            self._last_decision_beat = self._beat
+            reason = ("queue-depth" if depth >= self.scale_out_depth
+                      else "slo-burn")
+            return ScaleDecision("scale-out", reason, depth, burn)
+        if (depth <= self.scale_in_depth and burn < self.slo_burn / 2
+                and replicas > self.min_replicas):
+            self._last_decision_beat = self._beat
+            return ScaleDecision("scale-in", "idle", depth, burn)
+        return None
+
+
 # ------------------------------------------------------------- re-plan
 def divergence_ratios(default_model, calibrated_model) -> Dict[str, float]:
     """Per-hop drift between the generation-default alpha-beta entries

@@ -1,0 +1,169 @@
+"""``hvd.serve()`` — distributed inference serving (docs/serving.md).
+
+The serving stack reuses the training fast path's machinery end to end:
+
+- **Placement**: the model is TP-sharded by the SAME regex→PartitionSpec
+  rule tables that place it for training (``parallel/rules.py``), and
+  the paged KV cache by ``GPT_CACHE_RULES`` — both preflighted by the
+  Pass 5 validator before the decode step is built.
+- **Compute**: ``hvd.jax.make_decode_step`` compiles ONE batched
+  one-token decode (``models/transformer.tp_decode_apply`` — the same
+  one-psum-per-half-block Megatron structure as ``tp_apply``).
+- **Scheduling**: a pure continuous batcher (:mod:`.batcher`) feeds DP
+  replica loops (:mod:`.engine`); KV pages come from :mod:`.kvcache`.
+- **Observability**: every request lands in the
+  ``hvd_request_latency_seconds`` SLO histogram, the ``hvd_serve_*``
+  gauges/counters (docs/metrics.md "Serving"), and an ``hvd_request``
+  trace span (``tools/trace_merge.py``).
+- **Chaos**: the ``request``/``replica`` fault sites (``fault/plan.py``)
+  drop/delay requests and kill replicas mid-batch; the engine's ledger
+  keeps every answer exactly-once.
+- **Control**: ``run/selfdrive.ServeScalePolicy`` scales DP replicas
+  out/in on queue depth and SLO burn (the spare-promotion /
+  quarantine-shrink verbs applied to serving).
+
+Entry points: :func:`serve` below (in-process), ``hvdrun --serve``
+(launcher), ``python -m horovod_tpu.serve`` (standalone HTTP demo).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .batcher import BatchDecision, ContinuousBatcher
+from .engine import Completion, Request, ServeEngine
+from .frontend import ServeFrontend
+from .kvcache import (
+    PagePool,
+    PagePoolExhausted,
+    decode_state_specs,
+    make_decode_state,
+    preflight_decode_state,
+)
+
+__all__ = [
+    "BatchDecision",
+    "Completion",
+    "ContinuousBatcher",
+    "PagePool",
+    "PagePoolExhausted",
+    "Request",
+    "ServeEngine",
+    "ServeFrontend",
+    "ServeHandle",
+    "decode_state_specs",
+    "make_decode_state",
+    "preflight_decode_state",
+    "serve",
+]
+
+
+class ServeHandle:
+    """What :func:`serve` returns: the engine plus (optionally) its HTTP
+    frontend, with delegating conveniences so
+    ``handle.submit(...); handle.result(...)`` reads naturally."""
+
+    def __init__(self, engine: ServeEngine,
+                 frontend: Optional[ServeFrontend] = None):
+        self.engine = engine
+        self.frontend = frontend
+
+    @property
+    def port(self) -> Optional[int]:
+        return None if self.frontend is None else self.frontend.port
+
+    def submit(self, prompt, max_tokens: int = 16,
+               request_id: Optional[str] = None) -> str:
+        return self.engine.submit(
+            prompt, max_tokens=max_tokens, request_id=request_id
+        )
+
+    def result(self, request_id: str,
+               timeout: Optional[float] = None) -> Completion:
+        return self.engine.result(request_id, timeout=timeout)
+
+    def drain(self, timeout: float = 60.0) -> None:
+        self.engine.drain(timeout=timeout)
+
+    def request_log(self):
+        return self.engine.request_log()
+
+    def stop(self) -> None:
+        if self.frontend is not None:
+            self.frontend.stop()
+        self.engine.stop()
+
+    def __enter__(self) -> "ServeHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def serve(
+    params: Any,
+    *,
+    n_heads: int,
+    mesh: Any = None,
+    rules: Any = None,
+    cache_rules: Any = None,
+    config: Any = None,
+    dtype: Any = None,
+    scale_policy: Any = None,
+    http: bool = False,
+    request_timeout_s: float = 120.0,
+) -> ServeHandle:
+    """Stand up a serving engine over a :class:`TransformerLM` param
+    tree. Model geometry (layer count, head dim, context length) is read
+    off the live tree; every serving knob comes from the
+    ``HOROVOD_SERVE_*`` environment via ``Config.from_env()`` (or an
+    explicit ``config``). With ``mesh`` + ``rules`` the decode step runs
+    TP-sharded (Pass 5 preflighted); ``http=True`` also binds the
+    :class:`ServeFrontend` on ``config.serve_port`` (0 = pick a free
+    port)."""
+    import jax.numpy as jnp
+
+    from ..common.env import Config
+    from ..jax import make_decode_step
+    from ..models.transformer import transformer_n_layers
+
+    cfg = config if config is not None else Config.from_env()
+    dtype = jnp.float32 if dtype is None else dtype
+    emb = params["embeddings"]["embedding"]
+    pos = params["pos_embeddings"]["embedding"]
+    d_model = int(emb.shape[-1])
+    if d_model % int(n_heads):
+        raise ValueError(
+            f"d_model {d_model} not divisible by n_heads {n_heads}"
+        )
+    head_dim = d_model // int(n_heads)
+    max_context = min(
+        int(pos.shape[0]),
+        (int(cfg.serve_kv_pages) - 1) * int(cfg.serve_page_size),
+    )
+    step = make_decode_step(
+        n_heads=int(n_heads), mesh=mesh, rules=rules,
+        cache_rules=cache_rules, dtype=dtype,
+    )
+    engine = ServeEngine(
+        params, step,
+        n_layers=transformer_n_layers(params),
+        n_heads=int(n_heads), head_dim=head_dim,
+        num_pages=cfg.serve_kv_pages, page_size=cfg.serve_page_size,
+        max_batch_size=cfg.serve_max_batch,
+        max_wait_us=cfg.serve_max_wait_us,
+        queue_bound=cfg.serve_queue_bound,
+        max_context=max_context,
+        replicas=cfg.serve_replicas,
+        slo_ms=cfg.serve_slo_ms,
+        scale_policy=scale_policy,
+        cache_dtype=dtype,
+    ).start()
+    frontend = None
+    if http:
+        frontend = ServeFrontend(
+            engine, port=cfg.serve_port,
+            request_timeout_s=request_timeout_s,
+        )
+        frontend.start()
+    return ServeHandle(engine, frontend)

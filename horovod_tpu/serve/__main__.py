@@ -1,0 +1,62 @@
+"""``python -m horovod_tpu.serve`` — the default ``hvdrun --serve``
+command: initialize a (demo-sized) TransformerLM, stand up the engine +
+HTTP frontend on ``HOROVOD_SERVE_PORT``, and serve until interrupted.
+
+Demo geometry is env-tunable (``HVD_SERVE_DEMO_*``) so the same entry
+point drives both the chaos smoke and a by-hand curl session; real
+deployments call :func:`horovod_tpu.serve.serve` with their own params
+and rule tables.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerLM
+    from ..run.selfdrive import ServeScalePolicy
+    from . import serve
+
+    vocab = int(os.environ.get("HVD_SERVE_DEMO_VOCAB", "128"))
+    d_model = int(os.environ.get("HVD_SERVE_DEMO_D_MODEL", "64"))
+    n_heads = int(os.environ.get("HVD_SERVE_DEMO_HEADS", "4"))
+    n_layers = int(os.environ.get("HVD_SERVE_DEMO_LAYERS", "2"))
+    max_len = int(os.environ.get("HVD_SERVE_DEMO_MAX_LEN", "128"))
+    seed = int(os.environ.get("HVD_SERVE_DEMO_SEED", "0"))
+
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_heads=n_heads, n_layers=n_layers,
+                          max_len=max_len)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, max_len), jnp.int32)
+    )["params"]
+    handle = serve(
+        params, n_heads=n_heads, http=True,
+        scale_policy=ServeScalePolicy.from_env(),
+    )
+    print(
+        f"hvd.serve: listening on :{handle.port} "
+        f"(replicas={handle.engine.live_replicas()}, "
+        f"vocab={vocab}, d_model={d_model}, heads={n_heads}, "
+        f"layers={n_layers})",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(1.0)
+            handle.engine.autoscale_beat()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

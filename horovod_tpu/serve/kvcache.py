@@ -1,0 +1,150 @@
+"""Paged decode-state (KV-cache) for ``hvd.serve()``.
+
+Two halves, cleanly split:
+
+- :class:`PagePool` — the pure allocator. Fixed-size pages are granted
+  and returned per request slot; exhaustion REFUSES loudly
+  (:class:`PagePoolExhausted`) instead of over-committing, and the
+  refusal is all-or-nothing so a half-admitted request can never leak
+  pages. Page 0 is reserved as the scratch page padded (inactive) batch
+  slots write into, so padding can never corrupt a live request's cache.
+
+- :func:`make_decode_state` — the decode-state pytree: per layer,
+  ``block_i/attention/cache_k`` / ``cache_v`` buffers of shape
+  ``[num_pages, page_size, n_heads, head_dim]``. The names are chosen so
+  the SAME regex→PartitionSpec machinery that places the params places
+  the cache (``parallel/rules.GPT_CACHE_RULES`` shards the head dim over
+  the "model" axis), and :func:`preflight_decode_state` runs the Pass 5
+  validator over (cache rules, mesh, cache tree) before the decode step
+  is ever built — a typo'd axis or a non-divisible head count fails at
+  build time with a named finding, the composed-path discipline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class PagePoolExhausted(RuntimeError):
+    """KV-cache page allocation refused: the pool cannot cover the
+    request. The engine keeps the request QUEUED (admission pressure is
+    back-pressure, not data loss) and the batcher caps batch size to
+    what the pool can hold."""
+
+
+class PagePool:
+    """Fixed-size KV-cache page allocator (pure python, no jax)."""
+
+    #: index of the scratch page padded batch slots write into; never
+    #: granted to a request.
+    SCRATCH_PAGE = 0
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved scratch "
+                f"page), got {num_pages}"
+            )
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list, deterministic: page ids descend so the first
+        # alloc after construction is [1, 2, ...].
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._owner: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------ sizes
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` cache positions."""
+        return max(1, -(-int(tokens) // self.page_size))
+
+    def can_admit(self, tokens: int) -> bool:
+        return self.pages_for(tokens) <= self.pages_free
+
+    # ------------------------------------------------------------ alloc
+    def alloc(self, tokens: int, owner: Any = None) -> List[int]:
+        """Grant the pages for a ``tokens``-position slot, all or
+        nothing. Raises :class:`PagePoolExhausted` (pool unchanged) when
+        the request cannot be covered."""
+        n = self.pages_for(tokens)
+        if n > len(self._free):
+            raise PagePoolExhausted(
+                f"KV-cache pool exhausted: request needs {n} pages "
+                f"({tokens} tokens x page_size {self.page_size}) but only "
+                f"{len(self._free)}/{self.num_pages - 1} are free"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Return a slot's pages. Double-free and foreign pages raise —
+        a silent accounting error here becomes silent cross-request
+        cache corruption."""
+        for p in pages:
+            if p not in self._owner:
+                raise ValueError(
+                    f"page {p} is not allocated (double free or foreign "
+                    f"page)"
+                )
+        for p in pages:
+            del self._owner[p]
+            self._free.append(p)
+
+
+# ---------------------------------------------------------- decode state
+def make_decode_state(
+    n_layers: int,
+    *,
+    num_pages: int,
+    page_size: int,
+    n_heads: int,
+    head_dim: int,
+    dtype: Any = None,
+) -> Dict[str, Any]:
+    """The paged decode-state pytree: per layer, zeroed
+    ``cache_k``/``cache_v`` of shape [num_pages, page_size, n_heads,
+    head_dim]. Leaf NAMES mirror the param tree's ``block_i/attention/``
+    namespace so the rules engine places them by regex."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    shape = (int(num_pages), int(page_size), int(n_heads), int(head_dim))
+    return {
+        f"block_{i}": {
+            "attention": {
+                "cache_k": jnp.zeros(shape, dtype),
+                "cache_v": jnp.zeros(shape, dtype),
+            }
+        }
+        for i in range(int(n_layers))
+    }
+
+
+def decode_state_specs(cache_rules: Any, cache: Any) -> Any:
+    """PartitionSpec tree for a decode state from a cache-rule table
+    (first-match-wins, the param discipline)."""
+    from ..parallel.rules import match_partition_rules
+
+    return match_partition_rules(cache_rules, cache)
+
+
+def preflight_decode_state(cache_rules: Any, mesh: Any, cache: Any,
+                           *, suppress: Optional[Sequence[str]] = None
+                           ) -> None:
+    """Pass 5 over (cache rules, mesh, concrete cache tree) — ALWAYS
+    enforced before a sharded decode step is built, exactly like the
+    param table's preflight in the composed train path."""
+    from ..parallel.rules import preflight_rules
+
+    preflight_rules(cache_rules, mesh, cache, suppress=suppress)

@@ -61,6 +61,7 @@ from .calibrate import (  # noqa: F401
     save_calibration,
 )
 from .core import (  # noqa: F401
+    ServeSimConfig,
     SimConfig,
     SimGroup,
     SimProgram,
@@ -68,6 +69,7 @@ from .core import (  # noqa: F401
     program_from_layers,
     program_from_spec,
     simulate,
+    simulate_serve,
     straggler_sensitivity,
     tp_fixed_comm_us,
 )

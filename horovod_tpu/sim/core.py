@@ -686,3 +686,236 @@ def straggler_sensitivity(
                        fault_plan=probe)
     d = (delayed.mean_step_us - base.mean_step_us) / probe_delay_us
     return round(max(d, 0.0), 6)
+
+
+# ------------------------------------------------------- serving twin
+#
+# The serving half of the fleet simulator (docs/serving.md "Capacity
+# planning"): an open-loop Poisson arrival stream played through the
+# EXACT shipping batching policy (serve/batcher.ContinuousBatcher under
+# a virtual clock) against an affine batch-service-time model, with the
+# request/replica chaos sites of the same seeded fault plans the live
+# engine honors. Like the training twin: simulated microseconds from 0,
+# no wall clock, every report float rounded — a fixed seed is
+# byte-reproducible, which is what lets "what does p99 do at 2x qps?"
+# be answered deterministically on a laptop.
+
+
+@dataclass(frozen=True)
+class ServeSimConfig:
+    """Knobs of one serving simulation. ``qps`` drives the open-loop
+    Poisson arrival process (inter-arrival ~ Exp(qps), independent of
+    completions — the arrival stream does not slow down when the fleet
+    falls behind, which is exactly what makes overload visible).
+    Service time of a dispatched batch is affine:
+    ``service_base_us + service_per_request_us * live_slots`` — the
+    fixed cost of one compiled decode dispatch plus the marginal cost
+    of each occupied slot."""
+
+    qps: float = 50.0
+    duration_s: float = 10.0
+    replicas: int = 2
+    max_batch_size: int = 8
+    max_wait_us: int = 2000
+    queue_bound: int = 1024
+    slo_ms: float = 100.0
+    service_base_us: float = 2000.0
+    service_per_request_us: float = 500.0
+    seed: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "qps": round(float(self.qps), 4),
+            "duration_s": round(float(self.duration_s), 4),
+            "replicas": int(self.replicas),
+            "max_batch_size": int(self.max_batch_size),
+            "max_wait_us": int(self.max_wait_us),
+            "queue_bound": int(self.queue_bound),
+            "slo_ms": round(float(self.slo_ms), 4),
+            "service_base_us": round(float(self.service_base_us), 4),
+            "service_per_request_us": round(
+                float(self.service_per_request_us), 4
+            ),
+            "seed": int(self.seed),
+        }
+
+
+_SERVE_FAULT_KINDS = ("drop", "delay", "kill_replica")
+
+
+def simulate_serve(
+    config: ServeSimConfig,
+    fault_plan: Optional[FaultPlan] = None,
+) -> dict:
+    """Simulate one serving run; returns the (rounded, sort-keyed
+    deterministic) report dict.
+
+    Mechanics mirror the live engine one-for-one: arrivals enter the
+    real :class:`~horovod_tpu.serve.batcher.ContinuousBatcher`; the
+    earliest-free replica dispatches whenever the policy says a batch
+    is ready (max-batch or head-deadline); ``request``-site faults
+    resolve at admission in arrival order (``drop`` → answered as
+    dropped, ``delay`` → the enqueue slides but the latency clock keeps
+    counting from arrival); a ``replica``-site ``kill_replica`` on the
+    K-th batch dispatch kills that replica and re-queues its batch at
+    the FRONT with original timestamps (the exactly-once re-queue). A
+    full queue refuses (outcome ``rejected``), never silently drops.
+    """
+    import random as _random
+
+    from ..serve.batcher import ContinuousBatcher
+
+    if fault_plan is not None:
+        skipped = sorted({
+            a.kind for a in fault_plan.actions
+            if a.site not in ("request", "replica")
+        })
+        if skipped:
+            logger.warning(
+                "serve sim: fault plan carries non-serving action "
+                "kind(s) %s — only request/replica-site faults shape "
+                "this prediction", skipped,
+            )
+
+    # ---- open-loop Poisson arrivals (its own seeded stream).
+    rng = _random.Random(config.seed)
+    horizon_us = float(config.duration_s) * 1e6
+    arrivals: List[Tuple[str, float]] = []
+    t = 0.0
+    i = 0
+    while True:
+        t += rng.expovariate(max(float(config.qps), 1e-9)) * 1e6
+        if t >= horizon_us:
+            break
+        arrivals.append((f"req{i}", t))
+        i += 1
+
+    # ---- request-site faults resolve at admission, in arrival order
+    # (the site's 1-based hit counter IS the arrival index).
+    request_actions = [] if fault_plan is None else [
+        a for a in fault_plan.actions if a.site == "request"
+    ]
+    replica_actions = [] if fault_plan is None else [
+        a for a in fault_plan.actions if a.site == "replica"
+    ]
+    arrive_t: Dict[str, float] = {}
+    enqueue_t: Dict[str, float] = {}
+    outcomes: Dict[str, str] = {}
+    admitted: List[Tuple[str, float]] = []
+    for hit, (rid, t_arr) in enumerate(arrivals, start=1):
+        arrive_t[rid] = t_arr
+        t_enq = t_arr
+        dropped = False
+        for a in request_actions:
+            if a.in_window(hit) and fault_plan.decide(a, None):
+                if a.kind == "drop":
+                    dropped = True
+                else:  # delay: queueing latency before batching
+                    t_enq += float(a.seconds) * 1e6
+        if dropped:
+            outcomes[rid] = "dropped"
+        else:
+            admitted.append((rid, t_enq))
+            enqueue_t[rid] = t_enq
+    # Delays can reorder the enqueue stream; admission is by ENQUEUE time.
+    admitted.sort(key=lambda p: (p[1], p[0]))
+
+    # ---- discrete-event loop: earliest-free live replica dispatches.
+    batcher = ContinuousBatcher(
+        max_batch_size=config.max_batch_size,
+        max_wait_us=config.max_wait_us,
+        queue_bound=config.queue_bound,
+    )
+    replica_free = [0.0] * max(int(config.replicas), 1)
+    killed: set = set()
+    finish_t: Dict[str, float] = {}
+    batches = 0
+    occupancy = 0
+    requeued = 0
+    dispatch_hits = 0
+    idx = 0
+    inf = float("inf")
+    while True:
+        live = [r for r in range(len(replica_free)) if r not in killed]
+        if not live:
+            break
+        r = min(live, key=lambda j: (replica_free[j], j))
+        t_r = replica_free[r]
+        while idx < len(admitted) and admitted[idx][1] <= t_r:
+            rid, t_enq = admitted[idx]
+            idx += 1
+            if not batcher.offer(rid, int(t_enq)):
+                outcomes[rid] = "rejected"
+        decision = batcher.poll(int(t_r))
+        if not decision.ready:
+            cand = []
+            dl = batcher.next_deadline_us()
+            if dl is not None:
+                cand.append(float(dl))
+            if idx < len(admitted):
+                cand.append(admitted[idx][1])
+            if not cand:
+                break  # drained: no queue, no future arrivals
+            replica_free[r] = max(t_r, min(cand))
+            continue
+        dispatch_hits += 1
+        kill = any(
+            a.in_window(dispatch_hits) and fault_plan.decide(a, None)
+            for a in replica_actions
+        )
+        if kill:
+            for rid in reversed(decision.request_ids):
+                batcher.requeue(rid, int(enqueue_t[rid]))
+            requeued += len(decision.request_ids)
+            killed.add(r)
+            continue
+        n_live = len(decision.request_ids)
+        service = (float(config.service_base_us)
+                   + float(config.service_per_request_us) * n_live)
+        done = t_r + service
+        replica_free[r] = done
+        batches += 1
+        occupancy += n_live
+        for rid in decision.request_ids:
+            finish_t[rid] = done
+            outcomes[rid] = "ok"
+
+    # ---- report (rounded, canonical).
+    lat_ms = sorted(
+        (finish_t[rid] - arrive_t[rid]) / 1e3 for rid in finish_t
+    )
+
+    def pct(p: float) -> float:
+        if not lat_ms:
+            return 0.0
+        return lat_ms[min(int(p * (len(lat_ms) - 1)), len(lat_ms) - 1)]
+
+    served = sum(1 for o in outcomes.values() if o == "ok")
+    slo_viol = sum(1 for v in lat_ms if v > float(config.slo_ms))
+    unanswered = len(arrivals) - len(outcomes)
+    return {
+        "schema": SIM_SCHEMA,
+        "config": config.to_dict(),
+        "arrivals": len(arrivals),
+        "served": served,
+        "dropped": sum(1 for o in outcomes.values() if o == "dropped"),
+        "rejected": sum(1 for o in outcomes.values() if o == "rejected"),
+        "requeued": int(requeued),
+        "replicas_killed": len(killed),
+        "unanswered": int(unanswered),
+        "batches": int(batches),
+        "mean_batch_occupancy": round(occupancy / batches, 4) if batches
+        else 0.0,
+        "latency_ms": {
+            "p50": round(pct(0.50), 4),
+            "p90": round(pct(0.90), 4),
+            "p99": round(pct(0.99), 4),
+            "mean": round(sum(lat_ms) / len(lat_ms), 4) if lat_ms else 0.0,
+            "max": round(lat_ms[-1], 4) if lat_ms else 0.0,
+        },
+        "slo_violation_frac": round(slo_viol / served, 4) if served
+        else 0.0,
+        "throughput_rps": round(
+            served / float(config.duration_s), 4
+        ) if config.duration_s else 0.0,
+    }

@@ -168,6 +168,22 @@ class TraceTap:
             self.event(name, ph="X", cat=cat, ts=t0,
                        dur=time.time() - t0, **args)
 
+    @contextmanager
+    def request(self, request_id: Any, **args):
+        """One serving-request span (docs/serving.md): an ``hvd_request``
+        "X" event on cat ``request`` covering admission → completion,
+        stamped with the request id — the serving analogue of the step
+        span, renderable by ``tools/trace_merge.py`` on the same lane
+        machinery."""
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.event(
+                "hvd_request", ph="X", cat="request", ts=t0,
+                dur=time.time() - t0, request_id=str(request_id), **args,
+            )
+
     def timeline_event(self, ev: dict) -> None:
         """Mirror one catapult-timeline record into the ring (wall-clock
         restamped — the timeline's own clock is perf_counter-relative).
@@ -368,6 +384,10 @@ class _NullTraceTap:
 
     @contextmanager
     def span(self, *a, **kw):
+        yield
+
+    @contextmanager
+    def request(self, *a, **kw):
         yield
 
     def timeline_event(self, ev: dict) -> None:

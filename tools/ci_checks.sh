@@ -113,6 +113,16 @@
 # metered exactly, normalized event log byte-identical across two runs
 # (docs/fault_tolerance.md "Elastic resharding"). Budget: under 25s.
 #
+# Stage 16 (make serve-smoke; skip with HVD_CI_SKIP_SERVE=1): the
+# serving chaos smoke — a 2-replica CPU serving job (TP-sharded across
+# 2 virtual devices) under a seeded mid-batch kill_replica + request
+# drop: every submitted request answered exactly once (the dead
+# replica's in-flight batch re-queued to the survivor), normalized
+# request logs byte-identical across two seeded runs,
+# hvd_request_latency_seconds + queue-depth metered, request spans
+# rendered through tools/trace_merge.py (docs/serving.md).
+# Budget: under 30s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -226,4 +236,11 @@ if [ "${HVD_CI_SKIP_RESHARD:-0}" != "1" ]; then
     python tools/reshard_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: reshard smoke shrunk+grown+parity+byte-stable in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_SERVE:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/serve_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: serve smoke exactly-once+metered+traced+byte-stable in ${elapsed}s"
 fi

@@ -177,6 +177,82 @@ def _resize_block(args, calib):
     )
 
 
+def run_serve(args) -> int:
+    """``--serve``: open-loop Poisson serving simulation (docs/serving.md
+    "Capacity planning") — one deterministic report per ``--qps`` value,
+    so "what does p99 do at 2x qps?" is answered by one sweep."""
+    from horovod_tpu.fault.plan import FaultPlan
+    from horovod_tpu.sim import ServeSimConfig, simulate_serve
+
+    fault_plan = None
+    if args.fault_plan:
+        raw = args.fault_plan
+        if not raw.lstrip().startswith("{"):
+            with open(raw) as f:
+                raw = f.read()
+        fault_plan = FaultPlan.from_json(raw)
+    try:
+        qps_values = [float(q) for q in str(args.qps).split(",") if q]
+    except ValueError:
+        raise SystemExit(
+            f"fleet_sim: --qps wants a comma-separated list of rates, "
+            f"got {args.qps!r}"
+        )
+    if not qps_values:
+        raise SystemExit("fleet_sim: --serve needs --qps")
+    sweep = []
+    for qps in qps_values:
+        cfg = ServeSimConfig(
+            qps=qps,
+            duration_s=args.serve_duration,
+            replicas=args.serve_replicas,
+            max_batch_size=args.serve_max_batch,
+            max_wait_us=args.serve_max_wait_us,
+            queue_bound=args.serve_queue_bound,
+            slo_ms=args.serve_slo_ms,
+            service_base_us=args.serve_base_us,
+            service_per_request_us=args.serve_per_request_us,
+            seed=args.seed,
+        )
+        sweep.append(simulate_serve(cfg, fault_plan=fault_plan))
+    report = {
+        "schema_version": REPORT_SCHEMA,
+        "kind": "fleet_sim_serve_report",
+        "seed": int(args.seed),
+        "fault_plan": (
+            json.loads(fault_plan.canonical_schedule())
+            if fault_plan else None
+        ),
+        "sweep": sweep,
+    }
+    payload = json.dumps(report, sort_keys=True, indent=1) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(payload)
+    print(payload if not args.out else json.dumps({
+        "out": args.out,
+        "qps": qps_values,
+        "p99_ms": {
+            str(r["config"]["qps"]): r["latency_ms"]["p99"] for r in sweep
+        },
+    }, sort_keys=True), flush=True)
+    # Human-readable sweep line on stderr: the p99-vs-qps answer.
+    for r in sweep:
+        print(
+            "fleet_sim serve: qps={qps:g} served={served} "
+            "rejected={rejected} p50={p50}ms p99={p99}ms "
+            "occupancy={occ} slo_burn={burn}".format(
+                qps=r["config"]["qps"], served=r["served"],
+                rejected=r["rejected"], p50=r["latency_ms"]["p50"],
+                p99=r["latency_ms"]["p99"],
+                occ=r["mean_batch_occupancy"],
+                burn=r["slo_violation_frac"],
+            ),
+            file=sys.stderr,
+        )
+    return 0
+
+
 def run_predict(args) -> int:
     from horovod_tpu.fault.plan import FaultPlan
     from horovod_tpu.sim import (
@@ -551,6 +627,25 @@ def main(argv=None) -> int:
     ap.add_argument("--calibrate", default=None, metavar="TRACE",
                     help="fit calibration.json from an observed run "
                          "(trace dir or --stats JSON)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving mode (docs/serving.md): open-loop "
+                         "Poisson arrivals through the shipping "
+                         "continuous-batching policy; sweep --qps")
+    ap.add_argument("--qps", default=None,
+                    help="serving arrival rate(s), comma-separated "
+                         "(e.g. '50,100,200' answers p99-vs-qps in one "
+                         "sweep)")
+    ap.add_argument("--serve-duration", type=float, default=10.0,
+                    help="simulated seconds of arrivals per qps point")
+    ap.add_argument("--serve-replicas", type=int, default=2)
+    ap.add_argument("--serve-max-batch", type=int, default=8)
+    ap.add_argument("--serve-max-wait-us", type=int, default=2000)
+    ap.add_argument("--serve-queue-bound", type=int, default=1024)
+    ap.add_argument("--serve-slo-ms", type=float, default=100.0)
+    ap.add_argument("--serve-base-us", type=float, default=2000.0,
+                    help="fixed service cost of one batch dispatch")
+    ap.add_argument("--serve-per-request-us", type=float, default=500.0,
+                    help="marginal service cost per occupied batch slot")
     ap.add_argument("--trace-out", default=None,
                     help="render the first --ranks count's simulated "
                          "fleet as trace windows + a merged Perfetto "
@@ -567,6 +662,12 @@ def main(argv=None) -> int:
     # autotune_compiled.py discipline).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+    if args.serve:
+        if not args.qps:
+            ap.error("--serve needs --qps (comma-separated rates)")
+        return run_serve(args)
+    if args.qps:
+        ap.error("--qps only applies to --serve mode")
     if args.program == "layers" and not args.layer_bytes:
         ap.error("--program layers needs --layer-bytes")
     if args.calibrate:

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Serving CI smoke (docs/serving.md).
+
+Five gates over a 2-replica CPU serving job (TP-sharded across 2
+virtual devices when the host allows, dense otherwise):
+
+1. EXACTLY-ONCE UNDER CHAOS — a seeded ``kill_replica`` fires mid-batch
+   and a seeded request ``drop`` rejects one request; every submitted
+   request is answered exactly once (the killed replica's in-flight
+   batch is re-queued, ``engine.requeues >= 1``), and the dropped
+   request surfaces as outcome ``dropped`` — never silently lost.
+2. DETERMINISM — two runs from the same seed produce byte-identical
+   normalized request logs (sorted-JSON of ``engine.request_log()``),
+   the serving twin of the chaos-smoke decision-stream diff.
+3. SLO OBSERVABILITY — ``hvd_request_latency_seconds`` observed a
+   nonzero count and the queue-depth gauge exists in
+   ``metrics.flat()`` (docs/metrics.md "Serving").
+4. TRACE SPANS — the request spans land in the trace ring; the window
+   written as ``rank.0.json`` renders through ``tools/trace_merge.py``
+   (exit 0) and the merged trace contains ``hvd_request`` events.
+5. SCALE HOOK — after the kill, ``live_replicas() == 1`` (the engine's
+   replica accounting is what selfdrive's ServeScalePolicy acts on).
+
+Exit 0 = all assertions hold. Wired as the next tools/ci_checks.sh
+stage (skip: HVD_CI_SKIP_SERVE=1) and ``make serve-smoke``.
+Budget: ~20s CPU (two seeded end-to-end runs + compile).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Must land before jax imports: CPU backend with 2 virtual devices so
+# the smoke exercises the TP-sharded decode path on any host.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+VOCAB, D_MODEL, HEADS, LAYERS, MAX_LEN = 32, 16, 2, 1, 32
+N_REQUESTS = 12
+MAX_TOKENS = 4
+
+
+def build_params():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=VOCAB, d_model=D_MODEL,
+                          n_heads=HEADS, n_layers=LAYERS,
+                          max_len=MAX_LEN)
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, MAX_LEN), jnp.int32)
+    )["params"]
+
+
+def make_prompts(seed):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return [
+        [int(t) for t in rng.randint(0, VOCAB, size=rng.randint(1, 6))]
+        for _ in range(N_REQUESTS)
+    ]
+
+
+def run_once(params, seed):
+    """One seeded 2-replica serving run under the chaos plan.
+
+    Returns (normalized_log_json, engine_stats).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.fault import injector as inj
+    from horovod_tpu.fault.plan import FaultPlan
+    from horovod_tpu.jax import make_decode_step
+    from horovod_tpu.parallel.mesh import build_mesh
+    from horovod_tpu.serve import ServeEngine
+
+    tp = len(jax.devices()) >= 2
+    mesh = build_mesh({"model": 2}) if tp else None
+    step = make_decode_step(
+        n_heads=HEADS,
+        mesh=mesh,
+        rules="gpt" if tp else None,
+        dtype=jnp.float32,
+    )
+
+    plan = FaultPlan.from_json(json.dumps({
+        "seed": seed,
+        "faults": [
+            # 2nd batch dispatch anywhere in the fleet dies mid-batch.
+            {"kind": "kill_replica", "at_step": 2},
+            # 3rd submitted request is dropped at admission.
+            {"kind": "drop", "site": "request", "at_step": 3},
+        ],
+    }))
+
+    engine = ServeEngine(
+        params, step,
+        n_layers=LAYERS, n_heads=HEADS, head_dim=D_MODEL // HEADS,
+        num_pages=64, page_size=4, max_batch_size=4, max_wait_us=500,
+        max_context=MAX_LEN, replicas=2, slo_ms=250.0,
+        cache_dtype=jnp.float32,
+    )
+    inj.install_plan(plan)
+    try:
+        with engine:
+            for prompt in make_prompts(seed):
+                engine.submit(prompt, max_tokens=MAX_TOKENS)
+                time.sleep(0.002)  # stagger: multiple batch dispatches
+            engine.drain(timeout=120.0)
+            live_after = engine.live_replicas()
+        log = engine.request_log()
+    finally:
+        inj.install_plan(None)
+
+    stats = {
+        "requeues": engine.requeues,
+        "live_after": live_after,
+        "answered": len(log),
+        "tp": tp,
+    }
+    return json.dumps(log, sort_keys=True), stats
+
+
+def check_metrics():
+    from horovod_tpu import metrics
+
+    flat = metrics.flat()
+    lat = [
+        v for k, v in flat.items()
+        if k.startswith("hvd_request_latency_seconds") and
+        k.endswith("_count")
+    ]
+    assert lat and sum(lat) > 0, (
+        f"no hvd_request_latency_seconds observations: {sorted(flat)}"
+    )
+    assert any(
+        k.startswith("hvd_serve_queue_depth") for k in flat
+    ), f"hvd_serve_queue_depth gauge missing: {sorted(flat)}"
+    assert any(
+        k.startswith("hvd_serve_requeues_total") for k in flat
+    ), "hvd_serve_requeues_total missing"
+    print("serve_smoke: metrics gate ok "
+          f"(latency count={int(sum(lat))})")
+
+
+def check_trace(tmpdir):
+    from horovod_tpu import trace as hvd_trace
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import trace_merge as trace_merge_cli
+
+    window = hvd_trace.TAP.window()
+    names = [e.get("name") for e in window["events"]]
+    assert "hvd_request" in names, (
+        f"no hvd_request spans in trace window: {sorted(set(names))}"
+    )
+    with open(os.path.join(tmpdir, "rank.0.json"), "w") as f:
+        json.dump(window, f)
+    rc = trace_merge_cli.main([tmpdir])
+    assert rc == 0, f"trace_merge exited {rc}"
+    merged = os.path.join(tmpdir, "merged_trace.json")
+    with open(merged) as f:
+        doc = json.load(f)
+    spans = [e for e in doc["traceEvents"]
+             if e.get("name") == "hvd_request"]
+    assert spans, "merged trace has no hvd_request events"
+    print(f"serve_smoke: trace gate ok ({len(spans)} request spans "
+          f"rendered via trace_merge)")
+
+
+def main() -> int:
+    from horovod_tpu import metrics
+    from horovod_tpu import trace as hvd_trace
+
+    metrics.install(True)
+    hvd_trace.install(True)
+
+    params = build_params()
+
+    t0 = time.time()
+    log_a, stats_a = run_once(params, seed=7)
+    log_b, stats_b = run_once(params, seed=7)
+
+    # Gate 1: exactly-once under chaos.
+    for label, stats, log in (("a", stats_a, log_a),
+                              ("b", stats_b, log_b)):
+        parsed = json.loads(log)
+        assert stats["answered"] == N_REQUESTS, (
+            f"run {label}: {stats['answered']}/{N_REQUESTS} answered"
+        )
+        outcomes = [v["outcome"] for v in parsed.values()]
+        assert outcomes.count("dropped") == 1, (
+            f"run {label}: expected exactly 1 dropped, got {outcomes}"
+        )
+        assert outcomes.count("ok") == N_REQUESTS - 1, (
+            f"run {label}: outcomes {outcomes}"
+        )
+        assert stats["requeues"] >= 1, (
+            f"run {label}: kill_replica did not re-queue "
+            f"(requeues={stats['requeues']})"
+        )
+        # Gate 5: the kill actually shrank the fleet.
+        assert stats["live_after"] == 1, (
+            f"run {label}: live_after={stats['live_after']}"
+        )
+    print(f"serve_smoke: chaos gate ok (requeues={stats_a['requeues']}"
+          f"/{stats_b['requeues']}, 1 dropped, "
+          f"{N_REQUESTS - 1} ok, tp={stats_a['tp']})")
+
+    # Gate 2: seeded determinism, byte-identical normalized logs.
+    assert log_a == log_b, (
+        "seeded request logs differ:\n"
+        f"  a: {log_a}\n  b: {log_b}"
+    )
+    print("serve_smoke: determinism gate ok (byte-identical logs, "
+          f"{len(log_a)} bytes)")
+
+    # Gate 3: SLO observability.
+    check_metrics()
+
+    # Gate 4: trace spans render through trace_merge.
+    with tempfile.TemporaryDirectory() as tmpdir:
+        check_trace(tmpdir)
+
+    print(f"serve_smoke: all gates passed in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
