@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke serve-smoke ci
+.PHONY: test lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke serve-smoke tpfuse-smoke ci
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
@@ -16,7 +16,7 @@ test:
 # Pass 4 over the shipped train-step variants, Pass 5 over the reference
 # sharding-rule table.
 lint-collectives:
-	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 HVD_CI_SKIP_SELFDRIVE=1 HVD_CI_SKIP_LLM=1 HVD_CI_SKIP_RESHARD=1 HVD_CI_SKIP_SERVE=1 bash tools/ci_checks.sh
+	HVD_CI_SKIP_CHAOS=1 HVD_CI_SKIP_METRICS=1 HVD_CI_SKIP_OVERLAP=1 HVD_CI_SKIP_GUARD=1 HVD_CI_SKIP_DRIVER=1 HVD_CI_SKIP_TOPO=1 HVD_CI_SKIP_QUANT=1 HVD_CI_SKIP_TRACE=1 HVD_CI_SKIP_TUNE=1 HVD_CI_SKIP_ZERO=1 HVD_CI_SKIP_SIM=1 HVD_CI_SKIP_SELFDRIVE=1 HVD_CI_SKIP_LLM=1 HVD_CI_SKIP_RESHARD=1 HVD_CI_SKIP_SERVE=1 HVD_CI_SKIP_TPFUSE=1 bash tools/ci_checks.sh
 
 # Seeded fault-injection smoke (docs/fault_tolerance.md): worker kill +
 # slow rank + dropped control-plane burst, recovery asserted, <120s CPU.
@@ -132,4 +132,13 @@ reshard-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) tools/serve_smoke.py
 
-ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke serve-smoke test
+# Fused-TP collective-matmul smoke (docs/parallelism.md "Fused TP
+# overlap"): 2x2 fused step == classic to <=5e-7, fused forward HLO
+# free of model-axis all-reduces with exactly the predicted chunked
+# ring collective-permutes, the tuner's TP term pinning a fused chunk
+# count strictly below the exposed-psum constant on the transformer
+# program, normalized log byte-identical across two runs, <90s CPU.
+tpfuse-smoke:
+	JAX_PLATFORMS=cpu $(PY) tools/tpfuse_smoke.py
+
+ci: lint-collectives chaos-smoke metrics-smoke overlap-smoke guard-smoke driver-smoke topo-smoke quant-smoke trace-smoke tune-smoke zero-smoke sim-smoke selfdrive-smoke llm-smoke reshard-smoke serve-smoke tpfuse-smoke test

@@ -241,6 +241,16 @@ def _parse_args(argv=None):
              "bytes",
     )
     parser.add_argument(
+        "--tp-overlap", action="store_true",
+        help="with --tp N: fuse the TP psums into chunked "
+             "collective-matmul rings (docs/parallelism.md 'Fused TP "
+             "overlap') — the residual stream token-shards, each "
+             "in-block psum becomes all_gather_matmul + "
+             "matmul_reduce_scatter, and the sim prices only the "
+             "un-hideable remainder (chunk count rides "
+             "HOROVOD_TP_OVERLAP_CHUNKS)",
+    )
+    parser.add_argument(
         "--rules", default="", choices=["", "gpt"],
         help="sharding-rules table for --tp (default: gpt, the shipped "
              "models/transformer.py table)",
@@ -300,6 +310,12 @@ def _parse_args(argv=None):
         parser.error("--rules needs --tp N (the composed DP x TP mode)")
     if args.tp and args.tp < 2:
         parser.error("--tp needs a model-axis degree >= 2")
+    if args.tp_overlap and not args.tp:
+        parser.error(
+            "--tp-overlap fuses the TENSOR-PARALLEL psums into chunked "
+            "collective-matmul rings — without --tp N there is no "
+            "model axis and no TP psum to fuse; add --tp N (N >= 2)"
+        )
     if args.tp and not args.rules:
         args.rules = "gpt"
     return args
@@ -410,7 +426,8 @@ def _resolve_tuned(args, params, mesh):
 
 def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
                quantized_eff=False, tuned_kw=None, tp=0,
-               tp_psum_bytes=0, tp_psums=0, local_params=None):
+               tp_psum_bytes=0, tp_psums=0, tp_overlap=False,
+               local_params=None):
     """Fleet-simulator cross-check for the transformer report
     (docs/simulation.md): the digital twin's predicted step time for
     THIS program at THIS chip count next to the measured one, plus the
@@ -441,6 +458,7 @@ def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
             calib, where="bench",
         )
         fixed_comm_us = 0.0
+        tp_overlap_block = None
         if tp and tp > 1:
             # The composed TP psums as a fixed per-step ICI term
             # alongside the DP staircase (docs/parallelism.md).
@@ -448,6 +466,32 @@ def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
                 model, int(tp_psum_bytes), int(tp),
                 psums_per_step=int(tp_psums),
             )
+            if tp_overlap:
+                from horovod_tpu.ops.collective_matmul import (
+                    resolve_chunks,
+                )
+
+                chunks = resolve_chunks(
+                    max(int(args.batch_size) * int(args.seq_len)
+                        // int(tp), 1)
+                )
+                fused_us = hvdsim.tp_fixed_comm_us(
+                    model, int(tp_psum_bytes), int(tp),
+                    psums_per_step=int(tp_psums),
+                    overlap=True, chunks=chunks,
+                )
+                tp_overlap_block = {
+                    "chunks": int(chunks),
+                    "fixed_comm_us": round(float(fused_us), 4),
+                    "classic_fixed_comm_us": round(
+                        float(fixed_comm_us), 4
+                    ),
+                    # Priced with no adjacent-matmul hiding
+                    # (compute_us=0) — an upper bound; the fused rings
+                    # only improve as the matmul grows.
+                    "compute_hidden_us": 0.0,
+                }
+                fixed_comm_us = fused_us
         program = hvdsim.program_from_spec(
             spec, config, fixed_comm_us=fixed_comm_us
         )
@@ -473,6 +517,8 @@ def _sim_block(args, params, mesh, n_chips, measured_step_s, *,
             **({"tp": {
                 "degree": int(tp),
                 "fixed_comm_us": round(float(fixed_comm_us), 4),
+                **({"overlap": tp_overlap_block}
+                   if tp_overlap_block else {}),
             }} if tp and tp > 1 else {}),
         }
         if calibrated and measured_step_s > 0:
@@ -771,6 +817,7 @@ def run_lm_benchmark(args) -> int:
             composed_loss, tx, mesh, rules=args.rules,
             overlap=bool(args.overlap), quantized=quantized_eff,
             zero1=bool(args.zero1),
+            tp_overlap=(True if args.tp_overlap else None),
             fusion_threshold_bytes=czk["threshold_bytes"],
             first_bucket_bytes=czk["first_bucket_bytes"],
         )
@@ -984,6 +1031,11 @@ def run_lm_benchmark(args) -> int:
                 tp_psums * 2 * (tp - 1) / tp * psum_payload
             ),
             "wire_dtype": "bf16 (never quantized, never re-planned)",
+            # The fused pair moves the same total: AG (n-1)/n + RS
+            # (n-1)/n of the payload — fusion changes WHEN the bytes
+            # move (inside the matmul), not how many.
+            "path": ("collective_matmul (fused)" if args.tp_overlap
+                     else "psum (classic)"),
         }
     ring_factor = 2 * (dp - 1) / max(dp, 1)
     rs_factor = (dp - 1) / max(dp, 1)
@@ -1058,6 +1110,7 @@ def run_lm_benchmark(args) -> int:
         tp_psums=(
             tp_axis_block["psums_per_step"] if tp_axis_block else 0
         ),
+        tp_overlap=bool(args.tp_overlap),
         local_params=(local if tp else None),
     )
 

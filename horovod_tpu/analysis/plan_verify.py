@@ -36,7 +36,14 @@ from typing import (
     Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple,
 )
 
-from ..common.quant import WIRE_DTYPES, WIRE_F32, WIRE_INT8, int8_wire_bytes
+from ..common.quant import (
+    WIRE_BF16,
+    WIRE_DTYPES,
+    WIRE_F32,
+    WIRE_INT8,
+    bf16_wire_bytes,
+    int8_wire_bytes,
+)
 from ..common.types import ReduceOp
 from ..topo import compositor as _comp
 from ..topo.compositor import Plan, Stage, perm_rounds, stage_kind
@@ -263,6 +270,12 @@ class _PlanChecker:
             candidates = [
                 Fraction(int8_wire_bytes(int(c))) for c in candidates
             ]
+        elif getattr(stage, "wire_dtype", WIRE_F32) == WIRE_BF16:
+            # The cast rung: the wire carries the payload's bf16 image —
+            # two bytes per full-precision element, no scales.
+            candidates = [
+                Fraction(bf16_wire_bytes(int(c))) for c in candidates
+            ]
         if any(abs(declared - c) <= self.byte_tol for c in candidates):
             return
         self._flag(
@@ -445,6 +458,94 @@ class _PlanChecker:
                 )
                 return
 
+    def _verify_collective_matmul(
+        self, stages: Sequence[Tuple[int, Stage]], nbytes: int
+    ) -> None:
+        """Fused TP primitive (``topo.compositor.collective_matmul_plan``):
+        one direction stage per ring, each ``hops x chunks`` rounds of
+        the same +-1 shift. Per rank, fwd hop k delivers the segment k
+        behind (offset ``-k``), bwd hop k the segment k ahead (``+k``) —
+        for all_gather_matmul those are activation chunks gathered, for
+        matmul_reduce_scatter partial-product contributions reduced; the
+        movement algebra is identical. Completeness: the offsets plus
+        the rank's own segment must cover all ``n`` — a dropped chunk
+        (short round tag) leaves a hole, doubled bytes break the exact
+        ``nbytes*hops/n`` accounting, a corrupted round breaks
+        bijectivity."""
+        plan = self.plan
+        algo, sep, tail = plan.algorithm.rpartition("-c")
+        if not sep or not tail.isdigit() or algo not in getattr(
+            _comp, "COLLECTIVE_MATMUL_FLAVORS",
+            ("all_gather_matmul", "matmul_reduce_scatter"),
+        ):
+            self._flag_final(
+                f"unknown collective_matmul algorithm "
+                f"{plan.algorithm!r}; expected "
+                f"'<flavor>-c<chunks>'",
+            )
+            return
+        chunks = max(int(tail), 1)
+        offsets = {0}
+        g_seen: Optional[int] = None
+        for i, stage in stages:
+            kind, _, _ = stage_kind(stage.primitive)
+            if kind == "local":
+                continue
+            if kind != "collmm":
+                self._flag(
+                    RULE_PLAN_STAGE, i, stage,
+                    "unexpected primitive in a collective_matmul "
+                    "schedule",
+                )
+                return
+            levels = self._stage_levels(i, stage)
+            if levels is None:
+                return
+            g = self._group_size(levels)
+            if g_seen is None:
+                g_seen = g
+            elif g != g_seen:
+                self._flag(
+                    RULE_PLAN_STAGE, i, stage,
+                    f"direction stages ride hops of different sizes "
+                    f"({g_seen} vs {g})",
+                )
+                return
+            self._check_rounds_and_perm(i, stage, g)
+            base = stage.primitive
+            if base.endswith("-ring"):
+                base = base[: -len("-ring")]
+            _, r = _comp._rounds_tag(base)
+            if r is None or r <= 0 or r % chunks:
+                self._flag(
+                    RULE_PLAN_STAGE, i, stage,
+                    f"round tag {r!r} is not a positive multiple of the "
+                    f"chunk count ({chunks})",
+                )
+                return
+            hops = r // chunks
+            fwd = "_fwd" in stage.primitive
+            for k in range(1, hops + 1):
+                offsets.add((-k if fwd else k) % g)
+            # Exact symbolic bytes: hops deliveries of the 1/g segment,
+            # chunking is byte-invariant.
+            self._check_bytes(i, stage, Fraction(nbytes * hops, g))
+        if g_seen is None:
+            if self.n > 1:
+                self._flag_final(
+                    "collective_matmul schedule moved nothing over "
+                    f"{self.n} ranks",
+                )
+            return
+        missing = sorted(set(range(g_seen)) - offsets)
+        if missing:
+            self._flag_final(
+                f"chunked schedule leaves segment offsets {missing} "
+                f"unreached (of {g_seen}) — each rank must see every "
+                "chunk exactly once",
+                missing_offsets=missing,
+            )
+
     def _verify_broadcast(self, stages: Sequence[Tuple[int, Stage]],
                           nbytes: int) -> None:
         """Per rank: which of the root's L segments are held (L = inner
@@ -608,21 +709,23 @@ class _PlanChecker:
                     f"sound for additive reductions",
                 )
                 return self.findings
+        plan_wire = getattr(plan, "wire_dtype", WIRE_F32)
         if (
-            getattr(plan, "wire_dtype", WIRE_F32) == WIRE_INT8
+            plan_wire in (WIRE_INT8, WIRE_BF16)
             and plan.stages
             and not any(
-                getattr(s, "wire_dtype", WIRE_F32) == WIRE_INT8
+                getattr(s, "wire_dtype", WIRE_F32) == plan_wire
                 for s in plan.stages
+                if s.hop != "-"
             )
         ):
-            # A plan CLAIMING compression must actually quantize
+            # A plan CLAIMING a reduced wire must actually carry it
             # somewhere — otherwise its advertised bytes-on-wire savings
             # are fiction.
             self._flag_final(
-                "plan declares wire_dtype=int8 but no stage carries the "
-                "int8 wire — compression claimed without a quantize "
-                "stage",
+                f"plan declares wire_dtype={plan_wire} but no stage "
+                f"carries the {plan_wire} wire — reduced-precision "
+                "savings claimed without a converting stage",
             )
             return self.findings
         if self.n > 1 and not plan.stages:
@@ -668,6 +771,8 @@ class _PlanChecker:
             self._verify_broadcast(stages, plan.nbytes)
         elif plan.collective == "alltoall":
             self._verify_alltoall(stages, plan.nbytes)
+        elif plan.collective == "collective_matmul":
+            self._verify_collective_matmul(stages, plan.nbytes)
         else:
             self._flag_final(
                 f"unknown collective {plan.collective!r}",
@@ -712,17 +817,18 @@ def verify_plan_grid(
             op_list = ops if collective == "allreduce" else (ReduceOp.SUM,)
             for op in op_list:
                 # Quantized (int8+scales) candidates exist for allreduce
-                # SUM/AVERAGE; sweep them alongside the f32 grid so a
-                # corrupted compressed-bytes declaration is a lint
+                # SUM/AVERAGE; the bf16 cast rung exists for EVERY
+                # collective and op. Sweep them alongside the f32 grid
+                # so a corrupted reduced-wire byte declaration is a lint
                 # failure too.
-                wire_dtypes: Tuple[str, ...] = (WIRE_F32,)
+                wire_dtypes: Tuple[str, ...] = (WIRE_F32, WIRE_BF16)
                 if collective in ("allreduce", "reducescatter") and op in (
                     ReduceOp.SUM, ReduceOp.AVERAGE
                 ):
                     # Reduce-scatter joined the int8 grid with streamed
                     # ZeRO-1 (the gradient hop of the RS+AG
                     # decomposition).
-                    wire_dtypes = (WIRE_F32, WIRE_INT8)
+                    wire_dtypes = (WIRE_F32, WIRE_BF16, WIRE_INT8)
                 for wire_dtype in wire_dtypes:
                     for nbytes in payloads:
                         cands = _comp.candidate_plans(
@@ -741,6 +847,24 @@ def verify_plan_grid(
                                 )
                             findings.extend(fs)
                             verified += 1
+        # The fused-TP collective_matmul plan kind (innermost hop):
+        # both flavors, f32 + bf16 wire, the chunk counts the tuner
+        # searches.
+        for flavor in _comp.COLLECTIVE_MATMUL_FLAVORS:
+            for wire_dtype in (WIRE_F32, WIRE_BF16):
+                for chunks in (1, 2, 4):
+                    for nbytes in payloads:
+                        plan = _comp.collective_matmul_plan(
+                            model, flavor, nbytes, chunks=chunks,
+                            wire_dtype=wire_dtype,
+                        )
+                        fs = verify_plan(plan, model, suppress=suppress)
+                        for f in fs:
+                            f.location = f"{topo_name}/{f.location}"
+                            f.details.setdefault("topology", topo_name)
+                            f.details.setdefault("wire_dtype", wire_dtype)
+                        findings.extend(fs)
+                        verified += 1
     return findings, verified
 
 

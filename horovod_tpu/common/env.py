@@ -128,6 +128,14 @@ HOROVOD_TOPOLOGY_PLAN = "HOROVOD_TOPOLOGY_PLAN"
 # buckets over the int8+scales wire (flat: every hop; hierarchical:
 # DCN only), with the EF residual carried in optimizer state.
 HOROVOD_QUANTIZED_WIRE = "HOROVOD_QUANTIZED_WIRE"
+# Fused TP overlap (docs/parallelism.md "Fused TP overlap"): route the
+# composed DP×TP fast path's column/row layers through the chunked
+# collective-matmul primitives (ops/collective_matmul.py) so the
+# model-axis psums dissolve into ppermute chains that ride the wire
+# while the MXU multiplies. HOROVOD_TP_OVERLAP_CHUNKS sub-chunks each
+# ring hop's payload (0 = auto: one token chunk per rank).
+HOROVOD_TP_OVERLAP = "HOROVOD_TP_OVERLAP"
+HOROVOD_TP_OVERLAP_CHUNKS = "HOROVOD_TP_OVERLAP_CHUNKS"
 # Compiled-path offline tuning (docs/autotune.md "Compiled-path offline
 # tuning"): path to a ``tuned.json`` emitted by
 # tools/autotune_compiled.py. ``make_train_step`` / DistributedOptimizer
@@ -415,6 +423,10 @@ class Config:
     # a launched worker into `hvd.serve()` mode; the remaining fields
     # shape the continuous batcher, the paged KV-cache pool, and the
     # SLO target the selfdrive scale loop burns against.
+    # Fused TP overlap: collective-matmul path selection for the
+    # composed builder's tensor-parallel layers, and its chunking.
+    tp_overlap: bool = False
+    tp_overlap_chunks: int = 0
     serve: bool = False
     serve_port: int = 0
     serve_replicas: int = 1
@@ -490,6 +502,10 @@ class Config:
         cfg.eager_backend = os.environ.get(HOROVOD_TPU_EAGER_BACKEND, cfg.eager_backend)
         cfg.mesh_axes = os.environ.get(HOROVOD_TPU_MESH_AXES, cfg.mesh_axes)
         cfg.static_checks = _get_bool(HOROVOD_TPU_STATIC_CHECKS)
+        cfg.tp_overlap = _get_bool(HOROVOD_TP_OVERLAP)
+        cfg.tp_overlap_chunks = _get_int(
+            HOROVOD_TP_OVERLAP_CHUNKS, cfg.tp_overlap_chunks
+        )
         cfg.serve = _get_bool(HOROVOD_SERVE)
         cfg.serve_port = _get_int(HOROVOD_SERVE_PORT, cfg.serve_port)
         cfg.serve_replicas = _get_int(

@@ -22,9 +22,14 @@ BLOCK = 256
 SCALE_BYTES = 4
 
 # Wire dtype labels used by compositor plans and the plan verifier.
+# bf16 is a PURE cast rung: half the bytes of f32, no scales, no error
+# feedback — valid for every collective (a cast commutes with any data
+# movement and any SUM/AVERAGE), unlike int8 whose blockwise scales only
+# compose with the allreduce/reduce-scatter constructions.
 WIRE_F32 = "f32"
+WIRE_BF16 = "bf16"
 WIRE_INT8 = "int8"
-WIRE_DTYPES = (WIRE_F32, WIRE_INT8)
+WIRE_DTYPES = (WIRE_F32, WIRE_BF16, WIRE_INT8)
 
 
 def int8_wire_bytes(nbytes: int, dtype_bytes: int = 4) -> int:
@@ -38,6 +43,16 @@ def int8_wire_bytes(nbytes: int, dtype_bytes: int = 4) -> int:
     elems = -(-nbytes // int(dtype_bytes))  # ceil
     blocks = -(-elems // BLOCK)
     return elems + SCALE_BYTES * blocks
+
+
+def bf16_wire_bytes(nbytes: int, dtype_bytes: int = 4) -> int:
+    """Bytes a stage that declared ``nbytes`` of full-precision traffic
+    moves with the bf16 cast format: two bytes per element, no scales."""
+    nbytes = max(int(nbytes), 0)
+    if nbytes == 0:
+        return 0
+    elems = -(-nbytes // int(dtype_bytes))  # ceil
+    return 2 * elems
 
 
 def int8_saved_bytes(nbytes: int, dtype_bytes: int = 4) -> int:

@@ -1436,6 +1436,7 @@ def _build_composed_train_step(
     nonfinite: Optional[str] = None,
     topo_algorithm: Optional[str] = None,
     zero1: bool = False,
+    tp_overlap: Optional[bool] = None,
     tuned_cfg: Any = None,
     tuned_source: str = "none",
 ):
@@ -1457,6 +1458,7 @@ def _build_composed_train_step(
 
     from ..common.compat import needs_explicit_grad_reduce
     from ..parallel import rules as _rules
+    from ..parallel import tp as _tp
     from ..parallel import zero as _zero
     from .. import tune as _tune
 
@@ -1580,7 +1582,13 @@ def _build_composed_train_step(
                         quantized=quantized, nonfinite=nonfinite_policy,
                         zero1=zero1, **knobs,
                     )
-                return loss_fn(p, b)
+                # Pin the TP-path selection for the trace: tp_apply
+                # (and any user loss built on parallel/tp.py) consults
+                # tp.overlap_active() so `tp_overlap=True` here reaches
+                # the model without threading a flag through user code.
+                # None keeps HOROVOD_TP_OVERLAP in charge.
+                with _tp.overlap_scope(tp_overlap):
+                    return loss_fn(p, b)
 
             grad_fn = jax.value_and_grad(local_loss, has_aux=has_aux)
             if has_aux:
@@ -1676,6 +1684,7 @@ def _build_composed_train_step(
             return _trace.wrap_step(
                 step_fn,
                 composed=True, tp=n_model, dp=n_data,
+                tp_overlap=_tp.tp_overlap_enabled(tp_overlap),
                 overlap=overlap, quantized=quantized, zero1=zero1,
                 wire_dtype="int8" if quantized else "f32",
                 op=ReduceOp(op).name, nonfinite=nonfinite_policy,
@@ -1748,6 +1757,7 @@ def make_train_step(
     zero1: bool = False,
     rules: Any = None,
     model_axis: str = "model",
+    tp_overlap: Optional[bool] = None,
 ):
     """See :func:`_build_train_step` for the core semantics — this public
     wrapper adds pinned offline tuning (docs/autotune.md "Compiled-path
@@ -1762,7 +1772,13 @@ def make_train_step(
     (e.g. ``models.transformer.tp_apply``), and the whole
     overlap/quantized/zero1 reduction stack applies to the DATA axis
     only — TP psums are never bucketized, quantized, or re-planned.
-    ``zero1=True`` then takes the state from
+    ``tp_overlap=True`` (default: the ``HOROVOD_TP_OVERLAP`` knob)
+    additionally routes the TP layers through the chunked
+    collective-matmul primitives (docs/parallelism.md "Fused TP
+    overlap"): the residual stream token-shards over ``model_axis`` and
+    the block psums dissolve into bidirectional ppermute chains
+    overlapped with the matmuls — zero model-axis all-reduces in the
+    step's HLO. ``zero1=True`` then takes the state from
     :func:`init_composed_zero1_state`. The returned step exposes
     ``step.sharding_specs`` (after the first call) for the guard's
     digest agreement (``guard/digest.strip_rank_local``).
@@ -1810,7 +1826,14 @@ def make_train_step(
     if rules is not None:
         return _build_composed_train_step(
             loss_fn, optimizer, mesh, rules=rules, model_axis=model_axis,
+            tp_overlap=tp_overlap,
             tuned_cfg=tuned_cfg, tuned_source=tuned_source, **kwargs,
+        )
+    if tp_overlap is not None:
+        raise ValueError(
+            "tp_overlap selects the fused collective-matmul TP path of "
+            "the composed builder — pass rules=... (and a model axis); "
+            "without tensor parallelism there is no TP psum to fuse"
         )
     if tuned_cfg is None:
         return _build_train_step(loss_fn, optimizer, mesh, **kwargs)

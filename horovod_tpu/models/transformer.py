@@ -163,6 +163,7 @@ def tp_apply(
     positions=None,
     dtype: Any = jnp.bfloat16,
     causal: bool = True,
+    tp_overlap: Optional[bool] = None,
 ):
     """Functional forward of the :class:`TransformerLM` param tree on
     (possibly TP-local) shards.
@@ -176,8 +177,17 @@ def tp_apply(
     Embeddings, norms, and the lm head consume replicated leaves. With
     ``model_axis=None`` every shard is full-size and the function is the
     dense single-chip reference (bitwise the same interpretation of the
-    same tree)."""
+    same tree).
+
+    ``tp_overlap`` selects the FUSED collective-matmul path
+    (docs/parallelism.md "Fused TP overlap"): the residual stream rides
+    token-sharded between blocks, q/k/v ride ONE all-gather-matmul,
+    attention-out and MLP-down become matmul-reduce-scatters — zero
+    model-axis all-reduces inside the blocks. ``None`` defers to
+    ``parallel.tp.tp_overlap_enabled()`` (the composed builder's
+    ``overlap_scope`` / ``HOROVOD_TP_OVERLAP``)."""
     from ..parallel.tp import column_parallel, row_parallel, tp_block_input
+    from ..parallel.tp import tp_overlap_enabled
 
     B, T = tokens.shape
     if positions is None:
@@ -189,6 +199,22 @@ def tp_apply(
     if C % n_heads:
         raise ValueError(f"d_model {C} not divisible by n_heads {n_heads}")
     head_dim = C // n_heads
+
+    if model_axis is not None and tp_overlap_enabled(tp_overlap):
+        from ..common.compat import axis_size as _axis_size
+
+        n = _axis_size(model_axis)
+        if n > 1:
+            if T % n:
+                raise ValueError(
+                    f"tp_overlap needs the sequence length ({T}) "
+                    f"divisible by the model-axis size ({n}) — the "
+                    f"fused path token-shards the residual stream"
+                )
+            return _tp_apply_fused(
+                params, x, model_axis=model_axis, head_dim=head_dim,
+                dtype=dtype, causal=causal,
+            )
 
     def f(y):
         # Megatron's `f`: marks the replicated block input feeding
@@ -234,6 +260,79 @@ def tp_apply(
             u, mlp["down"]["kernel"].astype(dtype),
             mlp["down"]["bias"].astype(dtype),
         )
+    x = _layer_norm(x, params["ln_f"], dtype)
+    w = params["lm_head"]["kernel"].astype(jnp.float32)
+    return x.astype(jnp.float32) @ w
+
+
+def _tp_apply_fused(params, x, *, model_axis, head_dim, dtype, causal):
+    """Collective-matmul forward: token-sharded residual stream.
+
+    Per block: LN on the token shard → q/k/v via ONE all-gather-matmul
+    over the concatenated kernels (the gather chunks ride the ring while
+    the MXU multiplies) → flash attention on full tokens / local heads →
+    attention-out via matmul-reduce-scatter → LN → MLP up (all-gather-
+    matmul, gelu) → MLP down (matmul-reduce-scatter). Tokens scatter
+    once at entry (free slice) and gather once at exit before ln_f, so
+    the lm head sees exactly the classic replicated activation —
+    ``psum(y@W) == all_gather(reduce_scatter(y@W))`` over tokens makes
+    the whole thing block-for-block equivalent to :func:`tp_apply`'s
+    classic path with zero model-axis all-reduces in between. Block
+    layernorm params route through ``tp_replicated_params`` (their grads
+    are per-token-chunk partial on the sharded stream)."""
+    from ..parallel.tp import (
+        column_parallel_fused,
+        row_parallel_fused,
+        tp_gather_tokens,
+        tp_replicated_params,
+        tp_scatter_tokens,
+    )
+
+    B, T, C = x.shape
+    x = tp_scatter_tokens(x, axis_name=model_axis)  # [B, T/n, C]
+    for i in range(transformer_n_layers(params)):
+        bp = params[f"block_{i}"]
+        ln1 = tp_replicated_params(bp["ln_1"], axis_name=model_axis)
+        h = _layer_norm(x, ln1, dtype)
+        att = bp["attention"]
+        wqkv = jnp.concatenate(
+            [
+                att["query"]["kernel"].astype(dtype),
+                att["key"]["kernel"].astype(dtype),
+                att["value"]["kernel"].astype(dtype),
+            ],
+            axis=-1,
+        )
+        qkv = column_parallel_fused(h, wqkv, axis_name=model_axis)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if q.shape[-1] % head_dim:
+            raise ValueError(
+                f"local q/k/v width {q.shape[-1]} is not whole heads of "
+                f"dim {head_dim} — n_heads must divide by the model-axis "
+                f"size"
+            )
+        hl = q.shape[-1] // head_dim
+        shape = (B, T, hl, head_dim)
+        a = flash_attention_bthd(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            causal=causal,
+        )
+        a = a.reshape(B, T, hl * head_dim)
+        x = x + row_parallel_fused(
+            a, att["out"]["kernel"].astype(dtype), axis_name=model_axis
+        )
+        ln2 = tp_replicated_params(bp["ln_2"], axis_name=model_axis)
+        h = _layer_norm(x, ln2, dtype)
+        mlp = bp["mlp"]
+        u = jax.nn.gelu(column_parallel_fused(
+            h, mlp["up"]["kernel"].astype(dtype),
+            mlp["up"]["bias"].astype(dtype), axis_name=model_axis,
+        ))
+        x = x + row_parallel_fused(
+            u, mlp["down"]["kernel"].astype(dtype),
+            mlp["down"]["bias"].astype(dtype), axis_name=model_axis,
+        )
+    x = tp_gather_tokens(x, axis_name=model_axis)  # [B, T, C] replicated
     x = _layer_norm(x, params["ln_f"], dtype)
     w = params["lm_head"]["kernel"].astype(jnp.float32)
     return x.astype(jnp.float32) @ w
@@ -373,17 +472,20 @@ def make_gpt_loss_fn(
     *,
     model_axis: Optional[str] = None,
     dtype: Any = jnp.bfloat16,
+    tp_overlap: Optional[bool] = None,
 ):
     """``loss_fn(params, (tokens, labels))`` over :func:`tp_apply` — the
     loss the composed ``make_train_step(rules=...)`` trains and the
     dense reference (``model_axis=None``) the parity tests compare
-    against."""
+    against. ``tp_overlap`` pins the fused collective-matmul path
+    (``None`` defers to the builder's ``overlap_scope`` / the
+    ``HOROVOD_TP_OVERLAP`` knob)."""
 
     def loss_fn(params, batch):
         tokens, labels = batch
         logits = tp_apply(
             params, tokens, n_heads=n_heads, model_axis=model_axis,
-            dtype=dtype,
+            dtype=dtype, tp_overlap=tp_overlap,
         )
         return lm_loss(logits, labels)
 

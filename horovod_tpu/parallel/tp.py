@@ -132,6 +132,230 @@ def row_parallel(x_shard: jax.Array, w_shard: jax.Array, b_shard=None, *,
     return psum_replicated_grad(y, axis_name)
 
 
+# ------------------------------------------------- fused TP overlap
+#
+# The collective-matmul path (docs/parallelism.md "Fused TP overlap"):
+# the residual stream rides token-SHARDED between blocks, the column
+# consume is an all-gather-matmul and the row produce a
+# matmul-reduce-scatter (ops/collective_matmul.py), so the classic
+# exposed psum disappears from the forward — ppermute chains carry the
+# chunks while the MXU multiplies. ``psum(y@W) ==
+# all_gather(reduce_scatter(y@W))`` over tokens keeps the fused block
+# numerically equivalent to the classic one.
+
+_OVERLAP_SCOPE: list = []
+
+
+def overlap_scope(enabled):
+    """Context manager pinning the fused-path selection during a trace
+    (the composed builder wraps the user loss in one, so
+    ``make_train_step(rules=..., tp_overlap=...)`` reaches every
+    ``tp_apply`` call without threading a flag through user code).
+    ``enabled=None`` defers to the environment knob."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def scope():
+        _OVERLAP_SCOPE.append(None if enabled is None else bool(enabled))
+        try:
+            yield
+        finally:
+            _OVERLAP_SCOPE.pop()
+
+    return scope()
+
+
+def tp_overlap_enabled(explicit=None) -> bool:
+    """Resolve the fused-path switch: an explicit argument wins, then
+    the innermost :func:`overlap_scope`, then ``HOROVOD_TP_OVERLAP``."""
+    if explicit is not None:
+        return bool(explicit)
+    for v in reversed(_OVERLAP_SCOPE):
+        if v is not None:
+            return v
+    from ..common import env as _env
+
+    return _env._get_bool(_env.HOROVOD_TP_OVERLAP, False)
+
+
+def tp_overlap_chunks() -> int:
+    """The configured sub-chunk count (0 = auto: one chunk per rank)."""
+    from ..common import env as _env
+
+    return _env._get_int(_env.HOROVOD_TP_OVERLAP_CHUNKS, 0)
+
+
+def tp_scatter_tokens(x: jax.Array, *,
+                      axis_name: str = MODEL_AXIS) -> jax.Array:
+    """Enter the fused path: slice this rank's token chunk (dim −2) off
+    a REPLICATED activation — free of communication forward; the
+    backward reassembles and psums the cotangent over the model axis
+    (the embedding-boundary conjugate, explicit on old jax exactly like
+    :func:`tp_block_input`)."""
+    from ..common.compat import needs_explicit_grad_reduce
+
+    n = _axis_size(axis_name)
+    tc = x.shape[-2] // n
+    if tc * n != x.shape[-2]:
+        raise ValueError(
+            f"tp_scatter_tokens needs tokens ({x.shape[-2]}) divisible "
+            f"by the model-axis size ({n})"
+        )
+    if not needs_explicit_grad_reduce():
+        i = lax.axis_index(axis_name)
+        return lax.dynamic_slice_in_dim(x, i * tc, tc, axis=-2)
+    global _scatter_tokens_psum_bwd
+    if _scatter_tokens_psum_bwd is None:
+        _scatter_tokens_psum_bwd = _make_scatter_tokens_psum_bwd()
+    return _scatter_tokens_psum_bwd(x, axis_name)
+
+
+def _make_scatter_tokens_psum_bwd():
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def f(x, axis_name):
+        n = _axis_size(axis_name)
+        i = lax.axis_index(axis_name)
+        return lax.dynamic_slice_in_dim(
+            x, i * (x.shape[-2] // n), x.shape[-2] // n, axis=-2
+        )
+
+    def fwd(x, axis_name):
+        return f(x, axis_name), None
+
+    def bwd(axis_name, res, ct):
+        from ..ops import fusion as _fusion
+
+        n = _axis_size(axis_name)
+        shape = list(ct.shape)
+        shape[-2] = shape[-2] * n
+        i = lax.axis_index(axis_name)
+        full = jnp.zeros(tuple(shape), ct.dtype)
+        idx = [0] * len(shape)
+        idx[-2] = i * ct.shape[-2]
+        full = lax.dynamic_update_slice(full, ct, tuple(idx))
+        _fusion.record_axis_wire_bytes(
+            full.size * full.dtype.itemsize, axis_name, "psum"
+        )
+        return (lax.psum(full, axis_name),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_scatter_tokens_psum_bwd = None
+
+
+def tp_gather_tokens(x_shard: jax.Array, *,
+                     axis_name: str = MODEL_AXIS) -> jax.Array:
+    """Leave the fused path: all-gather the token chunks (dim −2) back
+    to a replicated activation. The backward takes this rank's LOCAL
+    cotangent slice — downstream cotangents are replicated-identical
+    (the loss is pmean'd over the model axis), so the all_gather's
+    psum-scatter transpose would n-fold count; explicit on old jax,
+    the vma machinery's job on new jax."""
+    from ..common.compat import needs_explicit_grad_reduce
+
+    if not needs_explicit_grad_reduce():
+        return lax.all_gather(
+            x_shard, axis_name, axis=x_shard.ndim - 2, tiled=True
+        )
+    global _gather_tokens_slice_bwd
+    if _gather_tokens_slice_bwd is None:
+        _gather_tokens_slice_bwd = _make_gather_tokens_slice_bwd()
+    return _gather_tokens_slice_bwd(x_shard, axis_name)
+
+
+def _make_gather_tokens_slice_bwd():
+    import functools
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def f(x_shard, axis_name):
+        from ..ops import fusion as _fusion
+
+        n = _axis_size(axis_name)
+        _fusion.record_axis_wire_bytes(
+            x_shard.size * x_shard.dtype.itemsize * n, axis_name,
+            "allgather",
+        )
+        return lax.all_gather(
+            x_shard, axis_name, axis=x_shard.ndim - 2, tiled=True
+        )
+
+    def fwd(x_shard, axis_name):
+        return f(x_shard, axis_name), None
+
+    def bwd(axis_name, res, ct):
+        tc = ct.shape[-2] // _axis_size(axis_name)
+        i = lax.axis_index(axis_name)
+        return (lax.dynamic_slice_in_dim(ct, i * tc, tc, axis=-2),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+_gather_tokens_slice_bwd = None
+
+
+def tp_replicated_params(tree: Any, *,
+                         axis_name: str = MODEL_AXIS) -> Any:
+    """Mark a REPLICATED param subtree consumed by token-sharded compute
+    on the fused path (block layernorms): each rank's grad covers only
+    its token chunk, so the cotangents psum over the model axis — the
+    same conjugate :func:`tp_block_input` provides, applied per leaf."""
+    return jax.tree.map(
+        lambda leaf: tp_block_input(leaf, axis_name=axis_name), tree
+    )
+
+
+def column_parallel_fused(x_shard: jax.Array, w_shard: jax.Array,
+                          b_shard=None, *,
+                          axis_name: str = MODEL_AXIS,
+                          chunks: int = 0) -> jax.Array:
+    """Fused column consume: ``y = all_gather(x_shard over tokens) @
+    W[:, shard]`` with the gather chunks riding the bidirectional ring
+    while the MXU multiplies — input is the token-sharded residual
+    stream, output full-token and feature-sharded (what attention and
+    the gelu need)."""
+    from ..ops.collective_matmul import all_gather_matmul
+
+    y = all_gather_matmul(
+        x_shard, w_shard, axis_name=axis_name, chunks=chunks
+    )
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel_fused(x_shard: jax.Array, w_shard: jax.Array,
+                       b_shard=None, *,
+                       axis_name: str = MODEL_AXIS,
+                       chunks: int = 0) -> jax.Array:
+    """Fused row produce: ``z = reduce_scatter(x @ W[shard, :] over
+    tokens)`` — partial products per destination chunk reduced along
+    the ring; the classic psum never materializes. Output is the
+    token-sharded residual stream
+    (``all_gather(row_parallel_fused(...)) == row_parallel(...)``)."""
+    from ..ops.collective_matmul import matmul_reduce_scatter
+
+    z = matmul_reduce_scatter(
+        x_shard, w_shard, axis_name=axis_name, chunks=chunks
+    )
+    if b_shard is not None:
+        n = _axis_size(axis_name)
+        f = b_shard.shape[-1]
+        if f * n != w_shard.shape[-1]:
+            raise ValueError(
+                f"row_parallel_fused bias must be the [D/n] shard: got "
+                f"{f} features for D={w_shard.shape[-1]} over n={n} "
+                f"shards"
+            )
+        b_full = lax.all_gather(b_shard, axis_name, axis=0, tiled=True)
+        z = z + b_full
+    return z
+
+
 def tp_mlp(params: dict, x: jax.Array, *,
            axis_name: str = MODEL_AXIS,
            activation: Callable = jax.nn.gelu) -> jax.Array:

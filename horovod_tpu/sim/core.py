@@ -187,6 +187,10 @@ def tp_fixed_comm_us(
     psum_bytes: int,
     tp_degree: int,
     psums_per_step: int = 1,
+    *,
+    overlap: bool = False,
+    chunks: int = 1,
+    compute_us_per_psum: float = 0.0,
 ) -> float:
     """Price the composed program's per-step tensor-parallel term: the
     in-block activation psums ride the INNERMOST (fastest, ICI) hop as
@@ -196,11 +200,32 @@ def tp_fixed_comm_us(
     ``tune(fixed_comm_us=...)``) as a constant every step pays, so the
     simulator's scale predictions and the tuner's knob costs stay honest
     for the composed shape. ``psums_per_step`` counts forward AND
-    backward conjugates (2 per Megatron half-block per direction)."""
+    backward conjugates (2 per Megatron half-block per direction).
+
+    ``overlap=True`` prices the fused collective-matmul path instead
+    (docs/parallelism.md "Fused TP overlap"): each psum becomes one
+    all_gather_matmul + one matmul_reduce_scatter, ``chunks`` ring
+    chunks each, hiding their wire behind ``compute_us_per_psum`` (the
+    psum's adjacent matmul time, split across the pair) — only the
+    un-hideable remainder (``topo.compositor.collective_matmul_cost_us``:
+    ``max(compute, wire) + ramp - compute``) is charged."""
     tp = int(tp_degree)
     if tp <= 1 or psum_bytes <= 0 or psums_per_step <= 0:
         return 0.0
     hop = model.hops[-1]
+    if overlap:
+        import dataclasses as _dc
+
+        from ..topo.compositor import collective_matmul_cost_us
+
+        inner = _dc.replace(model, hops=(_dc.replace(hop, size=tp),))
+        priced = collective_matmul_cost_us(
+            inner, int(psum_bytes), chunks=max(int(chunks), 1),
+            compute_us=float(compute_us_per_psum) / 2.0,
+        )
+        return round(
+            float(psums_per_step) * 2.0 * priced["exposed_us"], 4
+        )
     rounds = 2 * (tp - 1)
     onwire = 2 * (tp - 1) * int(psum_bytes) / tp
     one = hop.latency_us * rounds + onwire / (hop.bandwidth_gbps * 1e3)

@@ -54,9 +54,11 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..common.quant import (
+    WIRE_BF16,
     WIRE_DTYPES,
     WIRE_F32,
     WIRE_INT8,
+    bf16_wire_bytes,
     int8_wire_bytes,
 )
 from ..common.types import ReduceOp
@@ -75,9 +77,11 @@ _HIER_REDUCE_OPS = (
 
 # Stable stage metadata (consumed by analysis/plan_verify.py): the base
 # primitive kind behind each stage label. Suffixes encode the schedule
-# variant (``-ring`` / ``-halving`` / ``-doubling`` / ``-tree``) and, for
-# split mode, the bucket (``-b0`` / ``-b1``). ``local`` stages move no
-# bytes over any hop.
+# variant (``-ring`` / ``-halving`` / ``-doubling`` / ``-tree``), for
+# split mode the bucket (``-b0`` / ``-b1``), and for the chunked
+# collective-matmul direction stages the round count (``-r<N>`` — the
+# rounds depend on the chunk count, not just the hop size). ``local``
+# stages move no bytes over any hop.
 STAGE_KINDS = {
     "all_reduce": "allreduce",
     "reduce_scatter": "reducescatter",
@@ -85,7 +89,18 @@ STAGE_KINDS = {
     "broadcast": "broadcast",
     "all_to_all": "alltoall",
     "block_permute": "local",
+    "collective_matmul_fwd": "collmm",
+    "collective_matmul_bwd": "collmm",
 }
+
+
+def _rounds_tag(name: str) -> Tuple[str, Optional[int]]:
+    """Strip a trailing ``-r<N>`` round-count tag: ``"x-r6"`` ->
+    ``("x", 6)``."""
+    head, sep, tail = name.rpartition("-r")
+    if sep and tail.isdigit():
+        return head, int(tail)
+    return name, None
 
 
 def stage_kind(primitive: str) -> Tuple[str, str, Optional[int]]:
@@ -103,6 +118,7 @@ def stage_kind(primitive: str) -> Tuple[str, str, Optional[int]]:
         if name.endswith("-" + suffix):
             name, variant = name[: -(len(suffix) + 1)], suffix
             break
+    name, _ = _rounds_tag(name)
     return STAGE_KINDS.get(name, "?"), variant, bucket
 
 
@@ -112,8 +128,23 @@ def perm_rounds(primitive: str, size: int) -> Optional[List[List[Tuple[int, int]
     the metadata the symbolic plan verifier checks for bijectivity and
     round counts. Non-permute stages (XLA-native collectives, trees,
     local relayouts) return None."""
-    _, variant, _ = stage_kind(primitive)
+    kind, variant, _ = stage_kind(primitive)
     n = int(size)
+    if kind == "collmm":
+        # Chunked collective-matmul direction stage: the round count
+        # rides the ``-r<N>`` tag (hops x chunks — not derivable from
+        # the hop size alone); every round is the same +1 (fwd) or -1
+        # (bwd) ring shift.
+        base = primitive
+        for suffix in ("-ring",):
+            if base.endswith(suffix):
+                base = base[: -len(suffix)]
+        _, r = _rounds_tag(base)
+        if r is None or n <= 1:
+            return []
+        step = 1 if "_fwd" in primitive else -1
+        perm = [(i, (i + step) % n) for i in range(n)]
+        return [list(perm) for _ in range(r)]
     if variant == "ring":
         if n <= 1:
             return []
@@ -265,6 +296,18 @@ def _compress_stage(s: Stage) -> Stage:
         primitive=s.primitive, hop=s.hop, axis=s.axis,
         bytes_on_wire=int8_wire_bytes(s.bytes_on_wire), rounds=s.rounds,
         wire_dtype=WIRE_INT8,
+    )
+
+
+def _cast_stage(s: Stage) -> Stage:
+    """Re-declare a stage with the bf16 cast wire format: same schedule,
+    half the bytes, no scales. A cast commutes with any data movement
+    and any SUM/AVERAGE, so unlike int8 this applies to EVERY stage of
+    every candidate."""
+    return Stage(
+        primitive=s.primitive, hop=s.hop, axis=s.axis,
+        bytes_on_wire=bf16_wire_bytes(s.bytes_on_wire), rounds=s.rounds,
+        wire_dtype=WIRE_BF16,
     )
 
 
@@ -564,7 +607,11 @@ def candidate_plans(
     ``wire_dtype="int8"`` (allreduce and reduce-scatter, SUM/AVERAGE
     only — reduce-scatter is ZeRO-1's gradient hop) prices the
     quantized wire: every hop compressed for flat/ring, only the
-    outermost (DCN) hop for two-level."""
+    outermost (DCN) hop for two-level. ``wire_dtype="bf16"`` is the
+    pure-cast rung (docs/topology.md): half the bytes on EVERY stage of
+    EVERY candidate of EVERY collective — a cast commutes with any data
+    movement and any additive reduction, needs no scales and no error
+    feedback."""
     if collective not in COLLECTIVES:
         raise ValueError(
             f"unknown collective {collective!r}; one of {COLLECTIVES}"
@@ -603,6 +650,14 @@ def candidate_plans(
         cands = _candidates_alltoall(eff, nbytes)
     if not cands:
         cands = {"flat": []}
+    if wire_dtype == WIRE_BF16:
+        # The cast applies uniformly after the fact: same schedules,
+        # every wire stage at half the bytes (local relayouts move no
+        # wire bytes and stay as-is).
+        cands = {
+            name: [_cast_stage(s) if s.hop != "-" else s for s in stages]
+            for name, stages in cands.items()
+        }
     op_label = _op_name(
         op_enum if collective in ("allreduce", "reducescatter") else None
     )
@@ -610,7 +665,11 @@ def candidate_plans(
     for name in sorted(cands):
         stages = cands[name]
         if name == "split":
-            cost = _split_cost_us(eff, nbytes)
+            cost = _split_cost_us(
+                eff,
+                bf16_wire_bytes(nbytes) if wire_dtype == WIRE_BF16
+                else nbytes,
+            )
             f0, _ = split_fractions(eff)
             nb0 = int(nbytes * f0)
             split_bytes: Tuple[int, ...] = (nb0, nbytes - nb0)
@@ -673,6 +732,138 @@ def _split_cost_us(model: InterconnectModel, nbytes: int) -> float:
         )
         alpha += hop.latency_us * s.rounds
     return max(busy.values()) + 2 * alpha
+
+
+# --- collective-matmul plan kind (fused TP overlap) --------------------------
+#
+# docs/parallelism.md "Fused TP overlap": ops/collective_matmul.py's
+# all_gather_matmul / matmul_reduce_scatter dissolve the Megatron TP
+# psum into bidirectional chunked ppermute chains that ride the wire
+# WHILE the MXU multiplies. These plans price one such primitive:
+# cost = max(compute, wire) + ramp, where ramp is the pipeline fill (the
+# first sub-chunk's hop, which nothing can hide) — more chunks shrink
+# the ramp but pay more per-round latency, the trade the tuner searches.
+
+COLLECTIVE_MATMUL_FLAVORS = ("all_gather_matmul", "matmul_reduce_scatter")
+
+
+def ring_hops(n: int) -> Tuple[int, int]:
+    """Hops each ring direction carries for a bidirectional pass over
+    ``n`` ranks: ``(ceil((n-1)/2), floor((n-1)/2))`` — together exactly
+    the ``n-1`` deliveries, split so both link directions work."""
+    n = int(n)
+    if n <= 1:
+        return (0, 0)
+    return (-(-(n - 1) // 2), (n - 1) // 2)
+
+
+def collective_matmul_cost_us(
+    model: InterconnectModel,
+    nbytes: int,
+    *,
+    chunks: int = 1,
+    compute_us: float = 0.0,
+    wire_dtype: str = WIRE_F32,
+) -> Dict[str, float]:
+    """Price ONE chunked collective-matmul primitive on the innermost
+    hop (the TP axis rides ICI): ``wire`` is the busier ring direction's
+    time (the directions run concurrently), ``ramp`` the first
+    sub-chunk's un-hideable delivery, ``cost = max(compute, wire) +
+    ramp`` and ``exposed = cost - compute`` — what the step pays beyond
+    the matmul it had to run anyway. Compare against the classic
+    exposed-psum constant (``sim.tp_fixed_comm_us``)."""
+    hop = model.hops[-1]
+    n = hop.size
+    compute_us = float(compute_us)
+    if n <= 1:
+        return {
+            "cost_us": round(compute_us, 4), "exposed_us": 0.0,
+            "wire_us": 0.0, "ramp_us": 0.0,
+        }
+    h_fwd, h_bwd = ring_hops(n)
+    c = max(int(chunks), 1)
+    wire_bytes = (
+        bf16_wire_bytes(nbytes) if wire_dtype == WIRE_BF16
+        else int8_wire_bytes(nbytes) if wire_dtype == WIRE_INT8
+        else int(nbytes)
+    )
+    bw = hop.bandwidth_gbps * 1e3  # bytes/us
+    wire_fwd = hop.latency_us * h_fwd * c + wire_bytes * h_fwd / n / bw
+    wire_bwd = hop.latency_us * h_bwd * c + wire_bytes * h_bwd / n / bw
+    wire_us = max(wire_fwd, wire_bwd)
+    ramp_us = hop.latency_us + wire_bytes / (n * c) / bw
+    cost = max(compute_us, wire_us) + ramp_us
+    return {
+        "cost_us": round(cost, 4),
+        "exposed_us": round(cost - compute_us, 4),
+        "wire_us": round(wire_us, 4),
+        "ramp_us": round(ramp_us, 4),
+    }
+
+
+def collective_matmul_plan(
+    model: InterconnectModel,
+    flavor: str,
+    nbytes: int,
+    *,
+    chunks: int = 1,
+    compute_us: float = 0.0,
+    wire_dtype: str = WIRE_F32,
+) -> Plan:
+    """The machine-checkable schedule behind one fused primitive: one
+    direction stage per ring (the bwd stage vanishes at n=2 where the
+    backward ring carries nothing), each ``hops x chunks`` rounds of the
+    same +-1 shift with EXACT symbolic bytes ``nbytes*hops/n`` — what
+    ``analysis/plan_verify`` Pass 3 executes for per-round bijectivity
+    and byte accounting. ``cost_us`` embeds the overlap model of
+    :func:`collective_matmul_cost_us`."""
+    if flavor not in COLLECTIVE_MATMUL_FLAVORS:
+        raise ValueError(
+            f"unknown collective_matmul flavor {flavor!r}; one of "
+            f"{COLLECTIVE_MATMUL_FLAVORS}"
+        )
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"unknown wire_dtype {wire_dtype!r}; one of {WIRE_DTYPES}"
+        )
+    if wire_dtype == WIRE_INT8:
+        raise ValueError(
+            "wire_dtype='int8' is an allreduce/reduce-scatter "
+            "SUM/AVERAGE construction — the collective-matmul chunks "
+            "are consumed by a matmul per hop, which has no blockwise "
+            "requantization schedule; use 'bf16' for the cast rung"
+        )
+    nbytes = max(int(nbytes), 0)
+    hop = model.hops[-1]
+    n = hop.size
+    h_fwd, h_bwd = ring_hops(n)
+    c = max(int(chunks), 1)
+    stages: List[Stage] = []
+    for direction, hops in (("fwd", h_fwd), ("bwd", h_bwd)):
+        if hops <= 0:
+            continue
+        s = Stage(
+            primitive=(
+                f"collective_matmul_{direction}-r{hops * c}-ring"
+            ),
+            hop=hop.name, axis=hop.axis,
+            bytes_on_wire=int(nbytes * hops / n), rounds=hops * c,
+        )
+        stages.append(_cast_stage(s) if wire_dtype == WIRE_BF16 else s)
+    priced = collective_matmul_cost_us(
+        model, nbytes, chunks=c, compute_us=compute_us,
+        wire_dtype=wire_dtype,
+    )
+    return Plan(
+        collective="collective_matmul",
+        op="SUM" if flavor == "matmul_reduce_scatter" else "-",
+        algorithm=f"{flavor}-c{c}",
+        nbytes=nbytes,
+        hop_sizes=tuple(h.size for h in model.hops),
+        stages=tuple(stages),
+        cost_us=float(priced["cost_us"]),
+        wire_dtype=wire_dtype,
+    )
 
 
 # --- lowering layer (inside shard_map traces) --------------------------------
@@ -848,7 +1039,10 @@ def lower_allreduce(
     int8 quantization tolerance for ``wire_dtype="int8"`` (SUM/AVERAGE
     only): flat/ring lower through the int8 ring on every hop,
     two-level compresses only the outermost hop
-    (``ops/quantized.quantized_hierarchical_allreduce``)."""
+    (``ops/quantized.quantized_hierarchical_allreduce``), to bf16
+    rounding for ``wire_dtype="bf16"`` (any op, any algorithm: the
+    payload casts down once on entry and back up on exit — the
+    pure-cast rung, no scales, no error feedback)."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -856,6 +1050,13 @@ def lower_allreduce(
 
     axes = _axes_tuple(axes)
     total = axis_size(axes)
+    if wire_dtype == WIRE_BF16:
+        orig = x.dtype
+        out = lower_allreduce(
+            x.astype(jnp.bfloat16), axes, op=op, algorithm=algorithm,
+            split_fraction=split_fraction, wire_dtype=WIRE_F32,
+        )
+        return out.astype(orig)
     if wire_dtype == WIRE_INT8:
         from ..ops.quantized import (
             quantized_hierarchical_allreduce,

@@ -27,9 +27,12 @@ from typing import Any, Dict, Optional, Tuple
 from .gp import GP, expected_improvement, fit, posterior  # noqa: F401
 from .objective import (  # noqa: F401
     ProgramSpec,
+    TPTerm,
     free_objectives,
     group_plans,
     plan_for_bucket,
+    tp_group_plans,
+    tp_term_us,
 )
 from .signature import (  # noqa: F401
     mesh_axes_hash,
@@ -111,7 +114,13 @@ def tuned_step_kwargs(cfg: TunedConfig) -> Dict:
       to the compositor. On a flat mesh ``"auto"`` resolves to flat, so
       a pin tuned for a hierarchical mesh can never force an
       unrealizable lowering (and the signature's mesh hash keeps it
-      from being applied there in the first place).
+      from being applied there in the first place);
+    - ``tp_chunks`` (present only on TP-term tunings) → ``tp_overlap``:
+      a fused pin (chunks >= 1) maps to ``tp_overlap=True``, the
+      classic exposed psum to ``tp_overlap=False``; the chunk count
+      itself rides ``HOROVOD_TP_OVERLAP_CHUNKS`` (the fused layers
+      resolve it at trace time — docs/parallelism.md "Fused TP
+      overlap").
     """
     knobs = cfg.knobs
     topo = knobs.get("topo_algorithm") or "auto"
@@ -124,13 +133,16 @@ def tuned_step_kwargs(cfg: TunedConfig) -> Dict:
     else:
         hierarchical = "auto"
         algorithm = None
-    return {
+    out = {
         "fusion_threshold_bytes": int(knobs["fusion_threshold_bytes"]),
         "first_bucket_bytes": int(knobs["first_bucket_bytes"]),
         "quantized": knobs.get("wire_dtype") == "int8",
         "hierarchical": hierarchical,
         "topo_algorithm": algorithm,
     }
+    if "tp_chunks" in knobs:
+        out["tp_overlap"] = int(knobs["tp_chunks"]) > 0
+    return out
 
 
 def note_applied(source: str, signature: str, matched: bool,

@@ -36,6 +36,88 @@ from ..common.types import ReduceOp
 
 
 @dataclass(frozen=True)
+class TPTerm:
+    """The composed DP x TP program's per-step tensor-parallel
+    communication shape, declared so the tuner can price it PER CONFIG
+    instead of taking a pre-computed constant: ``degree`` model-axis
+    neighbours, ``psum_bytes`` activation payload per in-block psum,
+    ``psums_per_step`` psums a step pays (forward AND backward
+    conjugates), and ``compute_us`` — the matmul time adjacent to ONE
+    psum, i.e. what the fused collective-matmul pair
+    (docs/parallelism.md "Fused TP overlap") gets to hide its wire
+    behind. ``tp_chunks == 0`` in a config prices the classic exposed
+    psum (``sim.tp_fixed_comm_us``); ``tp_chunks >= 1`` prices the
+    chunked ring pair via ``topo.compositor.collective_matmul_cost_us``.
+    """
+
+    degree: int
+    psum_bytes: int
+    psums_per_step: int = 1
+    compute_us: float = 0.0
+
+    def to_dict(self) -> Dict:
+        return {
+            "degree": int(self.degree),
+            "psum_bytes": int(self.psum_bytes),
+            "psums_per_step": int(self.psums_per_step),
+            "compute_us": round(float(self.compute_us), 4),
+        }
+
+
+def tp_inner_model(model, degree: int):
+    """A single-hop model of the TP axis: the innermost (ICI) hop's
+    alpha-beta constants over ``degree`` neighbours — the model the
+    fused collective-matmul plans are priced and verified on (the DP
+    model's inner hop size is the data-local fanout, not the TP
+    degree, so the size must be re-pinned)."""
+    import dataclasses as _dc
+
+    hop = model.hops[-1]
+    return _dc.replace(model, hops=(_dc.replace(hop, size=int(degree)),))
+
+
+def tp_term_us(model, tp: TPTerm, chunks: int = 0) -> Dict:
+    """Price the per-step TP term under one chunk-count choice.
+
+    ``chunks == 0`` is the classic exposed-psum ring constant
+    (``sim.tp_fixed_comm_us`` — fully exposed, nothing overlaps);
+    ``chunks >= 1`` replaces each psum with one all_gather_matmul +
+    one matmul_reduce_scatter, each priced by the overlap-aware model
+    ``cost = max(compute, wire) + ramp`` with half the psum's adjacent
+    matmul time to hide behind — only the un-hideable remainder is
+    charged. Returns ``{"mode", "chunks", "fixed_comm_us", ...}``."""
+    n = int(tp.degree)
+    if n <= 1 or int(tp.psum_bytes) <= 0 or int(tp.psums_per_step) <= 0:
+        return {"mode": "none", "chunks": 0, "fixed_comm_us": 0.0}
+    if int(chunks) <= 0:
+        from ..sim.core import tp_fixed_comm_us
+
+        return {
+            "mode": "exposed-psum",
+            "chunks": 0,
+            "fixed_comm_us": tp_fixed_comm_us(
+                model, int(tp.psum_bytes), n,
+                psums_per_step=int(tp.psums_per_step),
+            ),
+        }
+    from ..topo.compositor import collective_matmul_cost_us
+
+    priced = collective_matmul_cost_us(
+        tp_inner_model(model, n), int(tp.psum_bytes),
+        chunks=int(chunks), compute_us=float(tp.compute_us) / 2.0,
+    )
+    fixed = round(
+        2.0 * priced["exposed_us"] * int(tp.psums_per_step), 4
+    )
+    return {
+        "mode": "collective_matmul",
+        "chunks": int(chunks),
+        "fixed_comm_us": fixed,
+        "per_primitive": priced,
+    }
+
+
+@dataclass(frozen=True)
 class ProgramSpec:
     """The abstract training program the tuner scores: top-level layer
     granularity (name, gradient bytes) in FORWARD order — exactly the
@@ -88,14 +170,18 @@ def plan_for_bucket(model, nbytes: int, config: Dict,
     this payload, else the cost-selected plan (the same fallback the
     lowering performs). Returns ``(plan, pinned_honored)``.
     ``collective`` defaults to the allreduce fast path; the zero1
-    objective prices ``"reducescatter"`` (int8-eligible) and
-    ``"allgather"`` (always full precision — parameters)."""
+    objective prices ``"reducescatter"`` (int8/bf16-eligible) and
+    ``"allgather"`` (always full precision — parameters). The bf16
+    rung is a pure cast, valid for any reduce op; int8's blockwise
+    requantization needs SUM/AVERAGE."""
     from ..topo.compositor import candidate_plans, select_plan
 
     wire = config.get("wire_dtype", WIRE_F32)
-    if (
-        op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
-        or collective == "allgather"
+    if collective == "allgather":
+        wire = WIRE_F32
+    elif (
+        wire == WIRE_INT8
+        and op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
     ):
         wire = WIRE_F32
     algo = config.get("topo_algorithm") or "auto"
@@ -112,7 +198,8 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
                     op: ReduceOp = ReduceOp.AVERAGE,
                     zero1: bool = False,
                     calibration=None,
-                    fixed_comm_us: float = 0.0) -> Dict:
+                    fixed_comm_us: float = 0.0,
+                    tp: Optional[TPTerm] = None) -> Dict:
     """Score ``config`` on ``spec`` over ``model`` with the two free
     cost models. Returns a plain dict (stable key order for the
     tuned.json record) whose ``score`` the GP maximizes.
@@ -131,16 +218,24 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
     discipline applied to the tuner's objective. A stale hop-ladder
     signature falls back loudly (``calibration.stale`` in the output).
 
-    ``fixed_comm_us`` is the composed program's constant per-step
-    communication term OUTSIDE the DP staircase — the tensor-parallel
-    in-block psums (``sim.tp_fixed_comm_us``). It shifts every config's
-    cost/exposed time identically (the argmax is knob-invariant by
-    construction — TP psums are never re-planned), but keeps the
-    recorded costs honest for the composed shape."""
+    ``fixed_comm_us`` is a caller-computed constant per-step
+    communication term OUTSIDE the DP staircase; it shifts every
+    config's cost/exposed time identically (knob-invariant). ``tp``
+    (a :class:`TPTerm`) REPLACES that constant with a term priced per
+    config from the config's own ``tp_chunks`` choice
+    (:func:`tp_term_us`) — the fused collective-matmul path makes the
+    TP term knob-DEPENDENT, so the argmax now weighs chunk count
+    against the DP knobs. The two are mutually exclusive."""
     import math as _math
 
     from ..ops.fusion import plan_layer_groups
 
+    if tp is not None and float(fixed_comm_us) > 0.0:
+        raise ValueError(
+            "pass either tp=TPTerm(...) (the TP term priced per config "
+            "from its tp_chunks choice) or the legacy knob-invariant "
+            "fixed_comm_us constant — not both"
+        )
     calib_info = None
     if calibration is not None:
         model, calib_info = calibrated_model(
@@ -200,13 +295,19 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
             entry["ag_algorithm"] = ag_plan.algorithm
             entry["ag_cost_us"] = round(ag_plan.cost_us, 4)
         per_group.append(entry)
-    fixed = max(float(fixed_comm_us), 0.0)
+    tp_info = None
+    if tp is not None:
+        tp_info = tp_term_us(model, tp, int(config.get("tp_chunks", 0)))
+        fixed = float(tp_info["fixed_comm_us"])
+    else:
+        fixed = max(float(fixed_comm_us), 0.0)
     cost_us += fixed
     exposed_us += fixed
     if zero1:
         return {
             "zero1": True,
             **({"calibration": calib_info} if calib_info else {}),
+            **({"tp": tp_info} if tp_info is not None else {}),
             **({"fixed_comm_us": round(fixed, 4)} if fixed else {}),
             "n_groups": len(groups),
             "cost_us": round(cost_us, 4),
@@ -219,6 +320,7 @@ def free_objectives(spec: ProgramSpec, config: Dict, model,
         }
     return {
         **({"calibration": calib_info} if calib_info else {}),
+        **({"tp": tp_info} if tp_info is not None else {}),
         **({"fixed_comm_us": round(fixed, 4)} if fixed else {}),
         "n_groups": len(groups),
         "cost_us": round(cost_us, 4),
@@ -244,7 +346,9 @@ def group_plans(spec: ProgramSpec, config: Dict, model,
     for each group (interleaved, reduction order). ``calibration``
     follows :func:`free_objectives` (calibrated constants can flip a
     cost-selected algorithm, so the verified plans must come from the
-    same model the objective priced)."""
+    same model the objective priced). The TP term's fused plans are
+    listed separately (:func:`tp_group_plans`) — they verify on the
+    TP-axis model, not this one."""
     import math as _math
 
     from ..ops.fusion import plan_layer_groups
@@ -275,3 +379,29 @@ def group_plans(spec: ProgramSpec, config: Dict, model,
             plan, _ = plan_for_bucket(model, nb, config, op=op)
             plans.append(plan)
     return plans
+
+
+def tp_group_plans(config: Dict, model, tp: Optional[TPTerm]) -> Tuple:
+    """The fused TP plans a config pins, with the model they verify on:
+    ``(plans, tp_model)``. Empty when there is no TP term or the config
+    keeps the classic exposed psum (``tp_chunks == 0`` — nothing fused,
+    nothing new to verify; the psum is the long-standing flat ring).
+    Both chunked flavors are listed — a step pays one all_gather_matmul
+    AND one matmul_reduce_scatter per psum it replaces."""
+    chunks = int(config.get("tp_chunks", 0))
+    if tp is None or chunks <= 0 or int(tp.degree) <= 1:
+        return (), None
+    from ..topo.compositor import (
+        COLLECTIVE_MATMUL_FLAVORS,
+        collective_matmul_plan,
+    )
+
+    inner = tp_inner_model(model, int(tp.degree))
+    plans = tuple(
+        collective_matmul_plan(
+            inner, flavor, int(tp.psum_bytes), chunks=chunks,
+            compute_us=float(tp.compute_us) / 2.0,
+        )
+        for flavor in COLLECTIVE_MATMUL_FLAVORS
+    )
+    return plans, inner

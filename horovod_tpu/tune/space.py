@@ -1,8 +1,8 @@
 """The joint compiled-path knob space the offline tuner searches.
 
-Five dimensions, mirroring the eager engine's 2-continuous +
+Six dimensions, extending the eager engine's 2-continuous +
 3-categorical shape (``cpp/src/autotune.cc`` — the golden-trace test
-depends on the kernel treating dims this way):
+depends on the kernel treating the shared dims this way):
 
 - ``x0`` — log2(HOROVOD_FUSION_THRESHOLD) in [16, 28], normalized to
   [0, 1] (the same range the eager tuner sweeps);
@@ -13,14 +13,21 @@ depends on the kernel treating dims this way):
 - ``x2``/``x3`` — the per-collective topology-plan choice for the
   gradient allreduce, two {0,1} embeddings encoding
   ``(auto, flat, two-level, split)``;
-- ``x4`` — ``wire_dtype`` {0,1} = f32/int8 (docs/overlap.md "Quantized
-  wire compression").
+- ``x4`` — ``wire_dtype`` at thirds: f32 / bf16 / int8 (docs/overlap.md
+  "Quantized wire compression"; the bf16 rung is a pure cast, always
+  admissible — int8 stays behind ``allow_int8``);
+- ``x5`` — the tensor-parallel chunk count for the fused
+  collective-matmul path (docs/parallelism.md "Fused TP overlap"):
+  ``0`` = the classic exposed psum, then {1, 2, 4, 8} ring chunks.
+  Only live when the program declares a TP term (``tp=True``) —
+  otherwise frozen at 0 and absent from decoded configs, so DP-only
+  tunings keep their exact historical knob dicts.
 
 Categorical dims that the target topology cannot realize (two-level on a
-single-hop model, int8 when the caller pins f32) are FROZEN at their
-default instead of dropped, exactly like the C++ engine freezes the
-hierarchical dims when no (cross, local) grid exists — the space stays
-5-D, the candidate grid just never varies them.
+single-hop model, int8 when the caller pins f32, TP chunks without a TP
+term) are FROZEN at their default instead of dropped, exactly like the
+C++ engine freezes the hierarchical dims when no (cross, local) grid
+exists — the space stays 6-D, the candidate grid just never varies them.
 """
 
 from __future__ import annotations
@@ -28,7 +35,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..common.quant import WIRE_DTYPES, WIRE_F32, WIRE_INT8
+from ..common.quant import WIRE_BF16, WIRE_DTYPES, WIRE_F32, WIRE_INT8
 
 # log2 bounds, continuous dims (x0 matches autotune.cc kF0/kF1).
 FUSION_LOG2_LO, FUSION_LOG2_HI = 16.0, 28.0
@@ -38,6 +45,12 @@ FIRST_LOG2_LO, FIRST_LOG2_HI = 12.0, 24.0
 # select_plan (the planner decides by payload); the rest pin one
 # algorithm for every bucket.
 TOPO_CHOICES: Tuple[str, ...] = ("auto", "flat", "two-level", "split")
+
+# TP chunk-count choice encoded in x5. 0 = the classic exposed psum
+# (no fusion); the rest are the fused collective-matmul ring's chunk
+# counts (ops/collective_matmul.py caps at 8 — latency rounds scale
+# linearly with chunks, so deeper pipelining stops paying).
+TP_CHUNK_CHOICES: Tuple[int, ...] = (0, 1, 2, 4, 8)
 
 # Grid resolution for the continuous dims (the C++ engine's 9x9 EI grid).
 GRID_POINTS = 9
@@ -56,17 +69,21 @@ def _denorm_bytes(x: float, lo: float, hi: float) -> int:
 
 @dataclass(frozen=True)
 class SearchSpace:
-    """The admissible slice of the 5-D space for one target topology.
+    """The admissible slice of the 6-D space for one target topology.
 
     ``topo_choices`` lists the realizable plan choices (a single-hop
     model lowers natively whatever the label says, so only "auto" is
-    offered there); ``allow_int8`` gates the wire dim (SUM/AVERAGE float
-    gradients only — and the tune-smoke pins it off so the tuned step
-    stays bitwise-identical to the untuned one)."""
+    offered there); ``allow_int8`` gates the top wire rung (SUM/AVERAGE
+    float gradients only — and the tune-smoke pins it off so the tuned
+    step stays bitwise-identical to the untuned one; the bf16 cast rung
+    is always admissible); ``tp`` activates the TP chunk-count dim —
+    only programs that declare a tensor-parallel term
+    (``tune(tp=TPTerm(...))``) have anything for it to price."""
 
     topo_choices: Tuple[str, ...] = TOPO_CHOICES
     allow_int8: bool = True
-    dims: int = field(default=5, init=False)
+    tp: bool = False
+    dims: int = field(default=6, init=False)
 
     def encode(self, config: Dict) -> Tuple[float, ...]:
         import math
@@ -74,6 +91,9 @@ class SearchSpace:
         topo = config.get("topo_algorithm") or "auto"
         idx = TOPO_CHOICES.index(topo) if topo in TOPO_CHOICES else 0
         wire = config.get("wire_dtype", WIRE_F32)
+        chunks = int(config.get("tp_chunks", 0))
+        ci = (TP_CHUNK_CHOICES.index(chunks)
+              if chunks in TP_CHUNK_CHOICES else 0)
         return (
             _norm(math.log2(max(int(config["fusion_threshold_bytes"]), 1)),
                   FUSION_LOG2_LO, FUSION_LOG2_HI),
@@ -81,7 +101,9 @@ class SearchSpace:
                   FIRST_LOG2_LO, FIRST_LOG2_HI),
             float(idx & 1),
             float((idx >> 1) & 1),
-            1.0 if wire == WIRE_INT8 else 0.0,
+            1.0 if wire == WIRE_INT8 else 0.5 if wire == WIRE_BF16
+            else 0.0,
+            ci / (len(TP_CHUNK_CHOICES) - 1.0),
         )
 
     def decode(self, x: Sequence[float]) -> Dict:
@@ -89,8 +111,15 @@ class SearchSpace:
         topo = TOPO_CHOICES[idx]
         if topo not in self.topo_choices:
             topo = "auto"
-        wire = WIRE_INT8 if (self.allow_int8 and x[4] > 0.5) else WIRE_F32
-        return {
+        if x[4] > 2.0 / 3.0:
+            # Top rung falls back to the cast rung when int8 is pinned
+            # off — bf16 is the strongest compression still admissible.
+            wire = WIRE_INT8 if self.allow_int8 else WIRE_BF16
+        elif x[4] > 1.0 / 3.0:
+            wire = WIRE_BF16
+        else:
+            wire = WIRE_F32
+        config = {
             "fusion_threshold_bytes": _denorm_bytes(
                 x[0], FUSION_LOG2_LO, FUSION_LOG2_HI),
             "first_bucket_bytes": _denorm_bytes(
@@ -98,24 +127,41 @@ class SearchSpace:
             "topo_algorithm": topo,
             "wire_dtype": wire,
         }
+        if self.tp:
+            x5 = float(x[5]) if len(x) > 5 else 0.0
+            ci = int(round(
+                min(max(x5, 0.0), 1.0) * (len(TP_CHUNK_CHOICES) - 1)
+            ))
+            config["tp_chunks"] = TP_CHUNK_CHOICES[ci]
+        return config
 
     def default_config(self) -> Dict:
-        return {
+        config = {
             "fusion_threshold_bytes": DEFAULT_FUSION_BYTES,
             "first_bucket_bytes": DEFAULT_FIRST_BUCKET_BYTES,
             "topo_algorithm": "auto",
             "wire_dtype": WIRE_F32,
         }
+        if self.tp:
+            config["tp_chunks"] = 0
+        return config
 
-    def _cat_combos(self) -> List[Tuple[float, float, float]]:
-        combos: List[Tuple[float, float, float]] = []
+    def _cat_combos(self) -> List[Tuple[float, float, float, float]]:
+        wires = (0.0, 0.5, 1.0) if self.allow_int8 else (0.0, 0.5)
+        chunk_xs = (
+            tuple(i / (len(TP_CHUNK_CHOICES) - 1.0)
+                  for i in range(len(TP_CHUNK_CHOICES)))
+            if self.tp else (0.0,)
+        )
+        combos: List[Tuple[float, float, float, float]] = []
         for idx, name in enumerate(TOPO_CHOICES):
             if name not in self.topo_choices:
                 continue
-            for wire in (0.0, 1.0) if self.allow_int8 else (0.0,):
-                combos.append(
-                    (float(idx & 1), float((idx >> 1) & 1), wire)
-                )
+            for wire in wires:
+                for cx in chunk_xs:
+                    combos.append(
+                        (float(idx & 1), float((idx >> 1) & 1), wire, cx)
+                    )
         return combos
 
     def candidate_grid(self) -> List[Tuple[float, ...]]:
@@ -144,11 +190,16 @@ class SearchSpace:
             raise ValueError(
                 f"unknown topo_algorithm {topo!r}; one of {TOPO_CHOICES}"
             )
+        chunks = int(config.get("tp_chunks", 0))
+        if chunks not in TP_CHUNK_CHOICES:
+            raise ValueError(
+                f"unknown tp_chunks {chunks!r}; one of {TP_CHUNK_CHOICES}"
+            )
         return config
 
 
 def space_for_model(model, allow_int8: bool = True,
-                    zero1: bool = False) -> SearchSpace:
+                    zero1: bool = False, tp: bool = False) -> SearchSpace:
     """The admissible space for an interconnect model: single-hop models
     freeze the topology dims (every label lowers natively flat there);
     two-level models drop "split" unless the FlexLink conditions
@@ -156,7 +207,8 @@ def space_for_model(model, allow_int8: bool = True,
     reduction shape) additionally drops "split" everywhere — the
     FlexLink concurrent-bucket mode has no reduce-scatter + all-gather
     decomposition — so the tuner never pins an unrealizable plan for a
-    zero1 program."""
+    zero1 program. ``tp=True`` (a program with a declared
+    tensor-parallel term) unfreezes the TP chunk-count dim."""
     if model.levels <= 1:
         choices: Tuple[str, ...] = ("auto",)
     elif model.levels == 2:
@@ -165,4 +217,5 @@ def space_for_model(model, allow_int8: bool = True,
         choices = ("auto", "flat", "two-level")
     if zero1:
         choices = tuple(c for c in choices if c != "split")
-    return SearchSpace(topo_choices=choices, allow_int8=bool(allow_int8))
+    return SearchSpace(topo_choices=choices, allow_int8=bool(allow_int8),
+                       tp=bool(tp))

@@ -29,7 +29,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..common.types import ReduceOp
 from . import gp as _gp
-from .objective import ProgramSpec, free_objectives, group_plans
+from .objective import (
+    ProgramSpec,
+    TPTerm,
+    free_objectives,
+    group_plans,
+    tp_group_plans,
+)
 from .signature import signature_hash
 from .space import SearchSpace, space_for_model
 
@@ -133,6 +139,7 @@ def tune(
     zero1: bool = False,
     calibration=None,
     fixed_comm_us: float = 0.0,
+    tp: Optional[TPTerm] = None,
 ) -> TunedConfig:
     """Search the joint compiled-path space for ``spec`` on ``model``.
 
@@ -158,28 +165,39 @@ def tune(
     and the search runs on generation defaults, recorded as such in
     ``search.calibration``.
 
-    ``fixed_comm_us`` prices the composed DP x TP shape's constant
-    per-step TP-psum term (``sim.tp_fixed_comm_us``) into every
-    objective — knob-invariant by construction (TP psums are never
-    re-planned), but the emitted evidence then carries the composed
-    program's true exposed time, recorded in ``search.fixed_comm_us``.
+    ``fixed_comm_us`` prices a caller-computed constant per-step
+    communication term into every objective — knob-invariant by
+    construction, recorded verbatim in ``search.fixed_comm_us``.
+    ``tp`` (a :class:`TPTerm`) supersedes it: the TP term is then
+    priced PER CONFIG from the config's own ``tp_chunks`` choice
+    (``objective.tp_term_us`` — the classic exposed psum at 0, the
+    fused collective-matmul pair above), the chunk-count dim joins the
+    search, the winner's fused plans are symbolically verified on the
+    TP-axis model, and ``search.fixed_comm_us`` records the WINNER's
+    computed term instead of a caller constant.
     """
     from .objective import calibrated_model
 
+    if tp is not None and float(fixed_comm_us) > 0.0:
+        raise ValueError(
+            "pass either tp=TPTerm(...) (priced per config) or the "
+            "legacy fixed_comm_us constant — not both"
+        )
     calib_info = {"applied": False, "source": "generation-defaults"}
     if calibration is not None:
         model, calib_info = calibrated_model(
             model, calibration, where="tune"
         )
+    tp_active = tp is not None and int(tp.degree) > 1
     space = space or space_for_model(model, allow_int8=allow_int8,
-                                     zero1=zero1)
+                                     zero1=zero1, tp=tp_active)
     grid = space.candidate_grid()
     rng = _gp.Lcg(seed)
     samples = max(int(samples), 1)
 
     def evaluate(config: Dict) -> Tuple[Dict, float]:
         obj = free_objectives(spec, config, model, op=op, zero1=zero1,
-                              fixed_comm_us=fixed_comm_us)
+                              fixed_comm_us=fixed_comm_us, tp=tp)
         score = obj["score"]
         if measure_fn is not None:
             measured_s = float(measure_fn(config))
@@ -223,6 +241,10 @@ def tune(
     if space.allow_int8:
         corners.append(dict(default, wire_dtype="int8"))
         corners.append(dict(corners[0], wire_dtype="int8"))
+    if getattr(space, "tp", False):
+        # The mid-chunk fused corner — teaches the GP the chunk axis
+        # against the default's classic exposed psum (tp_chunks=0).
+        corners.append(dict(default, tp_chunks=2))
     for c in corners:
         if len(xs) >= samples:
             break
@@ -266,6 +288,7 @@ def tune(
     best_config = configs[best_i]
     best_obj = objs[best_i]
 
+    tp_plans, tp_model = tp_group_plans(best_config, model, tp)
     findings: List = []
     if verify:
         from ..analysis.plan_verify import verify_plan
@@ -273,6 +296,10 @@ def tune(
         for plan in group_plans(spec, best_config, model, op=op,
                                 zero1=zero1):
             findings.extend(verify_plan(plan, model, rounds_fn=rounds_fn))
+        for plan in tp_plans:
+            findings.extend(
+                verify_plan(plan, tp_model, rounds_fn=rounds_fn)
+            )
         if findings:
             raise TuneVerificationError(findings)
 
@@ -295,14 +322,26 @@ def tune(
             "objective": "measured" if measure_fn is not None else "free",
             "zero1": bool(zero1),
             "calibration": calib_info,
-            "fixed_comm_us": round(max(float(fixed_comm_us), 0.0), 4),
+            # With a TP term, this is the WINNER's computed per-step TP
+            # time (its tp_chunks choice priced by tp_term_us) — no
+            # longer a caller-supplied constant.
+            "fixed_comm_us": (
+                round(float(best_obj.get("tp", {})
+                            .get("fixed_comm_us", 0.0)), 4)
+                if tp is not None
+                else round(max(float(fixed_comm_us), 0.0), 4)
+            ),
+            **({"tp": {**tp.to_dict(),
+                       "chunks": int(best_config.get("tp_chunks", 0))}}
+               if tp is not None else {}),
             "space": {
                 "topo_choices": list(space.topo_choices),
                 "allow_int8": bool(space.allow_int8),
+                **({"tp": True} if getattr(space, "tp", False) else {}),
             },
             "verified_plans": 0 if not verify else len(
                 group_plans(spec, best_config, model, op=op, zero1=zero1)
-            ),
+            ) + len(tp_plans),
         },
         history=history,
     )

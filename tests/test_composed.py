@@ -192,6 +192,35 @@ def test_forward_hlo_one_psum_per_tp_block(devices):
     assert len(model_ar) == 2 * LAYERS, hlo.count("all-reduce")
 
 
+def test_forward_hlo_fused_path_psum_free(devices):
+    """The fused path (docs/parallelism.md "Fused TP overlap") lowers
+    the FORWARD with ZERO model-axis all-reduces — every Megatron psum
+    dissolved into chunked collective-matmul rings, exactly
+    ``4 * layers * (n-1) * chunks`` collective-permutes (qkv AG-matmul,
+    attn-out MRS, mlp-up AG-matmul, mlp-down MRS per layer)."""
+    from horovod_tpu.ops.collective_matmul import expected_ppermutes
+
+    params = _params()
+    mesh = _mesh22(devices)
+    specs = R.match_partition_rules("gpt", params)
+    loss_fused = make_gpt_loss_fn(HEADS, model_axis="model",
+                                  dtype=jnp.float32, tp_overlap=True)
+    fwd = jax.jit(hvdj._shard_map(
+        loss_fused, mesh, in_specs=(specs, P("data")), out_specs=P()
+    ))
+    hlo = fwd.lower(params, _batch()).compiler_ir(
+        dialect="hlo"
+    ).as_hlo_text()
+    assert _model_axis_allreduces(hlo) == [], (
+        "fused forward still carries model-axis all-reduces"
+    )
+    pp = [ln for ln in hlo.splitlines()
+          if re.search(r"\bcollective-permute(-start)?\(", ln)]
+    assert len(pp) == 4 * LAYERS * expected_ppermutes(2, chunks=1), (
+        len(pp), hlo.count("collective-permute")
+    )
+
+
 def test_step_hlo_inner_axis_reduce_scatter_under_zero1(devices):
     """The composed zero1 step's HLO carries reduce-scatter
     instructions on the DATA-axis replica groups ({{0,2},{1,3}} on the
@@ -393,5 +422,39 @@ def test_axis_wire_bytes_split(devices):
             'collective="psum"' in k
             for k in axis if 'axis="model"' in k
         ), axis
+    finally:
+        metrics.install(False)
+
+
+def test_axis_wire_bytes_split_fused(devices):
+    """On the fused path the model axis is charged under the fused
+    primitives' own labels — the forward/backward rings show up as
+    ``all_gather_matmul`` / ``matmul_reduce_scatter``, with only the
+    conjugate psums (layernorm params, scatter boundary) and the exit
+    all-gather besides; never a bucketized collective."""
+    import horovod_tpu.metrics as metrics
+
+    params = _params()
+    tx = optax.sgd(0.05)
+    mesh = _mesh22(devices)
+    metrics.install(True)
+    try:
+        step = hvdj.make_train_step(LOSS_TP, tx, mesh, rules="gpt",
+                                    tp_overlap=True, donate=False)
+        step(params, tx.init(params), _batch())
+        flat = metrics.flat()
+        axis = {k: v for k, v in flat.items()
+                if "hvd_axis_wire_bytes_total" in k}
+        data_b = sum(v for k, v in axis.items() if 'axis="data"' in k)
+        model = {k: v for k, v in axis.items() if 'axis="model"' in k}
+        assert data_b > 0 and sum(model.values()) > 0, axis
+        labels = {
+            re.search(r'collective="([^"]+)"', k).group(1)
+            for k in model
+        }
+        assert "all_gather_matmul" in labels, model
+        assert "matmul_reduce_scatter" in labels, model
+        assert labels <= {"all_gather_matmul", "matmul_reduce_scatter",
+                          "psum", "allgather"}, model
     finally:
         metrics.install(False)

@@ -526,3 +526,84 @@ def test_tune_records_fixed_comm_and_keeps_winner():
     assert composed.search["fixed_comm_us"] == 123.4
     # A constant term cannot flip the knob choice.
     assert composed.knobs == plain.knobs
+
+
+# ---------------------------------------------------------------------------
+# TP term: overlap-aware pricing (docs/parallelism.md "Fused TP overlap")
+# ---------------------------------------------------------------------------
+
+def _tp_term(compute_us=25.0):
+    return T.TPTerm(degree=4, psum_bytes=1 << 16, psums_per_step=8,
+                    compute_us=compute_us)
+
+
+def test_space_roundtrip_with_tp_and_bf16():
+    space = T.SearchSpace(tp=True)
+    for config in (
+        space.default_config(),
+        {"fusion_threshold_bytes": 1 << 20,
+         "first_bucket_bytes": 1 << 16,
+         "topo_algorithm": "split", "wire_dtype": "bf16",
+         "tp_chunks": 4},
+    ):
+        assert space.decode(space.encode(config)) == config
+    # Without tp the chunk dim never appears in decoded configs.
+    assert "tp_chunks" not in T.SearchSpace().default_config()
+
+
+def test_tp_term_priced_from_chunked_plan():
+    model = synthetic_model(16)
+    term = _tp_term()
+    classic = T.tp_term_us(model, term, 0)
+    fused = T.tp_term_us(model, term, 2)
+    assert classic["mode"] == "exposed-psum"
+    assert fused["mode"] == "collective_matmul"
+    assert fused["chunks"] == 2
+    # Any adjacent-matmul time > 0 makes the overlapped rings a strict
+    # win over the exposed psum constant.
+    assert fused["fixed_comm_us"] < classic["fixed_comm_us"]
+
+
+def test_tune_tp_rejects_legacy_constant_alongside():
+    model = synthetic_model(16)
+    with pytest.raises(ValueError, match="not both"):
+        T.tune(_toy_spec(), model, samples=4, verify=False,
+               tp=_tp_term(), fixed_comm_us=99.0)
+
+
+def test_tune_tp_records_winner_computed_fixed_comm():
+    """search.fixed_comm_us is no longer a caller-supplied constant:
+    the tuner recomputes it from the winner's own chunk count, searches
+    tp_chunks jointly, verifies the winner's collective-matmul plans,
+    and stays run-to-run deterministic."""
+    model = synthetic_model(16)
+    spec = _toy_spec()
+    term = _tp_term()
+    cfg = T.tune(spec, model, samples=12, seed=0, tp=term)
+    chunks = int(cfg.knobs["tp_chunks"])
+    assert chunks >= 1, cfg.knobs
+    want = T.tp_term_us(model, term, chunks)["fixed_comm_us"]
+    assert cfg.search["fixed_comm_us"] == want
+    assert cfg.search["fixed_comm_us"] < (
+        T.tp_term_us(model, term, 0)["fixed_comm_us"]
+    )
+    assert cfg.search["tp"]["chunks"] == chunks
+    assert cfg.search["tp"]["degree"] == 4
+    # The winner's fused plans passed symbolic verification (2 flavors
+    # on top of the wire-plan grid).
+    assert cfg.search["verified_plans"] >= 2
+    again = T.tune(spec, model, samples=12, seed=0, tp=term)
+    assert again.knobs == cfg.knobs
+    assert again.search["fixed_comm_us"] == cfg.search["fixed_comm_us"]
+
+
+def test_tuned_step_kwargs_maps_tp_chunks_to_overlap():
+    cfg = T.TunedConfig(
+        knobs={"fusion_threshold_bytes": 123, "first_bucket_bytes": 7,
+               "topo_algorithm": "flat", "wire_dtype": "f32",
+               "tp_chunks": 2},
+        signature={}, objectives={}, baseline={},
+    )
+    assert T.tuned_step_kwargs(cfg)["tp_overlap"] is True
+    cfg.knobs["tp_chunks"] = 0
+    assert T.tuned_step_kwargs(cfg)["tp_overlap"] is False

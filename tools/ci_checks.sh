@@ -123,6 +123,17 @@
 # rendered through tools/trace_merge.py (docs/serving.md).
 # Budget: under 30s.
 #
+# Stage 17 (make tpfuse-smoke; skip with HVD_CI_SKIP_TPFUSE=1): the
+# fused-TP collective-matmul smoke — the 2x2 composed step with
+# tp_overlap=True matching the classic step to <=5e-7 on losses AND
+# params, the fused forward HLO carrying ZERO model-axis all-reduces
+# and exactly the predicted chunked-ring collective-permutes, the
+# tuner's TP term (tune(tp=TPTerm(...))) pinning a fused chunk count
+# whose modeled per-step TP time is strictly below the exposed-psum
+# constant on the transformer program, and the normalized log
+# byte-identical across two runs (docs/parallelism.md "Fused TP
+# overlap"). Budget: under 90s.
+#
 # Stage 9 (make trace-smoke; skip with HVD_CI_SKIP_TRACE=1): the
 # fleet-tracing smoke — a 2-rank run with a seeded rank-1 delay fault:
 # merged Perfetto trace (per-rank + driver lanes, clock-offset
@@ -243,4 +254,11 @@ if [ "${HVD_CI_SKIP_SERVE:-0}" != "1" ]; then
     python tools/serve_smoke.py
     elapsed=$(( $(date +%s) - start ))
     echo "ci_checks: serve smoke exactly-once+metered+traced+byte-stable in ${elapsed}s"
+fi
+
+if [ "${HVD_CI_SKIP_TPFUSE:-0}" != "1" ]; then
+    start=$(date +%s)
+    python tools/tpfuse_smoke.py
+    elapsed=$(( $(date +%s) - start ))
+    echo "ci_checks: tpfuse smoke fused==classic+psum-free-hlo+tuner-win+byte-stable in ${elapsed}s"
 fi

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Fused-TP collective-matmul CI smoke (docs/parallelism.md "Fused TP
+overlap").
+
+One process, a 2x2 virtual CPU mesh, <90s:
+
+1. FUSED == CLASSIC — the composed GPT step with
+   ``make_train_step(rules="gpt", tp_overlap=True)`` (token-sharded
+   residual, every in-block psum replaced by all_gather_matmul +
+   matmul_reduce_scatter) matches the classic composed step to <=5e-7
+   on losses AND final params after the smoke steps.
+2. FUSED FORWARD HLO IS PSUM-FREE — the fused forward lowers with ZERO
+   model-axis all-reduces and exactly the predicted
+   ``4 * layers * (n-1) * chunks`` collective-permutes (the chunked
+   rings); the classic forward keeps its ``2 * layers`` psums.
+3. TUNER PREFERS FUSION — ``tune(tp=TPTerm(...))`` on the transformer
+   program searches the chunk-count dim and pins a fused config
+   (``tp_chunks >= 1``) whose modeled per-step TP time is STRICTLY
+   below the classic exposed-psum constant (``tp_term_us(chunks=0)``),
+   with the winner's collective-matmul plans symbolically verified.
+4. BYTE-STABLE LOG — losses + param digests + HLO counts + the tuned
+   knobs serialize to a normalized JSON log; the run executes TWICE
+   and the logs must be byte-identical.
+
+Exit 0 = all assertions hold. Wired as ``tools/ci_checks.sh`` stage 17
+(skip: HVD_CI_SKIP_TPFUSE=1) and ``make tpfuse-smoke``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 2x2 virtual mesh; must precede the first jax backend touch.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+VOCAB, D, HEADS, LAYERS, T = 128, 64, 4, 2, 16
+STEPS = 3
+TOL = 5e-7
+
+
+def _digest(tree) -> str:
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.device_get(jax.tree.leaves(tree)):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def _model_axis_allreduces(hlo: str):
+    ar = [ln for ln in hlo.splitlines()
+          if re.search(r"\ball-reduce(-start)?\(", ln)]
+    return [ln for ln in ar
+            if "replica_groups={{0,1},{2,3}}" in ln
+            or re.search(r"replica_groups=\[2,2\]<=\[4\]\b", ln)]
+
+
+def _collective_permutes(hlo: str):
+    return [ln for ln in hlo.splitlines()
+            if re.search(r"\bcollective-permute(-start)?\(", ln)]
+
+
+def run_once() -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.jax as hvdj
+    from horovod_tpu.models.transformer import (
+        TransformerLM, make_gpt_loss_fn,
+    )
+    from horovod_tpu.ops.collective_matmul import expected_ppermutes
+    from horovod_tpu.parallel import rules as R
+    from horovod_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 2, "model": 2})
+    n_tp = 2
+    model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                          n_layers=LAYERS, max_len=T)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    rng = np.random.RandomState(0)
+    batch = (
+        jnp.asarray(rng.randint(0, VOCAB, (4, T)), jnp.int32),
+        jnp.asarray(rng.randint(0, VOCAB, (4, T)), jnp.int32),
+    )
+    loss_fn = make_gpt_loss_fn(HEADS, model_axis="model",
+                               dtype=jnp.float32)
+    tx = optax.adamw(1e-3)
+
+    # 1. Fused == classic to <=5e-7 (losses and params).
+    step_c = hvdj.make_train_step(loss_fn, tx, mesh, rules="gpt",
+                                  donate=False)
+    step_f = hvdj.make_train_step(loss_fn, tx, mesh, rules="gpt",
+                                  tp_overlap=True, donate=False)
+
+    def train(step):
+        p, s, losses = params, tx.init(params), []
+        for _ in range(STEPS):
+            p, s, loss = step(p, s, batch)
+            losses.append(round(float(loss), 6))
+        return p, losses
+
+    pc, losses_c = train(step_c)
+    pf, losses_f = train(step_f)
+    for a, b in zip(losses_c, losses_f):
+        assert abs(a - b) <= TOL * max(1.0, abs(a)), (losses_c, losses_f)
+    perr = max(
+        float(jnp.max(jnp.abs(x - y)))
+        for x, y in zip(jax.tree.leaves(pc), jax.tree.leaves(pf))
+    )
+    assert perr <= TOL, f"fused/classic param divergence {perr}"
+
+    # 2. Forward HLO: fused path psum-free with exactly the predicted
+    # ring traffic; classic path keeps its 2/layer psums.
+    specs = R.match_partition_rules("gpt", params)
+
+    def fwd_hlo(tp_overlap):
+        fn = make_gpt_loss_fn(HEADS, model_axis="model",
+                              dtype=jnp.float32, tp_overlap=tp_overlap)
+        fwd = jax.jit(hvdj._shard_map(
+            fn, mesh, in_specs=(specs, P("data")), out_specs=P()
+        ))
+        return fwd.lower(params, batch).compiler_ir(
+            dialect="hlo"
+        ).as_hlo_text()
+
+    hlo_f = fwd_hlo(True)
+    hlo_c = fwd_hlo(False)
+    fused_ars = len(_model_axis_allreduces(hlo_f))
+    classic_ars = len(_model_axis_allreduces(hlo_c))
+    fused_pp = len(_collective_permutes(hlo_f))
+    # 4 fused primitives per layer (qkv AG-matmul, attn-out MRS, mlp-up
+    # AG-matmul, mlp-down MRS), each one chunked ring traversal.
+    want_pp = 4 * LAYERS * expected_ppermutes(n_tp, chunks=1)
+    assert fused_ars == 0, f"fused forward carries {fused_ars} psums"
+    assert classic_ars == 2 * LAYERS, classic_ars
+    assert fused_pp == want_pp, (fused_pp, want_pp)
+
+    # 3. The tuner, given the TP term, pins a fused chunk count whose
+    # modeled per-step TP time strictly beats the exposed-psum
+    # constant — on the transformer program's own layer granularity.
+    from horovod_tpu import tune as TU
+    from horovod_tpu.topo.model import synthetic_model
+
+    spec = TU.spec_from_params("tpfuse-transformer", params)
+    sim_model = synthetic_model(16)
+    # degree 4, bf16 activation psums of the [B, T, D] stream, 4 psums
+    # per layer (fwd + bwd conjugates), and a genuinely positive
+    # adjacent-matmul time — any compute > 0 makes fusion a strict win.
+    term = TU.TPTerm(degree=4, psum_bytes=8 * T * D * 2,
+                     psums_per_step=4 * LAYERS, compute_us=25.0)
+    classic_us = TU.tp_term_us(sim_model, term, 0)["fixed_comm_us"]
+    cfg = TU.tune(spec, sim_model, samples=12, seed=0, tp=term)
+    chunks = int(cfg.knobs.get("tp_chunks", 0))
+    fused_us = float(cfg.search["fixed_comm_us"])
+    assert chunks >= 1, cfg.knobs
+    assert fused_us < classic_us, (fused_us, classic_us)
+    assert cfg.search["verified_plans"] >= 2, cfg.search
+
+    return {
+        "schema": 1,
+        "losses_classic": losses_c,
+        "losses_fused": losses_f,
+        "final_params_digest_classic": _digest(pc),
+        "final_params_digest_fused": _digest(pf),
+        "fused_fwd_model_axis_allreduces": fused_ars,
+        "classic_fwd_model_axis_allreduces": classic_ars,
+        "fused_fwd_collective_permutes": fused_pp,
+        "tuned_knobs": dict(cfg.knobs),
+        "tuned_tp_chunks": chunks,
+        "tuned_fixed_comm_us": round(fused_us, 4),
+        "classic_fixed_comm_us": round(float(classic_us), 4),
+    }
+
+
+def main() -> int:
+    t0 = time.time()
+    log1 = json.dumps(run_once(), sort_keys=True)
+    log2 = json.dumps(run_once(), sort_keys=True)
+    assert log1 == log2, "normalized event logs differ between runs:\n" \
+        f"{log1}\n{log2}"
+    print(f"tpfuse_smoke: OK in {time.time() - t0:.1f}s — {log1}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
